@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Regenerate the chart CRD from the native crdgen binary.
+# Same contract as the reference's generate-crd.sh:7.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+cmake -S native -B native/build -G Ninja >/dev/null
+ninja -C native/build tpubc-crdgen >/dev/null
+mkdir -p charts/tpu-bootstrap-controller/templates
+./native/build/tpubc-crdgen > charts/tpu-bootstrap-controller/templates/crd.yaml
+echo "wrote charts/tpu-bootstrap-controller/templates/crd.yaml"

@@ -1,0 +1,14 @@
+// tpubc-crdgen: print the UserBootstrap CRD as YAML on stdout.
+//
+// Same contract as the reference's crdgen binary
+// (/root/reference/src/crdgen.rs:3-8): hack/generate-crd.sh pipes this into
+// the Helm chart and CI diffs for drift.
+#include <cstdio>
+
+#include "tpubc/crd.h"
+
+int main() {
+  std::string yaml = tpubc::crd_yaml();
+  std::fwrite(yaml.data(), 1, yaml.size(), stdout);
+  return 0;
+}

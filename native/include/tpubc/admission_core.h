@@ -1,0 +1,49 @@
+// Pure admission-policy core.
+//
+// Behavioral parity with the reference webhook's `mutate()`
+// (/root/reference/src/admission.rs:241-431): OIDC-prefix user
+// classification, authorized-group gating on CREATE, normal-user
+// DELETE/UPDATE denial, self-service name matching, kube_username
+// injection/validation, quota/rolebinding tamper denial, and default
+// RoleBinding construction — plus the TPU extension: accelerator/topology
+// validation and slice-geometry defaulting (BASELINE.json north star).
+//
+// Everything here is a pure function of (request, config) so it is
+// unit-testable without TLS, HTTP, or a cluster — closing the test gap
+// the reference left open (SURVEY.md §4).
+#pragma once
+
+#include <string>
+
+#include "tpubc/json.h"
+
+namespace tpubc {
+
+// Requester classification, mirroring admission.rs:206-239.
+struct Username {
+  std::string original;  // as presented by the API server
+  std::string kube;      // prefix-stripped kube username
+  bool is_admin = false; // no OIDC prefix => admin
+};
+
+Username classify_username(const std::string& username, const std::string& oidc_prefix);
+
+// Admission config (parsed from CONF_* env by the daemon):
+//   oidc_username_prefix: string      (default "oidc:")
+//   default_role_name: string         (default "edit")
+//   authorized_group_names: [string]  (default ["tpu","admin"])
+//   default_accelerator: string       (default "tpu-v5-lite-podslice")
+//   max_chips_per_user: int           (0 = unlimited; >0 denies larger
+//                                      normal-user slice requests)
+Json default_admission_config();
+
+// Evaluate policy for a single AdmissionRequest (the `request` member of an
+// AdmissionReview). Returns an AdmissionResponse object: {uid, allowed,
+// status?, patch?, patchType?} with the patch base64-encoded as the API
+// server expects.
+Json mutate(const Json& request, const Json& config);
+
+// Full AdmissionReview handler: unwrap review -> mutate -> wrap response.
+Json mutate_review(const Json& review, const Json& config);
+
+}  // namespace tpubc

@@ -1,0 +1,35 @@
+// Env-only configuration with a CONF_ prefix — the reference's envy
+// contract (/root/reference/src/controller.rs:220, admission.rs:138,
+// synchronizer.rs:386), including the comma-separated list deserializer
+// (admission.rs:41-50). Helm values map onto these variables 1:1.
+#pragma once
+
+#include <string>
+#include <vector>
+
+#include "tpubc/json.h"
+
+namespace tpubc {
+
+class EnvConfig {
+ public:
+  // prefix is "CONF_" in production; tests may inject alternatives.
+  explicit EnvConfig(std::string prefix = "CONF_") : prefix_(std::move(prefix)) {}
+
+  // Required lookups throw std::runtime_error naming the missing variable
+  // (envy-style startup failure).
+  std::string require(const std::string& key) const;
+  std::string get(const std::string& key, const std::string& dflt = "") const;
+  int64_t get_int(const std::string& key, int64_t dflt) const;
+  bool has(const std::string& key) const;
+  // Comma-separated list (admission.rs:41-50 semantics: plain split, no
+  // trimming beyond what the values carry).
+  std::vector<std::string> get_list(const std::string& key,
+                                    const std::vector<std::string>& dflt) const;
+
+ private:
+  std::string env_name(const std::string& key) const;
+  std::string prefix_;
+};
+
+}  // namespace tpubc

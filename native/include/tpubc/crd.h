@@ -1,0 +1,37 @@
+// The TpuUserBootstrap API: group/version/kind constants and the CRD
+// document generator.
+//
+// Parity target: the reference's cluster-scoped `UserBootstrap` CR
+// (group bacchus.io/v1, shortname ub — /root/reference/src/crd.rs:9-18)
+// with spec {kube_username, quota, role, rolebinding} and status
+// {synchronized_with_sheet}. This build keeps those fields verbatim and
+// grows a `tpu` section (accelerator, topology, workload image/command)
+// plus a `slice` status block, per the north star in BASELINE.json.
+#pragma once
+
+#include <string>
+
+#include "tpubc/json.h"
+
+namespace tpubc {
+
+inline constexpr const char* kGroup = "tpu.bacchus.io";
+inline constexpr const char* kVersion = "v1";
+inline constexpr const char* kApiVersion = "tpu.bacchus.io/v1";
+inline constexpr const char* kKind = "UserBootstrap";
+inline constexpr const char* kPlural = "userbootstraps";
+inline constexpr const char* kSingular = "userbootstrap";
+inline constexpr const char* kShortName = "tub";
+// Server-side-apply field manager, mirroring the reference's
+// PATCH_MANAGER constant (/root/reference/src/controller.rs:22).
+inline constexpr const char* kFieldManager = "tpu-bootstrap-controller.tpu.bacchus.io";
+
+// Full CustomResourceDefinition object (apiextensions.k8s.io/v1) as JSON.
+Json crd_definition();
+
+// The same, serialized as YAML — what the `tpubc-crdgen` binary prints and
+// what charts/tpu-bootstrap-controller/templates/crd.yaml must match
+// (drift-checked in CI like the reference's check-crd-status workflow).
+std::string crd_yaml();
+
+}  // namespace tpubc

@@ -1,0 +1,204 @@
+// tpubc::Json — a small self-contained JSON value library for the
+// tpu-bootstrap-controller native daemons.
+//
+// The reference operator leans on serde_json for every wire payload
+// (/root/reference/src/admission.rs:349-430, synchronizer.rs:240-330).
+// This environment has no third-party C++ JSON library, so the framework
+// carries its own: parse, serialize (compact/pretty), JSON Pointer
+// (RFC 6901) and JSON Patch (RFC 6902) generation/application, plus a
+// strategic-merge-free "apply" helper used by the fake API server tests.
+//
+// Design notes:
+//  * Objects preserve insertion order (k8s API objects serialize in a
+//    stable, human-diffable order; CRD YAML generation depends on it).
+//  * Integers and doubles are kept distinct so quota quantities like
+//    "4" never round-trip into "4.0".
+#pragma once
+
+#include <cstdint>
+#include <initializer_list>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace tpubc {
+
+class Json;
+using JsonMember = std::pair<std::string, Json>;
+
+enum class JsonType : uint8_t {
+  Null,
+  Bool,
+  Int,
+  Double,
+  String,
+  Array,
+  Object,
+};
+
+class JsonError : public std::runtime_error {
+ public:
+  explicit JsonError(const std::string& what) : std::runtime_error(what) {}
+};
+
+class Json {
+ public:
+  // -- constructors -------------------------------------------------------
+  Json() : type_(JsonType::Null) {}
+  Json(std::nullptr_t) : type_(JsonType::Null) {}
+  Json(bool b) : type_(JsonType::Bool), bool_(b) {}
+  Json(int v) : type_(JsonType::Int), int_(v) {}
+  Json(int64_t v) : type_(JsonType::Int), int_(v) {}
+  Json(uint64_t v) : type_(JsonType::Int), int_(static_cast<int64_t>(v)) {}
+  Json(double v) : type_(JsonType::Double), double_(v) {}
+  Json(const char* s) : type_(JsonType::String), str_(s) {}
+  Json(std::string s) : type_(JsonType::String), str_(std::move(s)) {}
+
+  static Json array() {
+    Json j;
+    j.type_ = JsonType::Array;
+    return j;
+  }
+  static Json array(std::initializer_list<Json> items) {
+    Json j = array();
+    j.arr_.assign(items.begin(), items.end());
+    return j;
+  }
+  static Json object() {
+    Json j;
+    j.type_ = JsonType::Object;
+    return j;
+  }
+  static Json object(std::initializer_list<JsonMember> members) {
+    Json j = object();
+    for (const auto& m : members) j.set(m.first, m.second);
+    return j;
+  }
+
+  // -- type queries -------------------------------------------------------
+  JsonType type() const { return type_; }
+  bool is_null() const { return type_ == JsonType::Null; }
+  bool is_bool() const { return type_ == JsonType::Bool; }
+  bool is_int() const { return type_ == JsonType::Int; }
+  bool is_double() const { return type_ == JsonType::Double; }
+  bool is_number() const { return is_int() || is_double(); }
+  bool is_string() const { return type_ == JsonType::String; }
+  bool is_array() const { return type_ == JsonType::Array; }
+  bool is_object() const { return type_ == JsonType::Object; }
+
+  // -- scalar access ------------------------------------------------------
+  bool as_bool() const {
+    expect(JsonType::Bool, "bool");
+    return bool_;
+  }
+  int64_t as_int() const {
+    if (type_ == JsonType::Double) return static_cast<int64_t>(double_);
+    expect(JsonType::Int, "int");
+    return int_;
+  }
+  double as_double() const {
+    if (type_ == JsonType::Int) return static_cast<double>(int_);
+    expect(JsonType::Double, "double");
+    return double_;
+  }
+  const std::string& as_string() const {
+    expect(JsonType::String, "string");
+    return str_;
+  }
+
+  // -- array access -------------------------------------------------------
+  size_t size() const {
+    if (type_ == JsonType::Array) return arr_.size();
+    if (type_ == JsonType::Object) return members_.size();
+    throw JsonError("size() on non-container");
+  }
+  bool empty() const { return size() == 0; }
+  void push_back(Json v) {
+    expect(JsonType::Array, "array");
+    arr_.push_back(std::move(v));
+  }
+  Json& operator[](size_t i) {
+    expect(JsonType::Array, "array");
+    return arr_.at(i);
+  }
+  const Json& operator[](size_t i) const {
+    expect(JsonType::Array, "array");
+    return arr_.at(i);
+  }
+  std::vector<Json>& items() {
+    expect(JsonType::Array, "array");
+    return arr_;
+  }
+  const std::vector<Json>& items() const {
+    expect(JsonType::Array, "array");
+    return arr_;
+  }
+
+  // -- object access ------------------------------------------------------
+  bool contains(const std::string& key) const {
+    if (type_ != JsonType::Object) return false;
+    return find(key) != nullptr;
+  }
+  // Get member; returns shared null sentinel if absent (read-only use).
+  const Json& get(const std::string& key) const;
+  // Get-or-insert (auto-vivifies a Null as Object).
+  Json& operator[](const std::string& key);
+  const Json& operator[](const std::string& key) const { return get(key); }
+  void set(const std::string& key, Json v);
+  bool erase(const std::string& key);
+  const std::vector<JsonMember>& members() const {
+    expect(JsonType::Object, "object");
+    return members_;
+  }
+  std::vector<JsonMember>& members() {
+    expect(JsonType::Object, "object");
+    return members_;
+  }
+
+  // Convenience typed getters with defaults (used by config / CR parsing).
+  std::string get_string(const std::string& key, const std::string& dflt = "") const;
+  int64_t get_int(const std::string& key, int64_t dflt = 0) const;
+  bool get_bool(const std::string& key, bool dflt = false) const;
+
+  // Resolve a dotted path ("spec.tpu.topology"); null if any hop missing.
+  const Json& at_path(const std::string& dotted) const;
+
+  // -- JSON Pointer (RFC 6901) -------------------------------------------
+  // Returns nullptr when the pointer does not resolve.
+  const Json* pointer(const std::string& ptr) const;
+  // Escape one reference token ("~" -> "~0", "/" -> "~1").
+  static std::string pointer_escape(const std::string& token);
+
+  // -- JSON Patch (RFC 6902) ---------------------------------------------
+  // Apply a patch (array of op objects) in place. Throws JsonError on a
+  // malformed patch or unresolvable path, matching json-patch crate
+  // semantics the reference relies on (admission.rs:429).
+  void apply_patch(const Json& patch);
+
+  // -- (de)serialization --------------------------------------------------
+  static Json parse(const std::string& text);
+  std::string dump() const;             // compact
+  std::string dump(int indent) const;   // pretty
+  bool operator==(const Json& other) const;
+  bool operator!=(const Json& other) const { return !(*this == other); }
+
+ private:
+  void expect(JsonType t, const char* name) const {
+    if (type_ != t) throw JsonError(std::string("expected ") + name);
+  }
+  const Json* find(const std::string& key) const;
+  Json* find(const std::string& key);
+  void dump_to(std::string& out, int indent, int depth) const;
+
+  JsonType type_;
+  bool bool_ = false;
+  int64_t int_ = 0;
+  double double_ = 0.0;
+  std::string str_;
+  std::vector<Json> arr_;
+  std::vector<JsonMember> members_;
+};
+
+}  // namespace tpubc

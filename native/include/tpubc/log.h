@@ -1,0 +1,39 @@
+// Structured logger for the daemons.
+//
+// The reference uses tracing_subscriber's fmt layer with a RUST_LOG env
+// filter (/root/reference/src/controller.rs:217, deployment.yaml:40-41).
+// Same contract here: TPUBC_LOG (or RUST_LOG) selects the max level
+// (error|warn|info|debug|trace, default info); output is one line per
+// event: RFC3339 timestamp, level, target, message, then key=value fields.
+#pragma once
+
+#include <initializer_list>
+#include <string>
+#include <utility>
+
+namespace tpubc {
+
+enum class LogLevel { Error = 0, Warn, Info, Debug, Trace };
+
+void log_init(const std::string& target);  // call once per daemon main()
+LogLevel log_level();
+
+using LogField = std::pair<std::string, std::string>;
+
+void log_event(LogLevel level, const std::string& message,
+               std::initializer_list<LogField> fields = {});
+
+inline void log_error(const std::string& m, std::initializer_list<LogField> f = {}) {
+  log_event(LogLevel::Error, m, f);
+}
+inline void log_warn(const std::string& m, std::initializer_list<LogField> f = {}) {
+  log_event(LogLevel::Warn, m, f);
+}
+inline void log_info(const std::string& m, std::initializer_list<LogField> f = {}) {
+  log_event(LogLevel::Info, m, f);
+}
+inline void log_debug(const std::string& m, std::initializer_list<LogField> f = {}) {
+  log_event(LogLevel::Debug, m, f);
+}
+
+}  // namespace tpubc

@@ -1,0 +1,50 @@
+// Pure reconcile planner: UserBootstrap CR -> desired child objects.
+//
+// The reference reconciler performs four conditional server-side applies per
+// pass (/root/reference/src/controller.rs:50-155): Namespace always;
+// ResourceQuota iff spec.quota; Role iff spec.role; RoleBinding iff
+// spec.rolebinding AND status.synchronized_with_sheet (the sheet-approval
+// interlock). This planner reproduces that exactly and adds the TPU path:
+// a JobSet (jobset.x-k8s.io/v1alpha2) materializing the requested slice as
+// a gang-scheduled, indexed, multi-host job — iff spec.tpu AND the same
+// sheet interlock.
+//
+// Keeping the planner pure (CR in, objects out) makes multi-host behavior
+// testable without hardware: tests assert on the emitted JobSet
+// (SURVEY.md §4), which is exactly how BASELINE configs #2-#5 are scored.
+#pragma once
+
+#include <string>
+#include <vector>
+
+#include "tpubc/json.h"
+
+namespace tpubc {
+
+// Controller owner reference back to the CR (controller.rs:52) — gives
+// cascade deletion of everything the CR materialized.
+Json owner_reference(const Json& ub);
+
+// Target namespace name: CR name lowercased (controller.rs:55-63).
+std::string target_namespace(const Json& ub);
+
+// Reconciler config (from CONF_* env):
+//   requeue_secs: int        (default 30 — controller.rs:154)
+//   error_requeue_secs: int  (default 3  — controller.rs:174)
+//   workload_image: string   (default image for slice workers when the CR
+//                             does not specify spec.tpu.image)
+Json default_controller_config();
+
+// All desired children for one CR, in apply order. Each element is a full
+// typed object (apiVersion/kind/metadata/...) ready for server-side apply.
+std::vector<Json> desired_children(const Json& ub, const Json& config);
+
+// The JobSet for the CR's TPU slice (also emitted by desired_children when
+// gates pass). Exposed separately for direct assertions and for dry-run
+// tooling. Throws JsonError if spec.tpu is absent/invalid.
+Json build_jobset(const Json& ub, const Json& config);
+
+// Desired status.slice block given the CR and the observed JobSet (or null).
+Json slice_status(const Json& ub, const Json& observed_jobset);
+
+}  // namespace tpubc

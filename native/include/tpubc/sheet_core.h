@@ -1,0 +1,63 @@
+// Pure synchronizer core: Google-Form CSV -> per-user quota plans.
+//
+// Parity with the reference synchronizer's pipeline
+// (/root/reference/src/synchronizer.rs:96-330): Korean-header inference by
+// substring heuristics, tolerant row parsing (malformed rows skipped with a
+// warning), server-name substring filtering, last-match-wins authorized-row
+// lookup ("o" case/whitespace-insensitive), quota construction, and the
+// status-before-quota write ordering. Re-grounded for TPU: rows carry a TPU
+// chip count, the quota key becomes requests.google.com/tpu, and the sync
+// plan enforces pool chip inventory (the TPU analogue of the NVML-style
+// GPU-count polling named in the north star).
+#pragma once
+
+#include <string>
+#include <vector>
+
+#include "tpubc/json.h"
+
+namespace tpubc {
+
+// RFC-4180-ish CSV: quoted fields, embedded commas/newlines/doubled quotes,
+// CRLF tolerance. Returns rows of cells.
+std::vector<std::vector<std::string>> parse_csv_records(const std::string& content);
+
+// Map one raw (possibly Korean) form header to its canonical field name.
+// Mirrors synchronizer.rs:96-143 and adds TPU headers. Returns "" when the
+// header is unknown (caller treats that as a hard error, as the reference
+// does).
+std::string infer_header(const std::string& header);
+
+// Parsed sheet parse result: rows is an array of row objects
+// {name, department, id_username, server, tpu_request, gpu_request,
+//  cpu_request, memory_request, storage_request, mig_request, authorized},
+// warnings is an array of strings for skipped rows.
+// Throws JsonError on an unknown header (hard error, matching the
+// reference's CsvHeaderError).
+Json parse_sheet(const std::string& csv_content);
+
+// Synchronizer config (from CONF_* env):
+//   server_name: string          (substring filter on the server column —
+//                                 synchronizer.rs:208-212 semantics)
+//   device: "tpu" | "gpu"        (which quota keys to write; default tpu)
+//   pool_capacity_chips: int     (0 = unlimited; else authorized rows are
+//                                 admitted first-come until the pool is full)
+Json default_synchronizer_config();
+
+// Build the ResourceQuotaSpec for one row. Device-aware:
+//   tpu: requests/limits.cpu, requests/limits.memory (Gi),
+//        requests.google.com/tpu, requests.storage (Gi)
+//   gpu: the reference's exact key set incl. requests.nvidia.com/gpu and
+//        requests.nvidia.com/mig-1g.10gb (synchronizer.rs:249-281)
+Json build_quota(const Json& row, const std::string& device);
+
+// Compute the full sync plan: for each existing CR (by name), find the last
+// authorized matching row and emit
+//   {name, quota: <ResourceQuotaSpec>, patches: <JSON Patch ops>,
+//    status: {synchronized_with_sheet: true}, chips: N}
+// in list order. Rows that would overflow pool_capacity_chips are reported
+// in `skipped` instead. Result: {actions: [...], skipped: [...],
+// total_chips: N}.
+Json plan_sync(const Json& ub_list, const Json& rows, const Json& config);
+
+}  // namespace tpubc

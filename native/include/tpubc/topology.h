@@ -1,0 +1,66 @@
+// TPU accelerator / slice-topology model.
+//
+// The reference's accelerator awareness is a single pair of quota keys
+// (requests.nvidia.com/gpu, requests.nvidia.com/mig-1g.10gb —
+// /root/reference/src/synchronizer.rs:268-278). On GKE TPU the analogous
+// surface is richer: an accelerator *type* (node selector
+// cloud.google.com/gke-tpu-accelerator), a slice *topology* (node selector
+// cloud.google.com/gke-tpu-topology), and derived per-host chip counts
+// (google.com/tpu resource requests). Getting this arithmetic wrong fails
+// only on real hardware, so it lives here as pure, exhaustively unit-tested
+// functions (SURVEY.md §7 "Hard parts").
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "tpubc/json.h"
+
+namespace tpubc {
+
+// Node selector keys used by GKE TPU node pools.
+inline constexpr const char* kTpuAcceleratorNodeSelector =
+    "cloud.google.com/gke-tpu-accelerator";
+inline constexpr const char* kTpuTopologyNodeSelector =
+    "cloud.google.com/gke-tpu-topology";
+// Extended resource exposed by the TPU device plugin.
+inline constexpr const char* kTpuResource = "google.com/tpu";
+
+struct SliceGeometry {
+  std::string accelerator;       // e.g. "tpu-v5-lite-podslice"
+  std::string topology;          // e.g. "4x4x4"
+  std::vector<int64_t> dims;     // parsed topology dims
+  int64_t chips = 0;             // product of dims
+  int64_t hosts = 0;             // VMs in the slice
+  int64_t chips_per_host = 0;    // google.com/tpu request per worker pod
+  bool multi_host = false;
+
+  Json to_json() const;
+};
+
+struct TopologyError {
+  bool ok = true;
+  std::string reason;  // set when !ok
+};
+
+// Parse "AxB" / "AxBxC" into dims. Throws JsonError on malformed input.
+std::vector<int64_t> parse_topology(const std::string& topology);
+
+// All accelerator type names this build understands.
+const std::vector<std::string>& known_accelerators();
+
+// Validate an (accelerator, topology) pair against the GKE compatibility
+// tables. Returns ok=false with a human-readable reason usable verbatim in
+// an admission denial message.
+TopologyError validate_topology(const std::string& accelerator, const std::string& topology);
+
+// Compute slice geometry. Throws JsonError if validate_topology fails —
+// callers on the admission path should validate first for a clean denial.
+SliceGeometry slice_geometry(const std::string& accelerator, const std::string& topology);
+
+// Default topology for an accelerator (smallest valid slice), used by the
+// admission webhook's defaulting patch when spec.tpu.topology is omitted.
+std::string default_topology(const std::string& accelerator);
+
+}  // namespace tpubc

@@ -1,0 +1,34 @@
+// Small self-contained utilities shared by the daemons: base64 (admission
+// responses carry a base64 JSONPatch), SHA-256 (cert hot-reload change
+// detection, mirroring /root/reference/src/admission.rs:96-101), string
+// helpers, and time.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace tpubc {
+
+std::string base64_encode(const std::string& data);
+std::string base64_decode(const std::string& data);
+
+// Hex-encoded SHA-256 digest.
+std::string sha256_hex(const std::string& data);
+
+std::vector<std::string> split(const std::string& s, char sep);
+std::string to_lower(const std::string& s);
+std::string trim(const std::string& s);
+bool starts_with(const std::string& s, const std::string& prefix);
+bool contains(const std::string& s, const std::string& needle);
+
+// Read an entire file; throws std::runtime_error on failure.
+std::string read_file(const std::string& path);
+
+// Monotonic milliseconds (for intervals / latency measurement).
+int64_t monotonic_ms();
+
+// Wall-clock RFC3339 UTC timestamp (for logs).
+std::string now_rfc3339();
+
+}  // namespace tpubc

@@ -1,0 +1,18 @@
+// JSON -> YAML block-style emitter.
+//
+// The reference generates its CRD manifest by piping serde_yaml output into
+// the Helm chart (/root/reference/src/crdgen.rs:3-8, generate-crd.sh:7).
+// Our crdgen does the same with this emitter; CI diffs the output against
+// charts/tpu-bootstrap-controller/templates/crd.yaml to catch drift.
+#pragma once
+
+#include <string>
+
+#include "tpubc/json.h"
+
+namespace tpubc {
+
+// Serialize a Json value as a YAML document (no leading "---").
+std::string to_yaml(const Json& value);
+
+}  // namespace tpubc

@@ -1,0 +1,240 @@
+#include "tpubc/admission_core.h"
+
+#include "tpubc/crd.h"
+#include "tpubc/topology.h"
+#include "tpubc/util.h"
+
+namespace tpubc {
+
+namespace {
+
+Json base_response(const Json& request, bool allowed) {
+  return Json::object({{"uid", request.get_string("uid")}, {"allowed", allowed}});
+}
+
+// Policy denial (admission.rs `resp.deny(e)` analogue): 403 with message.
+Json deny(const Json& request, const std::string& message) {
+  Json r = base_response(request, false);
+  r.set("status", Json::object({{"code", 403}, {"message", message}}));
+  return r;
+}
+
+// Malformed request (AdmissionResponse::invalid analogue): 400.
+Json invalid(const Json& request, const std::string& message) {
+  Json r = base_response(request, false);
+  r.set("status", Json::object({{"code", 400}, {"message", message}}));
+  return r;
+}
+
+Json patch_op(const char* op, const std::string& path, Json value) {
+  return Json::object({{"op", op}, {"path", path}, {"value", std::move(value)}});
+}
+
+Json with_patch(Json resp, const Json& patches) {
+  resp.set("patchType", "JSONPatch");
+  resp.set("patch", base64_encode(patches.dump()));
+  return resp;
+}
+
+// Default RoleBinding: ClusterRole <default_role_name> bound to the user —
+// same shape the reference builds at admission.rs:399-411.
+Json default_rolebinding(const std::string& role_name, const std::string& subject_name) {
+  return Json::object({
+      {"role_ref", Json::object({
+                       {"api_group", "rbac.authorization.k8s.io"},
+                       {"kind", "ClusterRole"},
+                       {"name", role_name},
+                   })},
+      {"subjects", Json::array({Json::object({
+                       {"api_group", "rbac.authorization.k8s.io"},
+                       {"kind", "User"},
+                       {"name", subject_name},
+                   })})},
+  });
+}
+
+}  // namespace
+
+Username classify_username(const std::string& username, const std::string& oidc_prefix) {
+  Username u;
+  u.original = username;
+  if (!oidc_prefix.empty() && starts_with(username, oidc_prefix)) {
+    u.kube = username.substr(oidc_prefix.size());
+    u.is_admin = false;
+  } else {
+    // No OIDC prefix => authenticated by other means => admin
+    // (admission.rs:230-237).
+    u.kube = username;
+    u.is_admin = true;
+  }
+  return u;
+}
+
+Json default_admission_config() {
+  return Json::object({
+      {"oidc_username_prefix", "oidc:"},
+      {"default_role_name", "edit"},
+      {"authorized_group_names", Json::array({Json("tpu"), Json("admin")})},
+      {"default_accelerator", "tpu-v5-lite-podslice"},
+      {"max_chips_per_user", 0},
+  });
+}
+
+Json mutate(const Json& request, const Json& config) {
+  const Json& user_info = request.get("userInfo");
+  const Json& username_field = user_info.get("username");
+  if (!username_field.is_string() || username_field.as_string().empty()) {
+    return invalid(request, "cannot get requester's username from request");
+  }
+  Username username =
+      classify_username(username_field.as_string(), config.get_string("oidc_username_prefix"));
+
+  // Group membership against the authorized list (admission.rs:263-270).
+  bool in_group = false;
+  const Json& groups = user_info.get("groups");
+  const Json& authorized = config.get("authorized_group_names");
+  if (groups.is_array() && authorized.is_array()) {
+    for (const auto& g : groups.items()) {
+      for (const auto& a : authorized.items()) {
+        if (g.is_string() && a.is_string() && g.as_string() == a.as_string()) in_group = true;
+      }
+    }
+  }
+
+  const std::string op = request.get_string("operation");
+  if (op == "CREATE") {
+    if (!username.is_admin && !in_group) {
+      return deny(request, "user is not in authorized group");
+    }
+  } else if (op == "DELETE") {
+    if (!username.is_admin) {
+      return deny(request, "normal user is not allowed to delete resource");
+    }
+    return base_response(request, true);  // early allow (admission.rs:292-293)
+  } else if (op == "UPDATE") {
+    if (!username.is_admin) {
+      return deny(request, "normal user is not allowed to update resource");
+    }
+  } else {
+    return invalid(request, "invalid operation");
+  }
+
+  const Json& obj = request.get("object");
+  if (!obj.is_object()) {
+    // DELETE carries no object; anything else without one is a no-op allow
+    // (admission.rs:312-318).
+    return base_response(request, true);
+  }
+
+  const std::string resource_name = obj.get("metadata").get_string("name");
+  if (resource_name.empty()) {
+    return invalid(request, "cannot get resource name from request");
+  }
+
+  // Self-service rule: a normal user may only manage the CR named after
+  // themselves (admission.rs:330-338).
+  if (!username.is_admin && username.kube != resource_name) {
+    return deny(request, "username not match with resource name");
+  }
+
+  const Json& spec = obj.get("spec");
+  if (!spec.is_object()) {
+    return invalid(request, "request object has no spec; not a " + std::string(kKind));
+  }
+
+  Json patches = Json::array();
+
+  if (!username.is_admin) {
+    // Normal users get their identity stamped in (admission.rs:352-357).
+    patches.push_back(patch_op("add", "/spec/kube_username", Json(username.kube)));
+  } else {
+    // Admins must say who the bootstrap is for (admission.rs:359-373).
+    if (spec.get_string("kube_username").empty()) {
+      return deny(request, "kube_username field is empty. you are an admin, so fill it");
+    }
+  }
+
+  if (!spec.get("quota").is_null() && !username.is_admin) {
+    return deny(request, "quota field is not empty. you are a normal user, so leave it empty");
+  }
+
+  if (spec.get("rolebinding").is_null()) {
+    const std::string subject =
+        username.is_admin ? spec.get_string("kube_username") : username.original;
+    patches.push_back(patch_op(
+        "add", "/spec/rolebinding",
+        default_rolebinding(config.get_string("default_role_name", "edit"), subject)));
+  } else if (!username.is_admin) {
+    return deny(request, "rolebinding field is not empty. you are a normal user, so leave it empty");
+  }
+
+  // ---- TPU extension -----------------------------------------------------
+  // Validate the accelerator/topology pair and materialize derived slice
+  // geometry into the spec, so the reconciler and quota system never have
+  // to re-derive chip math (and invalid topologies die here, synchronously,
+  // instead of at node-pool scheduling time).
+  const Json& tpu = spec.get("tpu");
+  if (tpu.is_object()) {
+    std::string accelerator = tpu.get_string("accelerator");
+    if (accelerator.empty()) {
+      accelerator = config.get_string("default_accelerator", "tpu-v5-lite-podslice");
+      patches.push_back(patch_op("add", "/spec/tpu/accelerator", Json(accelerator)));
+    }
+    std::string topology = tpu.get_string("topology");
+    if (topology.empty()) {
+      try {
+        topology = default_topology(accelerator);
+      } catch (const JsonError& e) {
+        return deny(request, e.what());  // unknown accelerator
+      }
+      patches.push_back(patch_op("add", "/spec/tpu/topology", Json(topology)));
+    }
+    TopologyError check = validate_topology(accelerator, topology);
+    if (!check.ok) {
+      return deny(request, check.reason);
+    }
+    SliceGeometry geom = slice_geometry(accelerator, topology);
+
+    int64_t max_chips = config.get_int("max_chips_per_user", 0);
+    if (!username.is_admin && max_chips > 0 && geom.chips > max_chips) {
+      return deny(request, "requested slice has " + std::to_string(geom.chips) +
+                               " chips, exceeding the per-user limit of " +
+                               std::to_string(max_chips));
+    }
+
+    // JSON Patch "add" on an object member upserts, so these also correct
+    // any stale client-provided values.
+    patches.push_back(patch_op("add", "/spec/tpu/chips", Json(geom.chips)));
+    patches.push_back(patch_op("add", "/spec/tpu/hosts", Json(geom.hosts)));
+    patches.push_back(patch_op("add", "/spec/tpu/chips_per_host", Json(geom.chips_per_host)));
+  }
+
+  Json resp = base_response(request, true);
+  if (!patches.empty()) resp = with_patch(std::move(resp), patches);
+  return resp;
+}
+
+Json mutate_review(const Json& review, const Json& config) {
+  Json response;
+  const Json& request = review.get("request");
+  if (!request.is_object() || request.get_string("uid").empty()) {
+    response = Json::object({
+        {"uid", ""},
+        {"allowed", false},
+        {"status", Json::object({{"code", 400}, {"message", "invalid AdmissionReview: no request"}})},
+    });
+  } else {
+    try {
+      response = mutate(request, config);
+    } catch (const std::exception& e) {
+      response = invalid(request, std::string("admission error: ") + e.what());
+    }
+  }
+  return Json::object({
+      {"apiVersion", "admission.k8s.io/v1"},
+      {"kind", "AdmissionReview"},
+      {"response", response},
+  });
+}
+
+}  // namespace tpubc

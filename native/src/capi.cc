@@ -1,0 +1,171 @@
+// extern "C" surface exposing the pure cores to Python (ctypes).
+//
+// This is how the pytest suite exercises the real native logic — the same
+// object code the daemons link — without a cluster. Every function takes
+// UTF-8 JSON/string arguments and returns a malloc'd UTF-8 string the
+// caller must release with tpubc_free. Exceptions are converted to
+// {"error": "..."} payloads.
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "tpubc/admission_core.h"
+#include "tpubc/crd.h"
+#include "tpubc/json.h"
+#include "tpubc/reconcile_core.h"
+#include "tpubc/sheet_core.h"
+#include "tpubc/topology.h"
+#include "tpubc/util.h"
+#include "tpubc/yaml.h"
+
+namespace {
+
+char* dup_string(const std::string& s) {
+  char* out = static_cast<char*>(std::malloc(s.size() + 1));
+  std::memcpy(out, s.c_str(), s.size() + 1);
+  return out;
+}
+
+template <typename Fn>
+char* guarded(Fn&& fn) {
+  try {
+    return dup_string(fn());
+  } catch (const std::exception& e) {
+    return dup_string(tpubc::Json::object({{"error", std::string(e.what())}}).dump());
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void tpubc_free(char* p) { std::free(p); }
+
+char* tpubc_version() { return dup_string("tpu-bootstrap-controller 0.1.0"); }
+
+char* tpubc_crd_yaml() {
+  return guarded([] { return tpubc::crd_yaml(); });
+}
+
+char* tpubc_crd_json() {
+  return guarded([] { return tpubc::crd_definition().dump(); });
+}
+
+char* tpubc_to_yaml(const char* json) {
+  return guarded([&] { return tpubc::to_yaml(tpubc::Json::parse(json)); });
+}
+
+char* tpubc_json_roundtrip(const char* text) {
+  return guarded([&] { return tpubc::Json::parse(text).dump(); });
+}
+
+char* tpubc_json_patch(const char* doc, const char* patch) {
+  return guarded([&] {
+    tpubc::Json d = tpubc::Json::parse(doc);
+    d.apply_patch(tpubc::Json::parse(patch));
+    return d.dump();
+  });
+}
+
+char* tpubc_validate_topology(const char* accelerator, const char* topology) {
+  return guarded([&] {
+    tpubc::TopologyError err = tpubc::validate_topology(accelerator, topology);
+    return tpubc::Json::object({{"ok", err.ok}, {"reason", err.reason}}).dump();
+  });
+}
+
+char* tpubc_slice_geometry(const char* accelerator, const char* topology) {
+  return guarded([&] { return tpubc::slice_geometry(accelerator, topology).to_json().dump(); });
+}
+
+char* tpubc_default_topology(const char* accelerator) {
+  return guarded([&] { return tpubc::default_topology(accelerator); });
+}
+
+char* tpubc_classify_username(const char* username, const char* prefix) {
+  return guarded([&] {
+    tpubc::Username u = tpubc::classify_username(username, prefix);
+    return tpubc::Json::object(
+               {{"original", u.original}, {"kube", u.kube}, {"is_admin", u.is_admin}})
+        .dump();
+  });
+}
+
+char* tpubc_default_admission_config() {
+  return guarded([] { return tpubc::default_admission_config().dump(); });
+}
+
+char* tpubc_mutate(const char* request, const char* config) {
+  return guarded(
+      [&] { return tpubc::mutate(tpubc::Json::parse(request), tpubc::Json::parse(config)).dump(); });
+}
+
+char* tpubc_mutate_review(const char* review, const char* config) {
+  return guarded([&] {
+    return tpubc::mutate_review(tpubc::Json::parse(review), tpubc::Json::parse(config)).dump();
+  });
+}
+
+char* tpubc_default_controller_config() {
+  return guarded([] { return tpubc::default_controller_config().dump(); });
+}
+
+char* tpubc_desired_children(const char* ub, const char* config) {
+  return guarded([&] {
+    tpubc::Json out = tpubc::Json::array();
+    for (auto& child :
+         tpubc::desired_children(tpubc::Json::parse(ub), tpubc::Json::parse(config)))
+      out.push_back(std::move(child));
+    return out.dump();
+  });
+}
+
+char* tpubc_build_jobset(const char* ub, const char* config) {
+  return guarded([&] {
+    return tpubc::build_jobset(tpubc::Json::parse(ub), tpubc::Json::parse(config)).dump();
+  });
+}
+
+char* tpubc_slice_status(const char* ub, const char* jobset) {
+  return guarded([&] {
+    return tpubc::slice_status(tpubc::Json::parse(ub), tpubc::Json::parse(jobset)).dump();
+  });
+}
+
+char* tpubc_infer_header(const char* header) {
+  return guarded([&] { return tpubc::infer_header(header); });
+}
+
+char* tpubc_parse_sheet(const char* csv) {
+  return guarded([&] { return tpubc::parse_sheet(csv).dump(); });
+}
+
+char* tpubc_default_synchronizer_config() {
+  return guarded([] { return tpubc::default_synchronizer_config().dump(); });
+}
+
+char* tpubc_build_quota(const char* row, const char* device) {
+  return guarded([&] { return tpubc::build_quota(tpubc::Json::parse(row), device).dump(); });
+}
+
+char* tpubc_plan_sync(const char* ub_list, const char* rows, const char* config) {
+  return guarded([&] {
+    return tpubc::plan_sync(tpubc::Json::parse(ub_list), tpubc::Json::parse(rows),
+                            tpubc::Json::parse(config))
+        .dump();
+  });
+}
+
+char* tpubc_sha256_hex(const char* data) {
+  return guarded([&] { return tpubc::sha256_hex(data); });
+}
+
+char* tpubc_base64_encode(const char* data) {
+  return guarded([&] { return tpubc::base64_encode(data); });
+}
+
+char* tpubc_base64_decode(const char* data) {
+  return guarded([&] { return tpubc::base64_decode(data); });
+}
+
+}  // extern "C"

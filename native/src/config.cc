@@ -1,0 +1,49 @@
+#include "tpubc/config.h"
+
+#include <cstdlib>
+#include <stdexcept>
+
+#include "tpubc/util.h"
+
+namespace tpubc {
+
+std::string EnvConfig::env_name(const std::string& key) const {
+  std::string name = prefix_;
+  for (char c : key) name += static_cast<char>(std::toupper(static_cast<unsigned char>(c)));
+  return name;
+}
+
+bool EnvConfig::has(const std::string& key) const {
+  return std::getenv(env_name(key).c_str()) != nullptr;
+}
+
+std::string EnvConfig::require(const std::string& key) const {
+  const char* v = std::getenv(env_name(key).c_str());
+  if (!v) throw std::runtime_error("missing required environment variable " + env_name(key));
+  return v;
+}
+
+std::string EnvConfig::get(const std::string& key, const std::string& dflt) const {
+  const char* v = std::getenv(env_name(key).c_str());
+  return v ? std::string(v) : dflt;
+}
+
+int64_t EnvConfig::get_int(const std::string& key, int64_t dflt) const {
+  const char* v = std::getenv(env_name(key).c_str());
+  if (!v) return dflt;
+  try {
+    return std::stoll(v);
+  } catch (const std::exception&) {
+    throw std::runtime_error("environment variable " + env_name(key) +
+                             " is not an integer: " + std::string(v));
+  }
+}
+
+std::vector<std::string> EnvConfig::get_list(const std::string& key,
+                                             const std::vector<std::string>& dflt) const {
+  const char* v = std::getenv(env_name(key).c_str());
+  if (!v) return dflt;
+  return split(v, ',');
+}
+
+}  // namespace tpubc

@@ -1,0 +1,309 @@
+#include "tpubc/crd.h"
+
+#include "tpubc/topology.h"
+#include "tpubc/yaml.h"
+
+namespace tpubc {
+
+namespace {
+
+Json string_schema(const std::string& description) {
+  return Json::object({{"description", description}, {"type", "string"}});
+}
+
+Json nullable_string_schema(const std::string& description) {
+  return Json::object({{"description", description}, {"nullable", true}, {"type", "string"}});
+}
+
+Json int_schema(const std::string& description) {
+  return Json::object({{"description", description}, {"format", "int64"}, {"type", "integer"}});
+}
+
+// k8s Quantity: string or integer ("4", "16Gi", 4).
+Json quantity_schema() {
+  return Json::object({
+      {"x-kubernetes-int-or-string", true},
+      {"anyOf", Json::array({Json::object({{"type", "integer"}}), Json::object({{"type", "string"}})})},
+  });
+}
+
+// Vendored subset of io.k8s.api.core.v1.ResourceQuotaSpec — mirrors the
+// schema the reference embeds via k8s-openapi (crd.yaml:23-96 in the
+// reference chart) without re-deriving it from upstream at build time.
+Json quota_schema() {
+  Json scope_selector = Json::object({
+      {"description", "scopeSelector is also a collection of filters like scopes that must match "
+                      "each object tracked by a quota but expressed using ScopeSelectorOperator "
+                      "in combination with possible values."},
+      {"nullable", true},
+      {"type", "object"},
+      {"properties",
+       Json::object({
+           {"matchExpressions",
+            Json::object({
+                {"description", "A list of scope selector requirements by scope of the resources."},
+                {"type", "array"},
+                {"items",
+                 Json::object({
+                     {"type", "object"},
+                     {"required", Json::array({Json("operator"), Json("scopeName")})},
+                     {"properties",
+                      Json::object({
+                          {"operator", string_schema("Represents a scope's relationship to a set of values.")},
+                          {"scopeName", string_schema("The name of the scope that the selector applies to.")},
+                          {"values",
+                           Json::object({{"description", "An array of string values."},
+                                         {"type", "array"},
+                                         {"items", Json::object({{"type", "string"}})}})},
+                      })},
+                 })},
+            })},
+       })},
+  });
+  return Json::object({
+      {"description", "ResourceQuota for the user namespace. Hard caps include TPU chip "
+                      "requests (requests.google.com/tpu)."},
+      {"nullable", true},
+      {"type", "object"},
+      {"properties",
+       Json::object({
+           {"hard", Json::object({{"description",
+                                   "hard is the set of desired hard limits for each named resource."},
+                                  {"type", "object"},
+                                  {"additionalProperties", quantity_schema()}})},
+           {"scopeSelector", scope_selector},
+           {"scopes",
+            Json::object({{"description",
+                           "A collection of filters that must match each object tracked by a quota."},
+                          {"type", "array"},
+                          {"items", Json::object({{"type", "string"}})}})},
+       })},
+  });
+}
+
+// Vendored subset of io.k8s.api.rbac.v1.Role (metadata-free; the controller
+// stamps metadata — /root/reference/src/controller.rs:113-124 pattern).
+Json role_schema() {
+  Json policy_rule = Json::object({
+      {"type", "object"},
+      {"properties",
+       Json::object({
+           {"apiGroups",
+            Json::object({{"type", "array"}, {"items", Json::object({{"type", "string"}})}})},
+           {"nonResourceURLs",
+            Json::object({{"type", "array"}, {"items", Json::object({{"type", "string"}})}})},
+           {"resourceNames",
+            Json::object({{"type", "array"}, {"items", Json::object({{"type", "string"}})}})},
+           {"resources",
+            Json::object({{"type", "array"}, {"items", Json::object({{"type", "string"}})}})},
+           {"verbs",
+            Json::object({{"type", "array"}, {"items", Json::object({{"type", "string"}})}})},
+       })},
+      {"required", Json::array({Json("verbs")})},
+  });
+  return Json::object({
+      {"description", "Role created in the user namespace. Optional; if not specified, no "
+                      "additional Role is created."},
+      {"nullable", true},
+      {"type", "object"},
+      {"x-kubernetes-preserve-unknown-fields", true},
+      {"properties",
+       Json::object({
+           {"rules", Json::object({{"description", "Rules holds all the PolicyRules for this Role"},
+                                   {"type", "array"},
+                                   {"items", policy_rule}})},
+       })},
+  });
+}
+
+Json rolebinding_schema() {
+  return Json::object({
+      {"description", "RoleBinding (metadata-less) for the user namespace. If not specified, "
+                      "the admission webhook defaults it to the configured ClusterRole bound "
+                      "to the requesting user."},
+      {"nullable", true},
+      {"type", "object"},
+      {"required", Json::array({Json("role_ref")})},
+      {"properties",
+       Json::object({
+           {"role_ref",
+            Json::object({
+                {"type", "object"},
+                {"required", Json::array({Json("api_group"), Json("kind"), Json("name")})},
+                {"properties", Json::object({
+                                   {"api_group", string_schema("APIGroup of the referenced role.")},
+                                   {"kind", string_schema("Kind of the referenced role.")},
+                                   {"name", string_schema("Name of the referenced role.")},
+                               })},
+            })},
+           {"subjects",
+            Json::object({
+                {"nullable", true},
+                {"type", "array"},
+                {"items",
+                 Json::object({
+                     {"type", "object"},
+                     {"required", Json::array({Json("kind"), Json("name")})},
+                     {"properties",
+                      Json::object({
+                          {"api_group", nullable_string_schema("APIGroup of the subject.")},
+                          {"kind", string_schema("Kind of the subject (User/Group/ServiceAccount).")},
+                          {"name", string_schema("Name of the subject.")},
+                          {"namespace", nullable_string_schema("Namespace of the subject.")},
+                      })},
+                 })},
+            })},
+       })},
+  });
+}
+
+Json tpu_schema() {
+  Json accel_enum = Json::array();
+  for (const auto& name : known_accelerators()) accel_enum.push_back(name);
+  return Json::object({
+      {"description",
+       "TPU slice request. When present, the controller materializes a gang-scheduled "
+       "multi-host JobSet targeting one ICI-connected slice: nodeSelectors "
+       "cloud.google.com/gke-tpu-accelerator + cloud.google.com/gke-tpu-topology and "
+       "per-host google.com/tpu chip requests."},
+      {"nullable", true},
+      {"type", "object"},
+      {"properties",
+       Json::object({
+           {"accelerator", Json::object({{"description",
+                                          "GKE TPU accelerator type (gke-tpu-accelerator node "
+                                          "selector value)."},
+                                         {"type", "string"},
+                                         {"enum", accel_enum}})},
+           {"topology", nullable_string_schema(
+                            "Slice topology, e.g. \"2x2\" (v5e single host) or \"4x4x4\" "
+                            "(64-chip v5p). Defaulted by the admission webhook when omitted.")},
+           {"image", nullable_string_schema("Container image for slice workers.")},
+           {"command",
+            Json::object({{"description", "Worker entrypoint override."},
+                          {"nullable", true},
+                          {"type", "array"},
+                          {"items", Json::object({{"type", "string"}})}})},
+           {"args", Json::object({{"description", "Worker args."},
+                                  {"nullable", true},
+                                  {"type", "array"},
+                                  {"items", Json::object({{"type", "string"}})}})},
+           {"chips", Json::object({{"description", "Total chips in the slice (computed by the "
+                                                   "admission webhook from topology)."},
+                                   {"nullable", true},
+                                   {"format", "int64"},
+                                   {"type", "integer"}})},
+           {"hosts", Json::object({{"description", "Worker hosts in the slice (computed)."},
+                                   {"nullable", true},
+                                   {"format", "int64"},
+                                   {"type", "integer"}})},
+           {"chips_per_host", Json::object({{"description", "google.com/tpu request per host "
+                                                            "(computed)."},
+                                            {"nullable", true},
+                                            {"format", "int64"},
+                                            {"type", "integer"}})},
+           {"max_restarts", Json::object({{"description", "JobSet failurePolicy.maxRestarts for "
+                                                          "the slice (gang restart budget)."},
+                                          {"nullable", true},
+                                          {"format", "int64"},
+                                          {"type", "integer"}})},
+       })},
+  });
+}
+
+Json status_schema() {
+  return Json::object({
+      {"nullable", true},
+      {"type", "object"},
+      {"properties",
+       Json::object({
+           {"synchronized_with_sheet",
+            Json::object({{"description",
+                           "Set true by the synchronizer once an authorized sheet row has been "
+                           "applied; gates RoleBinding and JobSet creation."},
+                          {"type", "boolean"}})},
+           {"slice",
+            Json::object({
+                {"description", "Observed state of the TPU slice JobSet."},
+                {"nullable", true},
+                {"type", "object"},
+                {"properties",
+                 Json::object({
+                     {"phase", nullable_string_schema(
+                                   "Pending | Provisioning | Running | Failed | Absent.")},
+                     {"chips", int_schema("Chips granted.")},
+                     {"hosts", int_schema("Hosts granted.")},
+                     {"jobset", nullable_string_schema("Name of the materialized JobSet.")},
+                 })},
+            })},
+       })},
+      {"required", Json::array({Json("synchronized_with_sheet")})},
+  });
+}
+
+}  // namespace
+
+Json crd_definition() {
+  Json spec_props = Json::object({
+      {"kube_username", nullable_string_schema("Kubernetes username")},
+      {"quota", quota_schema()},
+      {"role", role_schema()},
+      {"rolebinding", rolebinding_schema()},
+      {"tpu", tpu_schema()},
+  });
+
+  Json schema = Json::object({
+      {"description", "Auto-generated derived type for UserBootstrapSpec via `CustomResource`"},
+      {"type", "object"},
+      {"required", Json::array({Json("spec")})},
+      {"properties", Json::object({
+                         {"spec", Json::object({{"type", "object"}, {"properties", spec_props}})},
+                         {"status", status_schema()},
+                     })},
+  });
+
+  return Json::object({
+      {"apiVersion", "apiextensions.k8s.io/v1"},
+      {"kind", "CustomResourceDefinition"},
+      {"metadata", Json::object({{"name", std::string(kPlural) + "." + kGroup}})},
+      {"spec",
+       Json::object({
+           {"group", kGroup},
+           {"names", Json::object({
+                         {"categories", Json::array()},
+                         {"kind", kKind},
+                         {"plural", kPlural},
+                         {"shortNames", Json::array({Json(kShortName)})},
+                         {"singular", kSingular},
+                     })},
+           {"scope", "Cluster"},
+           {"versions",
+            Json::array({Json::object({
+                {"additionalPrinterColumns",
+                 Json::array({
+                     Json::object({{"jsonPath", ".spec.tpu.accelerator"},
+                                   {"name", "Accelerator"},
+                                   {"type", "string"}}),
+                     Json::object({{"jsonPath", ".spec.tpu.topology"},
+                                   {"name", "Topology"},
+                                   {"type", "string"}}),
+                     Json::object({{"jsonPath", ".status.synchronized_with_sheet"},
+                                   {"name", "Synced"},
+                                   {"type", "boolean"}}),
+                     Json::object({{"jsonPath", ".status.slice.phase"},
+                                   {"name", "Slice"},
+                                   {"type", "string"}}),
+                 })},
+                {"name", kVersion},
+                {"schema", Json::object({{"openAPIV3Schema", schema}})},
+                {"served", true},
+                {"storage", true},
+                {"subresources", Json::object({{"status", Json::object()}})},
+            })})},
+       })},
+  });
+}
+
+std::string crd_yaml() { return to_yaml(crd_definition()); }
+
+}  // namespace tpubc

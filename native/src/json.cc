@@ -1,0 +1,696 @@
+#include "tpubc/json.h"
+
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+
+namespace tpubc {
+
+namespace {
+const Json kNull{};
+}  // namespace
+
+const Json& Json::get(const std::string& key) const {
+  if (type_ != JsonType::Object) return kNull;
+  const Json* j = find(key);
+  return j ? *j : kNull;
+}
+
+Json& Json::operator[](const std::string& key) {
+  if (type_ == JsonType::Null) type_ = JsonType::Object;
+  expect(JsonType::Object, "object");
+  Json* j = find(key);
+  if (j) return *j;
+  members_.emplace_back(key, Json());
+  return members_.back().second;
+}
+
+void Json::set(const std::string& key, Json v) {
+  if (type_ == JsonType::Null) type_ = JsonType::Object;
+  expect(JsonType::Object, "object");
+  Json* j = find(key);
+  if (j) {
+    *j = std::move(v);
+  } else {
+    members_.emplace_back(key, std::move(v));
+  }
+}
+
+bool Json::erase(const std::string& key) {
+  expect(JsonType::Object, "object");
+  for (auto it = members_.begin(); it != members_.end(); ++it) {
+    if (it->first == key) {
+      members_.erase(it);
+      return true;
+    }
+  }
+  return false;
+}
+
+const Json* Json::find(const std::string& key) const {
+  for (const auto& m : members_)
+    if (m.first == key) return &m.second;
+  return nullptr;
+}
+
+Json* Json::find(const std::string& key) {
+  for (auto& m : members_)
+    if (m.first == key) return &m.second;
+  return nullptr;
+}
+
+std::string Json::get_string(const std::string& key, const std::string& dflt) const {
+  const Json& j = get(key);
+  return j.is_string() ? j.as_string() : dflt;
+}
+
+int64_t Json::get_int(const std::string& key, int64_t dflt) const {
+  const Json& j = get(key);
+  return j.is_number() ? j.as_int() : dflt;
+}
+
+bool Json::get_bool(const std::string& key, bool dflt) const {
+  const Json& j = get(key);
+  return j.is_bool() ? j.as_bool() : dflt;
+}
+
+const Json& Json::at_path(const std::string& dotted) const {
+  const Json* cur = this;
+  size_t start = 0;
+  while (start <= dotted.size()) {
+    size_t dot = dotted.find('.', start);
+    std::string key = dotted.substr(start, dot == std::string::npos ? std::string::npos : dot - start);
+    if (!cur->is_object()) return kNull;
+    const Json* next = cur->find(key);
+    if (!next) return kNull;
+    cur = next;
+    if (dot == std::string::npos) break;
+    start = dot + 1;
+  }
+  return *cur;
+}
+
+// ---------------------------------------------------------------------------
+// JSON Pointer
+// ---------------------------------------------------------------------------
+
+std::string Json::pointer_escape(const std::string& token) {
+  std::string out;
+  out.reserve(token.size());
+  for (char c : token) {
+    if (c == '~')
+      out += "~0";
+    else if (c == '/')
+      out += "~1";
+    else
+      out += c;
+  }
+  return out;
+}
+
+namespace {
+
+std::string pointer_unescape(const std::string& token) {
+  std::string out;
+  out.reserve(token.size());
+  for (size_t i = 0; i < token.size(); ++i) {
+    if (token[i] == '~' && i + 1 < token.size()) {
+      if (token[i + 1] == '0') {
+        out += '~';
+        ++i;
+        continue;
+      }
+      if (token[i + 1] == '1') {
+        out += '/';
+        ++i;
+        continue;
+      }
+    }
+    out += token[i];
+  }
+  return out;
+}
+
+std::vector<std::string> pointer_tokens(const std::string& ptr) {
+  std::vector<std::string> toks;
+  if (ptr.empty()) return toks;
+  if (ptr[0] != '/') throw JsonError("json pointer must start with '/': " + ptr);
+  size_t start = 1;
+  while (start <= ptr.size()) {
+    size_t slash = ptr.find('/', start);
+    toks.push_back(pointer_unescape(
+        ptr.substr(start, slash == std::string::npos ? std::string::npos : slash - start)));
+    if (slash == std::string::npos) break;
+    start = slash + 1;
+  }
+  return toks;
+}
+
+bool parse_array_index(const std::string& tok, size_t* out) {
+  if (tok.empty()) return false;
+  size_t v = 0;
+  for (char c : tok) {
+    if (c < '0' || c > '9') return false;
+    v = v * 10 + static_cast<size_t>(c - '0');
+  }
+  if (tok.size() > 1 && tok[0] == '0') return false;  // no leading zeros
+  *out = v;
+  return true;
+}
+
+}  // namespace
+
+const Json* Json::pointer(const std::string& ptr) const {
+  const Json* cur = this;
+  for (const auto& tok : pointer_tokens(ptr)) {
+    if (cur->is_object()) {
+      cur = cur->find(tok);
+      if (!cur) return nullptr;
+    } else if (cur->is_array()) {
+      size_t idx;
+      if (!parse_array_index(tok, &idx) || idx >= cur->size()) return nullptr;
+      cur = &(*cur)[idx];
+    } else {
+      return nullptr;
+    }
+  }
+  return cur;
+}
+
+// ---------------------------------------------------------------------------
+// JSON Patch (RFC 6902): add, remove, replace, test, copy, move
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// Resolve the parent container of `ptr` plus the final token.
+Json* patch_parent(Json& root, const std::vector<std::string>& toks, std::string* last) {
+  if (toks.empty()) return nullptr;  // whole-document ops handled by caller
+  Json* cur = &root;
+  for (size_t i = 0; i + 1 < toks.size(); ++i) {
+    const std::string& tok = toks[i];
+    if (cur->is_object()) {
+      bool found = false;
+      for (auto& m : cur->members()) {
+        if (m.first == tok) {
+          cur = &m.second;
+          found = true;
+          break;
+        }
+      }
+      if (!found) throw JsonError("patch path not found at '" + tok + "'");
+    } else if (cur->is_array()) {
+      size_t idx;
+      if (!parse_array_index(tok, &idx) || idx >= cur->size())
+        throw JsonError("patch path bad index '" + tok + "'");
+      cur = &(*cur)[idx];
+    } else {
+      throw JsonError("patch path traverses scalar at '" + tok + "'");
+    }
+  }
+  *last = toks.back();
+  return cur;
+}
+
+Json patch_get(const Json& root, const std::string& path) {
+  const Json* j = root.pointer(path);
+  if (!j) throw JsonError("patch path not found: " + path);
+  return *j;
+}
+
+void patch_add(Json& root, const std::string& path, Json value) {
+  auto toks = pointer_tokens(path);
+  if (toks.empty()) {
+    root = std::move(value);
+    return;
+  }
+  std::string last;
+  Json* parent = patch_parent(root, toks, &last);
+  if (parent->is_object()) {
+    parent->set(last, std::move(value));
+  } else if (parent->is_array()) {
+    if (last == "-") {
+      parent->push_back(std::move(value));
+    } else {
+      size_t idx;
+      if (!parse_array_index(last, &idx) || idx > parent->size())
+        throw JsonError("patch add bad index '" + last + "'");
+      parent->items().insert(parent->items().begin() + static_cast<long>(idx), std::move(value));
+    }
+  } else {
+    throw JsonError("patch add target is a scalar");
+  }
+}
+
+void patch_remove(Json& root, const std::string& path) {
+  auto toks = pointer_tokens(path);
+  if (toks.empty()) throw JsonError("cannot remove whole document");
+  std::string last;
+  Json* parent = patch_parent(root, toks, &last);
+  if (parent->is_object()) {
+    if (!parent->erase(last)) throw JsonError("patch remove missing key '" + last + "'");
+  } else if (parent->is_array()) {
+    size_t idx;
+    if (!parse_array_index(last, &idx) || idx >= parent->size())
+      throw JsonError("patch remove bad index '" + last + "'");
+    parent->items().erase(parent->items().begin() + static_cast<long>(idx));
+  } else {
+    throw JsonError("patch remove target is a scalar");
+  }
+}
+
+}  // namespace
+
+void Json::apply_patch(const Json& patch) {
+  if (!patch.is_array()) throw JsonError("patch must be an array");
+  for (const auto& op : patch.items()) {
+    if (!op.is_object()) throw JsonError("patch op must be an object");
+    const std::string kind = op.get_string("op");
+    const std::string path = op.get_string("path");
+    if (kind == "add") {
+      patch_add(*this, path, op.get("value"));
+    } else if (kind == "remove") {
+      patch_remove(*this, path);
+    } else if (kind == "replace") {
+      patch_remove(*this, path);
+      patch_add(*this, path, op.get("value"));
+    } else if (kind == "test") {
+      if (patch_get(*this, path) != op.get("value"))
+        throw JsonError("patch test failed at " + path);
+    } else if (kind == "copy") {
+      patch_add(*this, path, patch_get(*this, op.get_string("from")));
+    } else if (kind == "move") {
+      Json v = patch_get(*this, op.get_string("from"));
+      patch_remove(*this, op.get_string("from"));
+      patch_add(*this, path, std::move(v));
+    } else {
+      throw JsonError("unknown patch op: " + kind);
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Parser
+// ---------------------------------------------------------------------------
+
+namespace {
+
+class Parser {
+ public:
+  explicit Parser(const std::string& text) : s_(text) {}
+
+  Json parse_document() {
+    Json v = parse_value();
+    skip_ws();
+    if (pos_ != s_.size()) fail("trailing characters");
+    return v;
+  }
+
+ private:
+  [[noreturn]] void fail(const std::string& msg) {
+    throw JsonError("json parse error at byte " + std::to_string(pos_) + ": " + msg);
+  }
+
+  void skip_ws() {
+    while (pos_ < s_.size()) {
+      char c = s_[pos_];
+      if (c == ' ' || c == '\t' || c == '\n' || c == '\r')
+        ++pos_;
+      else
+        break;
+    }
+  }
+
+  char peek() {
+    if (pos_ >= s_.size()) fail("unexpected end of input");
+    return s_[pos_];
+  }
+
+  void expect_literal(const char* lit) {
+    size_t n = std::strlen(lit);
+    if (s_.compare(pos_, n, lit) != 0) fail(std::string("expected '") + lit + "'");
+    pos_ += n;
+  }
+
+  Json parse_value() {
+    skip_ws();
+    char c = peek();
+    switch (c) {
+      case '{':
+        return parse_object();
+      case '[':
+        return parse_array();
+      case '"':
+        return Json(parse_string());
+      case 't':
+        expect_literal("true");
+        return Json(true);
+      case 'f':
+        expect_literal("false");
+        return Json(false);
+      case 'n':
+        expect_literal("null");
+        return Json(nullptr);
+      default:
+        return parse_number();
+    }
+  }
+
+  Json parse_object() {
+    ++pos_;  // '{'
+    Json obj = Json::object();
+    skip_ws();
+    if (peek() == '}') {
+      ++pos_;
+      return obj;
+    }
+    while (true) {
+      skip_ws();
+      if (peek() != '"') fail("expected object key");
+      std::string key = parse_string();
+      skip_ws();
+      if (peek() != ':') fail("expected ':'");
+      ++pos_;
+      obj.set(key, parse_value());
+      skip_ws();
+      char c = peek();
+      if (c == ',') {
+        ++pos_;
+        continue;
+      }
+      if (c == '}') {
+        ++pos_;
+        return obj;
+      }
+      fail("expected ',' or '}'");
+    }
+  }
+
+  Json parse_array() {
+    ++pos_;  // '['
+    Json arr = Json::array();
+    skip_ws();
+    if (peek() == ']') {
+      ++pos_;
+      return arr;
+    }
+    while (true) {
+      arr.push_back(parse_value());
+      skip_ws();
+      char c = peek();
+      if (c == ',') {
+        ++pos_;
+        continue;
+      }
+      if (c == ']') {
+        ++pos_;
+        return arr;
+      }
+      fail("expected ',' or ']'");
+    }
+  }
+
+  void append_utf8(std::string& out, uint32_t cp) {
+    if (cp < 0x80) {
+      out += static_cast<char>(cp);
+    } else if (cp < 0x800) {
+      out += static_cast<char>(0xC0 | (cp >> 6));
+      out += static_cast<char>(0x80 | (cp & 0x3F));
+    } else if (cp < 0x10000) {
+      out += static_cast<char>(0xE0 | (cp >> 12));
+      out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+      out += static_cast<char>(0x80 | (cp & 0x3F));
+    } else {
+      out += static_cast<char>(0xF0 | (cp >> 18));
+      out += static_cast<char>(0x80 | ((cp >> 12) & 0x3F));
+      out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+      out += static_cast<char>(0x80 | (cp & 0x3F));
+    }
+  }
+
+  uint32_t parse_hex4() {
+    if (pos_ + 4 > s_.size()) fail("bad \\u escape");
+    uint32_t v = 0;
+    for (int i = 0; i < 4; ++i) {
+      char c = s_[pos_++];
+      v <<= 4;
+      if (c >= '0' && c <= '9')
+        v |= static_cast<uint32_t>(c - '0');
+      else if (c >= 'a' && c <= 'f')
+        v |= static_cast<uint32_t>(c - 'a' + 10);
+      else if (c >= 'A' && c <= 'F')
+        v |= static_cast<uint32_t>(c - 'A' + 10);
+      else
+        fail("bad hex digit in \\u escape");
+    }
+    return v;
+  }
+
+  std::string parse_string() {
+    ++pos_;  // '"'
+    std::string out;
+    while (true) {
+      if (pos_ >= s_.size()) fail("unterminated string");
+      char c = s_[pos_++];
+      if (c == '"') return out;
+      if (c == '\\') {
+        if (pos_ >= s_.size()) fail("unterminated escape");
+        char e = s_[pos_++];
+        switch (e) {
+          case '"':
+            out += '"';
+            break;
+          case '\\':
+            out += '\\';
+            break;
+          case '/':
+            out += '/';
+            break;
+          case 'b':
+            out += '\b';
+            break;
+          case 'f':
+            out += '\f';
+            break;
+          case 'n':
+            out += '\n';
+            break;
+          case 'r':
+            out += '\r';
+            break;
+          case 't':
+            out += '\t';
+            break;
+          case 'u': {
+            uint32_t cp = parse_hex4();
+            if (cp >= 0xD800 && cp <= 0xDBFF) {  // surrogate pair
+              if (pos_ + 1 < s_.size() && s_[pos_] == '\\' && s_[pos_ + 1] == 'u') {
+                pos_ += 2;
+                uint32_t lo = parse_hex4();
+                if (lo >= 0xDC00 && lo <= 0xDFFF)
+                  cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+                else
+                  fail("bad low surrogate");
+              } else {
+                fail("lone high surrogate");
+              }
+            }
+            append_utf8(out, cp);
+            break;
+          }
+          default:
+            fail("bad escape character");
+        }
+      } else {
+        out += c;
+      }
+    }
+  }
+
+  Json parse_number() {
+    size_t start = pos_;
+    if (peek() == '-') ++pos_;
+    bool is_double = false;
+    while (pos_ < s_.size()) {
+      char c = s_[pos_];
+      if (c >= '0' && c <= '9') {
+        ++pos_;
+      } else if (c == '.' || c == 'e' || c == 'E' || c == '+' || c == '-') {
+        is_double = true;
+        ++pos_;
+      } else {
+        break;
+      }
+    }
+    std::string tok = s_.substr(start, pos_ - start);
+    if (tok.empty() || tok == "-") fail("bad number");
+    if (!is_double) {
+      errno = 0;
+      char* end = nullptr;
+      long long v = std::strtoll(tok.c_str(), &end, 10);
+      if (errno == 0 && end && *end == '\0') return Json(static_cast<int64_t>(v));
+      is_double = true;  // out of int64 range: fall through
+    }
+    char* end = nullptr;
+    double d = std::strtod(tok.c_str(), &end);
+    if (!end || *end != '\0') fail("bad number: " + tok);
+    return Json(d);
+  }
+
+  const std::string& s_;
+  size_t pos_ = 0;
+};
+
+void dump_string(std::string& out, const std::string& s) {
+  out += '"';
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"':
+        out += "\\\"";
+        break;
+      case '\\':
+        out += "\\\\";
+        break;
+      case '\b':
+        out += "\\b";
+        break;
+      case '\f':
+        out += "\\f";
+        break;
+      case '\n':
+        out += "\\n";
+        break;
+      case '\r':
+        out += "\\r";
+        break;
+      case '\t':
+        out += "\\t";
+        break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out += static_cast<char>(c);
+        }
+    }
+  }
+  out += '"';
+}
+
+std::string dump_double(double d) {
+  if (std::isfinite(d)) {
+    char buf[32];
+    std::snprintf(buf, sizeof(buf), "%.17g", d);
+    // trim to shortest round-trip-safe representation
+    for (int prec = 1; prec < 17; ++prec) {
+      char tight[32];
+      std::snprintf(tight, sizeof(tight), "%.*g", prec, d);
+      if (std::strtod(tight, nullptr) == d) return tight;
+    }
+    return buf;
+  }
+  return "null";  // JSON has no NaN/Inf
+}
+
+}  // namespace
+
+Json Json::parse(const std::string& text) { return Parser(text).parse_document(); }
+
+void Json::dump_to(std::string& out, int indent, int depth) const {
+  auto newline = [&](int d) {
+    if (indent > 0) {
+      out += '\n';
+      out.append(static_cast<size_t>(indent * d), ' ');
+    }
+  };
+  switch (type_) {
+    case JsonType::Null:
+      out += "null";
+      break;
+    case JsonType::Bool:
+      out += bool_ ? "true" : "false";
+      break;
+    case JsonType::Int:
+      out += std::to_string(int_);
+      break;
+    case JsonType::Double:
+      out += dump_double(double_);
+      break;
+    case JsonType::String:
+      dump_string(out, str_);
+      break;
+    case JsonType::Array: {
+      out += '[';
+      for (size_t i = 0; i < arr_.size(); ++i) {
+        if (i) out += ',';
+        newline(depth + 1);
+        arr_[i].dump_to(out, indent, depth + 1);
+      }
+      if (!arr_.empty()) newline(depth);
+      out += ']';
+      break;
+    }
+    case JsonType::Object: {
+      out += '{';
+      for (size_t i = 0; i < members_.size(); ++i) {
+        if (i) out += ',';
+        newline(depth + 1);
+        dump_string(out, members_[i].first);
+        out += ':';
+        if (indent > 0) out += ' ';
+        members_[i].second.dump_to(out, indent, depth + 1);
+      }
+      if (!members_.empty()) newline(depth);
+      out += '}';
+      break;
+    }
+  }
+}
+
+std::string Json::dump() const {
+  std::string out;
+  dump_to(out, 0, 0);
+  return out;
+}
+
+std::string Json::dump(int indent) const {
+  std::string out;
+  dump_to(out, indent, 0);
+  return out;
+}
+
+bool Json::operator==(const Json& other) const {
+  if (type_ != other.type_) {
+    // ints and doubles compare by numeric value (RFC 6902 test semantics)
+    if (is_number() && other.is_number()) return as_double() == other.as_double();
+    return false;
+  }
+  switch (type_) {
+    case JsonType::Null:
+      return true;
+    case JsonType::Bool:
+      return bool_ == other.bool_;
+    case JsonType::Int:
+      return int_ == other.int_;
+    case JsonType::Double:
+      return double_ == other.double_;
+    case JsonType::String:
+      return str_ == other.str_;
+    case JsonType::Array:
+      return arr_ == other.arr_;
+    case JsonType::Object: {
+      // order-insensitive object equality
+      if (members_.size() != other.members_.size()) return false;
+      for (const auto& m : members_) {
+        const Json* o = other.find(m.first);
+        if (!o || !(m.second == *o)) return false;
+      }
+      return true;
+    }
+  }
+  return false;
+}
+
+}  // namespace tpubc

@@ -1,0 +1,75 @@
+#include "tpubc/log.h"
+
+#include <cstdio>
+#include <cstdlib>
+#include <mutex>
+
+#include "tpubc/util.h"
+
+namespace tpubc {
+
+namespace {
+
+std::string g_target = "tpubc";
+LogLevel g_level = LogLevel::Info;
+std::mutex g_mutex;
+
+const char* level_name(LogLevel l) {
+  switch (l) {
+    case LogLevel::Error:
+      return "ERROR";
+    case LogLevel::Warn:
+      return " WARN";
+    case LogLevel::Info:
+      return " INFO";
+    case LogLevel::Debug:
+      return "DEBUG";
+    case LogLevel::Trace:
+      return "TRACE";
+  }
+  return "?";
+}
+
+LogLevel parse_level(const std::string& s) {
+  std::string l = to_lower(s);
+  if (l == "error") return LogLevel::Error;
+  if (l == "warn") return LogLevel::Warn;
+  if (l == "debug") return LogLevel::Debug;
+  if (l == "trace") return LogLevel::Trace;
+  return LogLevel::Info;
+}
+
+}  // namespace
+
+void log_init(const std::string& target) {
+  g_target = target;
+  const char* env = std::getenv("TPUBC_LOG");
+  if (!env) env = std::getenv("RUST_LOG");  // honour the reference's knob
+  if (env) g_level = parse_level(env);
+}
+
+LogLevel log_level() { return g_level; }
+
+void log_event(LogLevel level, const std::string& message,
+               std::initializer_list<LogField> fields) {
+  if (static_cast<int>(level) > static_cast<int>(g_level)) return;
+  std::string line = now_rfc3339();
+  line += " ";
+  line += level_name(level);
+  line += " ";
+  line += g_target;
+  line += ": ";
+  line += message;
+  for (const auto& f : fields) {
+    line += " ";
+    line += f.first;
+    line += "=";
+    line += f.second;
+  }
+  line += "\n";
+  std::lock_guard<std::mutex> lock(g_mutex);
+  std::fwrite(line.data(), 1, line.size(), stderr);
+  std::fflush(stderr);
+}
+
+}  // namespace tpubc

@@ -1,0 +1,235 @@
+#include "tpubc/reconcile_core.h"
+
+#include "tpubc/crd.h"
+#include "tpubc/topology.h"
+#include "tpubc/util.h"
+
+namespace tpubc {
+
+namespace {
+
+Json meta(const std::string& name, const Json& oref) {
+  return Json::object({{"name", name}, {"ownerReferences", Json::array({oref})}});
+}
+
+Json meta_ns(const std::string& name, const std::string& ns, const Json& oref) {
+  Json m = meta(name, oref);
+  m.set("namespace", ns);
+  return m;
+}
+
+}  // namespace
+
+Json owner_reference(const Json& ub) {
+  const Json& m = ub.get("metadata");
+  return Json::object({
+      {"apiVersion", kApiVersion},
+      {"kind", kKind},
+      {"name", m.get_string("name")},
+      {"uid", m.get_string("uid")},
+      {"controller", true},
+      {"blockOwnerDeletion", true},
+  });
+}
+
+std::string target_namespace(const Json& ub) {
+  return to_lower(ub.get("metadata").get_string("name"));
+}
+
+Json default_controller_config() {
+  return Json::object({
+      {"requeue_secs", 30},
+      {"error_requeue_secs", 3},
+      {"workload_image", "python:3.12-slim"},
+  });
+}
+
+Json build_jobset(const Json& ub, const Json& config) {
+  const Json& tpu = ub.get("spec").get("tpu");
+  if (!tpu.is_object()) throw JsonError("build_jobset: spec.tpu is absent");
+
+  const std::string accelerator = tpu.get_string("accelerator");
+  const std::string topology = tpu.get_string("topology");
+  SliceGeometry geom = slice_geometry(accelerator, topology);
+
+  const std::string ns = target_namespace(ub);
+  const std::string name = ns + "-slice";
+
+  std::string image = tpu.get_string("image");
+  if (image.empty()) image = config.get_string("workload_image", "python:3.12-slim");
+
+  Json container = Json::object({
+      {"name", "tpu-worker"},
+      {"image", image},
+      // Port 8471 is the TPU runtime's inter-host ICI bootstrap port; 8080
+      // serves the JAX coordinator (megascale) endpoint on worker 0.
+      {"ports", Json::array({
+                    Json::object({{"containerPort", 8471}, {"name", "tpu-runtime"}}),
+                    Json::object({{"containerPort", 8080}, {"name", "coordinator"}}),
+                })},
+      {"resources", Json::object({
+                        {"requests", Json::object({{kTpuResource, geom.chips_per_host}})},
+                        {"limits", Json::object({{kTpuResource, geom.chips_per_host}})},
+                    })},
+  });
+  if (tpu.get("command").is_array()) container.set("command", tpu.get("command"));
+  if (tpu.get("args").is_array()) container.set("args", tpu.get("args"));
+
+  Json pod_spec = Json::object({
+      {"nodeSelector", Json::object({
+                           {kTpuAcceleratorNodeSelector, accelerator},
+                           {kTpuTopologyNodeSelector, topology},
+                       })},
+      {"containers", Json::array({container})},
+      {"restartPolicy", "Never"},
+  });
+
+  Json job_template = Json::object({
+      {"spec", Json::object({
+                   // Gang shape: one indexed completion per slice host.
+                   {"parallelism", geom.hosts},
+                   {"completions", geom.hosts},
+                   {"completionMode", "Indexed"},
+                   {"backoffLimit", 0},
+                   {"template", Json::object({{"spec", pod_spec}})},
+               })},
+  });
+
+  int64_t max_restarts = tpu.get_int("max_restarts", 0);
+
+  return Json::object({
+      {"apiVersion", "jobset.x-k8s.io/v1alpha2"},
+      {"kind", "JobSet"},
+      {"metadata",
+       [&] {
+         Json m = meta_ns(name, ns, owner_reference(ub));
+         // All child jobs of one replicated job land on one ICI-connected
+         // slice: JobSet's exclusive-topology annotation pins the gang to a
+         // single node pool, the TPU analogue of NCCL clique placement.
+         m.set("annotations", Json::object({{"alpha.jobset.sigs.k8s.io/exclusive-topology",
+                                             "cloud.google.com/gke-nodepool"}}));
+         return m;
+       }()},
+      {"spec", Json::object({
+                   {"failurePolicy", Json::object({{"maxRestarts", max_restarts}})},
+                   {"replicatedJobs", Json::array({Json::object({
+                        {"name", "workers"},
+                        {"replicas", 1},
+                        {"template", job_template},
+                    })})},
+               })},
+  });
+}
+
+std::vector<Json> desired_children(const Json& ub, const Json& config) {
+  std::vector<Json> children;
+  const Json oref = owner_reference(ub);
+  const std::string ns = target_namespace(ub);
+  const Json& spec = ub.get("spec");
+  const bool synchronized =
+      ub.get("status").get_bool("synchronized_with_sheet", false);
+
+  // 1. Namespace — always (controller.rs:70-87).
+  children.push_back(Json::object({
+      {"apiVersion", "v1"},
+      {"kind", "Namespace"},
+      {"metadata", meta(ns, oref)},
+  }));
+
+  // 2. ResourceQuota — iff spec.quota (controller.rs:90-110).
+  if (spec.get("quota").is_object()) {
+    children.push_back(Json::object({
+        {"apiVersion", "v1"},
+        {"kind", "ResourceQuota"},
+        {"metadata", meta_ns(ns, ns, oref)},
+        {"spec", spec.get("quota")},
+    }));
+  }
+
+  // 3. Role — iff spec.role (controller.rs:113-124). The CR's role carries
+  // rules; the controller stamps name/namespace/ownership.
+  if (spec.get("role").is_object()) {
+    Json role = Json::object({
+        {"apiVersion", "rbac.authorization.k8s.io/v1"},
+        {"kind", "Role"},
+        {"metadata", meta_ns(ns, ns, oref)},
+    });
+    if (spec.get("role").get("rules").is_array()) role.set("rules", spec.get("role").get("rules"));
+    children.push_back(std::move(role));
+  }
+
+  // 4. RoleBinding — iff spec.rolebinding AND sheet-synchronized
+  // (controller.rs:127-152). The interlock keeps namespace access shut
+  // until an admin approves the sheet row.
+  if (spec.get("rolebinding").is_object() && synchronized) {
+    const Json& rb = spec.get("rolebinding");
+    const Json& role_ref = rb.get("role_ref");
+    Json subjects = Json::array();
+    if (rb.get("subjects").is_array()) {
+      for (const auto& s : rb.get("subjects").items()) {
+        Json subject = Json::object({
+            {"kind", s.get_string("kind", "User")},
+            {"name", s.get_string("name")},
+        });
+        if (!s.get_string("api_group").empty()) subject.set("apiGroup", s.get_string("api_group"));
+        if (!s.get_string("namespace").empty()) subject.set("namespace", s.get_string("namespace"));
+        subjects.push_back(std::move(subject));
+      }
+    }
+    children.push_back(Json::object({
+        {"apiVersion", "rbac.authorization.k8s.io/v1"},
+        {"kind", "RoleBinding"},
+        {"metadata", meta_ns(ns, ns, oref)},
+        {"roleRef", Json::object({
+                        {"apiGroup", role_ref.get_string("api_group", "rbac.authorization.k8s.io")},
+                        {"kind", role_ref.get_string("kind", "ClusterRole")},
+                        {"name", role_ref.get_string("name")},
+                    })},
+        {"subjects", subjects},
+    }));
+  }
+
+  // 5. JobSet — iff spec.tpu AND sheet-synchronized. Same interlock as the
+  // RoleBinding: chips are only granted after sheet approval lands quota.
+  if (spec.get("tpu").is_object() && synchronized) {
+    children.push_back(build_jobset(ub, config));
+  }
+
+  return children;
+}
+
+Json slice_status(const Json& ub, const Json& observed_jobset) {
+  const Json& tpu = ub.get("spec").get("tpu");
+  if (!tpu.is_object()) {
+    return Json::object({{"phase", "Absent"}});
+  }
+  Json st = Json::object({
+      {"phase", "Pending"},
+      {"chips", tpu.get_int("chips", 0)},
+      {"hosts", tpu.get_int("hosts", 0)},
+  });
+  if (observed_jobset.is_object()) {
+    st.set("jobset", observed_jobset.get("metadata").get_string("name"));
+    st.set("phase", "Provisioning");
+    const Json& conds = observed_jobset.get("status").get("conditions");
+    if (conds.is_array()) {
+      for (const auto& c : conds.items()) {
+        const std::string type = c.get_string("type");
+        if (c.get_string("status") == "True") {
+          if (type == "Completed") st.set("phase", "Running");
+          if (type == "Failed") st.set("phase", "Failed");
+        }
+      }
+    }
+    // Any active replicated job counts as Running for the slice.
+    const Json& rjs = observed_jobset.get("status").get("replicatedJobsStatus");
+    if (rjs.is_array()) {
+      for (const auto& rj : rjs.items()) {
+        if (rj.get_int("active", 0) > 0 || rj.get_int("ready", 0) > 0) st.set("phase", "Running");
+      }
+    }
+  }
+  return st;
+}
+
+}  // namespace tpubc

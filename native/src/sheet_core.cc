@@ -1,0 +1,278 @@
+#include "tpubc/sheet_core.h"
+
+#include "tpubc/util.h"
+
+namespace tpubc {
+
+std::vector<std::vector<std::string>> parse_csv_records(const std::string& content) {
+  std::vector<std::vector<std::string>> rows;
+  std::vector<std::string> row;
+  std::string cell;
+  bool in_quotes = false;
+  bool cell_started = false;
+
+  auto end_cell = [&] {
+    row.push_back(cell);
+    cell.clear();
+    cell_started = false;
+  };
+  auto end_row = [&] {
+    end_cell();
+    rows.push_back(row);
+    row.clear();
+  };
+
+  for (size_t i = 0; i < content.size(); ++i) {
+    char c = content[i];
+    if (in_quotes) {
+      if (c == '"') {
+        if (i + 1 < content.size() && content[i + 1] == '"') {
+          cell += '"';
+          ++i;
+        } else {
+          in_quotes = false;
+        }
+      } else {
+        cell += c;
+      }
+    } else {
+      if (c == '"' && !cell_started && cell.empty()) {
+        in_quotes = true;
+        cell_started = true;
+      } else if (c == ',') {
+        end_cell();
+      } else if (c == '\r') {
+        // swallow; \n handles the row break
+      } else if (c == '\n') {
+        end_row();
+      } else {
+        cell += c;
+        cell_started = true;
+      }
+    }
+  }
+  if (!cell.empty() || !row.empty()) end_row();
+  return rows;
+}
+
+std::string infer_header(const std::string& header) {
+  // Exact matches first (synchronizer.rs:99-107).
+  if (header == "타임스탬프") return "timestamp";
+  if (header == "이름") return "name";
+  if (header == "소속") return "department";
+  // Substring heuristics. Korean strings from the Bacchus request form plus
+  // English fallbacks so plain-English sheets work out of the box.
+  if (contains(header, "SNUCSE ID")) return "id_username";
+  if (contains(header, "사용할 서버")) return "server";
+  if (contains(header, "TPU 칩") || contains(header, "TPU 개수")) return "tpu_request";
+  if (contains(header, "GPU 개수")) return "gpu_request";
+  if (contains(header, "vCPU 개수")) return "cpu_request";
+  if (contains(header, "메모리")) return "memory_request";
+  if (contains(header, "스토리지")) return "storage_request";
+  if (contains(header, "MiG 개수")) return "mig_request";
+  if (contains(header, "요청 사유")) return "description";
+  if (contains(header, "승인")) return "authorized";
+  if (contains(header, "이메일")) return "email";
+  // English fallbacks (case-insensitive on the whole header).
+  std::string h = to_lower(header);
+  if (h == "timestamp") return "timestamp";
+  if (h == "name") return "name";
+  if (h == "department") return "department";
+  if (contains(h, "username") || h == "id") return "id_username";
+  if (contains(h, "server")) return "server";
+  if (contains(h, "tpu")) return "tpu_request";
+  if (contains(h, "gpu")) return "gpu_request";
+  if (contains(h, "mig")) return "mig_request";
+  if (contains(h, "cpu")) return "cpu_request";
+  if (contains(h, "memory")) return "memory_request";
+  if (contains(h, "storage")) return "storage_request";
+  if (contains(h, "authorized") || contains(h, "approved")) return "authorized";
+  if (contains(h, "email")) return "email";
+  if (contains(h, "description") || contains(h, "reason")) return "description";
+  return "";
+}
+
+namespace {
+
+// Fields a row must carry to be usable; missing/non-integer numerics make
+// the row malformed (skipped with a warning, synchronizer.rs:158-166).
+const char* kStringFields[] = {"name", "department", "id_username", "server", "authorized"};
+const char* kIntFields[] = {"cpu_request", "memory_request", "storage_request"};
+// Device counts: at least one of tpu/gpu must be present; both default 0.
+const char* kOptionalIntFields[] = {"tpu_request", "gpu_request", "mig_request"};
+
+bool parse_int_cell(const std::string& cell, int64_t* out) {
+  std::string t = trim(cell);
+  if (t.empty()) return false;
+  size_t i = (t[0] == '-') ? 1 : 0;
+  if (i == t.size()) return false;
+  for (; i < t.size(); ++i)
+    if (t[i] < '0' || t[i] > '9') return false;
+  *out = std::stoll(t);
+  return true;
+}
+
+}  // namespace
+
+Json parse_sheet(const std::string& csv_content) {
+  auto records = parse_csv_records(csv_content);
+  Json rows = Json::array();
+  Json warnings = Json::array();
+  if (records.empty()) {
+    return Json::object({{"rows", rows}, {"warnings", warnings}});
+  }
+
+  // Header inference is a hard error on unknown columns, like the
+  // reference's CsvHeaderError (synchronizer.rs:139-142): a renamed form
+  // column should page an operator, not silently drop quota updates.
+  std::vector<std::string> fields;
+  for (const auto& h : records[0]) {
+    std::string f = infer_header(trim(h));
+    if (f.empty()) throw JsonError("unknown header: \"" + trim(h) + "\"");
+    fields.push_back(f);
+  }
+
+  for (size_t r = 1; r < records.size(); ++r) {
+    const auto& rec = records[r];
+    if (rec.size() == 1 && trim(rec[0]).empty()) continue;  // blank line
+    Json row = Json::object();
+    for (size_t c = 0; c < fields.size() && c < rec.size(); ++c) row.set(fields[c], rec[c]);
+
+    bool ok = true;
+    std::string why;
+    for (const char* f : kStringFields) {
+      if (!row.contains(f)) {
+        ok = false;
+        why = std::string("missing field ") + f;
+        break;
+      }
+    }
+    if (ok) {
+      for (const char* f : kIntFields) {
+        int64_t v = 0;
+        if (!row.contains(f) || !parse_int_cell(row.get(f).as_string(), &v)) {
+          ok = false;
+          why = std::string("bad integer field ") + f;
+          break;
+        }
+        row.set(f, v);
+      }
+    }
+    if (ok) {
+      for (const char* f : kOptionalIntFields) {
+        int64_t v = 0;
+        if (row.contains(f) && parse_int_cell(row.get(f).as_string(), &v)) {
+          row.set(f, v);
+        } else {
+          row.set(f, 0);
+        }
+      }
+    }
+    if (!ok) {
+      warnings.push_back("row " + std::to_string(r) + " skipped: " + why);
+      continue;
+    }
+    rows.push_back(std::move(row));
+  }
+  return Json::object({{"rows", rows}, {"warnings", warnings}});
+}
+
+Json default_synchronizer_config() {
+  return Json::object({
+      {"server_name", ""},
+      {"device", "tpu"},
+      {"pool_capacity_chips", 0},
+  });
+}
+
+Json build_quota(const Json& row, const std::string& device) {
+  Json hard = Json::object();
+  hard.set("requests.cpu", std::to_string(row.get_int("cpu_request")));
+  hard.set("requests.memory", std::to_string(row.get_int("memory_request")) + "Gi");
+  hard.set("limits.cpu", std::to_string(row.get_int("cpu_request")));
+  hard.set("limits.memory", std::to_string(row.get_int("memory_request")) + "Gi");
+  if (device == "gpu") {
+    // Reference key set, verbatim (synchronizer.rs:267-278).
+    hard.set("requests.nvidia.com/gpu", std::to_string(row.get_int("gpu_request")));
+    hard.set("requests.storage", std::to_string(row.get_int("storage_request")) + "Gi");
+    hard.set("requests.nvidia.com/mig-1g.10gb", std::to_string(row.get_int("mig_request")));
+  } else {
+    hard.set("requests.google.com/tpu", std::to_string(row.get_int("tpu_request")));
+    hard.set("requests.storage", std::to_string(row.get_int("storage_request")) + "Gi");
+  }
+  return Json::object({{"hard", hard}});
+}
+
+Json plan_sync(const Json& ub_list, const Json& rows, const Json& config) {
+  const std::string server = config.get_string("server_name");
+  const std::string device = config.get_string("device", "tpu");
+  const int64_t capacity = config.get_int("pool_capacity_chips", 0);
+
+  // Server filter: substring, not equality (synchronizer.rs:211 NOTE).
+  std::vector<const Json*> filtered;
+  for (const auto& row : rows.items()) {
+    if (server.empty() || contains(row.get_string("server"), server)) filtered.push_back(&row);
+  }
+
+  Json actions = Json::array();
+  Json skipped = Json::array();
+  int64_t used_chips = 0;
+
+  for (const auto& ub : ub_list.items()) {
+    const std::string name = ub.get("metadata").get_string("name");
+    if (name.empty()) continue;
+
+    // Last matching authorized row wins (synchronizer.rs:225-236: iterate
+    // reversed, first hit) — resubmitted forms supersede older rows.
+    const Json* match = nullptr;
+    for (auto it = filtered.rbegin(); it != filtered.rend(); ++it) {
+      const Json& row = **it;
+      if (to_lower(trim(row.get_string("authorized"))) != "o") continue;
+      if (row.get_string("id_username") == name) {
+        match = &row;
+        break;
+      }
+    }
+    if (!match) continue;  // no row => leave the CR alone (sheet is source of truth)
+
+    const int64_t chips =
+        device == "gpu" ? match->get_int("gpu_request") : match->get_int("tpu_request");
+    if (capacity > 0 && used_chips + chips > capacity) {
+      skipped.push_back(Json::object({
+          {"name", name},
+          {"reason", "pool capacity exhausted: " + std::to_string(chips) + " chips requested, " +
+                         std::to_string(capacity - used_chips) + " remaining of " +
+                         std::to_string(capacity)},
+      }));
+      continue;
+    }
+    used_chips += chips;
+
+    Json quota = build_quota(*match, device);
+
+    // Patch sequence mirrors synchronizer.rs:240-287: ensure the key exists,
+    // then replace with the full quota.
+    Json patches = Json::array();
+    if (!ub.get("spec").get("quota").is_object()) {
+      patches.push_back(
+          Json::object({{"op", "add"}, {"path", "/spec/quota"}, {"value", Json::object()}}));
+    }
+    patches.push_back(Json::object({{"op", "replace"}, {"path", "/spec/quota"}, {"value", quota}}));
+
+    actions.push_back(Json::object({
+        {"name", name},
+        {"chips", chips},
+        {"quota", quota},
+        {"patches", patches},
+        // Status is written before the quota patch (synchronizer.rs:302 vs
+        // :324) so the controller's interlocks open as soon as possible.
+        {"status", Json::object({{"synchronized_with_sheet", true}})},
+        {"resource_version", ub.get("metadata").get_string("resourceVersion")},
+    }));
+  }
+
+  return Json::object(
+      {{"actions", actions}, {"skipped", skipped}, {"total_chips", used_chips}});
+}
+
+}  // namespace tpubc

@@ -1,0 +1,158 @@
+#include "tpubc/topology.h"
+
+#include <algorithm>
+#include <map>
+
+namespace tpubc {
+
+namespace {
+
+// Per-accelerator compatibility table. Encodes the public GKE TPU node-pool
+// rules: which topologies exist for each accelerator value, how many chips a
+// single host carries, and the single-host chip ceiling (slices at or below
+// it run on one VM; larger slices are multi-host with a fixed chips/host).
+struct AcceleratorTable {
+  int ndims;                              // required topology rank
+  int64_t multi_host_chips_per_host;      // chips/host once multi-host
+  int64_t single_host_max_chips;          // <= this product => single host
+  std::vector<std::string> topologies;    // allowed topology strings
+};
+
+const std::map<std::string, AcceleratorTable>& tables() {
+  static const std::map<std::string, AcceleratorTable> kTables = {
+      // v4 pod slices: 3D torus, 4 chips per host, always multi-host layout
+      // (the 2x2x1 slice is one host of 4 chips).
+      {"tpu-v4-podslice",
+       {3, 4, 4,
+        {"2x2x1", "2x2x2", "2x2x4", "2x4x4", "4x4x4", "4x4x8", "4x8x8", "8x8x8",
+         "8x8x16", "8x16x16", "16x16x16"}}},
+      // v5e (v5 lite) pod slices: 2D, single host up to 8 chips, multi-host
+      // slices expose 4 chips per host.
+      {"tpu-v5-lite-podslice",
+       {2, 4, 8, {"1x1", "2x2", "2x4", "4x4", "4x8", "8x8", "8x16", "16x16"}}},
+      // v5e single-host device pool (serving-oriented): 1, 4 or 8 chips.
+      {"tpu-v5-lite-device", {2, 8, 8, {"1x1", "2x2", "2x4"}}},
+      // v5p slices: 3D torus, 4 chips per host.
+      {"tpu-v5p-slice",
+       {3, 4, 4,
+        {"2x2x1", "2x2x2", "2x2x4", "2x4x4", "4x4x4", "4x4x8", "4x8x8", "8x8x8",
+         "8x8x16", "8x16x16", "12x12x12", "16x16x16"}}},
+      // v6e (Trillium): 2D, same host layout rules as v5e.
+      {"tpu-v6e-slice",
+       {2, 4, 8, {"1x1", "2x2", "2x4", "4x4", "4x8", "8x8", "8x16", "16x16"}}},
+  };
+  return kTables;
+}
+
+}  // namespace
+
+Json SliceGeometry::to_json() const {
+  Json dims_json = Json::array();
+  for (int64_t d : dims) dims_json.push_back(d);
+  return Json::object({
+      {"accelerator", accelerator},
+      {"topology", topology},
+      {"dims", dims_json},
+      {"chips", chips},
+      {"hosts", hosts},
+      {"chips_per_host", chips_per_host},
+      {"multi_host", multi_host},
+  });
+}
+
+std::vector<int64_t> parse_topology(const std::string& topology) {
+  std::vector<int64_t> dims;
+  std::string cur;
+  for (char c : topology) {
+    if (c == 'x' || c == 'X') {
+      if (cur.empty()) throw JsonError("malformed topology: " + topology);
+      dims.push_back(std::stoll(cur));
+      cur.clear();
+    } else if (c >= '0' && c <= '9') {
+      cur += c;
+    } else {
+      throw JsonError("malformed topology: " + topology);
+    }
+  }
+  if (cur.empty()) throw JsonError("malformed topology: " + topology);
+  dims.push_back(std::stoll(cur));
+  if (dims.size() < 1 || dims.size() > 3) throw JsonError("malformed topology: " + topology);
+  for (int64_t d : dims)
+    if (d <= 0) throw JsonError("malformed topology: " + topology);
+  return dims;
+}
+
+const std::vector<std::string>& known_accelerators() {
+  static const std::vector<std::string> kNames = [] {
+    std::vector<std::string> names;
+    for (const auto& kv : tables()) names.push_back(kv.first);
+    return names;
+  }();
+  return kNames;
+}
+
+TopologyError validate_topology(const std::string& accelerator, const std::string& topology) {
+  auto it = tables().find(accelerator);
+  if (it == tables().end()) {
+    std::string known;
+    for (const auto& name : known_accelerators()) {
+      if (!known.empty()) known += ", ";
+      known += name;
+    }
+    return {false, "unknown accelerator \"" + accelerator + "\" (known: " + known + ")"};
+  }
+  const AcceleratorTable& table = it->second;
+
+  std::vector<int64_t> dims;
+  try {
+    dims = parse_topology(topology);
+  } catch (const JsonError&) {
+    return {false, "malformed topology \"" + topology + "\" (expected e.g. \"2x2\" or \"4x4x4\")"};
+  }
+  if (static_cast<int>(dims.size()) != table.ndims) {
+    return {false, "accelerator \"" + accelerator + "\" takes " + std::to_string(table.ndims) +
+                       "D topologies, got \"" + topology + "\""};
+  }
+  if (std::find(table.topologies.begin(), table.topologies.end(), topology) ==
+      table.topologies.end()) {
+    std::string allowed;
+    for (const auto& t : table.topologies) {
+      if (!allowed.empty()) allowed += ", ";
+      allowed += t;
+    }
+    return {false, "topology \"" + topology + "\" is not available for accelerator \"" +
+                       accelerator + "\" (allowed: " + allowed + ")"};
+  }
+  return {true, ""};
+}
+
+SliceGeometry slice_geometry(const std::string& accelerator, const std::string& topology) {
+  TopologyError err = validate_topology(accelerator, topology);
+  if (!err.ok) throw JsonError(err.reason);
+  const AcceleratorTable& table = tables().at(accelerator);
+
+  SliceGeometry g;
+  g.accelerator = accelerator;
+  g.topology = topology;
+  g.dims = parse_topology(topology);
+  g.chips = 1;
+  for (int64_t d : g.dims) g.chips *= d;
+  if (g.chips <= table.single_host_max_chips) {
+    g.hosts = 1;
+    g.chips_per_host = g.chips;
+    g.multi_host = false;
+  } else {
+    g.chips_per_host = table.multi_host_chips_per_host;
+    g.hosts = g.chips / g.chips_per_host;
+    g.multi_host = true;
+  }
+  return g;
+}
+
+std::string default_topology(const std::string& accelerator) {
+  auto it = tables().find(accelerator);
+  if (it == tables().end()) throw JsonError("unknown accelerator: " + accelerator);
+  return it->second.topologies.front();
+}
+
+}  // namespace tpubc

@@ -1,0 +1,141 @@
+#include "tpubc/yaml.h"
+
+#include <cctype>
+
+namespace tpubc {
+
+namespace {
+
+// YAML 1.1/1.2 plain-scalar ambiguity: quote anything that a YAML parser
+// might re-type (bools, numbers, null-likes) or that contains syntax chars.
+bool needs_quoting(const std::string& s) {
+  if (s.empty()) return true;
+  static const char* kAmbiguous[] = {"true", "false", "null", "~",   "yes", "no",
+                                     "on",   "off",   "True", "False", "Null", "Yes",
+                                     "No",   "On",    "Off",  "TRUE", "FALSE", "NULL"};
+  for (const char* a : kAmbiguous)
+    if (s == a) return true;
+  char c0 = s.front();
+  if (std::isdigit(static_cast<unsigned char>(c0)) || c0 == '-' || c0 == '+' || c0 == '.' ||
+      c0 == ' ' || c0 == '?' || c0 == ':' || c0 == '&' || c0 == '*' || c0 == '!' || c0 == '|' ||
+      c0 == '>' || c0 == '%' || c0 == '@' || c0 == '`' || c0 == '"' || c0 == '\'' || c0 == '#' ||
+      c0 == '[' || c0 == ']' || c0 == '{' || c0 == '}' || c0 == ',')
+    return true;
+  if (s.back() == ' ') return true;
+  for (size_t i = 0; i < s.size(); ++i) {
+    char c = s[i];
+    if (c == '\n' || c == '\t') return true;
+    if (c == '#' && i > 0 && s[i - 1] == ' ') return true;
+    if (c == ':' && (i + 1 == s.size() || s[i + 1] == ' ')) return true;
+  }
+  return false;
+}
+
+std::string quote(const std::string& s) {
+  std::string out = "\"";
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"':
+        out += "\\\"";
+        break;
+      case '\\':
+        out += "\\\\";
+        break;
+      case '\n':
+        out += "\\n";
+        break;
+      case '\t':
+        out += "\\t";
+        break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\x%02x", c);
+          out += buf;
+        } else {
+          out += static_cast<char>(c);
+        }
+    }
+  }
+  out += '"';
+  return out;
+}
+
+std::string scalar(const Json& v) {
+  switch (v.type()) {
+    case JsonType::Null:
+      return "null";
+    case JsonType::Bool:
+      return v.as_bool() ? "true" : "false";
+    case JsonType::Int:
+      return std::to_string(v.as_int());
+    case JsonType::Double: {
+      // reuse JSON dump for shortest round-trip form
+      return Json(v.as_double()).dump();
+    }
+    case JsonType::String: {
+      const std::string& s = v.as_string();
+      return needs_quoting(s) ? quote(s) : s;
+    }
+    default:
+      return "";
+  }
+}
+
+void emit(const Json& v, std::string& out, int depth, bool in_seq_item) {
+  std::string pad(static_cast<size_t>(depth) * 2, ' ');
+  if (v.is_object()) {
+    if (v.empty()) {
+      out += "{}\n";
+      return;
+    }
+    bool first = true;
+    for (const auto& m : v.members()) {
+      if (!(first && in_seq_item)) out += pad;
+      first = false;
+      const std::string key = needs_quoting(m.first) ? quote(m.first) : m.first;
+      if (m.second.is_object() && !m.second.empty()) {
+        out += key + ":\n";
+        emit(m.second, out, depth + 1, false);
+      } else if (m.second.is_array() && !m.second.empty()) {
+        out += key + ":\n";
+        emit(m.second, out, depth + 1, false);
+      } else if ((m.second.is_object() || m.second.is_array()) && m.second.empty()) {
+        out += key + ": " + (m.second.is_object() ? "{}" : "[]") + "\n";
+      } else {
+        out += key + ": " + scalar(m.second) + "\n";
+      }
+    }
+  } else if (v.is_array()) {
+    if (v.empty()) {
+      out += "[]\n";
+      return;
+    }
+    for (const auto& item : v.items()) {
+      out += pad + "- ";
+      if (item.is_object() && !item.empty()) {
+        emit(item, out, depth + 1, true);
+      } else if (item.is_array() && !item.empty()) {
+        out += "\n";
+        emit(item, out, depth + 1, false);
+      } else if ((item.is_object() || item.is_array()) && item.empty()) {
+        out += (item.is_object() ? "{}" : "[]");
+        out += "\n";
+      } else {
+        out += scalar(item) + "\n";
+      }
+    }
+  } else {
+    out += pad + scalar(v) + "\n";
+  }
+}
+
+}  // namespace
+
+std::string to_yaml(const Json& value) {
+  std::string out;
+  emit(value, out, 0, false);
+  return out;
+}
+
+}  // namespace tpubc

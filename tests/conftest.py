@@ -1,0 +1,31 @@
+"""Test harness config.
+
+* Forces JAX onto a virtual 8-device CPU mesh so multi-chip sharding tests
+  run without TPU hardware (the driver separately dry-runs the multichip
+  path via __graft_entry__.dryrun_multichip).
+* Builds the native tree once per session and exposes the ctypes bridge.
+"""
+
+import os
+import sys
+from pathlib import Path
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+import pytest  # noqa: E402
+
+from tpu_bootstrap import nativelib  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def lib() -> nativelib.NativeLib:
+    return nativelib.get()

@@ -1,0 +1,306 @@
+"""Admission policy core tests.
+
+Covers the full decision table of the reference webhook's mutate()
+(/root/reference/src/admission.rs:241-431 — which shipped untested) plus
+the TPU accelerator/topology mutation path (BASELINE config #2).
+"""
+
+import base64
+import json
+
+import pytest
+
+
+def req(
+    operation="CREATE",
+    username="oidc:alice",
+    groups=("tpu",),
+    name="alice",
+    spec=None,
+    uid="uid-1",
+):
+    obj = None
+    if operation != "DELETE":
+        obj = {
+            "apiVersion": "tpu.bacchus.io/v1",
+            "kind": "UserBootstrap",
+            "metadata": {"name": name},
+            "spec": spec if spec is not None else {},
+        }
+    r = {
+        "uid": uid,
+        "operation": operation,
+        "userInfo": {"username": username, "groups": list(groups)},
+    }
+    if obj is not None:
+        r["object"] = obj
+    return r
+
+
+def decode_patch(resp):
+    assert resp.get("patchType") == "JSONPatch"
+    return json.loads(base64.b64decode(resp["patch"]))
+
+
+def apply_response(lib, request, resp):
+    """Apply the response patch to the request object, like the API server."""
+    obj = request["object"]
+    if "patch" in resp:
+        return lib.json_patch(obj, decode_patch(resp))
+    return obj
+
+
+# -- classification ---------------------------------------------------------
+
+
+def test_classify_oidc_user(lib):
+    u = lib.classify_username("oidc:alice", "oidc:")
+    assert u == {"original": "oidc:alice", "kube": "alice", "is_admin": False}
+
+
+def test_classify_admin(lib):
+    u = lib.classify_username("system:admin", "oidc:")
+    assert u["is_admin"] is True
+    assert u["kube"] == "system:admin"
+
+
+# -- group / operation policy ----------------------------------------------
+
+
+def test_create_authorized_user_allowed(lib):
+    resp = lib.mutate(req(), lib.default_admission_config())
+    assert resp["allowed"] is True
+
+
+def test_create_unauthorized_group_denied(lib):
+    resp = lib.mutate(req(groups=("students",)), lib.default_admission_config())
+    assert resp["allowed"] is False
+    assert "authorized group" in resp["status"]["message"]
+    assert resp["status"]["code"] == 403
+
+
+def test_create_admin_bypasses_group_check(lib):
+    resp = lib.mutate(
+        req(username="admin-sam", groups=(), spec={"kube_username": "bob"}),
+        lib.default_admission_config(),
+    )
+    assert resp["allowed"] is True
+
+
+def test_normal_user_cannot_delete(lib):
+    resp = lib.mutate(req(operation="DELETE"), lib.default_admission_config())
+    assert resp["allowed"] is False
+    assert "delete" in resp["status"]["message"]
+
+
+def test_admin_can_delete(lib):
+    resp = lib.mutate(
+        req(operation="DELETE", username="admin-sam"), lib.default_admission_config()
+    )
+    assert resp["allowed"] is True
+    assert "patch" not in resp  # early allow, no mutation
+
+
+def test_normal_user_cannot_update(lib):
+    resp = lib.mutate(req(operation="UPDATE"), lib.default_admission_config())
+    assert resp["allowed"] is False
+    assert "update" in resp["status"]["message"]
+
+
+def test_connect_operation_invalid(lib):
+    resp = lib.mutate(req(operation="CONNECT"), lib.default_admission_config())
+    assert resp["allowed"] is False
+    assert resp["status"]["code"] == 400
+
+
+def test_missing_username_invalid(lib):
+    r = req()
+    del r["userInfo"]["username"]
+    resp = lib.mutate(r, lib.default_admission_config())
+    assert resp["allowed"] is False
+    assert resp["status"]["code"] == 400
+
+
+# -- self-service naming ----------------------------------------------------
+
+
+def test_name_mismatch_denied(lib):
+    resp = lib.mutate(req(name="bob"), lib.default_admission_config())
+    assert resp["allowed"] is False
+    assert "not match" in resp["status"]["message"]
+
+
+def test_admin_may_create_any_name(lib):
+    resp = lib.mutate(
+        req(username="root-admin", name="bob", spec={"kube_username": "bob"}),
+        lib.default_admission_config(),
+    )
+    assert resp["allowed"] is True
+
+
+# -- kube_username handling -------------------------------------------------
+
+
+def test_normal_user_gets_kube_username_injected(lib):
+    request = req()
+    resp = lib.mutate(request, lib.default_admission_config())
+    obj = apply_response(lib, request, resp)
+    assert obj["spec"]["kube_username"] == "alice"
+
+
+def test_admin_without_kube_username_denied(lib):
+    resp = lib.mutate(
+        req(username="root-admin", name="bob", spec={}), lib.default_admission_config()
+    )
+    assert resp["allowed"] is False
+    assert "kube_username" in resp["status"]["message"]
+
+
+# -- quota / rolebinding tamper rules --------------------------------------
+
+
+def test_normal_user_presetting_quota_denied(lib):
+    resp = lib.mutate(
+        req(spec={"quota": {"hard": {"requests.google.com/tpu": "256"}}}),
+        lib.default_admission_config(),
+    )
+    assert resp["allowed"] is False
+    assert "quota" in resp["status"]["message"]
+
+
+def test_normal_user_presetting_rolebinding_denied(lib):
+    resp = lib.mutate(
+        req(spec={"rolebinding": {"role_ref": {"api_group": "", "kind": "ClusterRole", "name": "admin"}}}),
+        lib.default_admission_config(),
+    )
+    assert resp["allowed"] is False
+    assert "rolebinding" in resp["status"]["message"]
+
+
+def test_default_rolebinding_for_normal_user(lib):
+    request = req()
+    resp = lib.mutate(request, lib.default_admission_config())
+    obj = apply_response(lib, request, resp)
+    rb = obj["spec"]["rolebinding"]
+    assert rb["role_ref"] == {
+        "api_group": "rbac.authorization.k8s.io",
+        "kind": "ClusterRole",
+        "name": "edit",
+    }
+    # Subject is the ORIGINAL (prefixed) username — the name the API server
+    # authenticates (admission.rs:392-394).
+    assert rb["subjects"] == [
+        {"api_group": "rbac.authorization.k8s.io", "kind": "User", "name": "oidc:alice"}
+    ]
+
+
+def test_default_rolebinding_for_admin_uses_kube_username(lib):
+    request = req(username="root-admin", name="bob", spec={"kube_username": "bob"})
+    resp = lib.mutate(request, lib.default_admission_config())
+    obj = apply_response(lib, request, resp)
+    assert obj["spec"]["rolebinding"]["subjects"][0]["name"] == "bob"
+
+
+def test_admin_rolebinding_preserved(lib):
+    rb = {"role_ref": {"api_group": "rbac.authorization.k8s.io", "kind": "ClusterRole", "name": "view"}}
+    request = req(username="root-admin", name="bob", spec={"kube_username": "bob", "rolebinding": rb})
+    resp = lib.mutate(request, lib.default_admission_config())
+    obj = apply_response(lib, request, resp)
+    assert obj["spec"]["rolebinding"] == rb
+
+
+# -- TPU mutation path (BASELINE config #2) ---------------------------------
+
+
+def test_tpu_defaulting_and_geometry(lib):
+    request = req(spec={"tpu": {"accelerator": "tpu-v5-lite-podslice", "topology": "2x2"}})
+    resp = lib.mutate(request, lib.default_admission_config())
+    assert resp["allowed"] is True
+    obj = apply_response(lib, request, resp)
+    tpu = obj["spec"]["tpu"]
+    assert tpu["chips"] == 4
+    assert tpu["hosts"] == 1
+    assert tpu["chips_per_host"] == 4
+
+
+def test_tpu_accelerator_defaulted(lib):
+    request = req(spec={"tpu": {"topology": "2x4"}})
+    resp = lib.mutate(request, lib.default_admission_config())
+    obj = apply_response(lib, request, resp)
+    assert obj["spec"]["tpu"]["accelerator"] == "tpu-v5-lite-podslice"
+    assert obj["spec"]["tpu"]["chips"] == 8
+
+
+def test_tpu_topology_defaulted(lib):
+    request = req(spec={"tpu": {"accelerator": "tpu-v5p-slice"}})
+    resp = lib.mutate(request, lib.default_admission_config())
+    obj = apply_response(lib, request, resp)
+    assert obj["spec"]["tpu"]["topology"] == "2x2x1"
+    assert obj["spec"]["tpu"]["chips"] == 4
+
+
+def test_tpu_invalid_topology_denied(lib):
+    resp = lib.mutate(
+        req(spec={"tpu": {"accelerator": "tpu-v5p-slice", "topology": "4x4"}}),
+        lib.default_admission_config(),
+    )
+    assert resp["allowed"] is False
+    assert "3D" in resp["status"]["message"]
+
+
+def test_tpu_multihost_v5p_geometry(lib):
+    request = req(spec={"tpu": {"accelerator": "tpu-v5p-slice", "topology": "4x4x4"}})
+    resp = lib.mutate(request, lib.default_admission_config())
+    obj = apply_response(lib, request, resp)
+    tpu = obj["spec"]["tpu"]
+    assert (tpu["chips"], tpu["hosts"], tpu["chips_per_host"]) == (64, 16, 4)
+
+
+def test_tpu_stale_client_geometry_corrected(lib):
+    request = req(
+        spec={"tpu": {"accelerator": "tpu-v5-lite-podslice", "topology": "4x4", "chips": 9999}}
+    )
+    resp = lib.mutate(request, lib.default_admission_config())
+    obj = apply_response(lib, request, resp)
+    assert obj["spec"]["tpu"]["chips"] == 16
+
+
+def test_tpu_max_chips_limit_for_normal_users(lib):
+    config = lib.default_admission_config()
+    config["max_chips_per_user"] = 8
+    resp = lib.mutate(
+        req(spec={"tpu": {"accelerator": "tpu-v5-lite-podslice", "topology": "4x4"}}), config
+    )
+    assert resp["allowed"] is False
+    assert "exceeding" in resp["status"]["message"]
+    # admins are exempt
+    resp = lib.mutate(
+        req(
+            username="root-admin",
+            name="bob",
+            spec={"kube_username": "bob", "tpu": {"accelerator": "tpu-v5-lite-podslice", "topology": "4x4"}},
+        ),
+        config,
+    )
+    assert resp["allowed"] is True
+
+
+# -- review envelope --------------------------------------------------------
+
+
+def test_mutate_review_roundtrip(lib):
+    review = {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": req(),
+    }
+    out = lib.mutate_review(review, lib.default_admission_config())
+    assert out["kind"] == "AdmissionReview"
+    assert out["response"]["uid"] == "uid-1"
+    assert out["response"]["allowed"] is True
+
+
+def test_mutate_review_without_request(lib):
+    out = lib.mutate_review({"kind": "AdmissionReview"}, lib.default_admission_config())
+    assert out["response"]["allowed"] is False
+    assert out["response"]["status"]["code"] == 400

@@ -1,0 +1,69 @@
+"""CRD generation tests: schema shape + YAML validity + drift check
+(the reference CI's check-crd-status gate, check-crd-status.yml:17)."""
+
+import subprocess
+from pathlib import Path
+
+import pytest
+
+yaml = pytest.importorskip("yaml")
+
+REPO = Path(__file__).resolve().parent.parent
+CHART_CRD = REPO / "charts" / "tpu-bootstrap-controller" / "templates" / "crd.yaml"
+
+
+def test_crd_is_valid_yaml_and_wellformed(lib):
+    crd = yaml.safe_load(lib.crd_yaml())
+    assert crd["kind"] == "CustomResourceDefinition"
+    assert crd["metadata"]["name"] == "userbootstraps.tpu.bacchus.io"
+    spec = crd["spec"]
+    assert spec["group"] == "tpu.bacchus.io"
+    assert spec["scope"] == "Cluster"
+    assert spec["names"]["kind"] == "UserBootstrap"
+    assert spec["names"]["shortNames"] == ["tub"]
+    [version] = spec["versions"]
+    assert version["name"] == "v1"
+    assert version["served"] and version["storage"]
+    # status subresource, like the reference (crd.yaml:313-314)
+    assert version["subresources"] == {"status": {}}
+
+
+def test_crd_spec_fields(lib):
+    crd = yaml.safe_load(lib.crd_yaml())
+    props = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]["properties"]
+    spec_props = props["spec"]["properties"]
+    # reference parity fields
+    assert set(spec_props) >= {"kube_username", "quota", "role", "rolebinding"}
+    # TPU extension
+    tpu = spec_props["tpu"]
+    assert set(tpu["properties"]) >= {
+        "accelerator",
+        "topology",
+        "image",
+        "command",
+        "args",
+        "chips",
+        "hosts",
+        "chips_per_host",
+        "max_restarts",
+    }
+    accels = tpu["properties"]["accelerator"]["enum"]
+    assert "tpu-v5-lite-podslice" in accels
+    assert "tpu-v5p-slice" in accels
+    # status gate field
+    status = props["status"]["properties"]
+    assert "synchronized_with_sheet" in status
+    assert "slice" in status
+
+
+def test_crdgen_binary_matches_lib(lib):
+    binary = REPO / "native" / "build" / "tpubc-crdgen"
+    out = subprocess.run([str(binary)], capture_output=True, check=True, text=True)
+    assert out.stdout == lib.crd_yaml()
+
+
+def test_chart_crd_not_drifted(lib):
+    """The chart's CRD template must be regenerated whenever the schema
+    changes — same contract as the reference's CI drift check."""
+    assert CHART_CRD.exists(), "run hack/generate-crd.sh to (re)generate the chart CRD"
+    assert CHART_CRD.read_text() == lib.crd_yaml()

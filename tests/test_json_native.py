@@ -1,0 +1,137 @@
+"""Unit tests for the native JSON library (parse/serialize/pointer/patch)."""
+
+import json
+
+import pytest
+
+from tpu_bootstrap.nativelib import NativeError
+
+
+def roundtrip(lib, value):
+    return lib.json_roundtrip(json.dumps(value))
+
+
+def test_scalars_roundtrip(lib):
+    for v in [None, True, False, 0, -1, 42, 2**53, -(2**53), 3.5, -0.25, "", "héllo", "한국어"]:
+        assert roundtrip(lib, v) == v
+
+
+def test_containers_roundtrip(lib):
+    v = {"a": [1, 2, {"b": None}], "c": {"d": [True, "x"]}, "empty": {}, "earr": []}
+    assert roundtrip(lib, v) == v
+
+
+def test_unicode_escapes(lib):
+    # surrogate pair, BMP escape, control chars
+    assert lib.json_roundtrip('"\\ud83d\\ude00"') == "\U0001f600"
+    assert lib.json_roundtrip('"\\uc548\\ub155"') == "안녕"
+    assert lib.json_roundtrip('"a\\nb\\tc"') == "a\nb\tc"
+
+
+def test_int_double_distinction(lib):
+    # integers must not become floats on the wire (quota quantities!)
+    out = lib._call("tpubc_json_roundtrip", '{"a": 4, "b": 4.0}')
+    assert '"a":4' in out
+    assert '"b":4' in out  # 4.0 may print as 4; must parse equal either way
+
+
+def test_parse_errors(lib):
+    for bad in ["{", "[1,", '"unterminated', "tru", "01x", "{1:2}", ""]:
+        with pytest.raises(NativeError):
+            lib.json_roundtrip(bad)
+
+
+def test_trailing_garbage_rejected(lib):
+    with pytest.raises(NativeError):
+        lib.json_roundtrip("{} {}")
+
+
+def test_patch_add_replace_remove(lib):
+    doc = {"spec": {"a": 1}}
+    patch = [
+        {"op": "add", "path": "/spec/b", "value": {"x": 1}},
+        {"op": "replace", "path": "/spec/a", "value": 2},
+        {"op": "remove", "path": "/spec/b/x"},
+    ]
+    assert lib.json_patch(doc, patch) == {"spec": {"a": 2, "b": {}}}
+
+
+def test_patch_add_is_upsert_on_objects(lib):
+    # RFC 6902: "add" on an existing object member replaces it — the
+    # admission webhook relies on this for geometry correction.
+    doc = {"spec": {"tpu": {"chips": 999}}}
+    out = lib.json_patch(doc, [{"op": "add", "path": "/spec/tpu/chips", "value": 4}])
+    assert out["spec"]["tpu"]["chips"] == 4
+
+
+def test_patch_array_ops(lib):
+    doc = {"a": [1, 2, 3]}
+    out = lib.json_patch(
+        doc,
+        [
+            {"op": "add", "path": "/a/1", "value": 99},
+            {"op": "add", "path": "/a/-", "value": 100},
+            {"op": "remove", "path": "/a/0"},
+        ],
+    )
+    assert out == {"a": [99, 2, 3, 100]}
+
+
+def test_patch_test_move_copy(lib):
+    doc = {"a": 1, "b": {"c": 2}}
+    out = lib.json_patch(
+        doc,
+        [
+            {"op": "test", "path": "/a", "value": 1},
+            {"op": "copy", "from": "/b/c", "path": "/d"},
+            {"op": "move", "from": "/b/c", "path": "/e"},
+        ],
+    )
+    assert out == {"a": 1, "b": {}, "d": 2, "e": 2}
+
+
+def test_patch_test_failure(lib):
+    with pytest.raises(NativeError):
+        lib.json_patch({"a": 1}, [{"op": "test", "path": "/a", "value": 2}])
+
+
+def test_patch_escaped_pointer(lib):
+    doc = {"metadata": {"labels": {}}}
+    out = lib.json_patch(
+        doc,
+        [{"op": "add", "path": "/metadata/labels/app.kubernetes.io~1name", "value": "x"}],
+    )
+    assert out["metadata"]["labels"]["app.kubernetes.io/name"] == "x"
+
+
+def test_yaml_emitter_is_valid_yaml(lib):
+    yaml = pytest.importorskip("yaml")
+    value = {
+        "name": "test",
+        "quoted": "yes",  # YAML bool-lookalike must be quoted
+        "number_string": "123",
+        "colon": "a: b",
+        "hash": "a #comment",
+        "unicode": "메모리",
+        "nested": {"list": [{"a": 1}, {"b": [1, 2]}], "empty": {}, "earr": []},
+        "multiline": "a\nb",
+    }
+    parsed = yaml.safe_load(lib.to_yaml(value))
+    assert parsed == value
+
+
+def test_sha256(lib):
+    assert (
+        lib.sha256_hex("abc")
+        == "ba7816bf8f01cfea414140de5dae2223b00361a396177a9cb410ff61f20015ad"
+    )
+    assert (
+        lib.sha256_hex("") == "e3b0c44298fc1c149afbf4c8996fb92427ae41e4649b934ca495991b7852b855"
+    )
+
+
+def test_base64(lib):
+    assert lib.base64_encode("hello world") == "aGVsbG8gd29ybGQ="
+    assert lib.base64_decode("aGVsbG8gd29ybGQ=") == "hello world"
+    for s in ["", "a", "ab", "abc", "abcd"]:
+        assert lib.base64_decode(lib.base64_encode(s)) == s

@@ -1,0 +1,198 @@
+"""Reconcile planner tests: CR -> desired children, including the emitted
+JobSet (SURVEY.md §4: multi-host behavior is testable by asserting on the
+emitted objects — BASELINE configs #3 and #5)."""
+
+import pytest
+
+from tpu_bootstrap.nativelib import NativeError
+
+
+def ub(name="Alice", uid="u-1", spec=None, status=None):
+    o = {
+        "apiVersion": "tpu.bacchus.io/v1",
+        "kind": "UserBootstrap",
+        "metadata": {"name": name, "uid": uid},
+        "spec": spec or {},
+    }
+    if status is not None:
+        o["status"] = status
+    return o
+
+
+def by_kind(children):
+    return {c["kind"]: c for c in children}
+
+
+def test_namespace_always_emitted_lowercased(lib):
+    children = lib.desired_children(ub(name="Alice"))
+    kinds = by_kind(children)
+    assert set(kinds) == {"Namespace"}
+    ns = kinds["Namespace"]
+    assert ns["metadata"]["name"] == "alice"  # controller.rs:55-63 lowercase rule
+    oref = ns["metadata"]["ownerReferences"][0]
+    assert oref["kind"] == "UserBootstrap"
+    assert oref["name"] == "Alice"
+    assert oref["uid"] == "u-1"
+    assert oref["controller"] is True
+
+
+def test_quota_emitted_when_spec_quota_set(lib):
+    children = lib.desired_children(
+        ub(spec={"quota": {"hard": {"requests.google.com/tpu": "4"}}})
+    )
+    kinds = by_kind(children)
+    assert kinds["ResourceQuota"]["spec"]["hard"]["requests.google.com/tpu"] == "4"
+    assert kinds["ResourceQuota"]["metadata"]["namespace"] == "alice"
+
+
+def test_role_emitted_when_spec_role_set(lib):
+    rules = [{"apiGroups": [""], "resources": ["pods"], "verbs": ["get", "list"]}]
+    children = lib.desired_children(ub(spec={"role": {"rules": rules}}))
+    kinds = by_kind(children)
+    assert kinds["Role"]["rules"] == rules
+    assert kinds["Role"]["metadata"]["name"] == "alice"
+
+
+def test_rolebinding_gated_on_sheet_sync(lib):
+    spec = {
+        "rolebinding": {
+            "role_ref": {"api_group": "rbac.authorization.k8s.io", "kind": "ClusterRole", "name": "edit"},
+            "subjects": [{"api_group": "rbac.authorization.k8s.io", "kind": "User", "name": "oidc:alice"}],
+        }
+    }
+    # not synchronized -> no RoleBinding (controller.rs:127-130 interlock)
+    children = lib.desired_children(ub(spec=spec))
+    assert "RoleBinding" not in by_kind(children)
+    children = lib.desired_children(ub(spec=spec, status={"synchronized_with_sheet": False}))
+    assert "RoleBinding" not in by_kind(children)
+    # synchronized -> RoleBinding appears, converted to real k8s shape
+    children = lib.desired_children(ub(spec=spec, status={"synchronized_with_sheet": True}))
+    rb = by_kind(children)["RoleBinding"]
+    assert rb["roleRef"] == {
+        "apiGroup": "rbac.authorization.k8s.io",
+        "kind": "ClusterRole",
+        "name": "edit",
+    }
+    assert rb["subjects"][0]["name"] == "oidc:alice"
+
+
+def tpu_spec(accel="tpu-v5-lite-podslice", topo="2x2", **kw):
+    d = {"accelerator": accel, "topology": topo}
+    d.update(kw)
+    return d
+
+
+def test_jobset_gated_on_sheet_sync(lib):
+    spec = {"tpu": tpu_spec()}
+    assert "JobSet" not in by_kind(lib.desired_children(ub(spec=spec)))
+    children = lib.desired_children(ub(spec=spec, status={"synchronized_with_sheet": True}))
+    assert "JobSet" in by_kind(children)
+
+
+def test_jobset_single_host_v5e(lib):
+    """BASELINE config #3: v5e 2x2 slice -> 4-chip single-host JobSet."""
+    js = lib.build_jobset(ub(spec={"tpu": tpu_spec()}))
+    assert js["apiVersion"] == "jobset.x-k8s.io/v1alpha2"
+    assert js["metadata"]["name"] == "alice-slice"
+    assert js["metadata"]["namespace"] == "alice"
+    job = js["spec"]["replicatedJobs"][0]
+    assert job["replicas"] == 1
+    jspec = job["template"]["spec"]
+    assert jspec["parallelism"] == 1
+    assert jspec["completions"] == 1
+    assert jspec["completionMode"] == "Indexed"
+    pod = jspec["template"]["spec"]
+    assert pod["nodeSelector"] == {
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+        "cloud.google.com/gke-tpu-topology": "2x2",
+    }
+    res = pod["containers"][0]["resources"]
+    assert res["requests"]["google.com/tpu"] == 4
+    assert res["limits"]["google.com/tpu"] == 4
+
+
+def test_jobset_multi_host_v5p_4x4x4(lib):
+    """BASELINE config #5: 64-chip v5p slice -> 16-host gang-scheduled JobSet."""
+    js = lib.build_jobset(ub(spec={"tpu": tpu_spec("tpu-v5p-slice", "4x4x4")}))
+    jspec = js["spec"]["replicatedJobs"][0]["template"]["spec"]
+    assert jspec["parallelism"] == 16
+    assert jspec["completions"] == 16
+    assert jspec["backoffLimit"] == 0  # gang: any host failure fails the job
+    pod = jspec["template"]["spec"]
+    assert pod["containers"][0]["resources"]["requests"]["google.com/tpu"] == 4
+    assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "4x4x4"
+    # exclusive-topology pins the gang to one ICI-connected slice
+    ann = js["metadata"]["annotations"]
+    assert ann["alpha.jobset.sigs.k8s.io/exclusive-topology"] == "cloud.google.com/gke-nodepool"
+    assert js["spec"]["failurePolicy"]["maxRestarts"] == 0
+
+
+def test_jobset_image_command_and_restarts(lib):
+    js = lib.build_jobset(
+        ub(
+            spec={
+                "tpu": tpu_spec(
+                    image="gcr.io/proj/train:v1",
+                    command=["python", "train.py"],
+                    args=["--steps", "100"],
+                    max_restarts=3,
+                )
+            }
+        )
+    )
+    c = js["spec"]["replicatedJobs"][0]["template"]["spec"]["template"]["spec"]["containers"][0]
+    assert c["image"] == "gcr.io/proj/train:v1"
+    assert c["command"] == ["python", "train.py"]
+    assert c["args"] == ["--steps", "100"]
+    assert js["spec"]["failurePolicy"]["maxRestarts"] == 3
+
+
+def test_jobset_default_image_from_config(lib):
+    cfg = lib.default_controller_config()
+    cfg["workload_image"] = "example.com/workload:latest"
+    js = lib.build_jobset(ub(spec={"tpu": tpu_spec()}), cfg)
+    c = js["spec"]["replicatedJobs"][0]["template"]["spec"]["template"]["spec"]["containers"][0]
+    assert c["image"] == "example.com/workload:latest"
+
+
+def test_jobset_requires_tpu_spec(lib):
+    with pytest.raises(NativeError):
+        lib.build_jobset(ub())
+
+
+def test_full_slice_plan(lib):
+    """End-to-end plan for a fully-populated synchronized CR."""
+    spec = {
+        "kube_username": "alice",
+        "quota": {"hard": {"requests.google.com/tpu": "64"}},
+        "role": {"rules": [{"apiGroups": [""], "resources": ["pods"], "verbs": ["*"]}]},
+        "rolebinding": {
+            "role_ref": {"api_group": "rbac.authorization.k8s.io", "kind": "ClusterRole", "name": "edit"}
+        },
+        "tpu": tpu_spec("tpu-v5p-slice", "4x4x4"),
+    }
+    children = lib.desired_children(ub(spec=spec, status={"synchronized_with_sheet": True}))
+    assert [c["kind"] for c in children] == [
+        "Namespace",
+        "ResourceQuota",
+        "Role",
+        "RoleBinding",
+        "JobSet",
+    ]
+    # every child is owned by the CR => cascade deletion
+    for c in children:
+        assert c["metadata"]["ownerReferences"][0]["uid"] == "u-1"
+
+
+def test_slice_status_phases(lib):
+    cr = ub(spec={"tpu": tpu_spec(chips=4, hosts=1)})
+    assert lib.slice_status(ub(), None)["phase"] == "Absent"
+    assert lib.slice_status(cr, None)["phase"] == "Pending"
+    js = {"metadata": {"name": "alice-slice"}, "status": {}}
+    assert lib.slice_status(cr, js)["phase"] == "Provisioning"
+    js["status"] = {"replicatedJobsStatus": [{"name": "workers", "active": 1}]}
+    st = lib.slice_status(cr, js)
+    assert st["phase"] == "Running"
+    assert st["jobset"] == "alice-slice"
+    js["status"] = {"conditions": [{"type": "Failed", "status": "True"}]}
+    assert lib.slice_status(cr, js)["phase"] == "Failed"

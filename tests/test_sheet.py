@@ -1,0 +1,249 @@
+"""Synchronizer core tests: CSV parsing, Korean-header inference, quota
+construction and the inventory-aware sync plan
+(reference pipeline: /root/reference/src/synchronizer.rs:96-330)."""
+
+import pytest
+
+from tpu_bootstrap.nativelib import NativeError
+
+KOREAN_HEADER = (
+    "타임스탬프,이름,소속,이메일 주소,SNUCSE ID,사용할 서버,"
+    "TPU 칩 개수,GPU 개수,vCPU 개수,메모리 (GiB),스토리지 (GiB),MiG 개수,요청 사유,승인"
+)
+
+
+def row(
+    username="alice",
+    server="tpu-serv",
+    tpu=4,
+    gpu=0,
+    cpu=8,
+    mem=32,
+    storage=100,
+    mig=0,
+    authorized="o",
+    name="앨리스",
+    dept="CSE",
+):
+    return f"2024. 1. 1 오전 10:00:00,{name},{dept},a@snu.ac.kr,{username},{server},{tpu},{gpu},{cpu},{mem},{storage},{mig},research,{authorized}"
+
+
+def sheet(*rows):
+    return KOREAN_HEADER + "\n" + "\n".join(rows) + "\n"
+
+
+# -- header inference -------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "header,expect",
+    [
+        ("타임스탬프", "timestamp"),
+        ("이름", "name"),
+        ("소속", "department"),
+        ("SNUCSE ID (아이디)", "id_username"),
+        ("사용할 서버를 선택하세요", "server"),
+        ("TPU 칩 개수", "tpu_request"),
+        ("필요한 GPU 개수", "gpu_request"),
+        ("필요한 vCPU 개수", "cpu_request"),
+        ("메모리 (GiB)", "memory_request"),
+        ("스토리지 (GiB)", "storage_request"),
+        ("MiG 개수", "mig_request"),
+        ("요청 사유", "description"),
+        ("승인", "authorized"),
+        ("이메일 주소", "email"),
+        # English fallbacks
+        ("Username", "id_username"),
+        ("TPU chips", "tpu_request"),
+        ("Memory (GiB)", "memory_request"),
+        ("Approved", "authorized"),
+    ],
+)
+def test_infer_header(lib, header, expect):
+    assert lib.infer_header(header) == expect
+
+
+def test_unknown_header_is_hard_error(lib):
+    with pytest.raises(NativeError, match="unknown header"):
+        lib.parse_sheet("혈액형,이름\nA,x\n")
+
+
+# -- CSV parsing ------------------------------------------------------------
+
+
+def test_parse_sheet_basic(lib):
+    out = lib.parse_sheet(sheet(row()))
+    assert out["warnings"] == []
+    [r] = out["rows"]
+    assert r["id_username"] == "alice"
+    assert r["tpu_request"] == 4
+    assert r["cpu_request"] == 8
+    assert r["memory_request"] == 32
+    assert r["authorized"] == "o"
+    assert r["name"] == "앨리스"
+
+
+def test_quoted_cells_with_commas_and_newlines(lib):
+    csv = (
+        'name,department,username,server,TPU chips,cpu,memory,storage,approved\n'
+        '"Kim, Alice","CSE\nSeoul",alice,tpu-serv,4,8,32,100,o\n'
+    )
+    out = lib.parse_sheet(csv)
+    [r] = out["rows"]
+    assert r["name"] == "Kim, Alice"
+    assert r["department"] == "CSE\nSeoul"
+
+
+def test_doubled_quotes(lib):
+    csv = 'name,department,username,server,TPU chips,cpu,memory,storage,approved\n"say ""hi""",CSE,a,s,1,1,1,1,o\n'
+    assert lib.parse_sheet(csv)["rows"][0]["name"] == 'say "hi"'
+
+
+def test_malformed_rows_skipped_with_warning(lib):
+    out = lib.parse_sheet(sheet(row(), row(cpu="not-a-number"), row(username="bob")))
+    assert len(out["rows"]) == 2
+    assert len(out["warnings"]) == 1
+    assert "bad integer" in out["warnings"][0]
+
+
+def test_crlf_and_blank_lines(lib):
+    csv = sheet(row()).replace("\n", "\r\n") + "\r\n"
+    out = lib.parse_sheet(csv)
+    assert len(out["rows"]) == 1
+
+
+# -- quota construction -----------------------------------------------------
+
+
+def test_build_quota_tpu(lib):
+    r = {"cpu_request": 8, "memory_request": 32, "storage_request": 100, "tpu_request": 4}
+    q = lib.build_quota(r, "tpu")
+    assert q["hard"] == {
+        "requests.cpu": "8",
+        "requests.memory": "32Gi",
+        "limits.cpu": "8",
+        "limits.memory": "32Gi",
+        "requests.google.com/tpu": "4",
+        "requests.storage": "100Gi",
+    }
+
+
+def test_build_quota_gpu_matches_reference_keys(lib):
+    r = {
+        "cpu_request": 8,
+        "memory_request": 32,
+        "storage_request": 100,
+        "gpu_request": 2,
+        "mig_request": 1,
+    }
+    q = lib.build_quota(r, "gpu")
+    # exact reference key set (synchronizer.rs:249-281)
+    assert q["hard"] == {
+        "requests.cpu": "8",
+        "requests.memory": "32Gi",
+        "limits.cpu": "8",
+        "limits.memory": "32Gi",
+        "requests.nvidia.com/gpu": "2",
+        "requests.storage": "100Gi",
+        "requests.nvidia.com/mig-1g.10gb": "1",
+    }
+
+
+# -- sync planning ----------------------------------------------------------
+
+
+def ub(name, quota=None, rv="7"):
+    spec = {}
+    if quota is not None:
+        spec["quota"] = quota
+    return {"metadata": {"name": name, "resourceVersion": rv}, "spec": spec}
+
+
+def cfg(lib, **kw):
+    c = lib.default_synchronizer_config()
+    c["server_name"] = "tpu-serv"
+    c.update(kw)
+    return c
+
+
+def test_plan_sync_matches_authorized_row(lib):
+    rows = lib.parse_sheet(sheet(row()))["rows"]
+    plan = lib.plan_sync([ub("alice")], rows, cfg(lib))
+    [a] = plan["actions"]
+    assert a["name"] == "alice"
+    assert a["chips"] == 4
+    assert a["status"] == {"synchronized_with_sheet": True}
+    assert a["resource_version"] == "7"
+    # add-{} then replace (synchronizer.rs:240-287 patch sequence)
+    assert [p["op"] for p in a["patches"]] == ["add", "replace"]
+    assert a["patches"][1]["value"]["hard"]["requests.google.com/tpu"] == "4"
+
+
+def test_plan_sync_skips_unauthorized(lib):
+    rows = lib.parse_sheet(sheet(row(authorized="x")))["rows"]
+    plan = lib.plan_sync([ub("alice")], rows, cfg(lib))
+    assert plan["actions"] == []
+
+
+def test_plan_sync_authorized_is_case_whitespace_insensitive(lib):
+    rows = lib.parse_sheet(sheet(row(authorized=" O ")))["rows"]
+    plan = lib.plan_sync([ub("alice")], rows, cfg(lib))
+    assert len(plan["actions"]) == 1
+
+
+def test_plan_sync_last_match_wins(lib):
+    rows = lib.parse_sheet(sheet(row(tpu=4), row(tpu=16)))["rows"]
+    plan = lib.plan_sync([ub("alice")], rows, cfg(lib))
+    assert plan["actions"][0]["chips"] == 16
+
+
+def test_plan_sync_last_authorized_match_wins(lib):
+    # the later row is unauthorized -> falls back to the earlier approved one
+    rows = lib.parse_sheet(sheet(row(tpu=4), row(tpu=16, authorized="")))["rows"]
+    plan = lib.plan_sync([ub("alice")], rows, cfg(lib))
+    assert plan["actions"][0]["chips"] == 4
+
+
+def test_plan_sync_server_substring_filter(lib):
+    rows = lib.parse_sheet(
+        sheet(row(server="the-tpu-serv-a (v5e)"), row(username="bob", server="gpu-only"))
+    )["rows"]
+    plan = lib.plan_sync([ub("alice"), ub("bob")], rows, cfg(lib))
+    assert [a["name"] for a in plan["actions"]] == ["alice"]
+
+
+def test_plan_sync_no_row_leaves_cr_alone(lib):
+    rows = lib.parse_sheet(sheet(row()))["rows"]
+    plan = lib.plan_sync([ub("charlie")], rows, cfg(lib))
+    assert plan["actions"] == []
+    assert plan["skipped"] == []
+
+
+def test_plan_sync_existing_quota_no_add_patch(lib):
+    rows = lib.parse_sheet(sheet(row()))["rows"]
+    plan = lib.plan_sync([ub("alice", quota={"hard": {}})], rows, cfg(lib))
+    assert [p["op"] for p in plan["actions"][0]["patches"]] == ["replace"]
+
+
+def test_plan_sync_pool_capacity_enforced(lib):
+    """TPU chip inventory: first-come admission against pool capacity."""
+    rows = lib.parse_sheet(
+        sheet(row(username="alice", tpu=16), row(username="bob", tpu=16), row(username="carol", tpu=8))
+    )["rows"]
+    plan = lib.plan_sync(
+        [ub("alice"), ub("bob"), ub("carol")], rows, cfg(lib, pool_capacity_chips=24)
+    )
+    assert [a["name"] for a in plan["actions"]] == ["alice", "carol"]
+    assert plan["total_chips"] == 24
+    [s] = plan["skipped"]
+    assert s["name"] == "bob"
+    assert "capacity exhausted" in s["reason"]
+
+
+def test_plan_sync_gpu_device_uses_gpu_chips(lib):
+    rows = lib.parse_sheet(sheet(row(tpu=0, gpu=2)))["rows"]
+    plan = lib.plan_sync([ub("alice")], rows, cfg(lib, device="gpu"))
+    assert plan["actions"][0]["chips"] == 2
+    assert (
+        plan["actions"][0]["quota"]["hard"]["requests.nvidia.com/gpu"] == "2"
+    )

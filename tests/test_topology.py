@@ -1,0 +1,75 @@
+"""Slice-topology arithmetic: the pure functions that must be right before
+anything touches hardware (SURVEY.md §7 'Hard parts')."""
+
+import pytest
+
+from tpu_bootstrap.nativelib import NativeError
+
+
+@pytest.mark.parametrize(
+    "accel,topo,chips,hosts,cph,multi",
+    [
+        # v5e: single host up to 8 chips, multi-host at 4 chips/host
+        ("tpu-v5-lite-podslice", "1x1", 1, 1, 1, False),
+        ("tpu-v5-lite-podslice", "2x2", 4, 1, 4, False),
+        ("tpu-v5-lite-podslice", "2x4", 8, 1, 8, False),
+        ("tpu-v5-lite-podslice", "4x4", 16, 4, 4, True),
+        ("tpu-v5-lite-podslice", "4x8", 32, 8, 4, True),
+        ("tpu-v5-lite-podslice", "16x16", 256, 64, 4, True),
+        # v5p: 3D, 4 chips/host — BASELINE config #5 is 4x4x4 = 64 chips / 16 hosts
+        ("tpu-v5p-slice", "2x2x1", 4, 1, 4, False),
+        ("tpu-v5p-slice", "2x2x2", 8, 2, 4, True),
+        ("tpu-v5p-slice", "4x4x4", 64, 16, 4, True),
+        ("tpu-v5p-slice", "8x8x16", 1024, 256, 4, True),
+        # v4
+        ("tpu-v4-podslice", "2x2x1", 4, 1, 4, False),
+        ("tpu-v4-podslice", "4x4x4", 64, 16, 4, True),
+        # v6e
+        ("tpu-v6e-slice", "2x2", 4, 1, 4, False),
+        ("tpu-v6e-slice", "8x8", 64, 16, 4, True),
+    ],
+)
+def test_geometry(lib, accel, topo, chips, hosts, cph, multi):
+    g = lib.slice_geometry(accel, topo)
+    assert g["chips"] == chips
+    assert g["hosts"] == hosts
+    assert g["chips_per_host"] == cph
+    assert g["multi_host"] is multi
+    # invariant: hosts * chips_per_host == chips for every valid slice
+    assert g["hosts"] * g["chips_per_host"] == g["chips"]
+
+
+def test_unknown_accelerator(lib):
+    v = lib.validate_topology("tpu-v99", "2x2")
+    assert not v["ok"]
+    assert "unknown accelerator" in v["reason"]
+
+
+def test_wrong_rank(lib):
+    v = lib.validate_topology("tpu-v5p-slice", "4x4")
+    assert not v["ok"]
+    assert "3D" in v["reason"]
+
+
+def test_unavailable_topology(lib):
+    v = lib.validate_topology("tpu-v5-lite-podslice", "3x3")
+    assert not v["ok"]
+    assert "not available" in v["reason"]
+
+
+@pytest.mark.parametrize("bad", ["", "x", "4x", "x4", "4xx4", "0x2", "-2x2", "2x2x2x2", "axb"])
+def test_malformed_topologies(lib, bad):
+    v = lib.validate_topology("tpu-v5-lite-podslice", bad)
+    assert not v["ok"]
+
+
+def test_geometry_raises_on_invalid(lib):
+    with pytest.raises(NativeError):
+        lib.slice_geometry("tpu-v5p-slice", "9x9x9")
+
+
+def test_default_topologies(lib):
+    assert lib.default_topology("tpu-v5-lite-podslice") == "1x1"
+    assert lib.default_topology("tpu-v5p-slice") == "2x2x1"
+    with pytest.raises(NativeError):
+        lib.default_topology("nope")

@@ -1,0 +1,17 @@
+"""tpu-bootstrap-controller: a TPU-native Kubernetes user-bootstrap operator suite.
+
+A ground-up rebuild of the capabilities of bacchus-snu/bacchus-gpu-controller
+(reference mounted at /root/reference), re-grounded on GKE TPU node pools:
+
+* native C++ daemons (crdgen / controller / admission / synchronizer) under
+  ``native/``, sharing one core library — mirroring the reference's
+  one-crate/four-binaries layout (reference Cargo.toml:6-20);
+* a cluster-scoped ``UserBootstrap`` CRD (group ``tpu.bacchus.io``) whose spec
+  adds TPU accelerator/topology fields and whose controller materializes
+  multi-host TPU-slice JobSets;
+* this Python package: the ctypes bridge to the native cores (test surface),
+  a fake Kubernetes API server for integration tests and benchmarks, and the
+  JAX slice workload that the emitted JobSets run.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,189 @@
+"""ctypes bridge to the native core (libtpubc_capi.so).
+
+The pytest suite and the bench harness exercise the same object code the
+daemons link — the pure policy/planning cores are tested here without a
+cluster, closing the zero-test gap of the reference (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import subprocess
+from pathlib import Path
+from typing import Any
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+NATIVE_DIR = REPO_ROOT / "native"
+BUILD_DIR = NATIVE_DIR / "build"
+LIB_PATH = BUILD_DIR / "libtpubc_capi.so"
+
+_STRING_FUNCS = [
+    "tpubc_version",
+    "tpubc_crd_yaml",
+    "tpubc_crd_json",
+    "tpubc_to_yaml",
+    "tpubc_json_roundtrip",
+    "tpubc_json_patch",
+    "tpubc_validate_topology",
+    "tpubc_slice_geometry",
+    "tpubc_default_topology",
+    "tpubc_classify_username",
+    "tpubc_default_admission_config",
+    "tpubc_mutate",
+    "tpubc_mutate_review",
+    "tpubc_default_controller_config",
+    "tpubc_desired_children",
+    "tpubc_build_jobset",
+    "tpubc_slice_status",
+    "tpubc_infer_header",
+    "tpubc_parse_sheet",
+    "tpubc_default_synchronizer_config",
+    "tpubc_build_quota",
+    "tpubc_plan_sync",
+    "tpubc_sha256_hex",
+    "tpubc_base64_encode",
+    "tpubc_base64_decode",
+]
+
+
+def build_native(force: bool = False) -> None:
+    """Configure + build the native tree (cached; ninja makes this a no-op)."""
+    if LIB_PATH.exists() and not force:
+        # ninja is fast; always re-run so edited C++ is picked up in dev.
+        pass
+    if not (BUILD_DIR / "build.ninja").exists():
+        subprocess.run(
+            ["cmake", "-S", str(NATIVE_DIR), "-B", str(BUILD_DIR), "-G", "Ninja"],
+            check=True,
+            capture_output=True,
+        )
+    subprocess.run(["ninja", "-C", str(BUILD_DIR)], check=True, capture_output=True)
+
+
+class NativeError(RuntimeError):
+    """An {"error": ...} payload surfaced from the native core."""
+
+
+class NativeLib:
+    def __init__(self, path: os.PathLike | None = None):
+        build_native()
+        self._lib = ctypes.CDLL(str(path or LIB_PATH))
+        self._lib.tpubc_free.argtypes = [ctypes.c_void_p]
+        self._lib.tpubc_free.restype = None
+        for name in _STRING_FUNCS:
+            fn = getattr(self._lib, name)
+            fn.restype = ctypes.c_void_p  # keep the pointer so we can free it
+
+    def _call(self, name: str, *args: str) -> str:
+        fn = getattr(self._lib, name)
+        fn.argtypes = [ctypes.c_char_p] * len(args)
+        ptr = fn(*[a.encode("utf-8") for a in args])
+        try:
+            return ctypes.string_at(ptr).decode("utf-8")
+        finally:
+            self._lib.tpubc_free(ptr)
+
+    def _call_json(self, name: str, *args: Any) -> Any:
+        encoded = [a if isinstance(a, str) else json.dumps(a) for a in args]
+        out = json.loads(self._call(name, *encoded))
+        if isinstance(out, dict) and set(out.keys()) == {"error"}:
+            raise NativeError(out["error"])
+        return out
+
+    # -- raw string APIs ----------------------------------------------------
+    def version(self) -> str:
+        return self._call("tpubc_version")
+
+    def crd_yaml(self) -> str:
+        return self._call("tpubc_crd_yaml")
+
+    def to_yaml(self, value: Any) -> str:
+        return self._call("tpubc_to_yaml", json.dumps(value))
+
+    def default_topology(self, accelerator: str) -> str:
+        out = self._call("tpubc_default_topology", accelerator)
+        if out.startswith('{"error"'):
+            raise NativeError(json.loads(out)["error"])
+        return out
+
+    def infer_header(self, header: str) -> str:
+        return self._call("tpubc_infer_header", header)
+
+    def sha256_hex(self, data: str) -> str:
+        return self._call("tpubc_sha256_hex", data)
+
+    def base64_encode(self, data: str) -> str:
+        return self._call("tpubc_base64_encode", data)
+
+    def base64_decode(self, data: str) -> str:
+        return self._call("tpubc_base64_decode", data)
+
+    # -- JSON APIs ----------------------------------------------------------
+    def crd(self) -> dict:
+        return self._call_json("tpubc_crd_json")
+
+    def json_roundtrip(self, text: str) -> Any:
+        return self._call_json("tpubc_json_roundtrip", text)
+
+    def json_patch(self, doc: Any, patch: Any) -> Any:
+        return self._call_json("tpubc_json_patch", doc, patch)
+
+    def validate_topology(self, accelerator: str, topology: str) -> dict:
+        return self._call_json("tpubc_validate_topology", accelerator, topology)
+
+    def slice_geometry(self, accelerator: str, topology: str) -> dict:
+        return self._call_json("tpubc_slice_geometry", accelerator, topology)
+
+    def classify_username(self, username: str, prefix: str) -> dict:
+        return self._call_json("tpubc_classify_username", username, prefix)
+
+    def default_admission_config(self) -> dict:
+        return self._call_json("tpubc_default_admission_config")
+
+    def mutate(self, request: Any, config: Any) -> dict:
+        return self._call_json("tpubc_mutate", request, config)
+
+    def mutate_review(self, review: Any, config: Any) -> dict:
+        return self._call_json("tpubc_mutate_review", review, config)
+
+    def default_controller_config(self) -> dict:
+        return self._call_json("tpubc_default_controller_config")
+
+    def desired_children(self, ub: Any, config: Any | None = None) -> list:
+        return self._call_json(
+            "tpubc_desired_children", ub, config or self.default_controller_config()
+        )
+
+    def build_jobset(self, ub: Any, config: Any | None = None) -> dict:
+        return self._call_json(
+            "tpubc_build_jobset", ub, config or self.default_controller_config()
+        )
+
+    def slice_status(self, ub: Any, jobset: Any) -> dict:
+        return self._call_json("tpubc_slice_status", ub, jobset)
+
+    def parse_sheet(self, csv_text: str) -> dict:
+        return self._call_json("tpubc_parse_sheet", csv_text)
+
+    def default_synchronizer_config(self) -> dict:
+        return self._call_json("tpubc_default_synchronizer_config")
+
+    def build_quota(self, row: Any, device: str = "tpu") -> dict:
+        return self._call_json("tpubc_build_quota", row, device)
+
+    def plan_sync(self, ub_list: Any, rows: Any, config: Any | None = None) -> dict:
+        return self._call_json(
+            "tpubc_plan_sync", ub_list, rows, config or self.default_synchronizer_config()
+        )
+
+
+_shared: NativeLib | None = None
+
+
+def get() -> NativeLib:
+    global _shared
+    if _shared is None:
+        _shared = NativeLib()
+    return _shared
