@@ -1,0 +1,125 @@
+// tpubc-admission: the mutating admission webhook daemon.
+//
+// Reference behavior (/root/reference/src/admission.rs): TLS HTTP server
+// with POST /mutate evaluating the policy core and GET /health; certificate
+// hot-reload by sha256 file-hash polling every 60s (admission.rs:104-126);
+// CONF_* env config including the comma-separated authorized group list.
+//
+// TPU extensions: accelerator/topology validation + slice-geometry
+// defaulting happen in the shared policy core (admission_core.cc).
+// CONF_TLS_DISABLED=1 serves plain HTTP for tests/sidecar-TLS setups.
+#include <thread>
+
+#include "tpubc/admission_core.h"
+#include "tpubc/config.h"
+#include "tpubc/http.h"
+#include "tpubc/json.h"
+#include "tpubc/log.h"
+#include "tpubc/runtime.h"
+#include "tpubc/util.h"
+
+using namespace tpubc;
+
+int main() {
+  log_init("tpubc-admission");
+  install_signal_handlers();
+
+  EnvConfig env;
+  const std::string listen_addr = env.get("listen_addr", "0.0.0.0");
+  const int listen_port = static_cast<int>(env.get_int("listen_port", 12321));
+  const bool tls_disabled = env.get("tls_disabled", "0") == "1";
+  std::string cert_path, key_path;
+  if (!tls_disabled) {
+    cert_path = env.require("cert_path");
+    key_path = env.require("key_path");
+  }
+  const int64_t cert_reload_secs = env.get_int("cert_reload_secs", 60);
+
+  Json config = default_admission_config();
+  config.set("oidc_username_prefix", env.get("oidc_username_prefix", "oidc:"));
+  config.set("default_role_name", env.get("default_role_name", "edit"));
+  Json groups = Json::array();
+  for (const auto& g : env.get_list("authorized_group_names", {"tpu", "admin"}))
+    groups.push_back(g);
+  config.set("authorized_group_names", groups);
+  config.set("default_accelerator", env.get("default_accelerator", "tpu-v5-lite-podslice"));
+  config.set("max_chips_per_user", env.get_int("max_chips_per_user", 0));
+
+  HttpServer server(listen_addr, listen_port, [config](const HttpRequest& req) {
+    HttpResponse resp;
+    if (req.path == "/health") {
+      resp.status = 200;
+      resp.headers["Content-Type"] = "text/plain";
+      resp.body = "pong";
+      return resp;
+    }
+    if (req.path == "/metrics") {
+      resp.status = 200;
+      resp.body = Metrics::instance().to_json().dump();
+      return resp;
+    }
+    if (req.path == "/mutate" && req.method == "POST") {
+      Metrics::instance().inc("admission_requests_total");
+      Json review;
+      try {
+        review = Json::parse(req.body);
+      } catch (const JsonError& e) {
+        resp.status = 400;
+        resp.body = Json::object({{"error", std::string("bad AdmissionReview: ") + e.what()}}).dump();
+        return resp;
+      }
+      Json out = mutate_review(review, config);
+      if (!out.get("response").get_bool("allowed", false))
+        Metrics::instance().inc("admission_denials_total");
+      resp.status = 200;
+      resp.body = out.dump();
+      return resp;
+    }
+    resp.status = 404;
+    resp.body = "not found";
+    return resp;
+  });
+
+  if (!tls_disabled) server.enable_tls(cert_path, key_path);
+  server.start();
+  log_info("admission webhook listening",
+           {{"addr", listen_addr},
+            {"port", std::to_string(server.bound_port())},
+            {"tls", tls_disabled ? "disabled" : "enabled"}});
+
+  // Cert hot-reloader: hash-poll the PEM files, reload on change
+  // (admission.rs:104-126 parity, including the combined cert+key hash).
+  std::thread reloader;
+  if (!tls_disabled) {
+    reloader = std::thread([&, cert_path, key_path, cert_reload_secs] {
+      std::string hash;
+      try {
+        hash = sha256_hex(read_file(cert_path) + read_file(key_path));
+      } catch (const std::exception& e) {
+        log_error("cert hash failed", {{"error", e.what()}});
+      }
+      while (!stop_wait_ms(cert_reload_secs * 1000)) {
+        try {
+          std::string fresh = sha256_hex(read_file(cert_path) + read_file(key_path));
+          if (fresh != hash) {
+            log_info("cert changed, reloading...");
+            server.reload_certs();
+            hash = fresh;
+            Metrics::instance().inc("cert_reloads_total");
+            log_info("cert reloading done.");
+          }
+        } catch (const std::exception& e) {
+          log_error("cert reload failed", {{"error", e.what()}});
+        }
+      }
+    });
+  }
+
+  while (!stop_wait_ms(60'000)) {
+  }
+  log_info("signal received, starting graceful shutdown");
+  server.stop();
+  if (reloader.joinable()) reloader.join();
+  log_info("admission gracefully shut down");
+  return 0;
+}

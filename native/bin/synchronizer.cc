@@ -1,0 +1,171 @@
+// tpubc-synchronizer: the external-inventory sync daemon.
+//
+// Reference behavior (/root/reference/src/synchronizer.rs): every
+// sync_interval_secs (60 default), export the request sheet as CSV, parse
+// with Korean-header inference, filter by server-name substring, and for
+// each CR with an authorized row write status.synchronized_with_sheet=true
+// (resourceVersion-pinned replace) THEN json-patch spec.quota — status
+// first so the controller's interlocks open immediately.
+//
+// TPU re-grounding: quota keys target requests.google.com/tpu; the sheet
+// source is pluggable (CONF_SHEET_PATH file or CONF_SHEET_URL endpoint —
+// the Drive CSV-export URL works here once fronted with auth); chip
+// inventory comes from CONF_POOL_CAPACITY_CHIPS or a CONF_INVENTORY_URL
+// returning {"capacity_chips": N}, and admission against capacity is
+// first-come (plan_sync in sheet_core.cc).
+#include "tpubc/config.h"
+#include "tpubc/crd.h"
+#include "tpubc/http.h"
+#include "tpubc/json.h"
+#include "tpubc/kube_client.h"
+#include "tpubc/log.h"
+#include "tpubc/runtime.h"
+#include "tpubc/sheet_core.h"
+#include "tpubc/util.h"
+
+using namespace tpubc;
+
+namespace {
+
+std::string fetch_sheet(const std::string& path, const std::string& url) {
+  if (!path.empty()) return read_file(path);
+  HttpClient client(url);
+  Url u = parse_url(url);
+  HttpResponse resp = client.request("GET", u.path);
+  if (!resp.ok())
+    throw std::runtime_error("sheet fetch failed: HTTP " + std::to_string(resp.status));
+  return resp.body;
+}
+
+int64_t fetch_capacity(const std::string& inventory_url, int64_t fallback) {
+  if (inventory_url.empty()) return fallback;
+  try {
+    HttpClient client(inventory_url);
+    Url u = parse_url(inventory_url);
+    HttpResponse resp = client.request("GET", u.path);
+    if (!resp.ok()) throw std::runtime_error("HTTP " + std::to_string(resp.status));
+    Json inv = Json::parse(resp.body);
+    return inv.get_int("capacity_chips", fallback);
+  } catch (const std::exception& e) {
+    log_warn("inventory poll failed; using configured capacity",
+             {{"error", e.what()}, {"capacity", std::to_string(fallback)}});
+    return fallback;
+  }
+}
+
+void run_sync_once(KubeClient& client, const Json& sync_config, const std::string& sheet_path,
+                   const std::string& sheet_url, const std::string& inventory_url) {
+  log_info("starting synchronization");
+  std::string csv = fetch_sheet(sheet_path, sheet_url);
+  log_info("downloaded csv file", {{"bytes", std::to_string(csv.size())}});
+
+  Json parsed = parse_sheet(csv);
+  for (const auto& w : parsed.get("warnings").items())
+    log_warn("row parsing error. skipping", {{"detail", w.as_string()}});
+
+  Json config = sync_config;
+  config.set("pool_capacity_chips",
+             fetch_capacity(inventory_url, config.get_int("pool_capacity_chips", 0)));
+
+  Json list = client.list(kApiVersion, kKind);
+  Json plan = plan_sync(list.get("items"), parsed.get("rows"), config);
+
+  for (const auto& s : plan.get("skipped").items())
+    log_warn("sync skipped", {{"name", s.get_string("name")}, {"reason", s.get_string("reason")}});
+
+  for (const auto& action : plan.get("actions").items()) {
+    const std::string name = action.get_string("name");
+    // 1. status first (synchronizer.rs:302 before :324).
+    Json status_obj = Json::object({
+        {"apiVersion", kApiVersion},
+        {"kind", kKind},
+        {"metadata", Json::object({
+                         {"name", name},
+                         {"resourceVersion", action.get_string("resource_version")},
+                     })},
+        {"status", action.get("status")},
+    });
+    log_info("updating status", {{"name", name}});
+    try {
+      client.replace_status(kApiVersion, kKind, "", name, status_obj);
+    } catch (const KubeError& e) {
+      if (e.status == 409) {
+        // resourceVersion conflict: the CR moved under us. Next tick
+        // re-plans from fresh state (reference surfaces the error and
+        // aborts the whole loop; we keep going per-CR).
+        log_warn("status conflict; will retry next sync", {{"name", name}});
+        Metrics::instance().inc("sync_conflicts_total");
+        continue;
+      }
+      throw;
+    }
+    // 2. quota patch.
+    log_info("updating quota", {{"name", name}, {"chips", std::to_string(action.get_int("chips", 0))}});
+    client.json_patch(kApiVersion, kKind, "", name, action.get("patches"));
+    Metrics::instance().inc("sync_actions_total");
+    log_info("quota updated", {{"name", name}});
+  }
+  Metrics::instance().inc("syncs_total");
+  Metrics::instance().set("pool_chips_allocated", plan.get_int("total_chips", 0));
+}
+
+}  // namespace
+
+int main() {
+  log_init("tpubc-synchronizer");
+  install_signal_handlers();
+
+  EnvConfig env;
+  const std::string listen_addr = env.get("listen_addr", "0.0.0.0");
+  const int listen_port = static_cast<int>(env.get_int("listen_port", 12323));
+  const int64_t interval_secs = env.get_int("sync_interval_secs", 60);
+  const std::string sheet_path = env.get("sheet_path", "");
+  const std::string sheet_url = env.get("sheet_url", "");
+  const std::string inventory_url = env.get("inventory_url", "");
+  if (sheet_path.empty() && sheet_url.empty()) {
+    log_error("set CONF_SHEET_PATH or CONF_SHEET_URL");
+    return 1;
+  }
+
+  Json sync_config = default_synchronizer_config();
+  sync_config.set("server_name", env.get("server_name", env.get("gpu_server_name", "")));
+  sync_config.set("device", env.get("device", "tpu"));
+  sync_config.set("pool_capacity_chips", env.get_int("pool_capacity_chips", 0));
+
+  KubeClient client(kube_config_from_env());
+
+  HttpServer health(listen_addr, listen_port, [](const HttpRequest& req) {
+    HttpResponse resp;
+    if (req.path == "/health") {
+      resp.status = 200;
+      resp.headers["Content-Type"] = "text/plain";
+      resp.body = "pong";
+    } else if (req.path == "/metrics") {
+      resp.status = 200;
+      resp.body = Metrics::instance().to_json().dump();
+    } else {
+      resp.status = 404;
+      resp.body = "not found";
+    }
+    return resp;
+  });
+  health.start();
+  log_info("synchronizer started", {{"addr", listen_addr},
+                                    {"port", std::to_string(health.bound_port())},
+                                    {"interval_secs", std::to_string(interval_secs)}});
+
+  // Tick immediately, then every interval (tokio interval fires at t=0 too).
+  do {
+    try {
+      run_sync_once(client, sync_config, sheet_path, sheet_url, inventory_url);
+    } catch (const std::exception& e) {
+      log_error("synchronization failed", {{"error", e.what()}});
+      Metrics::instance().inc("sync_errors_total");
+    }
+  } while (!stop_wait_ms(interval_secs * 1000));
+
+  log_info("signal received, starting graceful shutdown");
+  health.stop();
+  log_info("synchronizer gracefully shut down");
+  return 0;
+}

@@ -1,0 +1,124 @@
+// Minimal HTTP/1.1 client + server over POSIX sockets with optional TLS.
+//
+// Fills the role axum/hyper/reqwest play in the reference daemons: the
+// client side talks to the Kubernetes API server (incl. chunked watch
+// streams) and external inventory/sheet endpoints; the server side serves
+// /health for all daemons and /mutate (TLS) for the admission webhook.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "tpubc/tls.h"
+
+namespace tpubc {
+
+struct Url {
+  std::string scheme;  // http | https
+  std::string host;
+  int port = 0;
+  std::string path;    // path + query, at least "/"
+};
+
+Url parse_url(const std::string& url);
+
+struct HttpResponse {
+  int status = 0;
+  std::map<std::string, std::string> headers;  // lower-cased keys
+  std::string body;
+  bool ok() const { return status >= 200 && status < 300; }
+};
+
+struct HttpRequest {
+  std::string method;
+  std::string path;     // path + query
+  std::map<std::string, std::string> headers;  // lower-cased keys
+  std::string body;
+};
+
+class HttpClient {
+ public:
+  // base_url e.g. "http://127.0.0.1:8001" or "https://10.0.0.1:443".
+  // ca_file/verify_peer only apply to https. bearer_token, if set, is sent
+  // as Authorization: Bearer on every request.
+  explicit HttpClient(const std::string& base_url, std::string ca_file = "",
+                      bool verify_peer = true, std::string bearer_token = "");
+
+  // One-shot request (new connection per call; the API-server LB friendly
+  // pattern — the reference's hyper client pools, we trade a socket per
+  // call for simplicity; watch streams dominate traffic anyway).
+  HttpResponse request(const std::string& method, const std::string& path,
+                       const std::string& body = "", const std::string& content_type = "",
+                       const std::map<std::string, std::string>& extra_headers = {},
+                       int timeout_secs = 30);
+
+  // Streaming GET: decode the chunked/streamed body incrementally and
+  // invoke on_line for every newline-terminated line (the k8s watch
+  // protocol frames one JSON event per line). Returns the HTTP status.
+  // Stops when the server closes, on_line returns false, or *cancel
+  // becomes true.
+  int stream_lines(const std::string& path, const std::function<bool(const std::string&)>& on_line,
+                   std::atomic<bool>* cancel, int connect_timeout_secs = 30);
+
+  const Url& base() const { return base_; }
+
+ private:
+  struct Conn;
+  std::unique_ptr<Conn> open(int timeout_secs);
+
+  Url base_;
+  std::string ca_file_;
+  bool verify_peer_;
+  std::string bearer_;
+  TlsCtxPtr tls_ctx_;  // lazily created
+};
+
+class HttpServer {
+ public:
+  using Handler = std::function<HttpResponse(const HttpRequest&)>;
+
+  // port 0 => ephemeral; bound_port() reports the real one.
+  HttpServer(const std::string& addr, int port, Handler handler);
+  ~HttpServer();
+
+  // Enable TLS before start(). reload_certs() re-reads the same paths and
+  // atomically swaps the context (cert-manager rotation, admission.rs
+  // cert_reloader parity); in-flight connections keep the old context.
+  void enable_tls(const std::string& cert_path, const std::string& key_path);
+  void reload_certs();
+
+  void start();
+  void stop();  // close listener, join accept thread
+  int bound_port() const { return bound_port_; }
+
+ private:
+  void accept_loop();
+  void handle_connection(int fd);
+
+  std::string addr_;
+  int port_;
+  Handler handler_;
+  int listen_fd_ = -1;
+  int bound_port_ = 0;
+  std::atomic<bool> stopping_{false};
+  std::thread accept_thread_;
+  // Connection threads run detached; stop() drains via this counter (10s
+  // grace, the reference's TLS drain window — admission.rs:93).
+  std::atomic<int> active_connections_{0};
+  std::mutex drain_mutex_;
+  std::condition_variable drain_cv_;
+
+  bool tls_enabled_ = false;
+  std::string cert_path_, key_path_;
+  TlsCtxPtr server_ctx_;
+  std::mutex ctx_mutex_;
+};
+
+}  // namespace tpubc

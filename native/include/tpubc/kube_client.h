@@ -1,0 +1,97 @@
+// Kubernetes API client — the part kube-rs gave the reference for free
+// (/root/reference/Cargo.toml:32); scoped to exactly the verbs the
+// operator's RBAC grants (reference serviceaccount.yaml:23-34): get, list,
+// watch, create-via-apply, patch, and the status subresource.
+//
+// Auth modes:
+//  * CONF_KUBE_API_URL set => talk to that URL (kubectl proxy / fake API
+//    server in tests), no token needed.
+//  * otherwise in-cluster: https://$KUBERNETES_SERVICE_HOST:$PORT with the
+//    mounted ServiceAccount token + CA (the kube::Client::try_default()
+//    path, controller.rs:224).
+#pragma once
+
+#include <atomic>
+#include <functional>
+#include <memory>
+#include <stdexcept>
+#include <string>
+
+#include "tpubc/http.h"
+#include "tpubc/json.h"
+
+namespace tpubc {
+
+struct KubeConfig {
+  std::string base_url;
+  std::string token;
+  std::string ca_file;
+  bool verify_tls = true;
+};
+
+// Resolve config from env (see header comment). Throws if neither mode is
+// configured.
+KubeConfig kube_config_from_env();
+
+class KubeError : public std::runtime_error {
+ public:
+  KubeError(int status, const std::string& message)
+      : std::runtime_error("kube api " + std::to_string(status) + ": " + message),
+        status(status) {}
+  int status;
+};
+
+// REST path for a (apiVersion, kind): collection path and item path.
+// Knows the fixed GVK set this operator manages. ns empty => cluster scope.
+std::string resource_path(const std::string& api_version, const std::string& kind,
+                          const std::string& ns, const std::string& name);
+
+class KubeClient {
+ public:
+  explicit KubeClient(KubeConfig config);
+
+  // GET collection; returns the List object.
+  Json list(const std::string& api_version, const std::string& kind,
+            const std::string& ns = "");
+  Json get(const std::string& api_version, const std::string& kind, const std::string& ns,
+           const std::string& name);
+
+  // Server-side apply (PATCH application/apply-patch+yaml with fieldManager
+  // and force=true — the reference's PatchParams::apply().force(),
+  // controller.rs:67). The object must carry apiVersion/kind/metadata.name.
+  Json apply(const Json& obj, const std::string& field_manager, bool force = true);
+
+  // RFC-6902 patch (synchronizer.rs:322-330).
+  Json json_patch(const std::string& api_version, const std::string& kind, const std::string& ns,
+                  const std::string& name, const Json& patch);
+
+  // PUT the status subresource (synchronizer.rs:302-308 replace_status).
+  Json replace_status(const std::string& api_version, const std::string& kind,
+                      const std::string& ns, const std::string& name, const Json& obj);
+
+  // PATCH (merge) the status subresource — used by the controller for
+  // status.slice without clobbering the synchronizer's fields.
+  Json merge_status(const std::string& api_version, const std::string& kind,
+                    const std::string& ns, const std::string& name, const Json& status_patch);
+
+  void remove(const std::string& api_version, const std::string& kind, const std::string& ns,
+              const std::string& name);
+
+  // Blocking watch on a collection starting at resource_version. Invokes
+  // on_event(type, object) per event. Returns when cancel is set, the
+  // server ends the stream, or a 410 Gone arrives (caller re-lists).
+  // Returns the last seen resourceVersion ("" on 410).
+  std::string watch(const std::string& api_version, const std::string& kind,
+                    const std::string& resource_version,
+                    const std::function<void(const std::string&, const Json&)>& on_event,
+                    std::atomic<bool>* cancel);
+
+  const KubeConfig& config() const { return config_; }
+
+ private:
+  Json check(const HttpResponse& resp);
+  KubeConfig config_;
+  std::unique_ptr<HttpClient> http_;
+};
+
+}  // namespace tpubc

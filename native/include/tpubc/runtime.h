@@ -1,0 +1,40 @@
+// Shared daemon runtime skeleton: SIGINT/SIGTERM -> graceful-stop flag
+// (the reference's broadcast-channel/Stopper pattern, controller.rs:177-205)
+// plus simple process-wide metrics counters surfaced at /metrics — an
+// addition over the reference (SURVEY.md §5: "the build should add a
+// metrics endpoint").
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <mutex>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "tpubc/json.h"
+
+namespace tpubc {
+
+// Install SIGINT/SIGTERM handlers that set the stop flag. Call once.
+void install_signal_handlers();
+std::atomic<bool>& stop_requested();
+// Sleep up to ms milliseconds, returning early (true) if stop requested.
+bool stop_wait_ms(int64_t ms);
+// Wake all stop_wait_ms sleepers (used by signal handler and tests).
+void request_stop();
+
+// Named monotonically-increasing counters, rendered by /metrics.
+class Metrics {
+ public:
+  static Metrics& instance();
+  void inc(const std::string& name, int64_t delta = 1);
+  void set(const std::string& name, int64_t value);
+  Json to_json() const;
+
+ private:
+  mutable std::mutex mutex_;
+  std::vector<std::pair<std::string, int64_t>> counters_;
+};
+
+}  // namespace tpubc

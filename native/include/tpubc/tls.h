@@ -1,0 +1,51 @@
+// Minimal OpenSSL 3 shim.
+//
+// This image ships libssl.so.3/libcrypto.so.3 but no OpenSSL headers, so we
+// declare the handful of stable C-ABI entry points the daemons need (client
+// connections for the kube/API clients, server TLS for the admission
+// webhook) and link -l:libssl.so.3 directly. Only opaque pointers cross the
+// boundary; no OpenSSL structs are dereferenced here.
+#pragma once
+
+#include <memory>
+#include <mutex>
+#include <string>
+
+namespace tpubc {
+
+struct TlsCtxDeleter {
+  void operator()(void* ctx) const;
+};
+using TlsCtxPtr = std::shared_ptr<void>;
+
+// Client context; verify_peer=false skips CA verification (dev only).
+// ca_file empty => default system roots.
+TlsCtxPtr tls_client_context(const std::string& ca_file = "", bool verify_peer = true);
+
+// Server context from PEM cert chain + key files. Throws std::runtime_error.
+TlsCtxPtr tls_server_context(const std::string& cert_path, const std::string& key_path);
+
+// A TLS stream over an already-connected socket fd. Takes shared ownership
+// of the context (hot-reload safe: in-flight connections keep the old ctx).
+class TlsStream {
+ public:
+  // Client handshake; sni may be empty.
+  static std::unique_ptr<TlsStream> connect(TlsCtxPtr ctx, int fd, const std::string& sni);
+  // Server-side accept handshake.
+  static std::unique_ptr<TlsStream> accept(TlsCtxPtr ctx, int fd);
+
+  ~TlsStream();
+  TlsStream(const TlsStream&) = delete;
+
+  // Returns bytes read (0 on orderly close), throws on fatal error.
+  size_t read(char* buf, size_t len);
+  void write_all(const char* buf, size_t len);
+  void shutdown();
+
+ private:
+  TlsStream(TlsCtxPtr ctx, void* ssl) : ctx_(std::move(ctx)), ssl_(ssl) {}
+  TlsCtxPtr ctx_;
+  void* ssl_;
+};
+
+}  // namespace tpubc

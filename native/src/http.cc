@@ -1,0 +1,534 @@
+#include "tpubc/http.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <sstream>
+#include <stdexcept>
+
+#include "tpubc/log.h"
+#include "tpubc/util.h"
+
+namespace tpubc {
+
+namespace {
+
+// Byte stream abstraction over plain fd / TLS.
+class Stream {
+ public:
+  virtual ~Stream() = default;
+  virtual size_t read_some(char* buf, size_t len) = 0;  // 0 => closed
+  virtual void write_all(const char* buf, size_t len) = 0;
+};
+
+class FdStream : public Stream {
+ public:
+  explicit FdStream(int fd) : fd_(fd) {}
+  size_t read_some(char* buf, size_t len) override {
+    ssize_t n = ::recv(fd_, buf, len, 0);
+    if (n < 0) throw std::runtime_error(std::string("recv: ") + std::strerror(errno));
+    return static_cast<size_t>(n);
+  }
+  void write_all(const char* buf, size_t len) override {
+    size_t off = 0;
+    while (off < len) {
+      ssize_t n = ::send(fd_, buf + off, len - off, MSG_NOSIGNAL);
+      if (n <= 0) throw std::runtime_error(std::string("send: ") + std::strerror(errno));
+      off += static_cast<size_t>(n);
+    }
+  }
+
+ private:
+  int fd_;
+};
+
+class TlsStreamAdapter : public Stream {
+ public:
+  explicit TlsStreamAdapter(std::unique_ptr<TlsStream> tls) : tls_(std::move(tls)) {}
+  size_t read_some(char* buf, size_t len) override { return tls_->read(buf, len); }
+  void write_all(const char* buf, size_t len) override { tls_->write_all(buf, len); }
+  TlsStream* tls() { return tls_.get(); }
+
+ private:
+  std::unique_ptr<TlsStream> tls_;
+};
+
+int tcp_connect(const std::string& host, int port, int timeout_secs) {
+  struct addrinfo hints{};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  std::string port_str = std::to_string(port);
+  int rc = ::getaddrinfo(host.c_str(), port_str.c_str(), &hints, &res);
+  if (rc != 0)
+    throw std::runtime_error("getaddrinfo " + host + ": " + gai_strerror(rc));
+  int fd = -1;
+  std::string err;
+  for (struct addrinfo* ai = res; ai; ai = ai->ai_next) {
+    fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    struct timeval tv{timeout_secs, 0};
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    err = std::strerror(errno);
+    ::close(fd);
+    fd = -1;
+  }
+  ::freeaddrinfo(res);
+  if (fd < 0) throw std::runtime_error("connect " + host + ":" + port_str + ": " + err);
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+// Incremental reader with internal buffer for header/line parsing.
+class BufReader {
+ public:
+  explicit BufReader(Stream* s) : s_(s) {}
+
+  // Read until delimiter; returns content without the delimiter.
+  // Throws on premature close unless allow_eof (then returns what's left
+  // and sets *eof).
+  std::string read_until(const std::string& delim, bool allow_eof = false, bool* eof = nullptr) {
+    while (true) {
+      size_t pos = buf_.find(delim);
+      if (pos != std::string::npos) {
+        std::string out = buf_.substr(0, pos);
+        buf_.erase(0, pos + delim.size());
+        return out;
+      }
+      char tmp[8192];
+      size_t n = s_->read_some(tmp, sizeof(tmp));
+      if (n == 0) {
+        if (allow_eof) {
+          if (eof) *eof = true;
+          std::string out;
+          out.swap(buf_);
+          return out;
+        }
+        throw std::runtime_error("connection closed mid-message");
+      }
+      buf_.append(tmp, n);
+    }
+  }
+
+  std::string read_exact(size_t len) {
+    while (buf_.size() < len) {
+      char tmp[8192];
+      size_t n = s_->read_some(tmp, sizeof(tmp));
+      if (n == 0) throw std::runtime_error("connection closed mid-body");
+      buf_.append(tmp, n);
+    }
+    std::string out = buf_.substr(0, len);
+    buf_.erase(0, len);
+    return out;
+  }
+
+  // Read whatever remains until EOF.
+  std::string read_to_eof() {
+    char tmp[8192];
+    while (true) {
+      size_t n = s_->read_some(tmp, sizeof(tmp));
+      if (n == 0) break;
+      buf_.append(tmp, n);
+    }
+    std::string out;
+    out.swap(buf_);
+    return out;
+  }
+
+ private:
+  Stream* s_;
+  std::string buf_;
+};
+
+std::map<std::string, std::string> parse_headers(BufReader& r) {
+  std::map<std::string, std::string> headers;
+  while (true) {
+    std::string line = r.read_until("\r\n");
+    if (line.empty()) break;
+    size_t colon = line.find(':');
+    if (colon == std::string::npos) continue;
+    std::string key = to_lower(trim(line.substr(0, colon)));
+    headers[key] = trim(line.substr(colon + 1));
+  }
+  return headers;
+}
+
+}  // namespace
+
+Url parse_url(const std::string& url) {
+  Url u;
+  std::string rest = url;
+  size_t scheme_end = rest.find("://");
+  if (scheme_end == std::string::npos) throw std::runtime_error("bad url (no scheme): " + url);
+  u.scheme = rest.substr(0, scheme_end);
+  if (u.scheme != "http" && u.scheme != "https")
+    throw std::runtime_error("unsupported scheme: " + u.scheme);
+  rest = rest.substr(scheme_end + 3);
+  size_t path_start = rest.find('/');
+  std::string hostport = path_start == std::string::npos ? rest : rest.substr(0, path_start);
+  u.path = path_start == std::string::npos ? "/" : rest.substr(path_start);
+  size_t colon = hostport.rfind(':');
+  if (colon != std::string::npos && hostport.find(']') == std::string::npos) {
+    u.host = hostport.substr(0, colon);
+    u.port = std::stoi(hostport.substr(colon + 1));
+  } else {
+    u.host = hostport;
+    u.port = u.scheme == "https" ? 443 : 80;
+  }
+  if (u.host.empty()) throw std::runtime_error("bad url (no host): " + url);
+  return u;
+}
+
+// ---------------------------------------------------------------------------
+// Client
+// ---------------------------------------------------------------------------
+
+struct HttpClient::Conn {
+  int fd = -1;
+  std::unique_ptr<Stream> stream;
+  ~Conn() {
+    if (fd >= 0) ::close(fd);
+  }
+};
+
+HttpClient::HttpClient(const std::string& base_url, std::string ca_file, bool verify_peer,
+                       std::string bearer_token)
+    : base_(parse_url(base_url)),
+      ca_file_(std::move(ca_file)),
+      verify_peer_(verify_peer),
+      bearer_(std::move(bearer_token)) {
+  // Eagerly build the TLS context: HttpClient is shared across reconcile
+  // workers, so lazy init in open() would race.
+  if (base_.scheme == "https") tls_ctx_ = tls_client_context(ca_file_, verify_peer_);
+}
+
+std::unique_ptr<HttpClient::Conn> HttpClient::open(int timeout_secs) {
+  auto conn = std::make_unique<Conn>();
+  conn->fd = tcp_connect(base_.host, base_.port, timeout_secs);
+  if (base_.scheme == "https") {
+    conn->stream = std::make_unique<TlsStreamAdapter>(
+        TlsStream::connect(tls_ctx_, conn->fd, base_.host));
+  } else {
+    conn->stream = std::make_unique<FdStream>(conn->fd);
+  }
+  return conn;
+}
+
+namespace {
+
+std::string build_request_head(const std::string& method, const std::string& path,
+                               const std::string& host, const std::string& bearer,
+                               const std::string& content_type, size_t body_len,
+                               const std::map<std::string, std::string>& extra) {
+  std::ostringstream ss;
+  ss << method << " " << path << " HTTP/1.1\r\n";
+  ss << "Host: " << host << "\r\n";
+  ss << "Connection: close\r\n";
+  ss << "Accept: application/json\r\n";
+  if (!bearer.empty()) ss << "Authorization: Bearer " << bearer << "\r\n";
+  if (!content_type.empty()) ss << "Content-Type: " << content_type << "\r\n";
+  if (body_len > 0 || content_type.size())
+    ss << "Content-Length: " << body_len << "\r\n";
+  for (const auto& kv : extra) ss << kv.first << ": " << kv.second << "\r\n";
+  ss << "\r\n";
+  return ss.str();
+}
+
+}  // namespace
+
+HttpResponse HttpClient::request(const std::string& method, const std::string& path,
+                                 const std::string& body, const std::string& content_type,
+                                 const std::map<std::string, std::string>& extra_headers,
+                                 int timeout_secs) {
+  auto conn = open(timeout_secs);
+  std::string head =
+      build_request_head(method, path, base_.host, bearer_, content_type, body.size(), extra_headers);
+  conn->stream->write_all(head.data(), head.size());
+  if (!body.empty()) conn->stream->write_all(body.data(), body.size());
+
+  BufReader reader(conn->stream.get());
+  std::string status_line = reader.read_until("\r\n");
+  HttpResponse resp;
+  if (status_line.size() < 12) throw std::runtime_error("bad status line: " + status_line);
+  resp.status = std::stoi(status_line.substr(9, 3));
+  resp.headers = parse_headers(reader);
+
+  auto it = resp.headers.find("transfer-encoding");
+  if (it != resp.headers.end() && contains(to_lower(it->second), "chunked")) {
+    while (true) {
+      std::string size_line = reader.read_until("\r\n");
+      size_t chunk_size = std::stoul(size_line, nullptr, 16);
+      if (chunk_size == 0) break;
+      resp.body += reader.read_exact(chunk_size);
+      reader.read_exact(2);  // trailing CRLF
+    }
+  } else if (resp.headers.count("content-length")) {
+    resp.body = reader.read_exact(std::stoul(resp.headers["content-length"]));
+  } else {
+    resp.body = reader.read_to_eof();
+  }
+  return resp;
+}
+
+int HttpClient::stream_lines(const std::string& path,
+                             const std::function<bool(const std::string&)>& on_line,
+                             std::atomic<bool>* cancel, int connect_timeout_secs) {
+  auto conn = open(connect_timeout_secs);
+  // Long receive timeout so watch connections survive idle periods but the
+  // cancel flag is still polled every timeout tick.
+  struct timeval tv{5, 0};
+  ::setsockopt(conn->fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+
+  std::string head = build_request_head("GET", path, base_.host, bearer_, "", 0, {});
+  conn->stream->write_all(head.data(), head.size());
+
+  std::string buf;        // raw bytes off the wire
+  std::string decoded;    // de-chunked payload
+  bool in_headers = true;
+  bool chunked = false;
+  int status = 0;
+  enum class ChunkState { Size, Data, Crlf } cstate = ChunkState::Size;
+  size_t chunk_remaining = 0;
+
+  char tmp[16384];
+  while (!(cancel && cancel->load())) {
+    size_t n;
+    try {
+      n = conn->stream->read_some(tmp, sizeof(tmp));
+    } catch (const std::exception&) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) continue;  // timeout tick
+      break;
+    }
+    if (n == 0) break;
+    buf.append(tmp, n);
+
+    if (in_headers) {
+      size_t hdr_end = buf.find("\r\n\r\n");
+      if (hdr_end == std::string::npos) continue;
+      std::string head_block = buf.substr(0, hdr_end);
+      buf.erase(0, hdr_end + 4);
+      size_t line_end = head_block.find("\r\n");
+      std::string status_line =
+          line_end == std::string::npos ? head_block : head_block.substr(0, line_end);
+      if (status_line.size() >= 12) status = std::stoi(status_line.substr(9, 3));
+      chunked = contains(to_lower(head_block), "transfer-encoding: chunked");
+      in_headers = false;
+      if (status >= 300) {
+        // Error bodies are small; collect to EOF and deliver as one line
+        // for diagnostics (the connection is Connection: close).
+        try {
+          while (true) {
+            size_t more = conn->stream->read_some(tmp, sizeof(tmp));
+            if (more == 0) break;
+            buf.append(tmp, more);
+          }
+        } catch (const std::exception&) {
+        }
+        on_line(buf);
+        return status;
+      }
+    }
+
+    // De-chunk (or pass through) into `decoded`.
+    if (!chunked) {
+      decoded.append(buf);
+      buf.clear();
+    } else {
+      bool need_more = false;
+      while (!buf.empty() && !need_more) {
+        switch (cstate) {
+          case ChunkState::Size: {
+            size_t crlf = buf.find("\r\n");
+            if (crlf == std::string::npos) {
+              need_more = true;
+              break;
+            }
+            chunk_remaining = std::stoul(buf.substr(0, crlf), nullptr, 16);
+            buf.erase(0, crlf + 2);
+            if (chunk_remaining == 0) return status;  // final chunk
+            cstate = ChunkState::Data;
+            break;
+          }
+          case ChunkState::Data: {
+            size_t take = std::min(chunk_remaining, buf.size());
+            decoded.append(buf, 0, take);
+            buf.erase(0, take);
+            chunk_remaining -= take;
+            if (chunk_remaining == 0) cstate = ChunkState::Crlf;
+            break;
+          }
+          case ChunkState::Crlf: {
+            if (buf.size() < 2) {
+              need_more = true;
+              break;
+            }
+            buf.erase(0, 2);
+            cstate = ChunkState::Size;
+            break;
+          }
+        }
+      }
+    }
+
+    // Emit complete lines.
+    size_t nl;
+    while ((nl = decoded.find('\n')) != std::string::npos) {
+      std::string line = decoded.substr(0, nl);
+      decoded.erase(0, nl + 1);
+      if (!line.empty() && line.back() == '\r') line.pop_back();
+      if (!line.empty() && !on_line(line)) return status;
+    }
+  }
+  return status;
+}
+
+// ---------------------------------------------------------------------------
+// Server
+// ---------------------------------------------------------------------------
+
+HttpServer::HttpServer(const std::string& addr, int port, Handler handler)
+    : addr_(addr), port_(port), handler_(std::move(handler)) {}
+
+HttpServer::~HttpServer() { stop(); }
+
+void HttpServer::enable_tls(const std::string& cert_path, const std::string& key_path) {
+  cert_path_ = cert_path;
+  key_path_ = key_path;
+  server_ctx_ = tls_server_context(cert_path, key_path);
+  tls_enabled_ = true;
+}
+
+void HttpServer::reload_certs() {
+  TlsCtxPtr fresh = tls_server_context(cert_path_, key_path_);
+  std::lock_guard<std::mutex> lock(ctx_mutex_);
+  server_ctx_ = std::move(fresh);
+}
+
+void HttpServer::start() {
+  listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (listen_fd_ < 0) throw std::runtime_error("socket() failed");
+  int one = 1;
+  ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_port = htons(static_cast<uint16_t>(port_));
+  if (::inet_pton(AF_INET, addr_.c_str(), &sa.sin_addr) != 1)
+    throw std::runtime_error("bad listen address: " + addr_);
+  if (::bind(listen_fd_, reinterpret_cast<struct sockaddr*>(&sa), sizeof(sa)) != 0)
+    throw std::runtime_error("bind " + addr_ + ":" + std::to_string(port_) + ": " +
+                             std::strerror(errno));
+  socklen_t len = sizeof(sa);
+  ::getsockname(listen_fd_, reinterpret_cast<struct sockaddr*>(&sa), &len);
+  bound_port_ = ntohs(sa.sin_port);
+  if (::listen(listen_fd_, 128) != 0) throw std::runtime_error("listen() failed");
+  accept_thread_ = std::thread([this] { accept_loop(); });
+}
+
+void HttpServer::stop() {
+  if (stopping_.exchange(true)) return;
+  if (listen_fd_ >= 0) {
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    listen_fd_ = -1;
+  }
+  if (accept_thread_.joinable()) accept_thread_.join();
+  // Drain in-flight connections (bounded grace period).
+  std::unique_lock<std::mutex> lock(drain_mutex_);
+  drain_cv_.wait_for(lock, std::chrono::seconds(10),
+                     [this] { return active_connections_.load() == 0; });
+}
+
+void HttpServer::accept_loop() {
+  while (!stopping_.load()) {
+    struct sockaddr_in peer{};
+    socklen_t peer_len = sizeof(peer);
+    int fd = ::accept(listen_fd_, reinterpret_cast<struct sockaddr*>(&peer), &peer_len);
+    if (fd < 0) {
+      if (stopping_.load()) break;
+      continue;
+    }
+    active_connections_.fetch_add(1);
+    std::thread([this, fd] {
+      handle_connection(fd);
+      {
+        std::lock_guard<std::mutex> lock(drain_mutex_);
+        active_connections_.fetch_sub(1);
+      }
+      drain_cv_.notify_all();
+    }).detach();
+  }
+}
+
+void HttpServer::handle_connection(int fd) {
+  struct timeval tv{30, 0};
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  std::unique_ptr<Stream> stream;
+  try {
+    if (tls_enabled_) {
+      TlsCtxPtr ctx;
+      {
+        std::lock_guard<std::mutex> lock(ctx_mutex_);
+        ctx = server_ctx_;
+      }
+      stream = std::make_unique<TlsStreamAdapter>(TlsStream::accept(std::move(ctx), fd));
+    } else {
+      stream = std::make_unique<FdStream>(fd);
+    }
+
+    BufReader reader(stream.get());
+    std::string request_line = reader.read_until("\r\n");
+    auto parts = split(request_line, ' ');
+    if (parts.size() < 3) throw std::runtime_error("bad request line");
+    HttpRequest req;
+    req.method = parts[0];
+    req.path = parts[1];
+    req.headers = parse_headers(reader);
+    if (req.headers.count("content-length")) {
+      size_t n = std::stoul(req.headers["content-length"]);
+      constexpr size_t kMaxBody = 16 * 1024 * 1024;
+      if (n > kMaxBody) throw std::runtime_error("request body too large");
+      req.body = reader.read_exact(n);
+    }
+
+    HttpResponse resp;
+    try {
+      resp = handler_(req);
+    } catch (const std::exception& e) {
+      resp.status = 500;
+      resp.body = std::string("internal error: ") + e.what();
+      log_error("handler exception", {{"error", e.what()}, {"path", req.path}});
+    }
+
+    std::ostringstream ss;
+    const char* reason = resp.status == 200   ? "OK"
+                         : resp.status == 404 ? "Not Found"
+                         : resp.status == 400 ? "Bad Request"
+                                              : "Status";
+    ss << "HTTP/1.1 " << resp.status << " " << reason << "\r\n";
+    bool have_ct = false;
+    for (const auto& kv : resp.headers) {
+      if (to_lower(kv.first) == "content-type") have_ct = true;
+      ss << kv.first << ": " << kv.second << "\r\n";
+    }
+    if (!have_ct) ss << "Content-Type: application/json\r\n";
+    ss << "Content-Length: " << resp.body.size() << "\r\n";
+    ss << "Connection: close\r\n\r\n";
+    std::string head = ss.str();
+    stream->write_all(head.data(), head.size());
+    if (!resp.body.empty()) stream->write_all(resp.body.data(), resp.body.size());
+  } catch (const std::exception& e) {
+    // connection-level failure; nothing to send
+    log_debug("connection error", {{"error", e.what()}});
+  }
+  ::close(fd);
+}
+
+}  // namespace tpubc

@@ -1,0 +1,207 @@
+#include "tpubc/kube_client.h"
+
+#include <cstdlib>
+
+#include "tpubc/crd.h"
+#include "tpubc/log.h"
+#include "tpubc/util.h"
+
+namespace tpubc {
+
+namespace {
+
+constexpr const char* kSaTokenPath = "/var/run/secrets/kubernetes.io/serviceaccount/token";
+constexpr const char* kSaCaPath = "/var/run/secrets/kubernetes.io/serviceaccount/ca.crt";
+
+struct KindInfo {
+  const char* api_version;
+  const char* kind;
+  const char* plural;
+  bool namespaced;
+};
+
+// The fixed set of kinds this operator touches (reference controller
+// children + the CRD + JobSet).
+const KindInfo kKinds[] = {
+    {"v1", "Namespace", "namespaces", false},
+    {"v1", "ResourceQuota", "resourcequotas", true},
+    {"v1", "Pod", "pods", true},
+    {"rbac.authorization.k8s.io/v1", "Role", "roles", true},
+    {"rbac.authorization.k8s.io/v1", "RoleBinding", "rolebindings", true},
+    {"jobset.x-k8s.io/v1alpha2", "JobSet", "jobsets", true},
+    {kApiVersion, kKind, kPlural, false},
+};
+
+const KindInfo& kind_info(const std::string& api_version, const std::string& kind) {
+  for (const auto& k : kKinds) {
+    if (kind == k.kind && api_version == k.api_version) return k;
+  }
+  throw std::runtime_error("unknown kind for API routing: " + api_version + "/" + kind);
+}
+
+}  // namespace
+
+std::string resource_path(const std::string& api_version, const std::string& kind,
+                          const std::string& ns, const std::string& name) {
+  const KindInfo& info = kind_info(api_version, kind);
+  std::string path;
+  if (api_version.find('/') == std::string::npos) {
+    path = "/api/" + api_version;  // core group
+  } else {
+    path = "/apis/" + api_version;
+  }
+  if (info.namespaced) {
+    if (ns.empty()) throw std::runtime_error(kind + " is namespaced but no namespace given");
+    path += "/namespaces/" + ns;
+  }
+  path += "/" + std::string(info.plural);
+  if (!name.empty()) path += "/" + name;
+  return path;
+}
+
+KubeConfig kube_config_from_env() {
+  KubeConfig cfg;
+  const char* url = std::getenv("CONF_KUBE_API_URL");
+  if (url && *url) {
+    cfg.base_url = url;
+    const char* insecure = std::getenv("CONF_KUBE_INSECURE_TLS");
+    if (insecure && std::string(insecure) == "1") cfg.verify_tls = false;
+    const char* token = std::getenv("CONF_KUBE_TOKEN");
+    if (token) cfg.token = token;
+    const char* ca = std::getenv("CONF_KUBE_CA_FILE");
+    if (ca) cfg.ca_file = ca;
+    return cfg;
+  }
+  const char* host = std::getenv("KUBERNETES_SERVICE_HOST");
+  const char* port = std::getenv("KUBERNETES_SERVICE_PORT");
+  if (!host || !port)
+    throw std::runtime_error(
+        "no Kubernetes config: set CONF_KUBE_API_URL or run in-cluster "
+        "(KUBERNETES_SERVICE_HOST unset)");
+  cfg.base_url = std::string("https://") + host + ":" + port;
+  cfg.token = trim(read_file(kSaTokenPath));
+  cfg.ca_file = kSaCaPath;
+  return cfg;
+}
+
+KubeClient::KubeClient(KubeConfig config) : config_(std::move(config)) {
+  http_ = std::make_unique<HttpClient>(config_.base_url, config_.ca_file, config_.verify_tls,
+                                       config_.token);
+}
+
+Json KubeClient::check(const HttpResponse& resp) {
+  if (!resp.ok()) {
+    std::string message = resp.body;
+    try {
+      Json status = Json::parse(resp.body);
+      if (status.is_object() && status.contains("message"))
+        message = status.get_string("message");
+    } catch (const JsonError&) {
+    }
+    throw KubeError(resp.status, message);
+  }
+  if (resp.body.empty()) return Json();
+  return Json::parse(resp.body);
+}
+
+Json KubeClient::list(const std::string& api_version, const std::string& kind,
+                      const std::string& ns) {
+  return check(http_->request("GET", resource_path(api_version, kind, ns, "")));
+}
+
+Json KubeClient::get(const std::string& api_version, const std::string& kind,
+                     const std::string& ns, const std::string& name) {
+  return check(http_->request("GET", resource_path(api_version, kind, ns, name)));
+}
+
+Json KubeClient::apply(const Json& obj, const std::string& field_manager, bool force) {
+  const std::string api_version = obj.get_string("apiVersion");
+  const std::string kind = obj.get_string("kind");
+  const std::string name = obj.get("metadata").get_string("name");
+  const std::string ns = obj.get("metadata").get_string("namespace");
+  if (name.empty()) throw std::runtime_error("apply: object has no metadata.name");
+  std::string path = resource_path(api_version, kind, ns, name);
+  path += "?fieldManager=" + field_manager;
+  if (force) path += "&force=true";
+  return check(http_->request("PATCH", path, obj.dump(), "application/apply-patch+yaml"));
+}
+
+Json KubeClient::json_patch(const std::string& api_version, const std::string& kind,
+                            const std::string& ns, const std::string& name, const Json& patch) {
+  return check(http_->request("PATCH", resource_path(api_version, kind, ns, name), patch.dump(),
+                              "application/json-patch+json"));
+}
+
+Json KubeClient::replace_status(const std::string& api_version, const std::string& kind,
+                                const std::string& ns, const std::string& name, const Json& obj) {
+  return check(http_->request("PUT", resource_path(api_version, kind, ns, name) + "/status",
+                              obj.dump(), "application/json"));
+}
+
+Json KubeClient::merge_status(const std::string& api_version, const std::string& kind,
+                              const std::string& ns, const std::string& name,
+                              const Json& status_patch) {
+  Json body = Json::object({{"status", status_patch}});
+  return check(http_->request("PATCH", resource_path(api_version, kind, ns, name) + "/status",
+                              body.dump(), "application/merge-patch+json"));
+}
+
+void KubeClient::remove(const std::string& api_version, const std::string& kind,
+                        const std::string& ns, const std::string& name) {
+  check(http_->request("DELETE", resource_path(api_version, kind, ns, name)));
+}
+
+std::string KubeClient::watch(const std::string& api_version, const std::string& kind,
+                              const std::string& resource_version,
+                              const std::function<void(const std::string&, const Json&)>& on_event,
+                              std::atomic<bool>* cancel) {
+  std::string path = resource_path(api_version, kind, "", "");
+  path += "?watch=1&allowWatchBookmarks=true";
+  if (!resource_version.empty()) path += "&resourceVersion=" + resource_version;
+
+  std::string last_rv = resource_version;
+  bool gone = false;
+  std::string error_body;
+  int status = http_->stream_lines(
+      path,
+      [&](const std::string& line) {
+        Json event;
+        try {
+          event = Json::parse(line);
+        } catch (const JsonError& e) {
+          // Could be a non-JSON HTTP error body; keep it for diagnostics.
+          error_body = line;
+          log_warn("unparseable watch line", {{"error", e.what()}});
+          return true;
+        }
+        if (event.get_string("kind") == "Status") {
+          // HTTP-level failure body (e.g. 403) delivered on the stream.
+          error_body = event.get_string("message");
+          return false;
+        }
+        const std::string type = event.get_string("type");
+        const Json& obj = event.get("object");
+        if (type == "ERROR") {
+          if (obj.get_int("code", 0) == 410) {
+            gone = true;  // history expired: caller must re-list
+            return false;
+          }
+          log_warn("watch error event", {{"message", obj.get_string("message")}});
+          return true;
+        }
+        const std::string rv = obj.get("metadata").get_string("resourceVersion");
+        if (!rv.empty()) last_rv = rv;
+        if (type == "BOOKMARK") return true;
+        on_event(type, obj);
+        return true;
+      },
+      cancel);
+  if (status == 410) return "";
+  if (status >= 300)
+    // Surface HTTP-level watch failures so callers back off instead of
+    // hot-looping on an instantly-failing stream.
+    throw KubeError(status, error_body.empty() ? "watch failed" : error_body);
+  return gone ? "" : last_rv;
+}
+
+}  // namespace tpubc

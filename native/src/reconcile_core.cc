@@ -203,10 +203,22 @@ Json slice_status(const Json& ub, const Json& observed_jobset) {
   if (!tpu.is_object()) {
     return Json::object({{"phase", "Absent"}});
   }
+  int64_t chips = tpu.get_int("chips", 0);
+  int64_t hosts = tpu.get_int("hosts", 0);
+  if (chips == 0 || hosts == 0) {
+    // CR bypassed admission defaulting (e.g. created before the webhook was
+    // registered): derive geometry directly.
+    try {
+      SliceGeometry g = slice_geometry(tpu.get_string("accelerator"), tpu.get_string("topology"));
+      chips = g.chips;
+      hosts = g.hosts;
+    } catch (const JsonError&) {
+    }
+  }
   Json st = Json::object({
       {"phase", "Pending"},
-      {"chips", tpu.get_int("chips", 0)},
-      {"hosts", tpu.get_int("hosts", 0)},
+      {"chips", chips},
+      {"hosts", hosts},
   });
   if (observed_jobset.is_object()) {
     st.set("jobset", observed_jobset.get("metadata").get_string("name"));

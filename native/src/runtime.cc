@@ -1,0 +1,83 @@
+#include "tpubc/runtime.h"
+
+#include <signal.h>
+
+#include <algorithm>
+#include <chrono>
+#include <condition_variable>
+#include <mutex>
+
+namespace tpubc {
+
+namespace {
+std::atomic<bool> g_stop{false};
+std::mutex g_stop_mutex;
+std::condition_variable g_stop_cv;
+
+// Async-signal-safe: only the atomic store happens here. Waiters poll the
+// flag in short cv slices (<=200ms), so shutdown latency stays sub-second
+// without notify_all (which is not signal-safe) in the handler.
+void handle_signal(int) { g_stop.store(true); }
+}  // namespace
+
+void install_signal_handlers() {
+  struct sigaction sa{};
+  sa.sa_handler = handle_signal;
+  sigaction(SIGINT, &sa, nullptr);
+  sigaction(SIGTERM, &sa, nullptr);
+  signal(SIGPIPE, SIG_IGN);
+}
+
+std::atomic<bool>& stop_requested() { return g_stop; }
+
+void request_stop() {
+  g_stop.store(true);
+  g_stop_cv.notify_all();
+}
+
+bool stop_wait_ms(int64_t ms) {
+  int64_t remaining = ms;
+  std::unique_lock<std::mutex> lock(g_stop_mutex);
+  while (remaining > 0 && !g_stop.load()) {
+    int64_t slice = std::min<int64_t>(remaining, 200);
+    g_stop_cv.wait_for(lock, std::chrono::milliseconds(slice), [] { return g_stop.load(); });
+    remaining -= slice;
+  }
+  return g_stop.load();
+}
+
+Metrics& Metrics::instance() {
+  static Metrics m;
+  return m;
+}
+
+void Metrics::inc(const std::string& name, int64_t delta) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  for (auto& kv : counters_) {
+    if (kv.first == name) {
+      kv.second += delta;
+      return;
+    }
+  }
+  counters_.emplace_back(name, delta);
+}
+
+void Metrics::set(const std::string& name, int64_t value) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  for (auto& kv : counters_) {
+    if (kv.first == name) {
+      kv.second = value;
+      return;
+    }
+  }
+  counters_.emplace_back(name, value);
+}
+
+Json Metrics::to_json() const {
+  std::lock_guard<std::mutex> lock(mutex_);
+  Json out = Json::object();
+  for (const auto& kv : counters_) out.set(kv.first, kv.second);
+  return out;
+}
+
+}  // namespace tpubc

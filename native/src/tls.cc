@@ -1,0 +1,141 @@
+#include "tpubc/tls.h"
+
+#include <stdexcept>
+
+namespace {
+
+// ---- hand-declared OpenSSL 3 C ABI (stable since 1.1) ----------------------
+extern "C" {
+typedef struct ssl_ctx_st SSL_CTX;
+typedef struct ssl_st SSL;
+typedef struct ssl_method_st SSL_METHOD;
+
+const SSL_METHOD* TLS_client_method(void);
+const SSL_METHOD* TLS_server_method(void);
+SSL_CTX* SSL_CTX_new(const SSL_METHOD* method);
+void SSL_CTX_free(SSL_CTX* ctx);
+int SSL_CTX_use_certificate_chain_file(SSL_CTX* ctx, const char* file);
+int SSL_CTX_use_PrivateKey_file(SSL_CTX* ctx, const char* file, int type);
+int SSL_CTX_check_private_key(const SSL_CTX* ctx);
+int SSL_CTX_load_verify_locations(SSL_CTX* ctx, const char* CAfile, const char* CApath);
+int SSL_CTX_set_default_verify_paths(SSL_CTX* ctx);
+void SSL_CTX_set_verify(SSL_CTX* ctx, int mode, void* callback);
+SSL* SSL_new(SSL_CTX* ctx);
+void SSL_free(SSL* ssl);
+int SSL_set_fd(SSL* ssl, int fd);
+int SSL_connect(SSL* ssl);
+int SSL_accept(SSL* ssl);
+int SSL_read(SSL* ssl, void* buf, int num);
+int SSL_write(SSL* ssl, const void* buf, int num);
+int SSL_shutdown(SSL* ssl);
+int SSL_get_error(const SSL* ssl, int ret);
+long SSL_ctrl(SSL* ssl, int cmd, long larg, void* parg);
+unsigned long ERR_get_error(void);
+void ERR_error_string_n(unsigned long e, char* buf, size_t len);
+}
+
+constexpr int kSSL_FILETYPE_PEM = 1;
+constexpr int kSSL_VERIFY_NONE = 0;
+constexpr int kSSL_VERIFY_PEER = 1;
+constexpr int kSSL_CTRL_SET_TLSEXT_HOSTNAME = 55;
+constexpr long kTLSEXT_NAMETYPE_host_name = 0;
+constexpr int kSSL_ERROR_ZERO_RETURN = 6;
+
+std::string last_error(const char* what) {
+  char buf[256];
+  unsigned long e = ERR_get_error();
+  if (e) {
+    ERR_error_string_n(e, buf, sizeof(buf));
+    return std::string(what) + ": " + buf;
+  }
+  return std::string(what) + ": unknown TLS error";
+}
+
+}  // namespace
+
+namespace tpubc {
+
+void TlsCtxDeleter::operator()(void* ctx) const {
+  if (ctx) SSL_CTX_free(static_cast<SSL_CTX*>(ctx));
+}
+
+TlsCtxPtr tls_client_context(const std::string& ca_file, bool verify_peer) {
+  SSL_CTX* ctx = SSL_CTX_new(TLS_client_method());
+  if (!ctx) throw std::runtime_error(last_error("SSL_CTX_new"));
+  TlsCtxPtr out(static_cast<void*>(ctx), TlsCtxDeleter());
+  if (!ca_file.empty()) {
+    if (SSL_CTX_load_verify_locations(ctx, ca_file.c_str(), nullptr) != 1)
+      throw std::runtime_error(last_error("load CA file"));
+  } else {
+    SSL_CTX_set_default_verify_paths(ctx);
+  }
+  SSL_CTX_set_verify(ctx, verify_peer ? kSSL_VERIFY_PEER : kSSL_VERIFY_NONE, nullptr);
+  return out;
+}
+
+TlsCtxPtr tls_server_context(const std::string& cert_path, const std::string& key_path) {
+  SSL_CTX* ctx = SSL_CTX_new(TLS_server_method());
+  if (!ctx) throw std::runtime_error(last_error("SSL_CTX_new"));
+  TlsCtxPtr out(static_cast<void*>(ctx), TlsCtxDeleter());
+  if (SSL_CTX_use_certificate_chain_file(ctx, cert_path.c_str()) != 1)
+    throw std::runtime_error(last_error(("load cert " + cert_path).c_str()));
+  if (SSL_CTX_use_PrivateKey_file(ctx, key_path.c_str(), kSSL_FILETYPE_PEM) != 1)
+    throw std::runtime_error(last_error(("load key " + key_path).c_str()));
+  if (SSL_CTX_check_private_key(ctx) != 1)
+    throw std::runtime_error(last_error("cert/key mismatch"));
+  return out;
+}
+
+std::unique_ptr<TlsStream> TlsStream::connect(TlsCtxPtr ctx, int fd, const std::string& sni) {
+  SSL* ssl = SSL_new(static_cast<SSL_CTX*>(ctx.get()));
+  if (!ssl) throw std::runtime_error(last_error("SSL_new"));
+  SSL_set_fd(ssl, fd);
+  if (!sni.empty())
+    SSL_ctrl(ssl, kSSL_CTRL_SET_TLSEXT_HOSTNAME, kTLSEXT_NAMETYPE_host_name,
+             const_cast<char*>(sni.c_str()));
+  if (SSL_connect(ssl) != 1) {
+    std::string err = last_error("TLS handshake");
+    SSL_free(ssl);
+    throw std::runtime_error(err);
+  }
+  return std::unique_ptr<TlsStream>(new TlsStream(std::move(ctx), ssl));
+}
+
+std::unique_ptr<TlsStream> TlsStream::accept(TlsCtxPtr ctx, int fd) {
+  SSL* ssl = SSL_new(static_cast<SSL_CTX*>(ctx.get()));
+  if (!ssl) throw std::runtime_error(last_error("SSL_new"));
+  SSL_set_fd(ssl, fd);
+  if (SSL_accept(ssl) != 1) {
+    std::string err = last_error("TLS accept");
+    SSL_free(ssl);
+    throw std::runtime_error(err);
+  }
+  return std::unique_ptr<TlsStream>(new TlsStream(std::move(ctx), ssl));
+}
+
+TlsStream::~TlsStream() {
+  if (ssl_) SSL_free(static_cast<SSL*>(ssl_));
+}
+
+size_t TlsStream::read(char* buf, size_t len) {
+  int n = SSL_read(static_cast<SSL*>(ssl_), buf, static_cast<int>(len));
+  if (n > 0) return static_cast<size_t>(n);
+  int err = SSL_get_error(static_cast<SSL*>(ssl_), n);
+  if (err == kSSL_ERROR_ZERO_RETURN) return 0;  // clean close
+  // Treat transport EOF as close too (peers often skip close_notify).
+  if (n == 0) return 0;
+  throw std::runtime_error("TLS read error " + std::to_string(err));
+}
+
+void TlsStream::write_all(const char* buf, size_t len) {
+  size_t off = 0;
+  while (off < len) {
+    int n = SSL_write(static_cast<SSL*>(ssl_), buf + off, static_cast<int>(len - off));
+    if (n <= 0) throw std::runtime_error("TLS write error");
+    off += static_cast<size_t>(n);
+  }
+}
+
+void TlsStream::shutdown() { SSL_shutdown(static_cast<SSL*>(ssl_)); }
+
+}  // namespace tpubc

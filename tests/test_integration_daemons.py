@@ -1,0 +1,474 @@
+"""Integration tests: the real C++ daemons against the fake API server.
+
+This is the BASELINE config #1 stand-in (kind cluster, CPU-only reconcile,
+fake extended resource): kubectl-style writes go into the fake API server
+and the daemons must converge the world, end to end, over real HTTP.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from tpu_bootstrap.fakeapi import FakeKube
+
+REPO = Path(__file__).resolve().parent.parent
+BUILD = REPO / "native" / "build"
+
+KEY_NS = ("api/v1", "", "namespaces")
+KEY_QUOTA = lambda ns: ("api/v1", ns, "resourcequotas")  # noqa: E731
+KEY_ROLE = lambda ns: ("apis/rbac.authorization.k8s.io/v1", ns, "roles")  # noqa: E731
+KEY_RB = lambda ns: ("apis/rbac.authorization.k8s.io/v1", ns, "rolebindings")  # noqa: E731
+KEY_JS = lambda ns: ("apis/jobset.x-k8s.io/v1alpha2", ns, "jobsets")  # noqa: E731
+
+
+class Daemon:
+    def __init__(self, binary: str, env: dict, health_port: int):
+        self.proc = subprocess.Popen(
+            [str(BUILD / binary)],
+            env={**os.environ, **env},
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+        )
+        self.health_port = health_port
+        self.binary = binary
+
+    def wait_healthy(self, timeout=10.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"{self.binary} exited early: {self.proc.stderr.read().decode()}"
+                )
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{self.health_port}/health", timeout=1
+                ) as r:
+                    if r.read() == b"pong":
+                        return self
+            except OSError:
+                time.sleep(0.05)
+        raise TimeoutError(f"{self.binary} never became healthy")
+
+    def metrics(self) -> dict:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{self.health_port}/metrics", timeout=2
+        ) as r:
+            return json.loads(r.read())
+
+    def stop(self, expect_graceful=True):
+        if self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGTERM)
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+                if expect_graceful:
+                    raise AssertionError(f"{self.binary} did not shut down on SIGTERM")
+        return self.proc.returncode, self.proc.stderr.read().decode()
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def wait_for(predicate, timeout=10.0, interval=0.05, desc="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        result = predicate()
+        if result:
+            return result
+        time.sleep(interval)
+    raise TimeoutError(f"timed out waiting for {desc}")
+
+
+@pytest.fixture()
+def fake():
+    server = FakeKube().start()
+    yield server
+    server.stop()
+
+
+def controller_env(fake, port, **extra):
+    env = {
+        "CONF_KUBE_API_URL": fake.url,
+        "CONF_LISTEN_ADDR": "127.0.0.1",
+        "CONF_LISTEN_PORT": str(port),
+        "TPUBC_LOG": "debug",
+    }
+    env.update({k.upper(): str(v) for k, v in extra.items()})
+    return env
+
+
+SYNCED = {"synchronized_with_sheet": True}
+
+
+def full_spec(tpu=True):
+    spec = {
+        "kube_username": "alice",
+        "quota": {"hard": {"requests.google.com/tpu": "64"}},
+        "rolebinding": {
+            "role_ref": {
+                "api_group": "rbac.authorization.k8s.io",
+                "kind": "ClusterRole",
+                "name": "edit",
+            },
+            "subjects": [
+                {"api_group": "rbac.authorization.k8s.io", "kind": "User", "name": "oidc:alice"}
+            ],
+        },
+    }
+    if tpu:
+        spec["tpu"] = {"accelerator": "tpu-v5p-slice", "topology": "4x4x4"}
+    return spec
+
+
+# ---------------------------------------------------------------------------
+# controller
+# ---------------------------------------------------------------------------
+
+
+def test_controller_materializes_full_slice(fake):
+    fake.create_ub("alice", spec=full_spec(), status=SYNCED)
+    port = free_port()
+    d = Daemon("tpubc-controller", controller_env(fake, port), port).wait_healthy()
+    try:
+        wait_for(lambda: fake.get(KEY_NS, "alice"), desc="namespace")
+        wait_for(lambda: fake.get(KEY_QUOTA("alice"), "alice"), desc="quota")
+        wait_for(lambda: fake.get(KEY_RB("alice"), "alice"), desc="rolebinding")
+        js = wait_for(lambda: fake.get(KEY_JS("alice"), "alice-slice"), desc="jobset")
+
+        jspec = js["spec"]["replicatedJobs"][0]["template"]["spec"]
+        assert jspec["parallelism"] == 16
+        pod = jspec["template"]["spec"]
+        assert pod["containers"][0]["resources"]["requests"]["google.com/tpu"] == 4
+        assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "4x4x4"
+
+        # ownership: cascade-delete wiring back to the CR
+        ub = fake.get(fake.KEY_UB, "alice")
+        ns = fake.get(KEY_NS, "alice")
+        assert ns["metadata"]["ownerReferences"][0]["uid"] == ub["metadata"]["uid"]
+
+        # status.slice maintained without clobbering the sync flag
+        ub = wait_for(
+            lambda: (lambda u: u if (u.get("status", {}).get("slice")) else None)(
+                fake.get(fake.KEY_UB, "alice")
+            ),
+            desc="slice status",
+        )
+        assert ub["status"]["synchronized_with_sheet"] is True
+        assert ub["status"]["slice"]["chips"] == 0 or "phase" in ub["status"]["slice"]
+
+        m = d.metrics()
+        assert m["reconciles_total"] >= 1
+        assert m["applies_total"] >= 4
+    finally:
+        code, err = d.stop()
+        assert code == 0, err
+
+
+def test_controller_sheet_gate_holds_back_rolebinding_and_jobset(fake):
+    fake.create_ub("bob", spec=full_spec())  # no status => not synchronized
+    port = free_port()
+    d = Daemon("tpubc-controller", controller_env(fake, port), port).wait_healthy()
+    try:
+        wait_for(lambda: fake.get(KEY_NS, "bob"), desc="namespace")
+        wait_for(lambda: fake.get(KEY_QUOTA("bob"), "bob"), desc="quota")
+        time.sleep(0.3)  # give it a chance to (wrongly) create the rest
+        assert fake.get(KEY_RB("bob"), "bob") is None
+        assert fake.get(KEY_JS("bob"), "bob-slice") is None
+
+        # flipping the gate opens it (watch event -> immediate reconcile)
+        ub = fake.get(fake.KEY_UB, "bob")
+        ub["status"] = SYNCED
+        fake.store.upsert(fake.KEY_UB, "bob", ub, preserve_status=False)
+        wait_for(lambda: fake.get(KEY_RB("bob"), "bob"), desc="rolebinding after gate")
+        wait_for(lambda: fake.get(KEY_JS("bob"), "bob-slice"), desc="jobset after gate")
+    finally:
+        code, err = d.stop()
+        assert code == 0, err
+
+
+def test_controller_event_driven_latency(fake):
+    """A CR created while the controller runs must materialize fast (watch
+    path, not the 30s resync — the perf story of this build)."""
+    port = free_port()
+    d = Daemon("tpubc-controller", controller_env(fake, port), port).wait_healthy()
+    try:
+        t0 = time.time()
+        fake.create_ub("carol", spec={"kube_username": "carol"})
+        wait_for(lambda: fake.get(KEY_NS, "carol"), desc="namespace via watch")
+        latency = time.time() - t0
+        assert latency < 2.0, f"watch-path reconcile took {latency:.2f}s"
+    finally:
+        code, err = d.stop()
+        assert code == 0, err
+
+
+def test_controller_survives_api_errors(fake):
+    """404s on deleted CRs and unknown names must not kill workers."""
+    port = free_port()
+    d = Daemon("tpubc-controller", controller_env(fake, port), port).wait_healthy()
+    try:
+        fake.create_ub("dave", spec={})
+        wait_for(lambda: fake.get(KEY_NS, "dave"), desc="namespace")
+        fake.store.delete(fake.KEY_UB, "dave")
+        # controller should keep functioning for other CRs
+        fake.create_ub("erin", spec={})
+        wait_for(lambda: fake.get(KEY_NS, "erin"), desc="second namespace")
+    finally:
+        code, err = d.stop()
+        assert code == 0, err
+
+
+# ---------------------------------------------------------------------------
+# admission daemon over HTTP
+# ---------------------------------------------------------------------------
+
+
+def admission_review(username="oidc:alice", groups=("tpu",), name="alice", spec=None):
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {
+            "uid": "u-123",
+            "operation": "CREATE",
+            "userInfo": {"username": username, "groups": list(groups)},
+            "object": {
+                "apiVersion": "tpu.bacchus.io/v1",
+                "kind": "UserBootstrap",
+                "metadata": {"name": name},
+                "spec": spec or {},
+            },
+        },
+    }
+
+
+def post_json(url, payload, ctx=None):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=5, context=ctx) as r:
+        return json.loads(r.read())
+
+
+def test_admission_daemon_plain_http():
+    port = free_port()
+    d = Daemon(
+        "tpubc-admission",
+        {
+            "CONF_LISTEN_ADDR": "127.0.0.1",
+            "CONF_LISTEN_PORT": str(port),
+            "CONF_TLS_DISABLED": "1",
+            "CONF_AUTHORIZED_GROUP_NAMES": "tpu,admin",
+        },
+        port,
+    ).wait_healthy()
+    try:
+        out = post_json(f"http://127.0.0.1:{port}/mutate", admission_review())
+        assert out["kind"] == "AdmissionReview"
+        assert out["response"]["allowed"] is True
+        assert out["response"]["patchType"] == "JSONPatch"
+
+        denied = post_json(
+            f"http://127.0.0.1:{port}/mutate", admission_review(groups=("students",))
+        )
+        assert denied["response"]["allowed"] is False
+
+        m = d.metrics()
+        assert m["admission_requests_total"] == 2
+        assert m["admission_denials_total"] == 1
+    finally:
+        code, err = d.stop()
+        assert code == 0, err
+
+
+@pytest.fixture()
+def certs(tmp_path):
+    def gen(cn):
+        cert, key = tmp_path / f"{cn}.crt", tmp_path / f"{cn}.key"
+        subprocess.run(
+            [
+                "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+                "-keyout", str(key), "-out", str(cert),
+                "-days", "1", "-subj", f"/CN={cn}",
+            ],
+            check=True,
+            capture_output=True,
+        )
+        return cert, key
+
+    return gen
+
+
+def test_admission_daemon_tls_and_hot_reload(certs, tmp_path):
+    import ssl
+
+    cert, key = certs("admission-v1")
+    live_cert, live_key = tmp_path / "live.crt", tmp_path / "live.key"
+    live_cert.write_bytes(cert.read_bytes())
+    live_key.write_bytes(key.read_bytes())
+
+    port = free_port()
+    d = Daemon(
+        "tpubc-admission",
+        {
+            "CONF_LISTEN_ADDR": "127.0.0.1",
+            "CONF_LISTEN_PORT": str(port),
+            "CONF_CERT_PATH": str(live_cert),
+            "CONF_KEY_PATH": str(live_key),
+            "CONF_CERT_RELOAD_SECS": "1",
+        },
+        port,
+    )
+    ctx = ssl.create_default_context()
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_NONE
+
+    def served_cn():
+        with socket.create_connection(("127.0.0.1", port), timeout=5) as raw:
+            with ctx.wrap_socket(raw) as tls:
+                der = tls.getpeercert(binary_form=True)
+        import subprocess as sp
+
+        out = sp.run(
+            ["openssl", "x509", "-inform", "der", "-noout", "-subject"],
+            input=der,
+            capture_output=True,
+            check=True,
+        )
+        return out.stdout.decode()
+
+    try:
+        # TLS healthz via raw TLS request
+        deadline = time.time() + 10
+        while True:
+            try:
+                out = post_json(f"https://127.0.0.1:{port}/mutate", admission_review(), ctx)
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.1)
+        assert out["response"]["allowed"] is True
+        assert "admission-v1" in served_cn()
+
+        # hot reload: swap PEM files, wait for the 1s hash poll
+        cert2, key2 = certs("admission-v2")
+        live_cert.write_bytes(cert2.read_bytes())
+        live_key.write_bytes(key2.read_bytes())
+        wait_for(lambda: "admission-v2" in served_cn(), timeout=15, desc="cert rotation")
+    finally:
+        code, err = d.stop()
+        assert code == 0, err
+
+
+# ---------------------------------------------------------------------------
+# synchronizer daemon
+# ---------------------------------------------------------------------------
+
+CSV_HEADER = "이름,소속,SNUCSE ID,사용할 서버,TPU 칩 개수,vCPU 개수,메모리 (GiB),스토리지 (GiB),승인\n"
+
+
+def test_synchronizer_end_to_end(fake, tmp_path):
+    sheet = tmp_path / "sheet.csv"
+    sheet.write_text(
+        CSV_HEADER + "앨리스,CSE,alice,tpu-serv,16,8,32,100,o\n" + "밥,CSE,bob,tpu-serv,16,8,32,100,x\n"
+    )
+    fake.create_ub("alice", spec={"kube_username": "alice"})
+    fake.create_ub("bob", spec={"kube_username": "bob"})
+
+    port = free_port()
+    d = Daemon(
+        "tpubc-synchronizer",
+        {
+            "CONF_KUBE_API_URL": fake.url,
+            "CONF_LISTEN_ADDR": "127.0.0.1",
+            "CONF_LISTEN_PORT": str(port),
+            "CONF_SHEET_PATH": str(sheet),
+            "CONF_SYNC_INTERVAL_SECS": "1",
+            "CONF_SERVER_NAME": "tpu-serv",
+        },
+        port,
+    ).wait_healthy()
+    try:
+        ub = wait_for(
+            lambda: (lambda u: u if u.get("status", {}).get("synchronized_with_sheet") else None)(
+                fake.get(fake.KEY_UB, "alice")
+            ),
+            desc="alice synchronized",
+        )
+        assert ub["spec"]["quota"]["hard"]["requests.google.com/tpu"] == "16"
+        assert ub["spec"]["quota"]["hard"]["requests.memory"] == "32Gi"
+
+        # unauthorized row: untouched (sheet is source of truth)
+        bob = fake.get(fake.KEY_UB, "bob")
+        assert "quota" not in bob["spec"]
+        assert not bob.get("status", {}).get("synchronized_with_sheet")
+
+        # sheet update picked up on the next tick (quota grows 16 -> 32)
+        sheet.write_text(CSV_HEADER + "앨리스,CSE,alice,tpu-serv,32,8,64,100,o\n")
+        wait_for(
+            lambda: fake.get(fake.KEY_UB, "alice")["spec"]
+            .get("quota", {})
+            .get("hard", {})
+            .get("requests.google.com/tpu")
+            == "32",
+            desc="quota refresh",
+        )
+    finally:
+        code, err = d.stop()
+        assert code == 0, err
+
+
+def test_synchronizer_pool_capacity(fake, tmp_path):
+    sheet = tmp_path / "sheet.csv"
+    sheet.write_text(
+        CSV_HEADER
+        + "a,CSE,alice,tpu-serv,16,8,32,100,o\n"
+        + "b,CSE,bob,tpu-serv,16,8,32,100,o\n"
+    )
+    fake.create_ub("alice", spec={})
+    fake.create_ub("bob", spec={})
+    port = free_port()
+    d = Daemon(
+        "tpubc-synchronizer",
+        {
+            "CONF_KUBE_API_URL": fake.url,
+            "CONF_LISTEN_ADDR": "127.0.0.1",
+            "CONF_LISTEN_PORT": str(port),
+            "CONF_SHEET_PATH": str(sheet),
+            "CONF_SYNC_INTERVAL_SECS": "1",
+            "CONF_SERVER_NAME": "tpu-serv",
+            "CONF_POOL_CAPACITY_CHIPS": "20",
+        },
+        port,
+    ).wait_healthy()
+    try:
+        wait_for(
+            lambda: fake.get(fake.KEY_UB, "alice").get("status", {}).get("synchronized_with_sheet"),
+            desc="alice within capacity",
+        )
+        time.sleep(1.5)
+        assert not fake.get(fake.KEY_UB, "bob").get("status", {}).get(
+            "synchronized_with_sheet"
+        ), "bob exceeds pool capacity and must not be authorized"
+        m = d.metrics()
+        assert m["pool_chips_allocated"] == 16
+    finally:
+        code, err = d.stop()
+        assert code == 0, err

@@ -1,0 +1,410 @@
+"""A fake Kubernetes API server for integration tests and benchmarks.
+
+Implements the subset of the API machinery the daemons use — LIST, GET,
+WATCH (chunked JSON-line streams), server-side-apply PATCH, RFC-6902 PATCH,
+merge-PATCH and resourceVersion-checked PUT on the status subresource,
+POST, DELETE — with a monotonically increasing resourceVersion and a
+watch-event log, so the C++ controller/synchronizer run against it exactly
+as they would against a real API server (SURVEY.md §4: "integration-test
+the reconciler against a fake/recorded API server"; BASELINE config #1's
+kind-cluster stand-in).
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+
+def apply_json_patch(doc, patch):
+    """Minimal RFC 6902 (add/replace/remove only — what the daemons emit)."""
+
+    def tokens(path):
+        return [t.replace("~1", "/").replace("~0", "~") for t in path.split("/")[1:]]
+
+    for op in patch:
+        toks = tokens(op["path"])
+        parent = doc
+        for t in toks[:-1]:
+            parent = parent[int(t)] if isinstance(parent, list) else parent[t]
+        last = toks[-1]
+        kind = op["op"]
+        if kind in ("add", "replace"):
+            if isinstance(parent, list):
+                if last == "-":
+                    parent.append(op["value"])
+                elif kind == "add":
+                    parent.insert(int(last), op["value"])
+                else:
+                    parent[int(last)] = op["value"]
+            else:
+                parent[last] = op["value"]
+        elif kind == "remove":
+            if isinstance(parent, list):
+                parent.pop(int(last))
+            else:
+                del parent[last]
+        else:
+            raise ValueError(f"unsupported patch op {kind}")
+    return doc
+
+
+def merge_patch(target, patch):
+    """RFC 7386 merge patch."""
+    if not isinstance(patch, dict):
+        return copy.deepcopy(patch)
+    if not isinstance(target, dict):
+        target = {}
+    for k, v in patch.items():
+        if v is None:
+            target.pop(k, None)
+        else:
+            target[k] = merge_patch(target.get(k), v)
+    return target
+
+
+class Store:
+    """Object store keyed by (api_prefix, namespace, plural) -> name -> obj."""
+
+    def __init__(self):
+        self.lock = threading.Condition()
+        self.objects: dict[tuple, dict[str, dict]] = {}
+        self.rv = 100
+        self.events: list[tuple[int, tuple, str, dict]] = []  # (rv, coll_key, type, obj)
+        self.request_log: list[tuple[str, str]] = []
+
+    def next_rv(self):
+        self.rv += 1
+        return self.rv
+
+    def collection(self, key):
+        return self.objects.setdefault(key, {})
+
+    def record_event(self, key, etype, obj):
+        self.events.append((int(obj["metadata"]["resourceVersion"]), key, etype, obj))
+        self.lock.notify_all()
+
+    def upsert(self, key, name, obj, *, preserve_status=True):
+        with self.lock:
+            coll = self.collection(key)
+            existing = coll.get(name)
+            obj = copy.deepcopy(obj)
+            meta = obj.setdefault("metadata", {})
+            meta["name"] = name
+            if existing:
+                meta.setdefault("uid", existing["metadata"]["uid"])
+                meta["creationTimestamp"] = existing["metadata"]["creationTimestamp"]
+                if preserve_status and "status" in existing and "status" not in obj:
+                    obj["status"] = existing["status"]
+                etype = "MODIFIED"
+            else:
+                meta.setdefault("uid", str(uuid.uuid4()))
+                meta["creationTimestamp"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+                etype = "ADDED"
+            meta["resourceVersion"] = str(self.next_rv())
+            coll[name] = obj
+            self.record_event(key, etype, obj)
+            return copy.deepcopy(obj)
+
+    def delete(self, key, name):
+        with self.lock:
+            coll = self.collection(key)
+            obj = coll.pop(name, None)
+            if obj is None:
+                return None
+            obj["metadata"]["resourceVersion"] = str(self.next_rv())
+            self.record_event(key, "DELETED", obj)
+            return obj
+
+
+class FakeKubeHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "FakeKube/0.1"
+
+    # ---- plumbing ---------------------------------------------------------
+
+    def log_message(self, *args):  # silence default stderr chatter
+        pass
+
+    @property
+    def store(self) -> Store:
+        return self.server.store  # type: ignore[attr-defined]
+
+    def send_json(self, code, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def send_status_error(self, code, message, reason=""):
+        self.send_json(
+            code,
+            {
+                "kind": "Status",
+                "apiVersion": "v1",
+                "status": "Failure",
+                "message": message,
+                "reason": reason,
+                "code": code,
+            },
+        )
+
+    def read_body(self):
+        n = int(self.headers.get("Content-Length", "0"))
+        return self.rfile.read(n) if n else b""
+
+    # ---- path routing -----------------------------------------------------
+
+    def route(self):
+        """Parse path -> (coll_key, name, subresource, query).
+
+        coll_key = (api_prefix, namespace, plural); namespace "" for
+        cluster-scoped collections.
+        """
+        parsed = urlparse(self.path)
+        query = parse_qs(parsed.query)
+        parts = [p for p in parsed.path.split("/") if p]
+        if not parts:
+            return None
+        if parts[0] == "api" and len(parts) >= 2:
+            prefix = "api/" + parts[1]
+            rest = parts[2:]
+        elif parts[0] == "apis" and len(parts) >= 3:
+            prefix = "apis/" + parts[1] + "/" + parts[2]
+            rest = parts[3:]
+        else:
+            return None
+        ns = ""
+        # namespaced collection: namespaces/{ns}/{plural}[...]; but
+        # /api/v1/namespaces[/name] is itself the cluster-scoped collection.
+        if rest and rest[0] == "namespaces" and len(rest) >= 3:
+            ns = rest[1]
+            rest = rest[2:]
+        if not rest:
+            return None
+        plural = rest[0]
+        name = rest[1] if len(rest) > 1 else ""
+        sub = rest[2] if len(rest) > 2 else ""
+        return (prefix, ns, plural), name, sub, query
+
+    # ---- verbs ------------------------------------------------------------
+
+    def do_GET(self):
+        routed = self.route()
+        if not routed:
+            return self.send_status_error(404, f"unknown path {self.path}")
+        key, name, sub, query = routed
+        self.store.request_log.append(("GET", self.path))
+        if name:
+            with self.store.lock:
+                obj = self.store.collection(key).get(name)
+            if obj is None:
+                return self.send_status_error(404, f"{key[2]} {name!r} not found", "NotFound")
+            return self.send_json(200, obj)
+        if query.get("watch", ["0"])[0] in ("1", "true"):
+            return self.serve_watch(key, query)
+        with self.store.lock:
+            items = [copy.deepcopy(o) for o in self.store.collection(key).values()]
+            rv = str(self.store.rv)
+        self.send_json(
+            200,
+            {"kind": "List", "apiVersion": "v1", "metadata": {"resourceVersion": rv}, "items": items},
+        )
+
+    def serve_watch(self, key, query):
+        since = int(query.get("resourceVersion", ["0"])[0] or 0)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def write_chunk(data: bytes):
+            self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+            self.wfile.flush()
+
+        cursor = since
+        try:
+            while True:
+                batch = []
+                with self.store.lock:
+                    for rv, ekey, etype, obj in self.store.events:
+                        if ekey == key and rv > cursor:
+                            batch.append((rv, etype, copy.deepcopy(obj)))
+                    if not batch:
+                        self.store.lock.wait(timeout=1.0)
+                for rv, etype, obj in batch:
+                    cursor = max(cursor, rv)
+                    line = json.dumps({"type": etype, "object": obj}) + "\n"
+                    write_chunk(line.encode())
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            return
+
+    def do_POST(self):
+        routed = self.route()
+        if not routed:
+            return self.send_status_error(404, f"unknown path {self.path}")
+        key, _, _, _ = routed
+        obj = json.loads(self.read_body())
+        name = obj.get("metadata", {}).get("name")
+        if not name:
+            return self.send_status_error(400, "metadata.name required")
+        with self.store.lock:
+            if name in self.store.collection(key):
+                return self.send_status_error(409, f"{name} already exists", "AlreadyExists")
+        self.store.request_log.append(("POST", self.path))
+        return self.send_json(201, self.store.upsert(key, name, obj))
+
+    def do_PATCH(self):
+        routed = self.route()
+        if not routed:
+            return self.send_status_error(404, f"unknown path {self.path}")
+        key, name, sub, _ = routed
+        if not name:
+            return self.send_status_error(405, "PATCH requires a name")
+        ctype = self.headers.get("Content-Type", "")
+        body = json.loads(self.read_body())
+        self.store.request_log.append(("PATCH", self.path))
+
+        with self.store.lock:
+            existing = copy.deepcopy(self.store.collection(key).get(name))
+
+        if sub == "status":
+            if existing is None:
+                return self.send_status_error(404, f"{name} not found", "NotFound")
+            if "merge-patch" in ctype:
+                existing["status"] = merge_patch(existing.get("status"), body.get("status"))
+            else:
+                return self.send_status_error(415, f"unsupported status patch type {ctype}")
+            return self.send_json(200, self.store.upsert(key, name, existing, preserve_status=False))
+
+        if "apply-patch" in ctype:
+            # Simplified SSA: the daemons always apply fully-specified
+            # objects, so upsert wholesale (status preserved).
+            return self.send_json(200 if existing else 201, self.store.upsert(key, name, body))
+        if "json-patch" in ctype:
+            if existing is None:
+                return self.send_status_error(404, f"{name} not found", "NotFound")
+            try:
+                patched = apply_json_patch(existing, body)
+            except Exception as e:  # noqa: BLE001
+                return self.send_status_error(422, f"invalid patch: {e}", "Invalid")
+            return self.send_json(200, self.store.upsert(key, name, patched, preserve_status=False))
+        if "merge-patch" in ctype:
+            if existing is None:
+                return self.send_status_error(404, f"{name} not found", "NotFound")
+            return self.send_json(
+                200, self.store.upsert(key, name, merge_patch(existing, body), preserve_status=False)
+            )
+        return self.send_status_error(415, f"unsupported patch type {ctype}")
+
+    def do_PUT(self):
+        routed = self.route()
+        if not routed:
+            return self.send_status_error(404, f"unknown path {self.path}")
+        key, name, sub, _ = routed
+        body = json.loads(self.read_body())
+        self.store.request_log.append(("PUT", self.path))
+        with self.store.lock:
+            existing = copy.deepcopy(self.store.collection(key).get(name))
+        if existing is None:
+            return self.send_status_error(404, f"{name} not found", "NotFound")
+        if sub == "status":
+            # Optimistic concurrency: resourceVersion must match
+            # (synchronizer.rs:294 relies on this).
+            want_rv = body.get("metadata", {}).get("resourceVersion")
+            if want_rv and want_rv != existing["metadata"]["resourceVersion"]:
+                return self.send_status_error(
+                    409,
+                    f"resourceVersion conflict: have {existing['metadata']['resourceVersion']}, "
+                    f"got {want_rv}",
+                    "Conflict",
+                )
+            existing["status"] = body.get("status", {})
+            return self.send_json(200, self.store.upsert(key, name, existing, preserve_status=False))
+        return self.send_json(200, self.store.upsert(key, name, body, preserve_status=True))
+
+    def do_DELETE(self):
+        routed = self.route()
+        if not routed:
+            return self.send_status_error(404, f"unknown path {self.path}")
+        key, name, _, _ = routed
+        self.store.request_log.append(("DELETE", self.path))
+        obj = self.store.delete(key, name)
+        if obj is None:
+            return self.send_status_error(404, f"{name} not found", "NotFound")
+        return self.send_json(200, obj)
+
+
+class FakeKube:
+    """In-process fake API server handle for tests."""
+
+    def __init__(self, port: int = 0):
+        self.store = Store()
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), FakeKubeHandler)
+        self.httpd.store = self.store  # type: ignore[attr-defined]
+        self.httpd.daemon_threads = True
+        self.thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self):
+        self.thread.start()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    # -- convenience accessors for tests ------------------------------------
+
+    KEY_UB = ("apis/tpu.bacchus.io/v1", "", "userbootstraps")
+
+    def create_ub(self, name, spec=None, status=None):
+        obj = {
+            "apiVersion": "tpu.bacchus.io/v1",
+            "kind": "UserBootstrap",
+            "metadata": {"name": name},
+            "spec": spec or {},
+        }
+        if status is not None:
+            obj["status"] = status
+        return self.store.upsert(self.KEY_UB, name, obj)
+
+    def get(self, key, name):
+        with self.store.lock:
+            return copy.deepcopy(self.store.collection(key).get(name))
+
+    def list_names(self, key):
+        with self.store.lock:
+            return sorted(self.store.collection(key))
+
+
+def main():
+    parser = argparse.ArgumentParser(description="fake Kubernetes API server")
+    parser.add_argument("--port", type=int, default=8001)
+    args = parser.parse_args()
+    server = FakeKube(args.port).start()
+    print(f"fake API server on {server.url}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()
