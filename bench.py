@@ -1,0 +1,184 @@
+#!/usr/bin/env python3
+"""Control-plane benchmark for tpu-bootstrap-controller.
+
+Metric (BASELINE.json): reconciles/sec + p50 CR-apply->slice latency. The
+reference publishes no numbers and its Rust toolchain is unavailable, so
+the baseline stand-in is this build's own controller constrained to the
+reference's architecture: one serial reconcile worker (the kube-rs runtime
+applies objects one CR at a time — reference controller.rs:50-155 performs
+1-4 sequential API writes per pass on a single reconcile loop).
+
+Protocol per configuration:
+  1. start the fake API server (in-process) pre-loaded with N sheet-synced
+     TPU CRs (v5e 2x2 slices — BASELINE config #3 shape);
+  2. start tpubc-controller; t0 = first reconcile observed;
+  3. wait until every CR's JobSet exists (full convergence); value =
+     N / elapsed = CR convergences per second;
+  4. with the controller warm, create K CRs one at a time and measure
+     apply->JobSet-visible latency; report the p50.
+
+Prints ONE JSON line:
+  {"metric": "reconciles_per_sec", "value": ..., "unit": "reconciles/s",
+   "vs_baseline": parallel/serial, ...extras}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent
+sys.path.insert(0, str(REPO))
+
+from tpu_bootstrap import nativelib  # noqa: E402
+from tpu_bootstrap.fakeapi import FakeKube  # noqa: E402
+
+N_BURST = 200
+K_LATENCY = 40
+
+KEY_JS = lambda ns: ("apis/jobset.x-k8s.io/v1alpha2", ns, "jobsets")  # noqa: E731
+
+SYNCED = {"synchronized_with_sheet": True}
+
+
+def cr_spec():
+    return {
+        "kube_username": "u",
+        "quota": {"hard": {"requests.google.com/tpu": "4"}},
+        "rolebinding": {
+            "role_ref": {
+                "api_group": "rbac.authorization.k8s.io",
+                "kind": "ClusterRole",
+                "name": "edit",
+            }
+        },
+        "tpu": {"accelerator": "tpu-v5-lite-podslice", "topology": "2x2"},
+    }
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def wait_health(port, proc, timeout=15.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(f"controller exited: {proc.stderr.read().decode()[-2000:]}")
+        try:
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}/health", timeout=1) as r:
+                if r.read() == b"pong":
+                    return
+        except OSError:
+            time.sleep(0.02)
+    raise TimeoutError("controller health timeout")
+
+
+def run_config(workers: int, n_burst: int = N_BURST, k_latency: int = K_LATENCY,
+               latency_ms: float = 0):
+    fake = FakeKube(latency_ms=latency_ms).start()
+    port = free_port()
+    try:
+        for i in range(n_burst):
+            fake.create_ub(f"bench-{i:04d}", spec=cr_spec(), status=dict(SYNCED))
+
+        proc = subprocess.Popen(
+            [str(REPO / "native" / "build" / "tpubc-controller")],
+            env={
+                **os.environ,
+                "CONF_KUBE_API_URL": fake.url,
+                "CONF_LISTEN_ADDR": "127.0.0.1",
+                "CONF_LISTEN_PORT": str(port),
+                "CONF_RECONCILE_WORKERS": str(workers),
+                "TPUBC_LOG": "error",
+            },
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE,
+        )
+        try:
+            wait_health(port, proc)
+            t0 = time.time()
+            deadline = t0 + 300
+            while time.time() < deadline:
+                with fake.store.lock:
+                    done = sum(
+                        1
+                        for i in range(n_burst)
+                        if fake.store.objects.get(KEY_JS(f"bench-{i:04d}"), {}).get(
+                            f"bench-{i:04d}-slice"
+                        )
+                    )
+                if done == n_burst:
+                    break
+                time.sleep(0.005)
+            else:
+                raise TimeoutError("burst convergence timeout")
+            burst_elapsed = time.time() - t0
+            burst_rate = n_burst / burst_elapsed
+
+            # p50 apply -> JobSet-visible latency on a warm controller.
+            latencies = []
+            for i in range(k_latency):
+                name = f"lat-{i:04d}"
+                t_apply = time.time()
+                fake.create_ub(name, spec=cr_spec(), status=dict(SYNCED))
+                while True:
+                    with fake.store.lock:
+                        if fake.store.objects.get(KEY_JS(name), {}).get(f"{name}-slice"):
+                            break
+                    if time.time() - t_apply > 30:
+                        raise TimeoutError(f"latency CR {name} never converged")
+                    time.sleep(0.001)
+                latencies.append((time.time() - t_apply) * 1000)
+            latencies.sort()
+            p50 = latencies[len(latencies) // 2]
+            return burst_rate, burst_elapsed, p50
+        finally:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    finally:
+        fake.stop()
+
+
+def main():
+    nativelib.build_native()
+
+    parallel_rate, parallel_elapsed, parallel_p50 = run_config(workers=8)
+    serial_rate, serial_elapsed, serial_p50 = run_config(workers=1)
+    # Same pair against a server with a 2ms/request RTT (kind/real API
+    # server territory): architecture scaling shows once requests have
+    # real latency to overlap.
+    rtt_parallel_rate, _, rtt_parallel_p50 = run_config(workers=8, latency_ms=2)
+    rtt_serial_rate, _, _ = run_config(workers=1, latency_ms=2)
+
+    result = {
+        "metric": "reconciles_per_sec",
+        "value": round(parallel_rate, 2),
+        "unit": "reconciles/s",
+        "vs_baseline": round(parallel_rate / serial_rate, 3),
+        "p50_apply_to_slice_ms": round(parallel_p50, 2),
+        "burst_n": N_BURST,
+        "burst_elapsed_s": round(parallel_elapsed, 3),
+        "serial_baseline_reconciles_per_sec": round(serial_rate, 2),
+        "serial_baseline_p50_ms": round(serial_p50, 2),
+        "rtt2ms_reconciles_per_sec": round(rtt_parallel_rate, 2),
+        "rtt2ms_vs_serial": round(rtt_parallel_rate / rtt_serial_rate, 3),
+        "rtt2ms_p50_ms": round(rtt_parallel_p50, 2),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

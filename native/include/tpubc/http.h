@@ -50,10 +50,11 @@ class HttpClient {
   // as Authorization: Bearer on every request.
   explicit HttpClient(const std::string& base_url, std::string ca_file = "",
                       bool verify_peer = true, std::string bearer_token = "");
+  ~HttpClient();  // out-of-line: Conn is incomplete here
 
-  // One-shot request (new connection per call; the API-server LB friendly
-  // pattern — the reference's hyper client pools, we trade a socket per
-  // call for simplicity; watch streams dominate traffic anyway).
+  // Request over a pooled keep-alive connection (the reference's hyper
+  // client pools connections too). A stale pooled connection is retried
+  // once on a fresh one.
   HttpResponse request(const std::string& method, const std::string& path,
                        const std::string& body = "", const std::string& content_type = "",
                        const std::map<std::string, std::string>& extra_headers = {},
@@ -72,12 +73,16 @@ class HttpClient {
  private:
   struct Conn;
   std::unique_ptr<Conn> open(int timeout_secs);
+  std::unique_ptr<Conn> take_pooled();
+  void pool(std::unique_ptr<Conn> conn);
 
   Url base_;
   std::string ca_file_;
   bool verify_peer_;
   std::string bearer_;
-  TlsCtxPtr tls_ctx_;  // lazily created
+  TlsCtxPtr tls_ctx_;
+  std::mutex pool_mutex_;
+  std::vector<std::unique_ptr<Conn>> idle_;
 };
 
 class HttpServer {

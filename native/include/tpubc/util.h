@@ -5,10 +5,19 @@
 #pragma once
 
 #include <cstdint>
+#include <stdexcept>
 #include <string>
 #include <vector>
 
 namespace tpubc {
+
+// A socket read exceeded its SO_RCVTIMEO. Distinguished from connection
+// errors so retry logic never replays a request the server may already be
+// processing, and watch loops can poll their cancel flag.
+class ReadTimeout : public std::runtime_error {
+ public:
+  ReadTimeout() : std::runtime_error("read timeout") {}
+};
 
 std::string base64_encode(const std::string& data);
 std::string base64_decode(const std::string& data);

@@ -31,7 +31,10 @@ class FdStream : public Stream {
   explicit FdStream(int fd) : fd_(fd) {}
   size_t read_some(char* buf, size_t len) override {
     ssize_t n = ::recv(fd_, buf, len, 0);
-    if (n < 0) throw std::runtime_error(std::string("recv: ") + std::strerror(errno));
+    if (n < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) throw ReadTimeout();
+      throw std::runtime_error(std::string("recv: ") + std::strerror(errno));
+    }
     return static_cast<size_t>(n);
   }
   void write_all(const char* buf, size_t len) override {
@@ -87,10 +90,18 @@ int tcp_connect(const std::string& host, int port, int timeout_secs) {
   return fd;
 }
 
-// Incremental reader with internal buffer for header/line parsing.
+// Incremental reader with internal buffer for header/line parsing. May be
+// seeded with bytes left over from a previous response on a keep-alive
+// connection; take_remaining() hands back the unconsumed tail.
 class BufReader {
  public:
-  explicit BufReader(Stream* s) : s_(s) {}
+  explicit BufReader(Stream* s, std::string initial = "") : s_(s), buf_(std::move(initial)) {}
+
+  std::string take_remaining() {
+    std::string out;
+    out.swap(buf_);
+    return out;
+  }
 
   // Read until delimiter; returns content without the delimiter.
   // Throws on premature close unless allow_eof (then returns what's left
@@ -194,8 +205,17 @@ Url parse_url(const std::string& url) {
 struct HttpClient::Conn {
   int fd = -1;
   std::unique_ptr<Stream> stream;
+  std::string leftover;  // bytes beyond the last response (keep-alive)
+  int timeout_secs = 0;  // currently-armed SO_RCVTIMEO/SNDTIMEO
   ~Conn() {
     if (fd >= 0) ::close(fd);
+  }
+  void set_timeout(int secs) {
+    if (secs == timeout_secs) return;
+    struct timeval tv{secs, 0};
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    timeout_secs = secs;
   }
 };
 
@@ -210,9 +230,12 @@ HttpClient::HttpClient(const std::string& base_url, std::string ca_file, bool ve
   if (base_.scheme == "https") tls_ctx_ = tls_client_context(ca_file_, verify_peer_);
 }
 
+HttpClient::~HttpClient() = default;
+
 std::unique_ptr<HttpClient::Conn> HttpClient::open(int timeout_secs) {
   auto conn = std::make_unique<Conn>();
   conn->fd = tcp_connect(base_.host, base_.port, timeout_secs);
+  conn->timeout_secs = timeout_secs;
   if (base_.scheme == "https") {
     conn->stream = std::make_unique<TlsStreamAdapter>(
         TlsStream::connect(tls_ctx_, conn->fd, base_.host));
@@ -227,11 +250,12 @@ namespace {
 std::string build_request_head(const std::string& method, const std::string& path,
                                const std::string& host, const std::string& bearer,
                                const std::string& content_type, size_t body_len,
-                               const std::map<std::string, std::string>& extra) {
+                               const std::map<std::string, std::string>& extra,
+                               bool keep_alive = true) {
   std::ostringstream ss;
   ss << method << " " << path << " HTTP/1.1\r\n";
   ss << "Host: " << host << "\r\n";
-  ss << "Connection: close\r\n";
+  ss << "Connection: " << (keep_alive ? "keep-alive" : "close") << "\r\n";
   ss << "Accept: application/json\r\n";
   if (!bearer.empty()) ss << "Authorization: Bearer " << bearer << "\r\n";
   if (!content_type.empty()) ss << "Content-Type: " << content_type << "\r\n";
@@ -244,38 +268,88 @@ std::string build_request_head(const std::string& method, const std::string& pat
 
 }  // namespace
 
+std::unique_ptr<HttpClient::Conn> HttpClient::take_pooled() {
+  std::lock_guard<std::mutex> lock(pool_mutex_);
+  if (idle_.empty()) return nullptr;
+  auto conn = std::move(idle_.back());
+  idle_.pop_back();
+  return conn;
+}
+
+void HttpClient::pool(std::unique_ptr<Conn> conn) {
+  std::lock_guard<std::mutex> lock(pool_mutex_);
+  constexpr size_t kMaxIdle = 16;
+  if (idle_.size() < kMaxIdle) idle_.push_back(std::move(conn));
+}
+
 HttpResponse HttpClient::request(const std::string& method, const std::string& path,
                                  const std::string& body, const std::string& content_type,
                                  const std::map<std::string, std::string>& extra_headers,
                                  int timeout_secs) {
-  auto conn = open(timeout_secs);
   std::string head =
       build_request_head(method, path, base_.host, bearer_, content_type, body.size(), extra_headers);
-  conn->stream->write_all(head.data(), head.size());
-  if (!body.empty()) conn->stream->write_all(body.data(), body.size());
 
-  BufReader reader(conn->stream.get());
-  std::string status_line = reader.read_until("\r\n");
-  HttpResponse resp;
-  if (status_line.size() < 12) throw std::runtime_error("bad status line: " + status_line);
-  resp.status = std::stoi(status_line.substr(9, 3));
-  resp.headers = parse_headers(reader);
+  for (int attempt = 0;; ++attempt) {
+    auto conn = attempt == 0 ? take_pooled() : nullptr;
+    const bool pooled = conn != nullptr;
+    if (!conn) conn = open(timeout_secs);
+    conn->set_timeout(timeout_secs);
+    bool got_response_bytes = false;
+    try {
+      // One write per request: head+body split across two TCP segments
+      // interacts badly with delayed ACK on the peer.
+      std::string frame = head + body;
+      conn->stream->write_all(frame.data(), frame.size());
 
-  auto it = resp.headers.find("transfer-encoding");
-  if (it != resp.headers.end() && contains(to_lower(it->second), "chunked")) {
-    while (true) {
-      std::string size_line = reader.read_until("\r\n");
-      size_t chunk_size = std::stoul(size_line, nullptr, 16);
-      if (chunk_size == 0) break;
-      resp.body += reader.read_exact(chunk_size);
-      reader.read_exact(2);  // trailing CRLF
+      BufReader reader(conn->stream.get(), std::move(conn->leftover));
+      std::string status_line = reader.read_until("\r\n");
+      got_response_bytes = true;
+      HttpResponse resp;
+      if (status_line.size() < 12) throw std::runtime_error("bad status line: " + status_line);
+      resp.status = std::stoi(status_line.substr(9, 3));
+      resp.headers = parse_headers(reader);
+
+      bool reusable = true;
+      auto te = resp.headers.find("transfer-encoding");
+      if (te != resp.headers.end() && contains(to_lower(te->second), "chunked")) {
+        while (true) {
+          std::string size_line = reader.read_until("\r\n");
+          size_t chunk_size = std::stoul(size_line, nullptr, 16);
+          if (chunk_size == 0) {
+            // consume trailer section up to its blank-line terminator
+            while (!reader.read_until("\r\n").empty()) {
+            }
+            break;
+          }
+          resp.body += reader.read_exact(chunk_size);
+          reader.read_exact(2);  // trailing CRLF
+        }
+      } else if (resp.headers.count("content-length")) {
+        resp.body = reader.read_exact(std::stoul(resp.headers["content-length"]));
+      } else {
+        resp.body = reader.read_to_eof();
+        reusable = false;  // framing by close
+      }
+      auto cn = resp.headers.find("connection");
+      if (cn != resp.headers.end() && contains(to_lower(cn->second), "close")) reusable = false;
+      if (reusable) {
+        conn->leftover = reader.take_remaining();
+        pool(std::move(conn));
+      }
+      return resp;
+    } catch (const ReadTimeout&) {
+      // The server may have received (and be processing) the request —
+      // never replay, regardless of pooling.
+      throw;
+    } catch (const std::exception&) {
+      // A pooled connection may have been closed by the peer between
+      // requests. Retry exactly once on a fresh connection, and only if no
+      // response bytes arrived (a partial response means the server acted
+      // on the request — replaying a non-idempotent PATCH/DELETE would
+      // double-execute it). Failures on a fresh connection are real.
+      if (!pooled || got_response_bytes) throw;
     }
-  } else if (resp.headers.count("content-length")) {
-    resp.body = reader.read_exact(std::stoul(resp.headers["content-length"]));
-  } else {
-    resp.body = reader.read_to_eof();
   }
-  return resp;
 }
 
 int HttpClient::stream_lines(const std::string& path,
@@ -287,7 +361,8 @@ int HttpClient::stream_lines(const std::string& path,
   struct timeval tv{5, 0};
   ::setsockopt(conn->fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
 
-  std::string head = build_request_head("GET", path, base_.host, bearer_, "", 0, {});
+  std::string head =
+      build_request_head("GET", path, base_.host, bearer_, "", 0, {}, /*keep_alive=*/false);
   conn->stream->write_all(head.data(), head.size());
 
   std::string buf;        // raw bytes off the wire
@@ -303,8 +378,9 @@ int HttpClient::stream_lines(const std::string& path,
     size_t n;
     try {
       n = conn->stream->read_some(tmp, sizeof(tmp));
+    } catch (const ReadTimeout&) {
+      continue;  // idle tick: poll the cancel flag
     } catch (const std::exception&) {
-      if (errno == EAGAIN || errno == EWOULDBLOCK) continue;  // timeout tick
       break;
     }
     if (n == 0) break;

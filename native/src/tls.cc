@@ -1,6 +1,9 @@
 #include "tpubc/tls.h"
 
+#include <cerrno>
 #include <stdexcept>
+
+#include "tpubc/util.h"
 
 namespace {
 
@@ -118,10 +121,13 @@ TlsStream::~TlsStream() {
 }
 
 size_t TlsStream::read(char* buf, size_t len) {
+  errno = 0;
   int n = SSL_read(static_cast<SSL*>(ssl_), buf, static_cast<int>(len));
   if (n > 0) return static_cast<size_t>(n);
   int err = SSL_get_error(static_cast<SSL*>(ssl_), n);
   if (err == kSSL_ERROR_ZERO_RETURN) return 0;  // clean close
+  // SSL_ERROR_SYSCALL with EAGAIN = the socket's SO_RCVTIMEO expired.
+  if (errno == EAGAIN || errno == EWOULDBLOCK) throw ReadTimeout();
   // Treat transport EOF as close too (peers often skip close_notify).
   if (n == 0) return 0;
   throw std::runtime_error("TLS read error " + std::to_string(err));

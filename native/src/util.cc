@@ -204,7 +204,7 @@ std::string now_rfc3339() {
             1000;
   std::tm tm_utc;
   gmtime_r(&t, &tm_utc);
-  char buf[40];
+  char buf[64];
   std::snprintf(buf, sizeof(buf), "%04d-%02d-%02dT%02d:%02d:%02d.%03lldZ", tm_utc.tm_year + 1900,
                 tm_utc.tm_mon + 1, tm_utc.tm_mday, tm_utc.tm_hour, tm_utc.tm_min, tm_utc.tm_sec,
                 static_cast<long long>(ms));

@@ -10,8 +10,10 @@ import os
 import sys
 from pathlib import Path
 
-# Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax is imported anywhere in the test process. Force
+# CPU even when the environment preconfigures a TPU platform (JAX_PLATFORMS
+# =axon on the bench host): tests always run on the virtual 8-device mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
@@ -22,6 +24,17 @@ REPO_ROOT = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO_ROOT))
 
 import pytest  # noqa: E402
+
+# A sitecustomize hook registers the axon TPU PJRT plugin at interpreter
+# startup, which pins the platform regardless of env vars — override via
+# the config API, which does take effect. Guarded so the native-only tests
+# still run in JAX-free environments.
+try:
+    import jax  # noqa: E402
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    pass
 
 from tpu_bootstrap import nativelib  # noqa: E402
 

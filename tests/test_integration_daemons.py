@@ -170,9 +170,9 @@ def test_controller_materializes_full_slice(fake):
         assert ub["status"]["synchronized_with_sheet"] is True
         assert ub["status"]["slice"]["chips"] == 0 or "phase" in ub["status"]["slice"]
 
-        m = d.metrics()
-        assert m["reconciles_total"] >= 1
-        assert m["applies_total"] >= 4
+        # the counter increments just after the status write lands; poll
+        wait_for(lambda: d.metrics().get("reconciles_total", 0) >= 1, desc="reconcile counter")
+        assert d.metrics()["applies_total"] >= 4
     finally:
         code, err = d.stop()
         assert code == 0, err

@@ -126,6 +126,9 @@ class Store:
 class FakeKubeHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = "FakeKube/0.1"
+    # Keep-alive + Nagle + delayed ACK = ~40ms per request; real API
+    # servers disable Nagle, so do we.
+    disable_nagle_algorithm = True
 
     # ---- plumbing ---------------------------------------------------------
 
@@ -135,6 +138,15 @@ class FakeKubeHandler(BaseHTTPRequestHandler):
     @property
     def store(self) -> Store:
         return self.server.store  # type: ignore[attr-defined]
+
+    def simulate_latency(self):
+        """Optional per-request delay modelling a real API server's network
+        + etcd round trip (a kind cluster sits at ~1-5ms). Benchmarks set
+        this so architecture differences (serial vs parallel reconcile)
+        surface instead of being masked by loopback speed."""
+        delay = getattr(self.server, "latency_ms", 0)
+        if delay:
+            time.sleep(delay / 1000.0)
 
     def send_json(self, code, payload):
         body = json.dumps(payload).encode()
@@ -198,6 +210,7 @@ class FakeKubeHandler(BaseHTTPRequestHandler):
     # ---- verbs ------------------------------------------------------------
 
     def do_GET(self):
+        self.simulate_latency()
         routed = self.route()
         if not routed:
             return self.send_status_error(404, f"unknown path {self.path}")
@@ -248,11 +261,13 @@ class FakeKubeHandler(BaseHTTPRequestHandler):
             return
 
     def do_POST(self):
+        self.simulate_latency()
+        raw = self.read_body()  # drain before any error return (keep-alive)
         routed = self.route()
         if not routed:
             return self.send_status_error(404, f"unknown path {self.path}")
         key, _, _, _ = routed
-        obj = json.loads(self.read_body())
+        obj = json.loads(raw)
         name = obj.get("metadata", {}).get("name")
         if not name:
             return self.send_status_error(400, "metadata.name required")
@@ -263,6 +278,8 @@ class FakeKubeHandler(BaseHTTPRequestHandler):
         return self.send_json(201, self.store.upsert(key, name, obj))
 
     def do_PATCH(self):
+        self.simulate_latency()
+        raw = self.read_body()  # drain before any error return (keep-alive)
         routed = self.route()
         if not routed:
             return self.send_status_error(404, f"unknown path {self.path}")
@@ -270,7 +287,7 @@ class FakeKubeHandler(BaseHTTPRequestHandler):
         if not name:
             return self.send_status_error(405, "PATCH requires a name")
         ctype = self.headers.get("Content-Type", "")
-        body = json.loads(self.read_body())
+        body = json.loads(raw)
         self.store.request_log.append(("PATCH", self.path))
 
         with self.store.lock:
@@ -306,11 +323,13 @@ class FakeKubeHandler(BaseHTTPRequestHandler):
         return self.send_status_error(415, f"unsupported patch type {ctype}")
 
     def do_PUT(self):
+        self.simulate_latency()
+        raw = self.read_body()  # drain before any error return (keep-alive)
         routed = self.route()
         if not routed:
             return self.send_status_error(404, f"unknown path {self.path}")
         key, name, sub, _ = routed
-        body = json.loads(self.read_body())
+        body = json.loads(raw)
         self.store.request_log.append(("PUT", self.path))
         with self.store.lock:
             existing = copy.deepcopy(self.store.collection(key).get(name))
@@ -332,6 +351,7 @@ class FakeKubeHandler(BaseHTTPRequestHandler):
         return self.send_json(200, self.store.upsert(key, name, body, preserve_status=True))
 
     def do_DELETE(self):
+        self.simulate_latency()
         routed = self.route()
         if not routed:
             return self.send_status_error(404, f"unknown path {self.path}")
@@ -346,10 +366,11 @@ class FakeKubeHandler(BaseHTTPRequestHandler):
 class FakeKube:
     """In-process fake API server handle for tests."""
 
-    def __init__(self, port: int = 0):
+    def __init__(self, port: int = 0, latency_ms: float = 0):
         self.store = Store()
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), FakeKubeHandler)
         self.httpd.store = self.store  # type: ignore[attr-defined]
+        self.httpd.latency_ms = latency_ms  # type: ignore[attr-defined]
         self.httpd.daemon_threads = True
         self.thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
 

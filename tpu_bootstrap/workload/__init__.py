@@ -1,0 +1,32 @@
+"""The TPU slice workload: what the controller's JobSets actually run.
+
+The reference operator schedules opaque GPU pods; this build ships a
+first-class, TPU-native payload so a provisioned slice is provably usable:
+a mesh-sharded transformer-LM training step (pjit over a
+data x fsdp x tensor `jax.sharding.Mesh`) that scales from one chip to a
+multi-host v5p slice purely by changing the mesh shape. The driver's
+`__graft_entry__.py` exercises exactly this code.
+"""
+
+from tpu_bootstrap.workload.model import ModelConfig, init_params, forward, loss_fn
+from tpu_bootstrap.workload.sharding import (
+    MeshConfig,
+    build_mesh,
+    param_shardings,
+    batch_shardings,
+)
+from tpu_bootstrap.workload.train import TrainConfig, make_train_step, init_train_state
+
+__all__ = [
+    "ModelConfig",
+    "init_params",
+    "forward",
+    "loss_fn",
+    "MeshConfig",
+    "build_mesh",
+    "param_shardings",
+    "batch_shardings",
+    "TrainConfig",
+    "make_train_step",
+    "init_train_state",
+]

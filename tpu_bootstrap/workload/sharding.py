@@ -1,0 +1,122 @@
+"""Mesh + sharding rules for the slice workload.
+
+The scaling-book recipe, applied: pick a mesh, annotate shardings on params
+and batch, let XLA insert the collectives, and keep them on ICI.
+
+Mesh axes:
+* ``data``   — pure data parallelism (gradient all-reduce).
+* ``fsdp``   — data parallelism with parameters sharded along it
+               (ZeRO-3 style: XLA all-gathers params per layer and
+               reduce-scatters grads).
+* ``tensor`` — Megatron tensor parallelism inside each block (attention
+               heads and the MLP hidden dim).
+
+For a GKE slice these axes map onto the physical topology so that `tensor`
+(highest-bandwidth, per-step all-reduces) rides intra-host ICI, `fsdp` the
+slice's remaining ICI dims, and `data` may span slices over DCN — the
+mesh-axis ordering below encodes that priority.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_bootstrap.workload.model import ModelConfig, Params
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    data: int = 1
+    fsdp: int = 1
+    tensor: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.data * self.fsdp * self.tensor
+
+    @staticmethod
+    def for_device_count(n: int) -> "MeshConfig":
+        """A sensible default factorization: tensor gets up to 2, fsdp up
+        to 2, the rest goes to data — mirroring how a v5p 4x4x4 slice would
+        be carved (tp within host, fsdp across hosts, dp across slices)."""
+        tensor = 2 if n % 2 == 0 else 1
+        rest = n // tensor
+        fsdp = 2 if rest % 2 == 0 else 1
+        data = rest // fsdp
+        return MeshConfig(data=data, fsdp=fsdp, tensor=tensor)
+
+
+def build_mesh(cfg: MeshConfig, devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    if len(devices) < cfg.size:
+        raise ValueError(f"mesh needs {cfg.size} devices, have {len(devices)}")
+    grid = np.array(devices[: cfg.size]).reshape(cfg.data, cfg.fsdp, cfg.tensor)
+    return Mesh(grid, ("data", "fsdp", "tensor"))
+
+
+def param_shardings(mesh: Mesh, params: Params):
+    """PartitionSpecs per parameter.
+
+    * embed:         (vocab, embed)        -> shard vocab over tensor,
+                                              embed over fsdp
+    * wq/wk/wv:      (embed, heads, hd)    -> heads over tensor (Megatron
+                                              column-parallel), embed over fsdp
+    * wo:            (heads, hd, embed)    -> heads over tensor (row-parallel:
+                                              XLA all-reduces the output),
+                                              embed over fsdp
+    * w_up:          (embed, mlp)          -> mlp over tensor, embed over fsdp
+    * w_down:        (mlp, embed)          -> mlp over tensor, embed over fsdp
+    * norms:         replicated
+    """
+
+    def spec_for(path: str, ndim: int) -> P:
+        if path.endswith("embed"):
+            return P("tensor", "fsdp")
+        if path.endswith(("wq", "wk", "wv")):
+            return P("fsdp", "tensor", None)
+        if path.endswith("wo"):
+            return P("tensor", None, "fsdp")
+        if path.endswith("w_up"):
+            return P("fsdp", "tensor")
+        if path.endswith("w_down"):
+            return P("tensor", "fsdp")
+        return P(*([None] * ndim))  # norms: replicated
+
+    def walk(tree, path=""):
+        if isinstance(tree, dict):
+            return {k: walk(v, f"{path}/{k}") for k, v in tree.items()}
+        if isinstance(tree, list):
+            return [walk(v, path) for v in tree]
+        return NamedSharding(mesh, spec_for(path, tree.ndim))
+
+    return walk(params)
+
+
+def batch_shardings(mesh: Mesh) -> NamedSharding:
+    """Tokens are sharded over both data-parallel axes; the sequence axis
+    stays unsharded here (ring-attention sequence parallelism is a separate
+    path, see workload/ring_attention.py)."""
+    return NamedSharding(mesh, P(("data", "fsdp"), None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_params(params: Params, shardings) -> Params:
+    return jax.tree.map(jax.device_put, params, shardings)
+
+
+__all__ = [
+    "MeshConfig",
+    "build_mesh",
+    "param_shardings",
+    "batch_shardings",
+    "replicated",
+    "shard_params",
+    "ModelConfig",
+]
