@@ -1,0 +1,26 @@
+# Build the four native daemons, ship them in one slim runtime image —
+# the reference's single-image/three-daemons packaging model.
+FROM debian:bookworm-slim AS build
+
+# No libssl-dev on purpose: the build declares the stable libssl C ABI
+# itself and links libssl.so.3 by soname (native/CMakeLists.txt).
+RUN apt-get update && apt-get install -y --no-install-recommends \
+    g++ cmake ninja-build libssl3 \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /src
+COPY native/ native/
+RUN cmake -S native -B native/build -G Ninja -DCMAKE_BUILD_TYPE=Release \
+    && ninja -C native/build
+
+FROM debian:bookworm-slim AS runtime
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+    ca-certificates libssl3 \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+COPY --from=build /src/native/build/tpubc-crdgen /app/
+COPY --from=build /src/native/build/tpubc-controller /app/
+COPY --from=build /src/native/build/tpubc-admission /app/
+COPY --from=build /src/native/build/tpubc-synchronizer /app/

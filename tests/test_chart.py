@@ -1,0 +1,116 @@
+"""Helm chart sanity checks (helm is unavailable in this image, so these
+validate structure + cross-reference template value paths against
+values.yaml — catching the typo class of chart bugs)."""
+
+import re
+from pathlib import Path
+
+import pytest
+
+yaml = pytest.importorskip("yaml")
+
+CHART = Path(__file__).resolve().parent.parent / "charts" / "tpu-bootstrap-controller"
+
+
+def load_values():
+    return yaml.safe_load((CHART / "values.yaml").read_text())
+
+
+def template_sources():
+    return {p.name: p.read_text() for p in (CHART / "templates").glob("*.yaml")}
+
+
+def test_chart_metadata():
+    chart = yaml.safe_load((CHART / "Chart.yaml").read_text())
+    assert chart["name"] == "tpu-bootstrap-controller"
+    assert chart["apiVersion"] == "v2"
+
+
+def test_values_have_component_sections():
+    values = load_values()
+    for comp in ("controller", "admission", "synchronizer"):
+        assert comp in values
+        assert "configs" in values[comp]
+        assert "service" in values[comp]
+    assert values["device"] == "tpu"
+    assert values["admission"]["replicaCount"] == 2  # HA webhook (reference parity)
+
+
+def test_template_value_paths_resolve():
+    """Every .Values.foo.bar reference in the templates must exist."""
+    values = load_values()
+    missing = []
+    for name, src in template_sources().items():
+        for match in re.finditer(r"\.Values\.([A-Za-z0-9_.]+)", src):
+            node = values
+            for part in match.group(1).split("."):
+                if isinstance(node, dict) and part in node:
+                    node = node[part]
+                else:
+                    missing.append(f"{name}: .Values.{match.group(1)}")
+                    break
+    assert not missing, missing
+
+
+def test_component_config_keys_exist():
+    """$ctx.configs.X references must exist in the right component section.
+
+    Blocks are delimited by the `if eq $component "<name>"` markers rather
+    than `{{- end }}` (nested `with` blocks contain inner `end`s): each
+    marker's section runs to the next marker, which safely over-covers.
+    References before the first marker are common to all components.
+    """
+    values = load_values()
+    src = template_sources()["deployment.yaml"]
+    markers = [
+        (m.start(), m.group(1))
+        for m in re.finditer(r'\{\{- if eq \$component "(\w+)" \}\}', src)
+    ]
+    assert {name for _, name in markers} == {"controller", "admission", "synchronizer"}
+    bounds = markers + [(len(src), None)]
+    # common prefix: must exist in every component
+    for match in re.finditer(r"\$ctx\.configs\.([A-Za-z0-9_]+)", src[: markers[0][0]]):
+        for comp in ("controller", "admission", "synchronizer"):
+            assert match.group(1) in values[comp]["configs"], (
+                f"common env references key {match.group(1)} missing from {comp}"
+            )
+    for (start, comp), (end, _) in zip(bounds, bounds[1:]):
+        for match in re.finditer(r"\$ctx\.configs\.([A-Za-z0-9_]+)", src[start:end]):
+            assert match.group(1) in values[comp]["configs"], (
+                f"{comp} env references missing config key {match.group(1)}"
+            )
+
+
+def test_deployment_env_matches_daemon_config_surface():
+    """The CONF_* names in the chart must be names the daemons actually
+    read (native/bin/*.cc via EnvConfig)."""
+    repo = CHART.parent.parent
+    daemon_src = "".join(
+        (repo / "native" / "bin" / f"{d}.cc").read_text()
+        for d in ("controller", "admission", "synchronizer")
+    ) + (repo / "native" / "src" / "kube_client.cc").read_text()
+    read_keys = set(re.findall(r'env\.(?:get|require|get_int|get_list)\("([a-z_]+)"', daemon_src))
+    read_keys |= {"kube_api_url", "kube_insecure_tls", "kube_token", "kube_ca_file"}
+
+    src = template_sources()["deployment.yaml"]
+    for conf in re.findall(r"CONF_([A-Z_]+)", src):
+        assert conf.lower() in read_keys, f"chart sets CONF_{conf} but no daemon reads it"
+
+
+def test_webhook_registration():
+    src = template_sources()["webhook.yaml"]
+    assert "failurePolicy: Fail" in src
+    assert "timeoutSeconds: 10" in src
+    assert 'operations: ["CREATE", "UPDATE", "DELETE"]' in src
+    assert "tpu.bacchus.io" in src
+    assert "path: /mutate" in src
+
+
+def test_rbac_grants_jobset_access():
+    src = template_sources()["rbac.yaml"]
+    assert "jobset.x-k8s.io" in src
+    assert "userbootstraps/status" in src
+
+
+def test_crd_template_is_generated_artifact(lib):
+    assert (CHART / "templates" / "crd.yaml").read_text() == lib.crd_yaml()
