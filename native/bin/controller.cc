@@ -20,11 +20,14 @@
 #include <thread>
 #include <vector>
 
+#include <unistd.h>
+
 #include "tpubc/config.h"
 #include "tpubc/crd.h"
 #include "tpubc/http.h"
 #include "tpubc/json.h"
 #include "tpubc/kube_client.h"
+#include "tpubc/leader.h"
 #include "tpubc/log.h"
 #include "tpubc/reconcile_core.h"
 #include "tpubc/runtime.h"
@@ -40,6 +43,8 @@ struct ControllerConfig {
   int64_t requeue_secs;
   int64_t error_requeue_secs;
   int64_t workers;
+  bool leader_elect;
+  LeaderConfig leader;
   Json core;  // config passed to the pure planner
 };
 
@@ -51,6 +56,29 @@ ControllerConfig load_config() {
   c.requeue_secs = env.get_int("requeue_secs", 30);
   c.error_requeue_secs = env.get_int("error_requeue_secs", 3);
   c.workers = env.get_int("reconcile_workers", 4);
+  c.leader_elect = env.get("leader_elect", "0") == "1";
+  if (c.leader_elect) {
+    // lease namespace: explicit env > in-cluster SA namespace > default
+    std::string ns = env.get("lease_namespace", "");
+    if (ns.empty()) {
+      try {
+        ns = trim(read_file("/var/run/secrets/kubernetes.io/serviceaccount/namespace"));
+      } catch (const std::exception&) {
+        ns = "default";
+      }
+    }
+    c.leader.lease_namespace = ns;
+    c.leader.lease_name = env.get("lease_name", "tpu-bootstrap-controller");
+    std::string identity = env.get("lease_identity", "");
+    if (identity.empty()) {
+      char host[256] = {0};
+      gethostname(host, sizeof(host) - 1);
+      identity = std::string(host) + "-" + std::to_string(::getpid());
+    }
+    c.leader.identity = identity;
+    c.leader.lease_duration_secs = env.get_int("lease_duration_secs", 15);
+    c.leader.renew_period_secs = env.get_int("lease_renew_secs", 5);
+  }
   c.core = default_controller_config();
   c.core.set("requeue_secs", c.requeue_secs);
   c.core.set("error_requeue_secs", c.error_requeue_secs);
@@ -204,6 +232,18 @@ int main() {
   log_info("health server listening",
            {{"addr", cfg.listen_addr}, {"port", std::to_string(health.bound_port())}});
 
+  // Leader election (optional): standbys serve /health but do not
+  // reconcile until they win the lease.
+  std::unique_ptr<LeaderElector> elector;
+  if (cfg.leader_elect) {
+    elector = std::make_unique<LeaderElector>(client, cfg.leader);
+    if (!elector->acquire(stop_requested())) {
+      health.stop();
+      log_info("stopped before acquiring leadership");
+      return 0;
+    }
+  }
+
   // Reconcile workers.
   std::vector<std::thread> workers;
   for (int64_t i = 0; i < cfg.workers; ++i) {
@@ -260,15 +300,26 @@ int main() {
     }
   });
 
-  // Block until a signal arrives (reference: tokio::try_join over tasks).
-  while (!stop_wait_ms(60'000)) {
+  // Block until a signal arrives (reference: tokio::try_join over tasks),
+  // or — with leader election — until leadership is lost.
+  bool lost_leadership = false;
+  if (elector) {
+    lost_leadership = !elector->hold(stop_requested());
+    if (lost_leadership) request_stop();  // wind everything down
+  } else {
+    while (!stop_wait_ms(60'000)) {
+    }
   }
-  log_info("signal received, starting graceful shutdown");
+  log_info(lost_leadership ? "leadership lost, shutting down for restart"
+                           : "signal received, starting graceful shutdown");
 
   queue.stop();
   for (auto& t : workers) t.join();
   watcher.join();
+  if (elector && !lost_leadership) elector->release();
   health.stop();
+  // Exit nonzero on leadership loss so the kubelet restarts the pod into
+  // standby mode rather than leaving a half-dead replica.
   log_info("controller gracefully shut down");
-  return 0;
+  return lost_leadership ? 1 : 0;
 }

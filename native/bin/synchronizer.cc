@@ -15,6 +15,7 @@
 // first-come (plan_sync in sheet_core.cc).
 #include "tpubc/config.h"
 #include "tpubc/crd.h"
+#include "tpubc/google_auth.h"
 #include "tpubc/http.h"
 #include "tpubc/json.h"
 #include "tpubc/kube_client.h"
@@ -27,15 +28,29 @@ using namespace tpubc;
 
 namespace {
 
-std::string fetch_sheet(const std::string& path, const std::string& url) {
-  if (!path.empty()) return read_file(path);
-  HttpClient client(url);
-  Url u = parse_url(url);
-  HttpResponse resp = client.request("GET", u.path);
-  if (!resp.ok())
-    throw std::runtime_error("sheet fetch failed: HTTP " + std::to_string(resp.status));
-  return resp.body;
-}
+// Sheet source priority: local file (tests/fixtures) > Google Drive export
+// with a service account (the reference's mode, synchronizer.rs:196-201) >
+// plain HTTP URL.
+struct SheetSource {
+  std::string path;
+  std::string url;
+  std::string google_file_id;
+  std::string google_api_base;  // test override
+  std::unique_ptr<GoogleTokenSource> tokens;
+
+  bool configured() const { return !path.empty() || !url.empty() || !google_file_id.empty(); }
+
+  std::string fetch() {
+    if (!path.empty()) return read_file(path);
+    if (tokens) return fetch_drive_csv(*tokens, google_file_id, google_api_base);
+    HttpClient client(url);
+    Url u = parse_url(url);
+    HttpResponse resp = client.request("GET", u.path);
+    if (!resp.ok())
+      throw std::runtime_error("sheet fetch failed: HTTP " + std::to_string(resp.status));
+    return resp.body;
+  }
+};
 
 int64_t fetch_capacity(const std::string& inventory_url, int64_t fallback) {
   if (inventory_url.empty()) return fallback;
@@ -53,10 +68,10 @@ int64_t fetch_capacity(const std::string& inventory_url, int64_t fallback) {
   }
 }
 
-void run_sync_once(KubeClient& client, const Json& sync_config, const std::string& sheet_path,
-                   const std::string& sheet_url, const std::string& inventory_url) {
+void run_sync_once(KubeClient& client, const Json& sync_config, SheetSource& sheet,
+                   const std::string& inventory_url) {
   log_info("starting synchronization");
-  std::string csv = fetch_sheet(sheet_path, sheet_url);
+  std::string csv = sheet.fetch();
   log_info("downloaded csv file", {{"bytes", std::to_string(csv.size())}});
 
   Json parsed = parse_sheet(csv);
@@ -119,11 +134,27 @@ int main() {
   const std::string listen_addr = env.get("listen_addr", "0.0.0.0");
   const int listen_port = static_cast<int>(env.get_int("listen_port", 12323));
   const int64_t interval_secs = env.get_int("sync_interval_secs", 60);
-  const std::string sheet_path = env.get("sheet_path", "");
-  const std::string sheet_url = env.get("sheet_url", "");
+  SheetSource sheet;
+  sheet.path = env.get("sheet_path", "");
+  sheet.url = env.get("sheet_url", "");
+  sheet.google_file_id = env.get("google_file_id", "");
+  sheet.google_api_base = env.get("google_api_base", "");
+  const std::string sa_key_path = env.get("google_service_account_json_path", "");
   const std::string inventory_url = env.get("inventory_url", "");
-  if (sheet_path.empty() && sheet_url.empty()) {
-    log_error("set CONF_SHEET_PATH or CONF_SHEET_URL");
+  if (!sheet.google_file_id.empty()) {
+    if (sa_key_path.empty()) {
+      log_error("CONF_GOOGLE_FILE_ID requires CONF_GOOGLE_SERVICE_ACCOUNT_JSON_PATH");
+      return 1;
+    }
+    try {
+      sheet.tokens = std::make_unique<GoogleTokenSource>(sa_key_path);
+    } catch (const std::exception& e) {
+      log_error("cannot load service-account key", {{"error", e.what()}});
+      return 1;
+    }
+  }
+  if (!sheet.configured()) {
+    log_error("set CONF_SHEET_PATH, CONF_SHEET_URL, or CONF_GOOGLE_FILE_ID");
     return 1;
   }
 
@@ -157,7 +188,7 @@ int main() {
   // Tick immediately, then every interval (tokio interval fires at t=0 too).
   do {
     try {
-      run_sync_once(client, sync_config, sheet_path, sheet_url, inventory_url);
+      run_sync_once(client, sync_config, sheet, inventory_url);
     } catch (const std::exception& e) {
       log_error("synchronization failed", {{"error", e.what()}});
       Metrics::instance().inc("sync_errors_total");

@@ -1,0 +1,65 @@
+// Google service-account OAuth2 (JWT bearer flow).
+//
+// The reference synchronizer authenticates to the Drive API with a
+// service-account key via yup-oauth2 (/root/reference/src/synchronizer.rs:
+// 178-187, Cargo.toml:29). Same flow here, natively: build an RS256-signed
+// JWT from the key file, exchange it at the token endpoint, cache the
+// access token until shortly before expiry, and fetch the sheet through
+// the Drive v3 CSV export — so `CONF_GOOGLE_SERVICE_ACCOUNT_JSON_PATH` +
+// `CONF_GOOGLE_FILE_ID` work exactly like the reference's config
+// (synchronizer.rs:30-31).
+//
+// RSA-SHA256 signing uses the stable libcrypto 3 EVP C ABI, declared by
+// hand like the TLS shim (no OpenSSL headers in this image).
+#pragma once
+
+#include <cstdint>
+#include <mutex>
+#include <string>
+
+#include "tpubc/json.h"
+
+namespace tpubc {
+
+inline constexpr const char* kDriveScope = "https://www.googleapis.com/auth/drive.readonly";
+
+// base64url (RFC 4648 §5, unpadded) — JWT segment encoding.
+std::string base64url_encode(const std::string& data);
+
+// RS256-sign `message` with a PEM private key (PKCS#8 or PKCS#1).
+// Returns the raw signature bytes; throws std::runtime_error.
+std::string rsa_sha256_sign(const std::string& pem_private_key, const std::string& message);
+
+// Build the signed JWT assertion for a service-account key object
+// ({client_email, private_key, token_uri}). `iat` is injectable for
+// deterministic tests (0 = now).
+std::string build_service_account_jwt(const Json& sa_key, const std::string& scope,
+                                      int64_t iat = 0);
+
+// Token source with caching + refresh.
+class GoogleTokenSource {
+ public:
+  // key_json_path: the mounted service-account key file.
+  GoogleTokenSource(std::string key_json_path, std::string scope = kDriveScope);
+
+  // Returns a live access token, refreshing via the token endpoint when
+  // the cached one is within 60s of expiry. Thread-safe.
+  std::string token();
+
+  const Json& key() const { return key_; }
+
+ private:
+  Json key_;
+  std::string scope_;
+  std::string cached_;
+  int64_t expires_at_ = 0;
+  std::mutex mutex_;
+};
+
+// Fetch a Drive file's CSV export (files/{id}/export?mimeType=text/csv),
+// following the reference's export call (synchronizer.rs:196-201).
+// api_base overrides https://www.googleapis.com for tests.
+std::string fetch_drive_csv(GoogleTokenSource& tokens, const std::string& file_id,
+                            const std::string& api_base = "");
+
+}  // namespace tpubc

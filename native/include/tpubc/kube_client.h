@@ -61,6 +61,14 @@ class KubeClient {
   // controller.rs:67). The object must carry apiVersion/kind/metadata.name.
   Json apply(const Json& obj, const std::string& field_manager, bool force = true);
 
+  // POST a new object (409 AlreadyExists if present — the primitive that
+  // makes lease acquisition race-free).
+  Json create(const Json& obj);
+
+  // PUT the full object (optimistic concurrency via the object's
+  // metadata.resourceVersion — 409 on conflict). Used by leader election.
+  Json replace(const Json& obj);
+
   // RFC-6902 patch (synchronizer.rs:322-330).
   Json json_patch(const std::string& api_version, const std::string& kind, const std::string& ns,
                   const std::string& name, const Json& patch);

@@ -1,0 +1,58 @@
+// Kubernetes Lease-based leader election.
+//
+// The reference's RBAC grants coordination.k8s.io/leases
+// (serviceaccount.yaml:26-28) but its controller never takes a lease —
+// running two replicas would double-reconcile. This build completes the
+// feature: classic acquire/renew/takeover over a coordination.k8s.io/v1
+// Lease with jittered retries, so controller.replicaCount > 1 gives real
+// HA (standbys take over within one lease duration).
+#pragma once
+
+#include <atomic>
+#include <string>
+
+#include "tpubc/kube_client.h"
+
+namespace tpubc {
+
+struct LeaderConfig {
+  std::string lease_namespace = "default";
+  std::string lease_name = "tpu-bootstrap-controller";
+  std::string identity;              // pod name / hostname
+  int64_t lease_duration_secs = 15;  // holder is presumed dead after this
+  int64_t renew_period_secs = 5;     // renew cadence (duration/3)
+};
+
+class LeaderElector {
+ public:
+  LeaderElector(KubeClient& client, LeaderConfig config);
+
+  // Block until this instance becomes the leader or stop is set.
+  // Returns true if leadership was acquired.
+  bool acquire(std::atomic<bool>& stop);
+
+  // Renew loop; returns when leadership is lost (renew failed / lease
+  // stolen) or stop is set. Returns true on clean stop, false on loss.
+  bool hold(std::atomic<bool>& stop);
+
+  // Release the lease on clean shutdown (so the next leader does not wait
+  // a full lease duration).
+  void release();
+
+  bool is_leader() const { return is_leader_.load(); }
+
+ private:
+  bool try_acquire_once();
+
+  KubeClient& client_;
+  LeaderConfig config_;
+  std::atomic<bool> is_leader_{false};
+};
+
+// RFC3339 micro-time helpers for Lease timestamps.
+std::string lease_now_rfc3339_micro();
+// Parse "...T...Z" into unix seconds (fractional part ignored); returns 0
+// on parse failure.
+int64_t lease_parse_rfc3339(const std::string& ts);
+
+}  // namespace tpubc

@@ -11,6 +11,7 @@
 
 #include "tpubc/admission_core.h"
 #include "tpubc/crd.h"
+#include "tpubc/google_auth.h"
 #include "tpubc/json.h"
 #include "tpubc/reconcile_core.h"
 #include "tpubc/sheet_core.h"
@@ -153,6 +154,17 @@ char* tpubc_plan_sync(const char* ub_list, const char* rows, const char* config)
     return tpubc::plan_sync(tpubc::Json::parse(ub_list), tpubc::Json::parse(rows),
                             tpubc::Json::parse(config))
         .dump();
+  });
+}
+
+char* tpubc_base64url_encode(const char* data) {
+  return guarded([&] { return tpubc::base64url_encode(data); });
+}
+
+char* tpubc_service_account_jwt(const char* sa_key_json, const char* scope, const char* iat) {
+  return guarded([&] {
+    return tpubc::build_service_account_jwt(tpubc::Json::parse(sa_key_json), scope,
+                                            std::stoll(iat));
   });
 }
 

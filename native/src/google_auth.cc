@@ -1,0 +1,165 @@
+#include "tpubc/google_auth.h"
+
+#include <ctime>
+#include <stdexcept>
+
+#include "tpubc/http.h"
+#include "tpubc/log.h"
+#include "tpubc/util.h"
+
+namespace {
+
+// ---- hand-declared libcrypto 3 C ABI (stable) ------------------------------
+extern "C" {
+typedef struct bio_st BIO;
+typedef struct evp_pkey_st EVP_PKEY;
+typedef struct evp_md_ctx_st EVP_MD_CTX;
+typedef struct evp_md_st EVP_MD;
+
+BIO* BIO_new_mem_buf(const void* buf, int len);
+int BIO_free(BIO* a);
+EVP_PKEY* PEM_read_bio_PrivateKey(BIO* bp, EVP_PKEY** x, void* cb, void* u);
+void EVP_PKEY_free(EVP_PKEY* pkey);
+EVP_MD_CTX* EVP_MD_CTX_new(void);
+void EVP_MD_CTX_free(EVP_MD_CTX* ctx);
+const EVP_MD* EVP_sha256(void);
+int EVP_DigestSignInit(EVP_MD_CTX* ctx, void* pctx, const EVP_MD* type, void* e, EVP_PKEY* pkey);
+int EVP_DigestSign(EVP_MD_CTX* ctx, unsigned char* sigret, size_t* siglen,
+                   const unsigned char* tbs, size_t tbslen);
+}
+
+std::string url_form_encode(const std::string& s) {
+  std::string out;
+  for (unsigned char c : s) {
+    if ((c >= 'A' && c <= 'Z') || (c >= 'a' && c <= 'z') || (c >= '0' && c <= '9') || c == '-' ||
+        c == '_' || c == '.' || c == '~') {
+      out += static_cast<char>(c);
+    } else {
+      char buf[4];
+      std::snprintf(buf, sizeof(buf), "%%%02X", c);
+      out += buf;
+    }
+  }
+  return out;
+}
+
+}  // namespace
+
+namespace tpubc {
+
+std::string base64url_encode(const std::string& data) {
+  std::string b64 = base64_encode(data);
+  std::string out;
+  out.reserve(b64.size());
+  for (char c : b64) {
+    if (c == '+')
+      out += '-';
+    else if (c == '/')
+      out += '_';
+    else if (c == '=')
+      break;  // padding is always trailing
+    else
+      out += c;
+  }
+  return out;
+}
+
+std::string rsa_sha256_sign(const std::string& pem_private_key, const std::string& message) {
+  BIO* bio = BIO_new_mem_buf(pem_private_key.data(), static_cast<int>(pem_private_key.size()));
+  if (!bio) throw std::runtime_error("BIO_new_mem_buf failed");
+  EVP_PKEY* pkey = PEM_read_bio_PrivateKey(bio, nullptr, nullptr, nullptr);
+  BIO_free(bio);
+  if (!pkey) throw std::runtime_error("cannot parse service-account private key PEM");
+
+  EVP_MD_CTX* ctx = EVP_MD_CTX_new();
+  std::string sig;
+  try {
+    if (!ctx) throw std::runtime_error("EVP_MD_CTX_new failed");
+    if (EVP_DigestSignInit(ctx, nullptr, EVP_sha256(), nullptr, pkey) != 1)
+      throw std::runtime_error("EVP_DigestSignInit failed");
+    size_t len = 0;
+    const unsigned char* msg = reinterpret_cast<const unsigned char*>(message.data());
+    if (EVP_DigestSign(ctx, nullptr, &len, msg, message.size()) != 1)
+      throw std::runtime_error("EVP_DigestSign sizing failed");
+    sig.resize(len);
+    if (EVP_DigestSign(ctx, reinterpret_cast<unsigned char*>(&sig[0]), &len, msg,
+                       message.size()) != 1)
+      throw std::runtime_error("EVP_DigestSign failed");
+    sig.resize(len);
+  } catch (...) {
+    if (ctx) EVP_MD_CTX_free(ctx);
+    EVP_PKEY_free(pkey);
+    throw;
+  }
+  EVP_MD_CTX_free(ctx);
+  EVP_PKEY_free(pkey);
+  return sig;
+}
+
+std::string build_service_account_jwt(const Json& sa_key, const std::string& scope, int64_t iat) {
+  if (iat == 0) iat = ::time(nullptr);
+  const std::string email = sa_key.get_string("client_email");
+  const std::string pem = sa_key.get_string("private_key");
+  const std::string token_uri =
+      sa_key.get_string("token_uri", "https://oauth2.googleapis.com/token");
+  if (email.empty() || pem.empty())
+    throw std::runtime_error("service-account key missing client_email/private_key");
+
+  Json header = Json::object({{"alg", "RS256"}, {"typ", "JWT"}});
+  Json claims = Json::object({
+      {"iss", email},
+      {"scope", scope},
+      {"aud", token_uri},
+      {"iat", iat},
+      {"exp", iat + 3600},
+  });
+  std::string signing_input =
+      base64url_encode(header.dump()) + "." + base64url_encode(claims.dump());
+  std::string signature = rsa_sha256_sign(pem, signing_input);
+  return signing_input + "." + base64url_encode(signature);
+}
+
+GoogleTokenSource::GoogleTokenSource(std::string key_json_path, std::string scope)
+    : scope_(std::move(scope)) {
+  key_ = Json::parse(read_file(key_json_path));
+}
+
+std::string GoogleTokenSource::token() {
+  std::lock_guard<std::mutex> lock(mutex_);
+  int64_t now = ::time(nullptr);
+  if (!cached_.empty() && now < expires_at_ - 60) return cached_;
+
+  const std::string token_uri =
+      key_.get_string("token_uri", "https://oauth2.googleapis.com/token");
+  std::string assertion = build_service_account_jwt(key_, scope_);
+  std::string body =
+      "grant_type=urn%3Aietf%3Aparams%3Aoauth%3Agrant-type%3Ajwt-bearer&assertion=" +
+      url_form_encode(assertion);
+
+  Url u = parse_url(token_uri);
+  HttpClient http(u.scheme + "://" + u.host + ":" + std::to_string(u.port));
+  HttpResponse resp = http.request("POST", u.path, body, "application/x-www-form-urlencoded");
+  if (!resp.ok())
+    throw std::runtime_error("token exchange failed: HTTP " + std::to_string(resp.status) + ": " +
+                             resp.body);
+  Json out = Json::parse(resp.body);
+  cached_ = out.get_string("access_token");
+  if (cached_.empty()) throw std::runtime_error("token response missing access_token");
+  expires_at_ = now + out.get_int("expires_in", 3600);
+  return cached_;
+}
+
+std::string fetch_drive_csv(GoogleTokenSource& tokens, const std::string& file_id,
+                            const std::string& api_base) {
+  std::string base = api_base.empty() ? "https://www.googleapis.com" : api_base;
+  HttpClient http(base);
+  std::string path = "/drive/v3/files/" + file_id + "/export?mimeType=text%2Fcsv";
+  HttpResponse resp =
+      http.request("GET", path, "", "", {{"Authorization", "Bearer " + tokens.token()}});
+  if (!resp.ok())
+    throw std::runtime_error("drive export failed: HTTP " + std::to_string(resp.status) + ": " +
+                             resp.body);
+  return resp.body;
+}
+
+}  // namespace tpubc

@@ -26,6 +26,7 @@ const KindInfo kKinds[] = {
     {"v1", "Namespace", "namespaces", false},
     {"v1", "ResourceQuota", "resourcequotas", true},
     {"v1", "Pod", "pods", true},
+    {"coordination.k8s.io/v1", "Lease", "leases", true},
     {"rbac.authorization.k8s.io/v1", "Role", "roles", true},
     {"rbac.authorization.k8s.io/v1", "RoleBinding", "rolebindings", true},
     {"jobset.x-k8s.io/v1alpha2", "JobSet", "jobsets", true},
@@ -124,6 +125,23 @@ Json KubeClient::apply(const Json& obj, const std::string& field_manager, bool f
   path += "?fieldManager=" + field_manager;
   if (force) path += "&force=true";
   return check(http_->request("PATCH", path, obj.dump(), "application/apply-patch+yaml"));
+}
+
+Json KubeClient::create(const Json& obj) {
+  const std::string api_version = obj.get_string("apiVersion");
+  const std::string kind = obj.get_string("kind");
+  const std::string ns = obj.get("metadata").get_string("namespace");
+  return check(http_->request("POST", resource_path(api_version, kind, ns, ""), obj.dump(),
+                              "application/json"));
+}
+
+Json KubeClient::replace(const Json& obj) {
+  const std::string api_version = obj.get_string("apiVersion");
+  const std::string kind = obj.get_string("kind");
+  const std::string name = obj.get("metadata").get_string("name");
+  const std::string ns = obj.get("metadata").get_string("namespace");
+  return check(http_->request("PUT", resource_path(api_version, kind, ns, name), obj.dump(),
+                              "application/json"));
 }
 
 Json KubeClient::json_patch(const std::string& api_version, const std::string& kind,

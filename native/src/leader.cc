@@ -1,0 +1,182 @@
+#include "tpubc/leader.h"
+
+#include <cinttypes>
+#include <cstdio>
+#include <ctime>
+
+#include "tpubc/log.h"
+#include "tpubc/runtime.h"
+#include "tpubc/util.h"
+
+namespace tpubc {
+
+namespace {
+constexpr const char* kLeaseApi = "coordination.k8s.io/v1";
+constexpr const char* kLeaseKind = "Lease";
+}  // namespace
+
+std::string lease_now_rfc3339_micro() {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  std::tm tm_utc;
+  gmtime_r(&ts.tv_sec, &tm_utc);
+  char buf[64];
+  std::snprintf(buf, sizeof(buf), "%04d-%02d-%02dT%02d:%02d:%02d.%06ldZ", tm_utc.tm_year + 1900,
+                tm_utc.tm_mon + 1, tm_utc.tm_mday, tm_utc.tm_hour, tm_utc.tm_min, tm_utc.tm_sec,
+                ts.tv_nsec / 1000);
+  return buf;
+}
+
+int64_t lease_parse_rfc3339(const std::string& ts) {
+  std::tm tm_utc{};
+  int y, mo, d, h, mi, s;
+  if (std::sscanf(ts.c_str(), "%d-%d-%dT%d:%d:%d", &y, &mo, &d, &h, &mi, &s) != 6) return 0;
+  tm_utc.tm_year = y - 1900;
+  tm_utc.tm_mon = mo - 1;
+  tm_utc.tm_mday = d;
+  tm_utc.tm_hour = h;
+  tm_utc.tm_min = mi;
+  tm_utc.tm_sec = s;
+  return timegm(&tm_utc);
+}
+
+LeaderElector::LeaderElector(KubeClient& client, LeaderConfig config)
+    : client_(client), config_(std::move(config)) {}
+
+bool LeaderElector::try_acquire_once() {
+  const std::string now = lease_now_rfc3339_micro();
+  Json lease;
+  bool exists = true;
+  try {
+    lease = client_.get(kLeaseApi, kLeaseKind, config_.lease_namespace, config_.lease_name);
+  } catch (const KubeError& e) {
+    if (e.status != 404) throw;
+    exists = false;
+  }
+
+  if (!exists) {
+    Json fresh = Json::object({
+        {"apiVersion", kLeaseApi},
+        {"kind", kLeaseKind},
+        {"metadata", Json::object({{"name", config_.lease_name},
+                                   {"namespace", config_.lease_namespace}})},
+        {"spec", Json::object({
+                     {"holderIdentity", config_.identity},
+                     {"leaseDurationSeconds", config_.lease_duration_secs},
+                     {"acquireTime", now},
+                     {"renewTime", now},
+                     {"leaseTransitions", 0},
+                 })},
+    });
+    // POST: exactly one racing standby wins; the rest see 409 AlreadyExists
+    // and stay on standby (SSA-with-force here would let both "win").
+    try {
+      client_.create(fresh);
+    } catch (const KubeError& e) {
+      if (e.status == 409) return false;
+      throw;
+    }
+    return true;
+  }
+
+  const Json& spec = lease.get("spec");
+  const std::string holder = spec.get_string("holderIdentity");
+  if (holder == config_.identity) {
+    // re-acquire our own lease (e.g. after restart)
+  } else {
+    int64_t renew = lease_parse_rfc3339(spec.get_string("renewTime"));
+    int64_t duration = spec.get_int("leaseDurationSeconds", config_.lease_duration_secs);
+    int64_t now_s = ::time(nullptr);
+    if (!holder.empty() && renew != 0 && now_s < renew + duration) {
+      return false;  // current holder still live
+    }
+    log_info("taking over expired lease",
+             {{"previous_holder", holder}, {"identity", config_.identity}});
+  }
+
+  Json updated = lease;
+  Json& uspec = updated["spec"];
+  int64_t transitions = spec.get_int("leaseTransitions", 0);
+  if (holder != config_.identity) transitions += 1;
+  uspec.set("holderIdentity", config_.identity);
+  uspec.set("leaseDurationSeconds", config_.lease_duration_secs);
+  uspec.set("acquireTime", now);
+  uspec.set("renewTime", now);
+  uspec.set("leaseTransitions", transitions);
+  // PUT with the read resourceVersion: a racing standby loses with a 409.
+  try {
+    client_.replace(updated);
+  } catch (const KubeError& e) {
+    if (e.status == 409) return false;
+    throw;
+  }
+  return true;
+}
+
+bool LeaderElector::acquire(std::atomic<bool>& stop) {
+  while (!stop.load()) {
+    try {
+      if (try_acquire_once()) {
+        is_leader_.store(true);
+        log_info("became leader", {{"identity", config_.identity},
+                                   {"lease", config_.lease_namespace + "/" + config_.lease_name}});
+        Metrics::instance().inc("leader_elections_total");
+        return true;
+      }
+    } catch (const std::exception& e) {
+      log_warn("lease acquire attempt failed", {{"error", e.what()}});
+    }
+    // Standbys poll at the renew cadence.
+    if (stop_wait_ms(config_.renew_period_secs * 1000)) break;
+  }
+  return false;
+}
+
+bool LeaderElector::hold(std::atomic<bool>& stop) {
+  int64_t first_failure = 0;
+  while (!stop.load()) {
+    if (stop_wait_ms(config_.renew_period_secs * 1000)) return true;
+    try {
+      Json lease =
+          client_.get(kLeaseApi, kLeaseKind, config_.lease_namespace, config_.lease_name);
+      if (lease.get("spec").get_string("holderIdentity") != config_.identity) {
+        log_error("lease stolen; stepping down",
+                  {{"holder", lease.get("spec").get_string("holderIdentity")}});
+        is_leader_.store(false);
+        return false;
+      }
+      Json& spec = lease["spec"];
+      spec.set("renewTime", lease_now_rfc3339_micro());
+      client_.replace(lease);
+      first_failure = 0;
+    } catch (const std::exception& e) {
+      // Failed renews are tolerated only while the lease is still fresh;
+      // step down once a full duration has passed without a success.
+      log_warn("lease renew failed", {{"error", e.what()}});
+      int64_t now = ::time(nullptr);
+      if (first_failure == 0) first_failure = now;
+      if (now - first_failure > config_.lease_duration_secs) {
+        is_leader_.store(false);
+        return false;
+      }
+    }
+  }
+  return true;
+}
+
+void LeaderElector::release() {
+  if (!is_leader_.load()) return;
+  try {
+    Json lease = client_.get(kLeaseApi, kLeaseKind, config_.lease_namespace, config_.lease_name);
+    if (lease.get("spec").get_string("holderIdentity") == config_.identity) {
+      Json& spec = lease["spec"];
+      spec.set("holderIdentity", "");
+      client_.replace(lease);
+    }
+  } catch (const std::exception& e) {
+    log_warn("lease release failed", {{"error", e.what()}});
+  }
+  is_leader_.store(false);
+}
+
+}  // namespace tpubc

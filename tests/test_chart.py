@@ -64,7 +64,7 @@ def test_component_config_keys_exist():
     src = template_sources()["deployment.yaml"]
     markers = [
         (m.start(), m.group(1))
-        for m in re.finditer(r'\{\{- if eq \$component "(\w+)" \}\}', src)
+        for m in re.finditer(r'\{\{- if (?:and \()?eq \$component "(\w+)"', src)
     ]
     assert {name for _, name in markers} == {"controller", "admission", "synchronizer"}
     bounds = markers + [(len(src), None)]

@@ -1,0 +1,156 @@
+"""Full user-onboarding lifecycle (SURVEY.md §3.5) with ALL THREE daemons
+running against one fake API server:
+
+1. oidc user applies a CR through the admission webhook (we play the API
+   server's webhook call + patch application);
+2. controller creates the namespace but withholds RoleBinding/JobSet
+   (sheet interlock);
+3. admin approves the sheet row;
+4. synchronizer writes quota + flips the gate;
+5. controller materializes ResourceQuota, RoleBinding and the TPU JobSet;
+6. user's slice reaches a running status once the JobSet reports active.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import time
+import urllib.request
+
+import pytest
+
+from tpu_bootstrap.fakeapi import FakeKube
+from tests.test_integration_daemons import (
+    CSV_HEADER,
+    Daemon,
+    KEY_JS,
+    KEY_NS,
+    KEY_QUOTA,
+    KEY_RB,
+    controller_env,
+    free_port,
+    post_json,
+    wait_for,
+)
+
+
+@pytest.fixture()
+def fake():
+    server = FakeKube().start()
+    yield server
+    server.stop()
+
+
+def test_full_onboarding_lifecycle(fake, tmp_path):
+    sheet = tmp_path / "sheet.csv"
+    sheet.write_text(CSV_HEADER)  # no rows yet: nothing approved
+
+    ctl_port, adm_port, sync_port = free_port(), free_port(), free_port()
+    # short steady-state requeue so the final status-refresh pass (step 6)
+    # does not wait the production 30s
+    ctl = Daemon(
+        "tpubc-controller", controller_env(fake, ctl_port, conf_requeue_secs=2), ctl_port
+    )
+    adm = Daemon(
+        "tpubc-admission",
+        {
+            "CONF_LISTEN_ADDR": "127.0.0.1",
+            "CONF_LISTEN_PORT": str(adm_port),
+            "CONF_TLS_DISABLED": "1",
+            "CONF_AUTHORIZED_GROUP_NAMES": "tpu,admin",
+        },
+        adm_port,
+    )
+    sync = Daemon(
+        "tpubc-synchronizer",
+        {
+            "CONF_KUBE_API_URL": fake.url,
+            "CONF_LISTEN_ADDR": "127.0.0.1",
+            "CONF_LISTEN_PORT": str(sync_port),
+            "CONF_SHEET_PATH": str(sheet),
+            "CONF_SYNC_INTERVAL_SECS": "1",
+            "CONF_SERVER_NAME": "tpu-serv",
+        },
+        sync_port,
+    )
+    for d in (ctl, adm, sync):
+        d.wait_healthy()
+    try:
+        # -- 1. user applies; API server consults the webhook ---------------
+        review = {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": {
+                "uid": "e2e",
+                "operation": "CREATE",
+                "userInfo": {"username": "oidc:alice", "groups": ["tpu"]},
+                "object": {
+                    "apiVersion": "tpu.bacchus.io/v1",
+                    "kind": "UserBootstrap",
+                    "metadata": {"name": "alice"},
+                    "spec": {"tpu": {"accelerator": "tpu-v5p-slice", "topology": "2x2x2"}},
+                },
+            },
+        }
+        out = post_json(f"http://127.0.0.1:{adm_port}/mutate", review)
+        assert out["response"]["allowed"] is True
+        patch = json.loads(base64.b64decode(out["response"]["patch"]))
+        obj = review["request"]["object"]
+        # apply the JSONPatch + persist, the way the API server would
+        from tpu_bootstrap.fakeapi import apply_json_patch
+
+        apply_json_patch(obj, patch)
+        fake.store.upsert(fake.KEY_UB, "alice", obj)
+
+        # -- 2. controller converges the pre-approval state ------------------
+        wait_for(lambda: fake.get(KEY_NS, "alice"), desc="namespace")
+        time.sleep(1.2)  # a couple of sync ticks with an empty sheet
+        assert fake.get(KEY_RB("alice"), "alice") is None, "gate must hold"
+        assert fake.get(KEY_JS("alice"), "alice-slice") is None
+        assert fake.get(KEY_QUOTA("alice"), "alice") is None
+
+        # -- 3. admin approves the sheet row ---------------------------------
+        sheet.write_text(CSV_HEADER + "앨리스,CSE,alice,tpu-serv,8,16,64,200,o\n")
+
+        # -- 4+5. synchronizer + controller converge the approved state ------
+        ub = wait_for(
+            lambda: (lambda u: u if u.get("status", {}).get("synchronized_with_sheet") else None)(
+                fake.get(fake.KEY_UB, "alice")
+            ),
+            desc="sheet sync",
+        )
+        assert ub["spec"]["quota"]["hard"]["requests.google.com/tpu"] == "8"
+        assert ub["spec"]["kube_username"] == "alice"  # admission patch stuck
+        assert ub["spec"]["rolebinding"]["subjects"][0]["name"] == "oidc:alice"
+
+        quota = wait_for(lambda: fake.get(KEY_QUOTA("alice"), "alice"), desc="quota object")
+        assert quota["spec"]["hard"]["requests.google.com/tpu"] == "8"
+        rb = wait_for(lambda: fake.get(KEY_RB("alice"), "alice"), desc="rolebinding")
+        assert rb["roleRef"]["name"] == "edit"
+        js = wait_for(lambda: fake.get(KEY_JS("alice"), "alice-slice"), desc="jobset")
+        jspec = js["spec"]["replicatedJobs"][0]["template"]["spec"]
+        assert jspec["parallelism"] == 2  # 2x2x2 v5p = 8 chips / 4 per host
+        assert (
+            jspec["template"]["spec"]["nodeSelector"]["cloud.google.com/gke-tpu-topology"]
+            == "2x2x2"
+        )
+
+        # -- 6. JobSet reports active -> slice status becomes Running --------
+        with fake.store.lock:
+            js_live = fake.store.objects[KEY_JS("alice")]["alice-slice"]
+            js_live["status"] = {"replicatedJobsStatus": [{"name": "workers", "active": 2}]}
+        fake.store.upsert(KEY_JS("alice"), "alice-slice", js_live, preserve_status=False)
+        ub = wait_for(
+            lambda: (lambda u: u
+                     if u.get("status", {}).get("slice", {}).get("phase") == "Running"
+                     else None)(fake.get(fake.KEY_UB, "alice")),
+            timeout=15,  # covered by the 2s requeue pass (we don't watch jobsets yet)
+            desc="slice Running",
+        )
+        assert ub["status"]["slice"]["chips"] == 8
+        assert ub["status"]["slice"]["hosts"] == 2
+    finally:
+        for d in (ctl, adm, sync):
+            code, err = d.stop()
+            assert code == 0, err

@@ -1,0 +1,102 @@
+"""Leader election: two controller replicas share one Lease; only the
+leader reconciles; killing the leader hands over within a lease duration.
+(The reference ships the lease RBAC but no implementation — SURVEY.md §2.)"""
+
+import time
+
+import pytest
+
+from tpu_bootstrap.fakeapi import FakeKube
+from tests.test_integration_daemons import (
+    Daemon,
+    KEY_NS,
+    controller_env,
+    free_port,
+    wait_for,
+)
+
+KEY_LEASE = ("apis/coordination.k8s.io/v1", "election", "leases")
+
+
+@pytest.fixture()
+def fake():
+    server = FakeKube().start()
+    yield server
+    server.stop()
+
+
+def le_env(fake, port, identity):
+    return controller_env(
+        fake,
+        port,
+        conf_leader_elect="1",
+        conf_lease_namespace="election",
+        conf_lease_identity=identity,
+        conf_lease_duration_secs="2",
+        conf_lease_renew_secs="1",
+    )
+
+
+def lease_holder(fake):
+    lease = fake.get(KEY_LEASE, "tpu-bootstrap-controller")
+    return lease["spec"]["holderIdentity"] if lease else None
+
+
+def test_single_leader_and_failover(fake):
+    port_a, port_b = free_port(), free_port()
+    a = Daemon("tpubc-controller", le_env(fake, port_a, "ctl-a"), port_a).wait_healthy()
+    wait_for(lambda: lease_holder(fake) == "ctl-a", desc="a leads")
+    b = Daemon("tpubc-controller", le_env(fake, port_b, "ctl-b"), port_b).wait_healthy()
+    try:
+        # only the leader reconciles
+        fake.create_ub("alice", spec={})
+        wait_for(lambda: fake.get(KEY_NS, "alice"), desc="leader reconciles")
+        time.sleep(1.0)
+        assert lease_holder(fake) == "ctl-a", "standby must not steal a live lease"
+        assert "reconciles_total" not in b.metrics(), "standby must not reconcile"
+
+        # hard-kill the leader: no release, standby must take over after expiry
+        a.proc.kill()
+        a.proc.wait()
+        wait_for(lambda: lease_holder(fake) == "ctl-b", timeout=15, desc="b takes over")
+        fake.create_ub("bob", spec={})
+        wait_for(lambda: fake.get(KEY_NS, "bob"), desc="new leader reconciles")
+        lease = fake.get(KEY_LEASE, "tpu-bootstrap-controller")
+        assert lease["spec"]["leaseTransitions"] >= 1
+    finally:
+        for d in (a, b):
+            if d.proc.poll() is None:
+                d.stop()
+
+
+def test_simultaneous_start_elects_exactly_one_leader(fake):
+    """Both replicas race the initial POST; exactly one may win (the loser
+    gets 409 AlreadyExists — split-brain on a fresh lease is the classic
+    SSA-with-force bug)."""
+    port_a, port_b = free_port(), free_port()
+    a = Daemon("tpubc-controller", le_env(fake, port_a, "race-a"), port_a)
+    b = Daemon("tpubc-controller", le_env(fake, port_b, "race-b"), port_b)
+    a.wait_healthy()
+    b.wait_healthy()
+    try:
+        wait_for(lambda: lease_holder(fake) in ("race-a", "race-b"), desc="a leader exists")
+        fake.create_ub("race-user", spec={})
+        wait_for(lambda: fake.get(KEY_NS, "race-user"), desc="leader reconciles")
+        time.sleep(1.0)
+        leaders = [
+            d for d in (a, b) if d.metrics().get("leader_elections_total", 0) > 0
+        ]
+        assert len(leaders) == 1, "exactly one replica may hold the lease"
+    finally:
+        for d in (a, b):
+            if d.proc.poll() is None:
+                d.stop(expect_graceful=False)
+
+
+def test_clean_shutdown_releases_lease(fake):
+    port = free_port()
+    d = Daemon("tpubc-controller", le_env(fake, port, "ctl-solo"), port).wait_healthy()
+    wait_for(lambda: lease_holder(fake) == "ctl-solo", desc="leadership")
+    code, err = d.stop()
+    assert code == 0, err
+    assert lease_holder(fake) == "", "clean shutdown must release the lease"

@@ -348,6 +348,16 @@ class FakeKubeHandler(BaseHTTPRequestHandler):
                 )
             existing["status"] = body.get("status", {})
             return self.send_json(200, self.store.upsert(key, name, existing, preserve_status=False))
+        # plain PUT: optimistic concurrency when the caller pins a
+        # resourceVersion (leader-election lease updates depend on this)
+        want_rv = body.get("metadata", {}).get("resourceVersion")
+        if want_rv and want_rv != existing["metadata"]["resourceVersion"]:
+            return self.send_status_error(
+                409,
+                f"resourceVersion conflict: have {existing['metadata']['resourceVersion']}, "
+                f"got {want_rv}",
+                "Conflict",
+            )
         return self.send_json(200, self.store.upsert(key, name, body, preserve_status=True))
 
     def do_DELETE(self):

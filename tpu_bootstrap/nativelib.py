@@ -19,35 +19,6 @@ NATIVE_DIR = REPO_ROOT / "native"
 BUILD_DIR = NATIVE_DIR / "build"
 LIB_PATH = BUILD_DIR / "libtpubc_capi.so"
 
-_STRING_FUNCS = [
-    "tpubc_version",
-    "tpubc_crd_yaml",
-    "tpubc_crd_json",
-    "tpubc_to_yaml",
-    "tpubc_json_roundtrip",
-    "tpubc_json_patch",
-    "tpubc_validate_topology",
-    "tpubc_slice_geometry",
-    "tpubc_default_topology",
-    "tpubc_classify_username",
-    "tpubc_default_admission_config",
-    "tpubc_mutate",
-    "tpubc_mutate_review",
-    "tpubc_default_controller_config",
-    "tpubc_desired_children",
-    "tpubc_build_jobset",
-    "tpubc_slice_status",
-    "tpubc_infer_header",
-    "tpubc_parse_sheet",
-    "tpubc_default_synchronizer_config",
-    "tpubc_build_quota",
-    "tpubc_plan_sync",
-    "tpubc_sha256_hex",
-    "tpubc_base64_encode",
-    "tpubc_base64_decode",
-]
-
-
 def build_native(force: bool = False) -> None:
     """Configure + build the native tree (cached; ninja makes this a no-op)."""
     if LIB_PATH.exists() and not force:
@@ -72,12 +43,12 @@ class NativeLib:
         self._lib = ctypes.CDLL(str(path or LIB_PATH))
         self._lib.tpubc_free.argtypes = [ctypes.c_void_p]
         self._lib.tpubc_free.restype = None
-        for name in _STRING_FUNCS:
-            fn = getattr(self._lib, name)
-            fn.restype = ctypes.c_void_p  # keep the pointer so we can free it
 
     def _call(self, name: str, *args: str) -> str:
         fn = getattr(self._lib, name)
+        # every tpubc_* function returns a malloc'd char* — set restype on
+        # first use (a default int restype would truncate the pointer)
+        fn.restype = ctypes.c_void_p
         fn.argtypes = [ctypes.c_char_p] * len(args)
         ptr = fn(*[a.encode("utf-8") for a in args])
         try:
