@@ -1,5 +1,6 @@
 #include "tpubc/leader.h"
 
+#include <algorithm>
 #include <cinttypes>
 #include <cstdio>
 #include <ctime>
@@ -133,7 +134,14 @@ bool LeaderElector::acquire(std::atomic<bool>& stop) {
 }
 
 bool LeaderElector::hold(std::atomic<bool>& stop) {
-  int64_t first_failure = 0;
+  // A standby may legitimately take over at last-renew + leaseDuration (that
+  // timestamp is what the lease advertises), so the renew deadline is
+  // measured from the LAST SUCCESSFUL renew and sits one renew period short
+  // of the lease duration: we step down strictly before anyone else can
+  // become leader, never alongside them.
+  int64_t last_success = ::time(nullptr);
+  const int64_t renew_deadline =
+      std::max<int64_t>(config_.lease_duration_secs - config_.renew_period_secs, 1);
   while (!stop.load()) {
     if (stop_wait_ms(config_.renew_period_secs * 1000)) return true;
     try {
@@ -148,14 +156,11 @@ bool LeaderElector::hold(std::atomic<bool>& stop) {
       Json& spec = lease["spec"];
       spec.set("renewTime", lease_now_rfc3339_micro());
       client_.replace(lease);
-      first_failure = 0;
+      last_success = ::time(nullptr);
     } catch (const std::exception& e) {
-      // Failed renews are tolerated only while the lease is still fresh;
-      // step down once a full duration has passed without a success.
       log_warn("lease renew failed", {{"error", e.what()}});
-      int64_t now = ::time(nullptr);
-      if (first_failure == 0) first_failure = now;
-      if (now - first_failure > config_.lease_duration_secs) {
+      if (::time(nullptr) - last_success >= renew_deadline) {
+        log_error("renew deadline exceeded; stepping down before lease expiry", {});
         is_leader_.store(false);
         return false;
       }

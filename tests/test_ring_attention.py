@@ -1,0 +1,125 @@
+"""Ring attention (sequence parallelism) on the virtual 8-device mesh.
+
+The correctness bar: ring attention over any seq-axis size must be
+bitwise-semantically identical (to fp tolerance) to unsharded causal
+attention — outputs AND gradients, since the backward pass is its own
+counter-rotating ring.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_bootstrap.workload.model import ModelConfig, init_params, loss_fn
+from tpu_bootstrap.workload.ring_attention import (
+    make_ring_attention,
+    reference_attention,
+)
+
+
+def _qkv(key, batch=2, seq=32, heads=4, head_dim=8, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (batch, seq, heads, head_dim)
+    return (
+        jax.random.normal(kq, shape, dtype),
+        jax.random.normal(kk, shape, dtype),
+        jax.random.normal(kv, shape, dtype),
+    )
+
+
+def _seq_mesh(n):
+    return Mesh(np.array(jax.devices()[:n]).reshape(1, n), ("data", "seq"))
+
+
+@pytest.mark.parametrize("n_seq", [2, 4, 8])
+def test_ring_matches_reference(n_seq):
+    mesh = _seq_mesh(n_seq)
+    q, k, v = _qkv(jax.random.PRNGKey(0))
+    expected = reference_attention(q, k, v)
+
+    ring = make_ring_attention(mesh, seq_axis="seq", batch_axes=("data",))
+    spec = NamedSharding(mesh, P("data", "seq", None, None))
+    qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+    got = jax.jit(ring)(qs, ks, vs)
+
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=1e-5)
+
+
+def test_ring_gradients_match_reference():
+    mesh = _seq_mesh(4)
+    q, k, v = _qkv(jax.random.PRNGKey(1), seq=16)
+    ring = make_ring_attention(mesh, seq_axis="seq", batch_axes=("data",))
+    spec = NamedSharding(mesh, P("data", "seq", None, None))
+
+    def scalar_loss(attn):
+        def f(q, k, v):
+            return jnp.sum(jnp.square(attn(q, k, v)))
+
+        return f
+
+    g_ref = jax.grad(scalar_loss(reference_attention), argnums=(0, 1, 2))(q, k, v)
+    qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+    g_ring = jax.jit(jax.grad(scalar_loss(ring), argnums=(0, 1, 2)))(qs, ks, vs)
+
+    for a, b in zip(g_ref, g_ring):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=1e-4)
+
+
+def test_ring_is_causal():
+    """Perturbing a future position must not change earlier outputs."""
+    mesh = _seq_mesh(4)
+    ring = make_ring_attention(mesh, seq_axis="seq", batch_axes=("data",))
+    q, k, v = _qkv(jax.random.PRNGKey(2), batch=1, seq=16)
+    out_a = np.asarray(jax.jit(ring)(q, k, v))
+    k2 = k.at[0, -1].add(1.0)
+    v2 = v.at[0, -1].add(1.0)
+    out_b = np.asarray(jax.jit(ring)(q, k2, v2))
+    np.testing.assert_allclose(out_a[0, :-1], out_b[0, :-1], atol=1e-5)
+    assert not np.allclose(out_a[0, -1], out_b[0, -1])
+
+
+def test_ring_bfloat16_inputs():
+    """bf16 activations with f32 accumulation — the TPU recipe."""
+    mesh = _seq_mesh(4)
+    ring = make_ring_attention(mesh, seq_axis="seq", batch_axes=("data",))
+    q, k, v = _qkv(jax.random.PRNGKey(3), dtype=jnp.bfloat16)
+    got = jax.jit(ring)(q, k, v)
+    assert got.dtype == jnp.bfloat16
+    expected = reference_attention(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(expected), atol=3e-2
+    )
+
+
+def test_ring_composes_with_tensor_parallel_heads():
+    """seq x tensor mesh: heads sharded over tensor, sequence over seq."""
+    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("seq", "tensor"))
+    ring = make_ring_attention(
+        mesh, seq_axis="seq", batch_axes=(), head_axis="tensor"
+    )
+    q, k, v = _qkv(jax.random.PRNGKey(4), batch=1, seq=16, heads=4)
+    spec = NamedSharding(mesh, P(None, "seq", "tensor", None))
+    qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+    got = jax.jit(ring)(qs, ks, vs)
+    expected = reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=1e-5)
+
+
+def test_full_model_with_ring_attention():
+    """End-to-end: transformer loss with the ring core == vanilla loss."""
+    mesh = _seq_mesh(4)
+    cfg = ModelConfig(num_layers=2, max_seq_len=33)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0, cfg.vocab_size)
+
+    ref = float(loss_fn(params, tokens, cfg))
+    ring = make_ring_attention(mesh, seq_axis="seq", batch_axes=("data",))
+    # seq len inside the model is 32 after the shift — divisible by 4.
+    got = float(
+        jax.jit(lambda p, t: loss_fn(p, t, cfg, attn_fn=ring))(params, tokens)
+    )
+    assert abs(ref - got) < 1e-4

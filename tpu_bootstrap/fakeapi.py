@@ -331,34 +331,30 @@ class FakeKubeHandler(BaseHTTPRequestHandler):
         key, name, sub, _ = routed
         body = json.loads(raw)
         self.store.request_log.append(("PUT", self.path))
+        # The resourceVersion check and the write must be one critical
+        # section (store.lock is reentrant): two racing PUTs pinning the
+        # same rv must resolve to exactly one 200 and one 409 — leader
+        # election's takeover path depends on that guarantee.
         with self.store.lock:
             existing = copy.deepcopy(self.store.collection(key).get(name))
-        if existing is None:
-            return self.send_status_error(404, f"{name} not found", "NotFound")
-        if sub == "status":
-            # Optimistic concurrency: resourceVersion must match
-            # (synchronizer.rs:294 relies on this).
+            if existing is None:
+                return self.send_status_error(404, f"{name} not found", "NotFound")
             want_rv = body.get("metadata", {}).get("resourceVersion")
             if want_rv and want_rv != existing["metadata"]["resourceVersion"]:
+                # Optimistic concurrency (synchronizer.rs:294 and the
+                # lease updates rely on this).
                 return self.send_status_error(
                     409,
                     f"resourceVersion conflict: have {existing['metadata']['resourceVersion']}, "
                     f"got {want_rv}",
                     "Conflict",
                 )
-            existing["status"] = body.get("status", {})
-            return self.send_json(200, self.store.upsert(key, name, existing, preserve_status=False))
-        # plain PUT: optimistic concurrency when the caller pins a
-        # resourceVersion (leader-election lease updates depend on this)
-        want_rv = body.get("metadata", {}).get("resourceVersion")
-        if want_rv and want_rv != existing["metadata"]["resourceVersion"]:
-            return self.send_status_error(
-                409,
-                f"resourceVersion conflict: have {existing['metadata']['resourceVersion']}, "
-                f"got {want_rv}",
-                "Conflict",
-            )
-        return self.send_json(200, self.store.upsert(key, name, body, preserve_status=True))
+            if sub == "status":
+                existing["status"] = body.get("status", {})
+                result = self.store.upsert(key, name, existing, preserve_status=False)
+            else:
+                result = self.store.upsert(key, name, body, preserve_status=True)
+        return self.send_json(200, result)
 
     def do_DELETE(self):
         self.simulate_latency()

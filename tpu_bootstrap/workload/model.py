@@ -94,8 +94,15 @@ def _rotary(x: jax.Array, positions: jax.Array) -> jax.Array:
     return rotated.reshape(x.shape)
 
 
-def _attention(block: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
-    """Causal multi-head attention. x: (batch, seq, embed)."""
+def _attention(block: Params, x: jax.Array, cfg: ModelConfig, attn_fn=None) -> jax.Array:
+    """Causal multi-head attention. x: (batch, seq, embed).
+
+    ``attn_fn(q, k, v) -> out`` (all (batch, seq, heads, head_dim))
+    replaces the attention core when given — the hook through which ring
+    attention (sequence parallelism) and the pallas flash kernel plug in.
+    The QKV/rotary/output projections around it are per-token and need no
+    communication, so they work unchanged under any sequence sharding.
+    """
     dtype = cfg.compute_dtype
     seq = x.shape[1]
     positions = jnp.arange(seq)
@@ -107,13 +114,16 @@ def _attention(block: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     q = _rotary(q, positions)
     k = _rotary(k, positions)
 
-    scores = jnp.einsum("bshd,bthd->bhst", q, k) / jnp.sqrt(
-        jnp.asarray(cfg.head_dim, jnp.float32)
-    ).astype(dtype)
-    causal = jnp.tril(jnp.ones((seq, seq), jnp.bool_))
-    scores = jnp.where(causal[None, None, :, :], scores, jnp.asarray(-1e30, dtype))
-    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dtype)
-    out = jnp.einsum("bhst,bthd->bshd", probs, v)
+    if attn_fn is not None:
+        out = attn_fn(q, k, v)
+    else:
+        scores = jnp.einsum("bshd,bthd->bhst", q, k) / jnp.sqrt(
+            jnp.asarray(cfg.head_dim, jnp.float32)
+        ).astype(dtype)
+        causal = jnp.tril(jnp.ones((seq, seq), jnp.bool_))
+        scores = jnp.where(causal[None, None, :, :], scores, jnp.asarray(-1e30, dtype))
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dtype)
+        out = jnp.einsum("bhst,bthd->bshd", probs, v)
     return jnp.einsum("bshd,hde->bse", out, block["wo"].astype(dtype))
 
 
@@ -125,21 +135,21 @@ def _mlp(block: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     return jnp.einsum("bsm,me->bse", h, block["w_down"].astype(dtype))
 
 
-def forward(params: Params, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
+def forward(params: Params, tokens: jax.Array, cfg: ModelConfig, attn_fn=None) -> jax.Array:
     """tokens (batch, seq) int32 -> logits (batch, seq, vocab)."""
     dtype = cfg.compute_dtype
     x = params["embed"].astype(dtype)[tokens]
     for block in params["blocks"]:
-        x = x + _attention(block, x, cfg)
+        x = x + _attention(block, x, cfg, attn_fn)
         x = x + _mlp(block, x, cfg)
     x = _rms_norm(x, params["final_norm"])
     # logits in float32 for a numerically stable softmax/xent
     return jnp.einsum("bse,ve->bsv", x.astype(jnp.float32), params["embed"])
 
 
-def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
+def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig, attn_fn=None) -> jax.Array:
     """Next-token cross-entropy averaged over all positions."""
-    logits = forward(params, tokens[:, :-1], cfg)
+    logits = forward(params, tokens[:, :-1], cfg, attn_fn)
     targets = tokens[:, 1:]
     logprobs = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]

@@ -1,0 +1,168 @@
+"""Ring attention: causal attention with the sequence axis sharded over a
+mesh axis — the long-context path of the slice workload.
+
+Why a ring (and not just `jax.nn` under jit): with the sequence sharded,
+full attention needs every query shard to see every earlier KV shard.
+Materializing the whole K/V on each device (all-gather) costs O(seq)
+memory per chip and a DCN-unfriendly burst. The ring instead rotates KV
+shards one hop per step over `lax.ppermute` — each step is a
+neighbor-to-neighbor transfer that rides ICI, overlapping with that
+step's block matmul — while queries stay put. Memory per chip stays
+O(seq/n), and the per-step compute (a (Bq x Bk) block attention) is
+MXU-shaped.
+
+Numerics: flash-attention-style online softmax. Each device keeps a
+running row-max `m`, row-sum `l`, and unnormalized accumulator `acc` in
+float32, rescaling them as new KV blocks arrive, so the result is exactly
+softmax(qk)v regardless of block order. Causality is a per-block mask on
+*global* positions (shard index x block size + offset): blocks strictly
+in the future contribute all-zero weights and cost one masked matmul —
+acceptable because the ring must circulate anyway for the earliest
+queries.
+
+The whole thing is `lax.scan` + `lax.ppermute` inside `shard_map`: static
+trip count, reverse-differentiable (ppermute transposes to the inverse
+permutation, so the backward pass is a counter-rotating ring — this is
+exactly the memory-efficient ring-attention backward), and jit-compatible.
+
+Reference parity note: the reference system (bacchus-gpu-controller) has
+no compute path at all (SURVEY.md §2); this module is part of the slice
+workload that our controller's JobSets run, covering the long-context /
+sequence-parallel axis the TPU build treats as first-class.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+shard_map = jax.shard_map
+
+_NEG = -1e30  # finite "minus infinity": keeps exp() arithmetic NaN-free
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, n_shards: int):
+    """Per-device body under shard_map.
+
+    q, k, v: (batch, block, heads, head_dim) — the local sequence shard.
+    Returns the local shard of softmax(QK^T / sqrt(d)) V with causal mask
+    applied on global positions.
+    """
+    batch, block, heads, head_dim = q.shape
+    idx = lax.axis_index(axis_name)  # which sequence shard we hold
+    scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, jnp.float32))
+
+    qf = q.astype(jnp.float32)
+    q_pos = idx * block + jnp.arange(block)  # global query positions
+
+    # Online-softmax state, all float32.
+    acc = jnp.zeros((batch, block, heads, head_dim), jnp.float32)
+    m = jnp.full((batch, heads, block), _NEG, jnp.float32)  # running row max
+    l = jnp.zeros((batch, heads, block), jnp.float32)  # running row sum
+
+    perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+
+    def step(carry, s):
+        k_blk, v_blk, acc, m, l = carry
+        # After s rotations we hold the KV block originally on shard idx-s.
+        src = (idx - s) % n_shards
+        k_pos = src * block + jnp.arange(block)
+        mask = k_pos[None, :] <= q_pos[:, None]  # (block_q, block_k)
+
+        scores = (
+            jnp.einsum(
+                "bqhd,bkhd->bhqk",
+                qf,
+                k_blk.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )
+        scores = jnp.where(mask[None, None], scores, _NEG)
+
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+        # Rows with nothing visible yet keep m == _NEG; exp(_NEG - x) == 0
+        # for any finite x, so they contribute nothing — no NaNs.
+        p = jnp.exp(scores - m_new[..., None])
+        p = jnp.where(mask[None, None], p, 0.0)
+        correction = jnp.exp(m - m_new)  # rescale old state to the new max
+
+        l = correction * l + jnp.sum(p, axis=-1)
+        pv = jnp.einsum(
+            "bhqk,bkhd->bqhd",
+            p,
+            v_blk.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        acc = acc * jnp.transpose(correction, (0, 2, 1))[..., None] + pv
+
+        # Rotate KV one hop around the ring (neighbor transfer on ICI).
+        k_blk, v_blk = lax.ppermute((k_blk, v_blk), axis_name, perm=perm)
+        return (k_blk, v_blk, acc, m_new, l), None
+
+    (k, v, acc, m, l), _ = lax.scan(step, (k, v, acc, m, l), jnp.arange(n_shards))
+
+    # Every causal row sees at least its own position, so l > 0.
+    out = acc / jnp.transpose(l, (0, 2, 1))[..., None]
+    return out.astype(q.dtype)
+
+
+def make_ring_attention(
+    mesh: Mesh,
+    seq_axis: str = "seq",
+    batch_axes=("data", "fsdp"),
+    head_axis: str | None = None,
+):
+    """Build an attention function (q, k, v) -> out for sequence-sharded
+    inputs of shape (batch, seq, heads, head_dim).
+
+    ``batch_axes``/``head_axis`` describe how batch and heads are already
+    sharded (dp/fsdp and tensor parallelism compose with the ring: the
+    ring only moves the KV shards along ``seq_axis``; every other axis is
+    purely elementwise from its point of view).
+    """
+    batch_axes = tuple(a for a in batch_axes if a in mesh.axis_names)
+    if head_axis is not None and head_axis not in mesh.axis_names:
+        head_axis = None
+    spec = P(batch_axes if batch_axes else None, seq_axis, head_axis, None)
+    n_shards = mesh.shape[seq_axis]
+
+    local = partial(_ring_attention_local, axis_name=seq_axis, n_shards=n_shards)
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+
+
+def reference_attention(q, k, v):
+    """Unsharded causal attention with identical semantics — the test
+    oracle and the single-device fallback."""
+    head_dim = q.shape[-1]
+    seq = q.shape[1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, jnp.float32))
+    scores = (
+        jnp.einsum(
+            "bqhd,bkhd->bhqk",
+            q.astype(jnp.float32),
+            k.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        * scale
+    )
+    causal = jnp.tril(jnp.ones((seq, seq), jnp.bool_))
+    scores = jnp.where(causal[None, None], scores, _NEG)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd",
+        probs,
+        v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)
