@@ -78,6 +78,7 @@ ControllerConfig load_config() {
     c.leader.identity = identity;
     c.leader.lease_duration_secs = env.get_int("lease_duration_secs", 15);
     c.leader.renew_period_secs = env.get_int("lease_renew_secs", 5);
+    c.leader.retry_period_secs = env.get_int("lease_retry_secs", 2);
   }
   c.core = default_controller_config();
   c.core.set("requeue_secs", c.requeue_secs);
@@ -250,6 +251,17 @@ int main() {
     workers.emplace_back([&] {
       std::string name;
       while (queue.pop(&name)) {
+        // Per-pass leadership gate: is_leader() is wall-clock-deadline
+        // checked, so even while hold() is stuck in a slow renew we stop
+        // writing the moment our lease validity lapses (no split-brain
+        // writes alongside a legitimate new leader). The item is requeued
+        // so a re-elected leader (or this process after restart) picks it
+        // up.
+        if (elector && !elector->is_leader()) {
+          queue.done(name);
+          queue.add(name, cfg.error_requeue_secs * 1000);
+          continue;
+        }
         try {
           bool exists = reconcile_one(client, cfg, name);
           queue.done(name);

@@ -27,6 +27,9 @@ struct KubeConfig {
   std::string token;
   std::string ca_file;
   bool verify_tls = true;
+  // Per-request timeout for non-streaming verbs. Leader election clamps
+  // this so a hung renew cannot outlive the lease deadline.
+  int request_timeout_secs = 30;
 };
 
 // Resolve config from env (see header comment). Throws if neither mode is

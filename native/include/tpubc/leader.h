@@ -9,6 +9,8 @@
 #pragma once
 
 #include <atomic>
+#include <cstdint>
+#include <ctime>
 #include <string>
 
 #include "tpubc/kube_client.h"
@@ -21,6 +23,7 @@ struct LeaderConfig {
   std::string identity;              // pod name / hostname
   int64_t lease_duration_secs = 15;  // holder is presumed dead after this
   int64_t renew_period_secs = 5;     // renew cadence (duration/3)
+  int64_t retry_period_secs = 2;     // cadence after a failed renew
 };
 
 class LeaderElector {
@@ -39,14 +42,30 @@ class LeaderElector {
   // a full lease duration).
   void release();
 
-  bool is_leader() const { return is_leader_.load(); }
+  // Deadline-gated: true only while the last successful acquire/renew is
+  // younger than the renew deadline (lease_duration - renew_period, i.e.
+  // one renew period before a standby could legitimately take over). The
+  // gate is pure wall-clock — it does NOT depend on any in-flight renew
+  // request returning, so a hung/slow-dripping API server cannot extend
+  // this instance's claimed leadership past lease expiry. Callers must
+  // consult this per protected action (e.g. per reconcile pass), not
+  // cache it.
+  bool is_leader() const {
+    return is_leader_.load() && ::time(nullptr) < leader_until_.load();
+  }
 
  private:
   bool try_acquire_once();
 
-  KubeClient& client_;
+  // Dedicated client whose per-request timeout is clamped to half the
+  // renew period, so one GET+PUT attempt fits inside a renew period and a
+  // hung API server cannot keep hold() blocked past the renew deadline.
+  int64_t renew_deadline_secs() const;
+
+  KubeClient client_;
   LeaderConfig config_;
   std::atomic<bool> is_leader_{false};
+  std::atomic<int64_t> leader_until_{0};  // unix secs; see is_leader()
 };
 
 // RFC3339 micro-time helpers for Lease timestamps.

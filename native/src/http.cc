@@ -7,6 +7,9 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
+#include <chrono>
+#include <functional>
 #include <cstring>
 #include <sstream>
 #include <stdexcept>
@@ -206,16 +209,17 @@ struct HttpClient::Conn {
   int fd = -1;
   std::unique_ptr<Stream> stream;
   std::string leftover;  // bytes beyond the last response (keep-alive)
-  int timeout_secs = 0;  // currently-armed SO_RCVTIMEO/SNDTIMEO
+  long timeout_ms = 0;  // currently-armed SO_RCVTIMEO/SNDTIMEO
   ~Conn() {
     if (fd >= 0) ::close(fd);
   }
-  void set_timeout(int secs) {
-    if (secs == timeout_secs) return;
-    struct timeval tv{secs, 0};
+  void set_timeout(int secs) { set_timeout_ms(secs * 1000L); }
+  void set_timeout_ms(long ms) {
+    if (ms == timeout_ms) return;
+    struct timeval tv{ms / 1000, static_cast<suseconds_t>((ms % 1000) * 1000)};
     ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
     ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
-    timeout_secs = secs;
+    timeout_ms = ms;
   }
 };
 
@@ -235,7 +239,7 @@ HttpClient::~HttpClient() = default;
 std::unique_ptr<HttpClient::Conn> HttpClient::open(int timeout_secs) {
   auto conn = std::make_unique<Conn>();
   conn->fd = tcp_connect(base_.host, base_.port, timeout_secs);
-  conn->timeout_secs = timeout_secs;
+  conn->timeout_ms = timeout_secs * 1000L;
   if (base_.scheme == "https") {
     conn->stream = std::make_unique<TlsStreamAdapter>(
         TlsStream::connect(tls_ctx_, conn->fd, base_.host));
@@ -282,12 +286,53 @@ void HttpClient::pool(std::unique_ptr<Conn> conn) {
   if (idle_.size() < kMaxIdle) idle_.push_back(std::move(conn));
 }
 
+namespace {
+
+// Enforces a wall-clock deadline over a whole request. SO_RCVTIMEO alone
+// only bounds each individual recv, so a slow-dripping peer could stretch
+// one request arbitrarily (each read completing just under the timeout);
+// leader election's step-down guarantee needs timeout_secs to bound the
+// entire GET/PUT. Before every read/write this re-arms the socket timeout
+// to the REMAINING time and fails once the deadline passes.
+class DeadlineStream : public Stream {
+ public:
+  DeadlineStream(Stream* inner, std::function<void(long)> set_timeout,
+                 std::chrono::steady_clock::time_point deadline)
+      : inner_(inner), set_timeout_(std::move(set_timeout)), deadline_(deadline) {}
+  size_t read_some(char* buf, size_t len) override {
+    arm();
+    return inner_->read_some(buf, len);
+  }
+  void write_all(const char* buf, size_t len) override {
+    arm();
+    inner_->write_all(buf, len);
+  }
+
+ private:
+  void arm() {
+    auto now = std::chrono::steady_clock::now();
+    if (now >= deadline_) throw ReadTimeout();
+    auto remaining_ms =
+        std::chrono::duration_cast<std::chrono::milliseconds>(deadline_ - now).count();
+    // Ceil to avoid arming 0 (= "no timeout" to setsockopt).
+    set_timeout_(std::max<long>(static_cast<long>(remaining_ms), 10));
+  }
+  Stream* inner_;
+  std::function<void(long)> set_timeout_;
+  std::chrono::steady_clock::time_point deadline_;
+};
+
+}  // namespace
+
 HttpResponse HttpClient::request(const std::string& method, const std::string& path,
                                  const std::string& body, const std::string& content_type,
                                  const std::map<std::string, std::string>& extra_headers,
                                  int timeout_secs) {
   std::string head =
       build_request_head(method, path, base_.host, bearer_, content_type, body.size(), extra_headers);
+  // One deadline across both attempts: the stale-pooled-connection retry
+  // must not double the caller's time budget.
+  const auto deadline = std::chrono::steady_clock::now() + std::chrono::seconds(timeout_secs);
 
   for (int attempt = 0;; ++attempt) {
     auto conn = attempt == 0 ? take_pooled() : nullptr;
@@ -296,12 +341,14 @@ HttpResponse HttpClient::request(const std::string& method, const std::string& p
     conn->set_timeout(timeout_secs);
     bool got_response_bytes = false;
     try {
+      DeadlineStream stream(
+          conn->stream.get(), [&](long ms) { conn->set_timeout_ms(ms); }, deadline);
       // One write per request: head+body split across two TCP segments
       // interacts badly with delayed ACK on the peer.
       std::string frame = head + body;
-      conn->stream->write_all(frame.data(), frame.size());
+      stream.write_all(frame.data(), frame.size());
 
-      BufReader reader(conn->stream.get(), std::move(conn->leftover));
+      BufReader reader(&stream, std::move(conn->leftover));
       std::string status_line = reader.read_until("\r\n");
       got_response_bytes = true;
       HttpResponse resp;

@@ -107,12 +107,14 @@ Json KubeClient::check(const HttpResponse& resp) {
 
 Json KubeClient::list(const std::string& api_version, const std::string& kind,
                       const std::string& ns) {
-  return check(http_->request("GET", resource_path(api_version, kind, ns, "")));
+  return check(http_->request("GET", resource_path(api_version, kind, ns, ""), "", "", {},
+                              config_.request_timeout_secs));
 }
 
 Json KubeClient::get(const std::string& api_version, const std::string& kind,
                      const std::string& ns, const std::string& name) {
-  return check(http_->request("GET", resource_path(api_version, kind, ns, name)));
+  return check(http_->request("GET", resource_path(api_version, kind, ns, name), "", "", {},
+                              config_.request_timeout_secs));
 }
 
 Json KubeClient::apply(const Json& obj, const std::string& field_manager, bool force) {
@@ -124,7 +126,8 @@ Json KubeClient::apply(const Json& obj, const std::string& field_manager, bool f
   std::string path = resource_path(api_version, kind, ns, name);
   path += "?fieldManager=" + field_manager;
   if (force) path += "&force=true";
-  return check(http_->request("PATCH", path, obj.dump(), "application/apply-patch+yaml"));
+  return check(http_->request("PATCH", path, obj.dump(), "application/apply-patch+yaml", {},
+                              config_.request_timeout_secs));
 }
 
 Json KubeClient::create(const Json& obj) {
@@ -132,7 +135,7 @@ Json KubeClient::create(const Json& obj) {
   const std::string kind = obj.get_string("kind");
   const std::string ns = obj.get("metadata").get_string("namespace");
   return check(http_->request("POST", resource_path(api_version, kind, ns, ""), obj.dump(),
-                              "application/json"));
+                              "application/json", {}, config_.request_timeout_secs));
 }
 
 Json KubeClient::replace(const Json& obj) {
@@ -141,19 +144,19 @@ Json KubeClient::replace(const Json& obj) {
   const std::string name = obj.get("metadata").get_string("name");
   const std::string ns = obj.get("metadata").get_string("namespace");
   return check(http_->request("PUT", resource_path(api_version, kind, ns, name), obj.dump(),
-                              "application/json"));
+                              "application/json", {}, config_.request_timeout_secs));
 }
 
 Json KubeClient::json_patch(const std::string& api_version, const std::string& kind,
                             const std::string& ns, const std::string& name, const Json& patch) {
   return check(http_->request("PATCH", resource_path(api_version, kind, ns, name), patch.dump(),
-                              "application/json-patch+json"));
+                              "application/json-patch+json", {}, config_.request_timeout_secs));
 }
 
 Json KubeClient::replace_status(const std::string& api_version, const std::string& kind,
                                 const std::string& ns, const std::string& name, const Json& obj) {
   return check(http_->request("PUT", resource_path(api_version, kind, ns, name) + "/status",
-                              obj.dump(), "application/json"));
+                              obj.dump(), "application/json", {}, config_.request_timeout_secs));
 }
 
 Json KubeClient::merge_status(const std::string& api_version, const std::string& kind,
@@ -161,12 +164,14 @@ Json KubeClient::merge_status(const std::string& api_version, const std::string&
                               const Json& status_patch) {
   Json body = Json::object({{"status", status_patch}});
   return check(http_->request("PATCH", resource_path(api_version, kind, ns, name) + "/status",
-                              body.dump(), "application/merge-patch+json"));
+                              body.dump(), "application/merge-patch+json", {},
+                              config_.request_timeout_secs));
 }
 
 void KubeClient::remove(const std::string& api_version, const std::string& kind,
                         const std::string& ns, const std::string& name) {
-  check(http_->request("DELETE", resource_path(api_version, kind, ns, name)));
+  check(http_->request("DELETE", resource_path(api_version, kind, ns, name), "", "", {},
+                        config_.request_timeout_secs));
 }
 
 std::string KubeClient::watch(const std::string& api_version, const std::string& kind,

@@ -41,8 +41,20 @@ int64_t lease_parse_rfc3339(const std::string& ts) {
   return timegm(&tm_utc);
 }
 
+namespace {
+KubeConfig lease_client_config(const KubeConfig& base, const LeaderConfig& lc) {
+  KubeConfig kc = base;
+  kc.request_timeout_secs = std::max<int64_t>(1, lc.renew_period_secs / 2);
+  return kc;
+}
+}  // namespace
+
 LeaderElector::LeaderElector(KubeClient& client, LeaderConfig config)
-    : client_(client), config_(std::move(config)) {}
+    : client_(lease_client_config(client.config(), config)), config_(std::move(config)) {}
+
+int64_t LeaderElector::renew_deadline_secs() const {
+  return std::max<int64_t>(config_.lease_duration_secs - config_.renew_period_secs, 1);
+}
 
 bool LeaderElector::try_acquire_once() {
   const std::string now = lease_now_rfc3339_micro();
@@ -118,6 +130,7 @@ bool LeaderElector::acquire(std::atomic<bool>& stop) {
   while (!stop.load()) {
     try {
       if (try_acquire_once()) {
+        leader_until_.store(::time(nullptr) + renew_deadline_secs());
         is_leader_.store(true);
         log_info("became leader", {{"identity", config_.identity},
                                    {"lease", config_.lease_namespace + "/" + config_.lease_name}});
@@ -139,11 +152,24 @@ bool LeaderElector::hold(std::atomic<bool>& stop) {
   // measured from the LAST SUCCESSFUL renew and sits one renew period short
   // of the lease duration: we step down strictly before anyone else can
   // become leader, never alongside them.
+  //
+  // The HARD guarantee does not live in this loop at all: is_leader() is
+  // gated on leader_until_ (wall clock), so even if a renew attempt blocks
+  // arbitrarily long on a pathological transport, the exported leadership
+  // flips false at the deadline on its own. This loop's wall-clock checks
+  // plus the lease client's whole-request deadline (request timeout
+  // <= renew_period/2, DeadlineStream in http.cc) merely keep the loop
+  // itself responsive so the daemon can wind down and restart promptly.
   int64_t last_success = ::time(nullptr);
-  const int64_t renew_deadline =
-      std::max<int64_t>(config_.lease_duration_secs - config_.renew_period_secs, 1);
+  const int64_t renew_deadline = renew_deadline_secs();
+  int64_t wait_secs = config_.renew_period_secs;
   while (!stop.load()) {
-    if (stop_wait_ms(config_.renew_period_secs * 1000)) return true;
+    if (stop_wait_ms(wait_secs * 1000)) return true;
+    if (::time(nullptr) - last_success >= renew_deadline) {
+      log_error("renew deadline exceeded; stepping down before lease expiry", {});
+      is_leader_.store(false);
+      return false;
+    }
     try {
       Json lease =
           client_.get(kLeaseApi, kLeaseKind, config_.lease_namespace, config_.lease_name);
@@ -156,9 +182,18 @@ bool LeaderElector::hold(std::atomic<bool>& stop) {
       Json& spec = lease["spec"];
       spec.set("renewTime", lease_now_rfc3339_micro());
       client_.replace(lease);
+      // last_success is measured AFTER the PUT while the lease advertises
+      // the BEFORE-the-PUT renewTime; the gap is bounded by the request
+      // deadline (< renew_period), which the renew_deadline slack of one
+      // full renew period absorbs — leader_until_ stays strictly earlier
+      // than any standby's takeover time of renewTime + lease_duration.
       last_success = ::time(nullptr);
+      leader_until_.store(last_success + renew_deadline);
+      wait_secs = config_.renew_period_secs;
     } catch (const std::exception& e) {
       log_warn("lease renew failed", {{"error", e.what()}});
+      // Retry fast: the remaining budget before the deadline is small.
+      wait_secs = std::max<int64_t>(config_.retry_period_secs, 1);
       if (::time(nullptr) - last_success >= renew_deadline) {
         log_error("renew deadline exceeded; stepping down before lease expiry", {});
         is_leader_.store(false);

@@ -1,0 +1,133 @@
+"""Flash-attention kernel vs the dense reference path.
+
+Runs the Pallas kernel in interpreter mode on CPU (conftest forces the
+virtual-CPU platform); on TPU the same code compiles via Mosaic.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_bootstrap.workload.flash_attention import flash_attention, make_flash_attn_fn
+from tpu_bootstrap.workload.model import ModelConfig, init_params, loss_fn
+from tpu_bootstrap.workload.ring_attention import reference_attention as dense_reference
+
+
+def make_qkv(key, batch=2, seq=128, heads=4, head_dim=32):
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (batch, seq, heads, head_dim)
+    return (
+        jax.random.normal(kq, shape, jnp.float32),
+        jax.random.normal(kk, shape, jnp.float32),
+        jax.random.normal(kv, shape, jnp.float32),
+    )
+
+
+@pytest.mark.parametrize("seq,block", [(128, 64), (128, 128), (256, 128)])
+@pytest.mark.parametrize("causal", [True, False])
+def test_forward_matches_dense(seq, block, causal):
+    q, k, v = make_qkv(jax.random.PRNGKey(0), seq=seq)
+    out = flash_attention(q, k, v, causal=causal, block_size=block)
+    ref = dense_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_forward_under_jit():
+    q, k, v = make_qkv(jax.random.PRNGKey(1), seq=128)
+    out = jax.jit(lambda q, k, v: flash_attention(q, k, v, block_size=64))(q, k, v)
+    np.testing.assert_allclose(out, dense_reference(q, k, v), atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_grads_match_dense(causal):
+    q, k, v = make_qkv(jax.random.PRNGKey(2), seq=128, heads=2, head_dim=16)
+    # A non-trivial scalar readout so every output element gets a distinct
+    # cotangent.
+    w = jax.random.normal(jax.random.PRNGKey(3), q.shape, jnp.float32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal, block_size=64) * w)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dense_reference(q, k, v, causal=causal) * w)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gf, gd, name in zip(g_flash, g_dense, "qkv"):
+        np.testing.assert_allclose(gf, gd, atol=5e-5, rtol=5e-5, err_msg=name)
+
+
+def test_bad_shapes_rejected():
+    q, k, v = make_qkv(jax.random.PRNGKey(4), seq=64)
+    with pytest.raises(ValueError, match="must match"):
+        flash_attention(q, k[:, :50], v)
+    with pytest.raises(ValueError, match="multiple of 8"):
+        flash_attention(q, k, v, block_size=60)
+
+
+@pytest.mark.parametrize("seq", [100, 127, 130])
+@pytest.mark.parametrize("causal", [True, False])
+def test_unaligned_seq_is_padded(seq, causal):
+    """The train path always arrives with seq = max_seq_len - 1; padding
+    must be invisible in both the output and the gradients."""
+    q, k, v = make_qkv(jax.random.PRNGKey(9), seq=seq, heads=2, head_dim=16)
+    out = flash_attention(q, k, v, causal=causal, block_size=64)
+    ref = dense_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    w = jax.random.normal(jax.random.PRNGKey(10), q.shape, jnp.float32)
+    g_flash = jax.grad(
+        lambda q, k, v: jnp.sum(flash_attention(q, k, v, causal=causal, block_size=64) * w),
+        argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(
+        lambda q, k, v: jnp.sum(dense_reference(q, k, v, causal=causal) * w),
+        argnums=(0, 1, 2))(q, k, v)
+    for gf, gd, name in zip(g_flash, g_dense, "qkv"):
+        np.testing.assert_allclose(gf, gd, atol=5e-5, rtol=5e-5, err_msg=name)
+
+
+def test_model_loss_with_flash_attn_matches_dense():
+    cfg = ModelConfig(vocab_size=64, num_layers=2, num_heads=4, head_dim=16,
+                      embed_dim=64, mlp_dim=128, max_seq_len=129)
+    params = init_params(cfg, jax.random.PRNGKey(5))
+    tokens = jax.random.randint(jax.random.PRNGKey(6), (2, 129), 0, cfg.vocab_size)
+    # loss_fn drops the last token before attention -> seq 128.
+    dense = loss_fn(params, tokens, cfg)
+    flash = loss_fn(params, tokens, cfg, attn_fn=make_flash_attn_fn(block_size=64))
+    np.testing.assert_allclose(flash, dense, atol=1e-5, rtol=1e-5)
+
+
+def test_model_grads_with_flash_attn_match_dense():
+    cfg = ModelConfig(vocab_size=64, num_layers=1, num_heads=2, head_dim=16,
+                      embed_dim=32, mlp_dim=64, max_seq_len=65)
+    params = init_params(cfg, jax.random.PRNGKey(7))
+    tokens = jax.random.randint(jax.random.PRNGKey(8), (2, 65), 0, cfg.vocab_size)
+    attn = make_flash_attn_fn(block_size=64)
+    g_dense = jax.grad(loss_fn)(params, tokens, cfg)
+    g_flash = jax.grad(lambda p, t, c: loss_fn(p, t, c, attn_fn=attn))(params, tokens, cfg)
+    flat_d, _ = jax.tree.flatten(g_dense)
+    flat_f, _ = jax.tree.flatten(g_flash)
+    for a, b in zip(flat_d, flat_f):
+        np.testing.assert_allclose(b, a, atol=5e-5, rtol=5e-5)
+
+
+def test_train_step_with_flash_matches_dense():
+    from tpu_bootstrap.workload.sharding import MeshConfig, batch_shardings, build_mesh
+    from tpu_bootstrap.workload.train import TrainConfig, init_train_state, make_train_step
+
+    model = ModelConfig(vocab_size=64, num_layers=1, num_heads=2, head_dim=16,
+                        embed_dim=32, mlp_dim=64, max_seq_len=65)
+    losses = {}
+    for attention in ("dense", "flash"):
+        cfg = TrainConfig(model=model, mesh=MeshConfig(data=2, fsdp=2, tensor=2),
+                          attention=attention, attention_block=64)
+        mesh = build_mesh(cfg.mesh)
+        params, opt_state, p_sh = init_train_state(cfg, mesh, jax.random.PRNGKey(0))
+        step = make_train_step(cfg, mesh, p_sh)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 65), 0, 64)
+        tokens = jax.device_put(tokens, batch_shardings(mesh))
+        params, opt_state, l0 = step(params, opt_state, tokens)
+        _, _, l1 = step(params, opt_state, tokens)
+        losses[attention] = (float(l0), float(l1))
+    np.testing.assert_allclose(losses["flash"], losses["dense"], atol=1e-5, rtol=1e-5)

@@ -100,3 +100,86 @@ def test_clean_shutdown_releases_lease(fake):
     code, err = d.stop()
     assert code == 0, err
     assert lease_holder(fake) == "", "clean shutdown must release the lease"
+
+
+def test_leader_steps_down_when_api_unreachable(fake):
+    """Renew failures must flip leadership within the renew deadline: the
+    daemon exits 1 (restart-into-standby) instead of reconciling blind."""
+    port = free_port()
+    a = Daemon("tpubc-controller", le_env(fake, port, "ctl-a"), port).wait_healthy()
+    wait_for(lambda: lease_holder(fake) == "ctl-a", desc="a leads")
+    fake.stop()
+    start = time.time()
+    rc = a.proc.wait(timeout=15)
+    elapsed = time.time() - start
+    assert rc == 1, "leadership loss must exit nonzero for kubelet restart"
+    # duration=2/renew=1 -> deadline 1s; connection-refused renews fail
+    # fast, retry cadence 2s: step-down must land well under one duration
+    # past the deadline plus scheduling slack.
+    assert elapsed < 10, f"step-down took {elapsed:.1f}s"
+
+
+def test_leader_steps_down_when_api_hangs(fake):
+    """A server that accepts renew requests but never answers must NOT be
+    able to extend leadership: the whole-request deadline (DeadlineStream)
+    bounds the in-flight renew and the wall-clock gate flips is_leader()."""
+    import socket
+    import threading
+
+    # TCP proxy in front of the fake API that can switch to black-hole
+    # mode: connections stay open, bytes flow nowhere.
+    upstream_port = int(fake.url.rsplit(":", 1)[1])
+    lsock = socket.socket()
+    lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(16)
+    proxy_port = lsock.getsockname()[1]
+    stall = threading.Event()
+    stop = threading.Event()
+
+    def pump(src, dst):
+        try:
+            while not stop.is_set():
+                data = src.recv(8192)
+                if not data:
+                    break
+                if stall.is_set():
+                    continue  # swallow: the peer waits forever
+                dst.sendall(data)
+        except OSError:
+            pass
+
+    def accept_loop():
+        while not stop.is_set():
+            try:
+                client, _ = lsock.accept()
+            except OSError:
+                break
+            try:
+                up = socket.create_connection(("127.0.0.1", upstream_port))
+            except OSError:
+                client.close()
+                continue
+            threading.Thread(target=pump, args=(client, up), daemon=True).start()
+            threading.Thread(target=pump, args=(up, client), daemon=True).start()
+
+    threading.Thread(target=accept_loop, daemon=True).start()
+
+    port = free_port()
+    env = le_env(fake, port, "ctl-a")
+    env["CONF_KUBE_API_URL"] = f"http://127.0.0.1:{proxy_port}"
+    a = Daemon("tpubc-controller", env, port).wait_healthy()
+    try:
+        wait_for(lambda: lease_holder(fake) == "ctl-a", desc="a leads via proxy")
+        stall.set()  # renews now hang instead of failing fast
+        start = time.time()
+        rc = a.proc.wait(timeout=20)
+        elapsed = time.time() - start
+        assert rc == 1, "hung renews must still surface as leadership loss"
+        assert elapsed < 12, f"step-down with hung API took {elapsed:.1f}s"
+    finally:
+        stop.set()
+        lsock.close()
+        if a.proc.poll() is None:
+            a.proc.kill()
+            a.proc.wait()

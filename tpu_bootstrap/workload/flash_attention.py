@@ -1,0 +1,323 @@
+"""Flash attention as a Pallas TPU kernel — the hot op of the slice workload.
+
+Why a kernel at all (and not just the einsum path in ``model.py``): dense
+attention materializes the (seq x seq) score matrix in HBM, so its memory
+traffic scales O(seq^2) and XLA cannot fuse the softmax row-reductions into
+the two matmuls around them. The flash formulation never materializes
+scores: each (block_q x block_k) tile is computed in VMEM, folded into a
+running online softmax (row-max ``m``, row-sum ``l``, unnormalized
+accumulator ``acc``, all float32), and discarded. HBM traffic drops to
+O(seq) per row, and both tile matmuls are MXU-shaped.
+
+Layout/grid design:
+* Inputs come in model layout (batch, seq, heads, head_dim) — the
+  ``attn_fn`` hook of ``model.py:_attention`` — and are folded to
+  (batch*heads, seq, head_dim); batch*heads is the embarrassingly parallel
+  grid axis.
+* Grid = (batch*heads, seq/block). Q/dO tiles stream per grid step; K/V
+  ride VMEM whole per (batch, head) — right for the few-K seq lengths a
+  single chip handles; the sequence axis beyond that is ring attention's
+  job (``ring_attention.py`` shards seq over the mesh and runs a
+  length-seq/n_shards attention per device, which is exactly where this
+  kernel slots in underneath).
+* Causality skips whole future tiles via a data-dependent
+  ``lax.fori_loop`` trip count (traced scalar bound — legal under jit and
+  Mosaic, it lowers to a while loop), and masks the diagonal tile on
+  global positions.
+
+Backward is the standard flash decomposition, also as Pallas kernels:
+``delta = rowsum(dO * O)`` (one fused elementwise-reduce, left to XLA),
+then a dQ kernel gridded over Q tiles and a dK/dV kernel gridded over KV
+tiles, each recomputing probabilities from the saved logsumexp — O(seq)
+residual memory instead of O(seq^2). Wired up via ``jax.custom_vjp``.
+
+Reference parity note: the reference (bacchus-gpu-controller) has no
+compute path (SURVEY.md §2); this module belongs to the JAX workload its
+JobSets launch, and exists because the TPU build treats the compute path
+as first-class.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -1e30  # finite stand-in for -inf: keeps exp()/max() NaN-free
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _dot(a, b, trans_b=False):
+    """f32-accumulated tile matmul (MXU-friendly)."""
+    dims = (((1,), (1 if trans_b else 0,)), ((), ()))
+    return jax.lax.dot_general(a, b, dims, preferred_element_type=jnp.float32)
+
+
+def _tile_mask(qi, kj, block, causal, true_len, seq):
+    """Validity mask for score tile (qi, kj), or None if nothing to mask.
+
+    Combines the causal constraint with masking of padded KV columns
+    (cols >= true_len, present when seq was padded up to a block
+    multiple). Under causal the padded columns sit strictly in every real
+    query's future, so the causal term already covers them. Fully-masked
+    (padded) query rows come out as finite junk — exp(_NEG - _NEG) — and
+    are sliced off by the caller; _NEG being finite keeps them NaN-free.
+    """
+    if not causal and true_len >= seq:
+        return None
+    rows = qi * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
+    cols = kj * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
+    if causal:
+        return rows >= cols
+    return cols < true_len
+
+
+# ---------------------------------------------------------------- forward
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, block, causal,
+                true_len):
+    qi = pl.program_id(1)
+    seq = k_ref.shape[0]
+    num_kv = seq // block
+
+    q = q_ref[:].astype(jnp.float32) * sm_scale
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[pl.ds(j * block, block), :].astype(jnp.float32)
+        v = v_ref[pl.ds(j * block, block), :].astype(jnp.float32)
+        s = _dot(q, k, trans_b=True)  # (block, block)
+        mask = _tile_mask(qi, j, block, causal, true_len, seq)
+        if mask is not None:
+            s = jnp.where(mask, s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc * alpha + _dot(p, v)
+        return m_new, l, acc
+
+    m0 = jnp.full((block, 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((block, 1), jnp.float32)
+    acc0 = jnp.zeros((block, q.shape[1]), jnp.float32)
+    # Causal: tiles strictly above the diagonal contribute nothing — skip
+    # them entirely with a data-dependent trip count.
+    upper = qi + 1 if causal else num_kv
+    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
+
+    o_ref[:] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0, :] = (m + jnp.log(l))[:, 0]
+
+
+def _fwd(q3, k3, v3, sm_scale, block, causal, true_len, interpret):
+    """q3/k3/v3: (bh, seq, head_dim) -> (out, lse)."""
+    bh, seq, hd = q3.shape
+    grid = (bh, seq // block)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, sm_scale=sm_scale, block=block, causal=causal,
+                          true_len=true_len),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block, hd), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, seq, hd), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, seq, hd), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block, hd), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq, hd), q3.dtype),
+            jax.ShapeDtypeStruct((bh, seq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3)
+    return out, lse
+
+
+# ---------------------------------------------------------------- backward
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               sm_scale, block, causal, true_len):
+    qi = pl.program_id(1)
+    seq = k_ref.shape[0]
+    num_kv = seq // block
+
+    q = q_ref[:].astype(jnp.float32) * sm_scale
+    do = do_ref[:].astype(jnp.float32)
+    lse = lse_ref[0, :][:, None]
+    delta = delta_ref[0, :][:, None]
+
+    def body(j, dq):
+        k = k_ref[pl.ds(j * block, block), :].astype(jnp.float32)
+        v = v_ref[pl.ds(j * block, block), :].astype(jnp.float32)
+        s = _dot(q, k, trans_b=True)
+        mask = _tile_mask(qi, j, block, causal, true_len, seq)
+        if mask is not None:
+            s = jnp.where(mask, s, _NEG)
+        p = jnp.exp(s - lse)
+        dp = _dot(do, v, trans_b=True)
+        ds = p * (dp - delta)
+        return dq + _dot(ds, k)
+
+    dq0 = jnp.zeros((block, q.shape[1]), jnp.float32)
+    upper = qi + 1 if causal else num_kv
+    dq = jax.lax.fori_loop(0, upper, body, dq0)
+    dq_ref[:] = (dq * sm_scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                *, sm_scale, block, causal, true_len):
+    kj = pl.program_id(1)
+    seq = q_ref.shape[0]
+    num_q = seq // block
+
+    k = k_ref[:].astype(jnp.float32)
+    v = v_ref[:].astype(jnp.float32)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[pl.ds(i * block, block), :].astype(jnp.float32) * sm_scale
+        do = do_ref[pl.ds(i * block, block), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block, block)][:, None]
+        delta = delta_ref[0, pl.ds(i * block, block)][:, None]
+        s = _dot(q, k, trans_b=True)  # (q block, kv block)
+        mask = _tile_mask(i, kj, block, causal, true_len, seq)
+        if mask is not None:
+            s = jnp.where(mask, s, _NEG)
+        p = jnp.exp(s - lse)
+        dv = dv + _dot(p.T, do)
+        dp = _dot(do, v, trans_b=True)
+        ds = p * (dp - delta)
+        dk = dk + _dot(ds.T, q)
+        return dk, dv
+
+    dk0 = jnp.zeros((block, k.shape[1]), jnp.float32)
+    dv0 = jnp.zeros((block, v.shape[1]), jnp.float32)
+    # Causal: Q tiles strictly before this KV tile see none of it.
+    lower = kj if causal else 0
+    dk, dv = jax.lax.fori_loop(lower, num_q, body, (dk0, dv0))
+    # q was pre-scaled by sm_scale in the loop, so dk already carries the
+    # ds/dk = sm_scale * q factor.
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(sm_scale, block, causal, true_len, interpret, residuals, dout3):
+    q3, k3, v3, out3, lse = residuals
+    bh, seq, hd = q3.shape
+    delta = jnp.sum(dout3.astype(jnp.float32) * out3.astype(jnp.float32), axis=-1)
+
+    grid = (bh, seq // block)
+    tile = lambda: pl.BlockSpec((None, block, hd), lambda b, i: (b, i, 0))  # noqa: E731
+    slab = lambda: pl.BlockSpec((None, seq, hd), lambda b, i: (b, 0, 0))  # noqa: E731
+    rowblock = lambda: pl.BlockSpec((1, block), lambda b, i: (b, i))  # noqa: E731
+    rowslab = lambda: pl.BlockSpec((1, seq), lambda b, i: (b, 0))  # noqa: E731
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, sm_scale=sm_scale, block=block, causal=causal,
+                          true_len=true_len),
+        grid=grid,
+        in_specs=[tile(), slab(), slab(), tile(), rowblock(), rowblock()],
+        out_specs=[tile()],
+        out_shape=[jax.ShapeDtypeStruct((bh, seq, hd), q3.dtype)],
+        interpret=interpret,
+    )(q3, k3, v3, dout3, lse, delta)[0]
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, sm_scale=sm_scale, block=block, causal=causal,
+                          true_len=true_len),
+        grid=grid,
+        in_specs=[slab(), tile(), tile(), slab(), rowslab(), rowslab()],
+        out_specs=[tile(), tile()],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq, hd), k3.dtype),
+            jax.ShapeDtypeStruct((bh, seq, hd), v3.dtype),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3, dout3, lse, delta)
+
+    return dq, dk, dv
+
+
+# ------------------------------------------------------------ public API
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash3(q3, k3, v3, sm_scale, block, causal, true_len, interpret):
+    out, _ = _fwd(q3, k3, v3, sm_scale, block, causal, true_len, interpret)
+    return out
+
+
+def _flash3_fwd(q3, k3, v3, sm_scale, block, causal, true_len, interpret):
+    out, lse = _fwd(q3, k3, v3, sm_scale, block, causal, true_len, interpret)
+    return out, (q3, k3, v3, out, lse)
+
+
+_flash3.defvjp(_flash3_fwd, _bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    sm_scale: float | None = None,
+    block_size: int = 128,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Flash attention over model-layout tensors.
+
+    q/k/v: (batch, seq, heads, head_dim); returns the same shape —
+    drop-in for the ``attn_fn`` hook of ``model._attention`` (which
+    applies no scaling itself, so the 1/sqrt(head_dim) default here
+    matches its dense path).
+    """
+    if q.shape != k.shape or q.shape != v.shape:
+        raise ValueError(f"q/k/v shapes must match, got {q.shape}/{k.shape}/{v.shape}")
+    if block_size % 8 != 0:
+        raise ValueError(f"block_size must be a multiple of 8, got {block_size}")
+    b, s, h, d = q.shape
+    if sm_scale is None:
+        sm_scale = float(d) ** -0.5
+    if interpret is None:
+        interpret = _interpret_default()
+
+    # Any seq length works: pad up to a block multiple (the train path
+    # always arrives with max_seq_len - 1), mask/slice the padding away.
+    # Block stays a multiple of 8 — the f32 sublane tile Mosaic requires.
+    round8 = -(-s // 8) * 8
+    block = min(block_size, round8)
+    s_pad = -(-s // block) * block
+
+    def fold(x):
+        if s_pad != s:
+            x = jnp.pad(x, ((0, 0), (0, s_pad - s), (0, 0), (0, 0)))
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s_pad, d)
+
+    out3 = _flash3(fold(q), fold(k), fold(v), sm_scale, block, bool(causal), s, interpret)
+    out = out3.reshape(b, h, s_pad, d).transpose(0, 2, 1, 3)
+    return out[:, :s] if s_pad != s else out
+
+
+def make_flash_attn_fn(*, block_size: int = 128, interpret: bool | None = None):
+    """An ``attn_fn`` for ``model.forward``/``loss_fn`` backed by the kernel."""
+
+    def attn_fn(q, k, v):
+        return flash_attention(
+            q, k, v, causal=True, block_size=block_size, interpret=interpret
+        )
+
+    return attn_fn
+
+
+__all__ = ["flash_attention", "make_flash_attn_fn"]

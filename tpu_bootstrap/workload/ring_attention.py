@@ -66,8 +66,7 @@ def _ring_attention_local(q, k, v, *, axis_name: str, n_shards: int):
 
     perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
 
-    def step(carry, s):
-        k_blk, v_blk, acc, m, l = carry
+    def fold_block(k_blk, v_blk, acc, m, l, s):
         # After s rotations we hold the KV block originally on shard idx-s.
         src = (idx - s) % n_shards
         k_pos = src * block + jnp.arange(block)
@@ -99,12 +98,20 @@ def _ring_attention_local(q, k, v, *, axis_name: str, n_shards: int):
             preferred_element_type=jnp.float32,
         )
         acc = acc * jnp.transpose(correction, (0, 2, 1))[..., None] + pv
+        return acc, m_new, l
 
+    def step(carry, s):
+        k_blk, v_blk, acc, m, l = carry
+        acc, m, l = fold_block(k_blk, v_blk, acc, m, l, s)
         # Rotate KV one hop around the ring (neighbor transfer on ICI).
         k_blk, v_blk = lax.ppermute((k_blk, v_blk), axis_name, perm=perm)
-        return (k_blk, v_blk, acc, m_new, l), None
+        return (k_blk, v_blk, acc, m, l), None
 
-    (k, v, acc, m, l), _ = lax.scan(step, (k, v, acc, m, l), jnp.arange(n_shards))
+    # The last block needs no rotation after it — folding it outside the
+    # scan saves one full KV neighbor transfer per call (the scan's final
+    # ppermute result would be discarded, but scan can't DCE a collective).
+    (k, v, acc, m, l), _ = lax.scan(step, (k, v, acc, m, l), jnp.arange(n_shards - 1))
+    acc, m, l = fold_block(k, v, acc, m, l, n_shards - 1)
 
     # Every causal row sees at least its own position, so l > 0.
     out = acc / jnp.transpose(l, (0, 2, 1))[..., None]
@@ -141,9 +148,10 @@ def make_ring_attention(
     )
 
 
-def reference_attention(q, k, v):
-    """Unsharded causal attention with identical semantics — the test
-    oracle and the single-device fallback."""
+def reference_attention(q, k, v, causal=True):
+    """Unsharded attention with identical semantics — the test oracle
+    (shared with the flash-attention tests) and the single-device
+    fallback."""
     head_dim = q.shape[-1]
     seq = q.shape[1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, jnp.float32))
@@ -156,8 +164,9 @@ def reference_attention(q, k, v):
         )
         * scale
     )
-    causal = jnp.tril(jnp.ones((seq, seq), jnp.bool_))
-    scores = jnp.where(causal[None, None], scores, _NEG)
+    if causal:
+        mask = jnp.tril(jnp.ones((seq, seq), jnp.bool_))
+        scores = jnp.where(mask[None, None], scores, _NEG)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum(
         "bhqk,bkhd->bqhd",

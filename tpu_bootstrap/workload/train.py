@@ -33,6 +33,10 @@ class TrainConfig:
     mesh: MeshConfig = MeshConfig()
     learning_rate: float = 3e-4
     remat: bool = False  # jax.checkpoint the loss to trade FLOPs for HBM
+    # Attention core: "dense" (einsum path, XLA-fused) or "flash" (the
+    # Pallas kernel, O(seq) memory — see workload/flash_attention.py).
+    attention: str = "dense"
+    attention_block: int = 128
 
 
 def make_optimizer(cfg: TrainConfig):
@@ -54,7 +58,29 @@ def init_train_state(cfg: TrainConfig, mesh, key: jax.Array):
 def make_train_step(cfg: TrainConfig, mesh, p_shardings):
     """Returns jitted (params, opt_state, tokens) -> (params, opt_state, loss)."""
     opt = make_optimizer(cfg)
-    loss = loss_fn
+    if cfg.attention == "flash":
+        from jax.sharding import PartitionSpec as P
+
+        from tpu_bootstrap.workload.flash_attention import make_flash_attn_fn
+
+        # Attention is independent per (batch, head), so shard_map it over
+        # the batch (data+fsdp) and heads (tensor) axes: each device runs
+        # the Pallas kernel on its local shard. Without this, GSPMD has no
+        # partitioning rule for pallas_call and would all-gather q/k/v and
+        # run the kernel fully replicated.
+        spec = P(("data", "fsdp"), None, "tensor", None)
+        attn = jax.shard_map(
+            make_flash_attn_fn(block_size=cfg.attention_block),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )
+        loss = lambda p, t, m: loss_fn(p, t, m, attn_fn=attn)  # noqa: E731
+    elif cfg.attention == "dense":
+        loss = loss_fn
+    else:
+        raise ValueError(f"unknown attention {cfg.attention!r}")
     if cfg.remat:
         loss = jax.checkpoint(loss, static_argnums=(2,))
 
