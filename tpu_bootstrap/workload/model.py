@@ -147,10 +147,20 @@ def forward(params: Params, tokens: jax.Array, cfg: ModelConfig, attn_fn=None) -
     return jnp.einsum("bse,ve->bsv", x.astype(jnp.float32), params["embed"])
 
 
-def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig, attn_fn=None) -> jax.Array:
-    """Next-token cross-entropy averaged over all positions."""
-    logits = forward(params, tokens[:, :-1], cfg, attn_fn)
-    targets = tokens[:, 1:]
+def loss_from_inputs(params: Params, inputs: jax.Array, targets: jax.Array,
+                     cfg: ModelConfig, attn_fn=None) -> jax.Array:
+    """Cross-entropy of ``targets`` under the model run on ``inputs``.
+
+    Split out from loss_fn so the train step can shift tokens itself and
+    pin shardings on the shifted int32 arrays (sequence parallelism needs
+    inputs/targets sharded over the seq axis; the unshifted tokens are one
+    element too long to tile)."""
+    logits = forward(params, inputs, cfg, attn_fn)
     logprobs = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
     return jnp.mean(nll)
+
+
+def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig, attn_fn=None) -> jax.Array:
+    """Next-token cross-entropy averaged over all positions."""
+    return loss_from_inputs(params, tokens[:, :-1], tokens[:, 1:], cfg, attn_fn)

@@ -8,13 +8,17 @@ Mesh axes:
 * ``fsdp``   — data parallelism with parameters sharded along it
                (ZeRO-3 style: XLA all-gathers params per layer and
                reduce-scatters grads).
+* ``seq``    — sequence (context) parallelism: activations sharded along
+               the sequence axis, attention via the ppermute ring in
+               ring_attention.py. The long-context axis.
 * ``tensor`` — Megatron tensor parallelism inside each block (attention
                heads and the MLP hidden dim).
 
 For a GKE slice these axes map onto the physical topology so that `tensor`
-(highest-bandwidth, per-step all-reduces) rides intra-host ICI, `fsdp` the
-slice's remaining ICI dims, and `data` may span slices over DCN — the
-mesh-axis ordering below encodes that priority.
+(highest-bandwidth, per-step all-reduces) rides intra-host ICI, `seq`
+(neighbor-only ring hops) and `fsdp` the slice's remaining ICI dims, and
+`data` may span slices over DCN — the mesh-axis ordering below encodes
+that priority.
 """
 
 from __future__ import annotations
@@ -32,17 +36,20 @@ from tpu_bootstrap.workload.model import ModelConfig, Params
 class MeshConfig:
     data: int = 1
     fsdp: int = 1
+    seq: int = 1
     tensor: int = 1
 
     @property
     def size(self) -> int:
-        return self.data * self.fsdp * self.tensor
+        return self.data * self.fsdp * self.seq * self.tensor
 
     @staticmethod
     def for_device_count(n: int) -> "MeshConfig":
         """A sensible default factorization: tensor gets up to 2, fsdp up
         to 2, the rest goes to data — mirroring how a v5p 4x4x4 slice would
-        be carved (tp within host, fsdp across hosts, dp across slices)."""
+        be carved (tp within host, fsdp across hosts, dp across slices).
+        Sequence parallelism is opt-in (long-context runs set seq
+        explicitly), so the default leaves seq=1."""
         tensor = 2 if n % 2 == 0 else 1
         rest = n // tensor
         fsdp = 2 if rest % 2 == 0 else 1
@@ -54,15 +61,25 @@ def build_mesh(cfg: MeshConfig, devices=None) -> Mesh:
     devices = devices if devices is not None else jax.devices()
     if len(devices) < cfg.size:
         raise ValueError(f"mesh needs {cfg.size} devices, have {len(devices)}")
-    grid = np.array(devices[: cfg.size]).reshape(cfg.data, cfg.fsdp, cfg.tensor)
-    return Mesh(grid, ("data", "fsdp", "tensor"))
+    grid = np.array(devices[: cfg.size]).reshape(cfg.data, cfg.fsdp, cfg.seq, cfg.tensor)
+    return Mesh(grid, ("data", "fsdp", "seq", "tensor"))
 
 
 def param_shardings(mesh: Mesh, params: Params):
     """PartitionSpecs per parameter.
 
-    * embed:         (vocab, embed)        -> shard vocab over tensor,
-                                              embed over fsdp
+    * embed:         (vocab, embed)        -> shard vocab over fsdp,
+                                              embed-dim over tensor. Vocab
+                                              over the batch-sharded axis
+                                              matters: the embedding
+                                              gradient (scatter-add of
+                                              batch-sharded activations)
+                                              then partitions cleanly,
+                                              where embed-over-fsdp forced
+                                              GSPMD into an involuntary
+                                              full rematerialization of
+                                              the (batch, seq, embed)
+                                              cotangent.
     * wq/wk/wv:      (embed, heads, hd)    -> heads over tensor (Megatron
                                               column-parallel), embed over fsdp
     * wo:            (heads, hd, embed)    -> heads over tensor (row-parallel:
@@ -75,7 +92,7 @@ def param_shardings(mesh: Mesh, params: Params):
 
     def spec_for(path: str, ndim: int) -> P:
         if path.endswith("embed"):
-            return P("tensor", "fsdp")
+            return P("fsdp", "tensor")
         if path.endswith(("wq", "wk", "wv")):
             return P("fsdp", "tensor", None)
         if path.endswith("wo"):
@@ -97,9 +114,12 @@ def param_shardings(mesh: Mesh, params: Params):
 
 
 def batch_shardings(mesh: Mesh) -> NamedSharding:
-    """Tokens are sharded over both data-parallel axes; the sequence axis
-    stays unsharded here (ring-attention sequence parallelism is a separate
-    path, see workload/ring_attention.py)."""
+    """Tokens: batch over both data-parallel axes. The raw token sequence
+    stays unsharded — its length (max_seq_len) is one more than the
+    activation length after loss_fn's shift, so it cannot tile evenly over
+    the seq axis; with seq>1 the ring-attention shard_map boundary pins
+    the activation sharding and GSPMD inserts the (tiny, int32) reshard of
+    the embedded tokens."""
     return NamedSharding(mesh, P(("data", "fsdp"), None))
 
 

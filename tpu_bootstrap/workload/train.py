@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from tpu_bootstrap.workload.model import ModelConfig, init_params, loss_fn
+from tpu_bootstrap.workload.model import ModelConfig, init_params, loss_from_inputs
 from tpu_bootstrap.workload.sharding import (
     MeshConfig,
     batch_shardings,
@@ -57,10 +57,34 @@ def init_train_state(cfg: TrainConfig, mesh, key: jax.Array):
 
 def make_train_step(cfg: TrainConfig, mesh, p_shardings):
     """Returns jitted (params, opt_state, tokens) -> (params, opt_state, loss)."""
-    opt = make_optimizer(cfg)
-    if cfg.attention == "flash":
-        from jax.sharding import PartitionSpec as P
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
+    if cfg.attention not in ("dense", "flash"):
+        raise ValueError(f"unknown attention {cfg.attention!r}")
+    opt = make_optimizer(cfg)
+    seq_parallel = mesh.shape["seq"] > 1
+    if seq_parallel:
+        # Sequence (context) parallelism: activations are sharded along
+        # the sequence axis, so attention must see every earlier KV shard
+        # — the ppermute ring provides that with O(seq/n) memory per
+        # device and neighbor-only ICI traffic.
+        if cfg.attention == "flash":
+            raise ValueError(
+                "attention='flash' does not yet compose with seq>1; use 'dense' "
+                "(the ring runs its own blockwise online-softmax core)"
+            )
+        shifted = cfg.model.max_seq_len - 1
+        if shifted % mesh.shape["seq"] != 0:
+            raise ValueError(
+                f"sequence parallelism needs (max_seq_len - 1) divisible by the "
+                f"seq mesh axis: max_seq_len={cfg.model.max_seq_len} shifts to "
+                f"{shifted}, seq={mesh.shape['seq']} (loss_fn drops one token; "
+                f"pick max_seq_len = k*seq + 1)"
+            )
+        from tpu_bootstrap.workload.ring_attention import make_ring_attention
+
+        attn = make_ring_attention(mesh, head_axis="tensor")
+    elif cfg.attention == "flash":
         from tpu_bootstrap.workload.flash_attention import make_flash_attn_fn
 
         # Attention is independent per (batch, head), so shard_map it over
@@ -76,16 +100,26 @@ def make_train_step(cfg: TrainConfig, mesh, p_shardings):
             out_specs=spec,
             check_vma=False,
         )
-        loss = lambda p, t, m: loss_fn(p, t, m, attn_fn=attn)  # noqa: E731
-    elif cfg.attention == "dense":
-        loss = loss_fn
     else:
-        raise ValueError(f"unknown attention {cfg.attention!r}")
+        attn = None
+
+    def loss(params, inputs, targets):
+        return loss_from_inputs(params, inputs, targets, cfg.model, attn_fn=attn)
+
     if cfg.remat:
-        loss = jax.checkpoint(loss, static_argnums=(2,))
+        loss = jax.checkpoint(loss)
+
+    # The next-token shift happens inside the step so the shifted int32
+    # inputs/targets (length max_seq_len - 1, which DOES tile over seq)
+    # can be pinned to the seq axis; resharding a few int32 tokens is
+    # cheap, whereas leaving the boundary to GSPMD made it rematerialize
+    # full f32 activations at the ring's shard_map edge.
+    shifted_sharding = NamedSharding(mesh, P(("data", "fsdp"), "seq" if seq_parallel else None))
 
     def step(params, opt_state, tokens):
-        loss_value, grads = jax.value_and_grad(loss)(params, tokens, cfg.model)
+        inputs = jax.lax.with_sharding_constraint(tokens[:, :-1], shifted_sharding)
+        targets = jax.lax.with_sharding_constraint(tokens[:, 1:], shifted_sharding)
+        loss_value, grads = jax.value_and_grad(loss)(params, inputs, targets)
         updates, opt_state = opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss_value
