@@ -1,0 +1,60 @@
+"""Checkpoint/resume: an interrupted-and-resumed run must continue exactly
+where an uninterrupted run would be — same data order, same losses."""
+
+import jax
+import numpy as np
+
+from tpu_bootstrap.workload.model import ModelConfig
+from tpu_bootstrap.workload.sharding import MeshConfig
+from tpu_bootstrap.workload.train import TrainConfig, train_loop
+
+CFG = TrainConfig(
+    model=ModelConfig(vocab_size=64, num_layers=1, num_heads=2, head_dim=8,
+                      embed_dim=16, mlp_dim=32, max_seq_len=16),
+    mesh=MeshConfig(data=2, fsdp=2, tensor=2),
+)
+
+
+def test_resume_matches_uninterrupted(tmp_path):
+    full = train_loop(CFG, 6, checkpoint_dir=str(tmp_path / "full"), save_every=2)
+    assert len(full) == 6
+
+    part_dir = str(tmp_path / "part")
+    first = train_loop(CFG, 3, checkpoint_dir=part_dir, save_every=1)
+    assert len(first) == 3
+    resumed = train_loop(CFG, 6, checkpoint_dir=part_dir, save_every=1)
+    # Restored params/opt_state + deterministic batches => the continuation
+    # reproduces the uninterrupted run bit-for-bit.
+    assert len(resumed) == 3
+    np.testing.assert_array_equal(np.asarray(first + resumed), np.asarray(full))
+
+
+def test_resume_at_target_is_noop(tmp_path):
+    d = str(tmp_path / "done")
+    train_loop(CFG, 2, checkpoint_dir=d, save_every=1)
+    again = train_loop(CFG, 2, checkpoint_dir=d, save_every=1)
+    assert again == []
+
+
+def test_checkpoint_restores_shardings(tmp_path):
+    from tpu_bootstrap.workload import checkpoint as ckpt
+    from tpu_bootstrap.workload.sharding import build_mesh
+    from tpu_bootstrap.workload.train import init_train_state
+
+    mesh = build_mesh(CFG.mesh)
+    params, opt_state, _ = init_train_state(CFG, mesh, jax.random.PRNGKey(0))
+    mgr = ckpt.make_manager(str(tmp_path / "ck"))
+    ckpt.save(mgr, 1, params, opt_state)
+    mgr.wait_until_finished()
+
+    params2, opt2, _ = init_train_state(CFG, mesh, jax.random.PRNGKey(7))
+    r_params, r_opt = ckpt.restore(mgr, 1, params2, opt2)
+    # values come back from step-1 state, not the key-7 init
+    np.testing.assert_array_equal(
+        np.asarray(r_params["embed"]), np.asarray(params["embed"])
+    )
+    # and every leaf lands on the sharding the mesh assigns it
+    flat_a = jax.tree.leaves(r_params)
+    flat_b = jax.tree.leaves(params)
+    for a, b in zip(flat_a, flat_b):
+        assert a.sharding == b.sharding

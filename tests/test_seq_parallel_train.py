@@ -2,6 +2,8 @@
 produce the same losses as the dense (seq=1) factorization — the mesh
 carve-up is an implementation detail, not a semantics change."""
 
+import functools
+
 import jax
 import numpy as np
 import pytest
@@ -14,6 +16,7 @@ MODEL = ModelConfig(vocab_size=64, num_layers=2, num_heads=4, head_dim=8,
                     embed_dim=32, mlp_dim=64, max_seq_len=33)
 
 
+@functools.lru_cache(maxsize=None)
 def run_two_steps(mesh_cfg):
     cfg = TrainConfig(model=MODEL, mesh=mesh_cfg)
     mesh = build_mesh(cfg.mesh)
@@ -24,7 +27,7 @@ def run_two_steps(mesh_cfg):
     tokens = jax.device_put(tokens, batch_shardings(mesh))
     params, opt_state, l0 = step(params, opt_state, tokens)
     _, _, l1 = step(params, opt_state, tokens)
-    return float(l0), float(l1)
+    return (float(l0), float(l1))
 
 
 @pytest.mark.parametrize(

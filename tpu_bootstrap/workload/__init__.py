@@ -15,7 +15,12 @@ from tpu_bootstrap.workload.sharding import (
     param_shardings,
     batch_shardings,
 )
-from tpu_bootstrap.workload.train import TrainConfig, make_train_step, init_train_state
+from tpu_bootstrap.workload.train import (
+    TrainConfig,
+    init_train_state,
+    make_train_step,
+    train_loop,
+)
 
 __all__ = [
     "ModelConfig",
@@ -28,5 +33,6 @@ __all__ = [
     "batch_shardings",
     "TrainConfig",
     "make_train_step",
+    "train_loop",
     "init_train_state",
 ]

@@ -1,0 +1,84 @@
+"""Checkpoint/resume for the slice workload (orbax-backed).
+
+The reference operator's only durable state lives in etcd (SURVEY.md §5 —
+its daemons are stateless); the workload its JobSets run, however, holds
+real state (params + optimizer moments), and multi-host TPU slices get
+preempted. This module makes a JobSet restart (`failurePolicy` /
+max_restarts in the emitted JobSet, reconcile_core.cc) resume instead of
+recompute: every worker writes/reads the same directory (GCS fuse mount or
+PVC in production), orbax handles the per-shard layout, and restore places
+each shard back on the device the mesh assigns it — no full-state
+materialization on any single host.
+
+Orbax specifics worth knowing:
+* saves are async — `wait_until_finished()` before trusting latest_step();
+* restore takes an "abstract" pytree (ShapeDtypeStruct + sharding) so the
+  restored arrays come back already sharded onto the live mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import orbax.checkpoint as ocp
+
+STATE_KEY = "state"
+
+
+def make_manager(directory: str, max_to_keep: int = 3) -> ocp.CheckpointManager:
+    return ocp.CheckpointManager(
+        directory,
+        options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep, create=True),
+    )
+
+
+def save(mgr: ocp.CheckpointManager, step: int, params, opt_state) -> None:
+    state = {"params": params, "opt_state": opt_state}
+    mgr.save(step, args=ocp.args.Composite(**{STATE_KEY: ocp.args.StandardSave(state)}))
+
+
+def abstract_like(tree, mesh=None):
+    """ShapeDtypeStruct pytree carrying each leaf's sharding — the restore
+    target that tells orbax where every shard belongs.
+
+    Leaves without a mesh sharding (e.g. the optimizer's scalar ``count``,
+    which ``optax.init`` leaves on the default device) are normalized to
+    replicated-on-mesh: restore commits arrays to their shardings, and a
+    single-device scalar next to 8-device params would make the next jitted
+    step fail with an incompatible-devices error."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    def spec(x):
+        s = x.sharding
+        if mesh is not None and not isinstance(s, NamedSharding):
+            s = NamedSharding(mesh, PartitionSpec())
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s)
+
+    return jax.tree.map(spec, tree)
+
+
+def restore(mgr: ocp.CheckpointManager, step: int, params, opt_state):
+    """Restore (params, opt_state) saved at ``step``, sharded like the
+    given live pytrees (typically fresh-initialized state on the same
+    mesh)."""
+    from jax.sharding import NamedSharding
+
+    mesh = next(
+        leaf.sharding.mesh
+        for leaf in jax.tree.leaves(params)
+        if isinstance(leaf.sharding, NamedSharding)
+    )
+    target = {
+        "params": abstract_like(params, mesh),
+        "opt_state": abstract_like(opt_state, mesh),
+    }
+    out = mgr.restore(
+        step, args=ocp.args.Composite(**{STATE_KEY: ocp.args.StandardRestore(target)})
+    )[STATE_KEY]
+    return out["params"], out["opt_state"]
+
+
+def latest_step(mgr: ocp.CheckpointManager):
+    return mgr.latest_step()
+
+
+__all__ = ["make_manager", "save", "restore", "abstract_like", "latest_step"]

@@ -132,6 +132,75 @@ def make_train_step(cfg: TrainConfig, mesh, p_shardings):
     )
 
 
+def synthetic_batch(cfg: TrainConfig, step_index: int, seed: int = 0):
+    """Deterministic per-step token batch: resume from a checkpoint sees
+    exactly the data an uninterrupted run would have seen."""
+    batch = max(2 * cfg.mesh.data * cfg.mesh.fsdp, 2)
+    return jax.random.randint(
+        jax.random.PRNGKey(seed * 1_000_003 + step_index),
+        (batch, cfg.model.max_seq_len), 0, cfg.model.vocab_size,
+    )
+
+
+def train_loop(cfg: TrainConfig, steps: int, *, checkpoint_dir: str | None = None,
+               save_every: int = 10, seed: int = 0, mesh=None):
+    """Run (or resume) training for ``steps`` total steps.
+
+    With checkpoint_dir set, the latest checkpoint in it is restored and
+    training continues from there — the JobSet-restart recovery path (a
+    preempted slice re-runs this very function and picks up where the last
+    completed save left off). Returns the losses of the steps actually
+    executed this call.
+    """
+    if save_every < 1:
+        raise ValueError(f"save_every must be >= 1, got {save_every}")
+    mesh = mesh if mesh is not None else build_mesh(cfg.mesh)
+
+    mgr = None
+    latest = None
+    if checkpoint_dir is not None:
+        from tpu_bootstrap.workload import checkpoint as ckpt
+
+        mgr = ckpt.make_manager(checkpoint_dir)
+        latest = ckpt.latest_step(mgr)
+
+    start = 0
+    if latest is not None:
+        # Resume: never materialize the fresh random init just to throw it
+        # away — build the abstract (shape/dtype/sharding) state and let
+        # orbax place the restored shards directly onto the mesh. The
+        # optimizer-state shardings come from compiling (not running)
+        # opt.init on the sharded param avals.
+        params_sds = jax.eval_shape(partial(init_params, cfg.model), jax.random.PRNGKey(seed))
+        p_shardings = param_shardings(mesh, params_sds)
+        params_abs = jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            params_sds, p_shardings,
+        )
+        opt = make_optimizer(cfg)
+        opt_shardings = jax.jit(opt.init).lower(params_abs).compile().output_shardings
+        opt_abs = jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            jax.eval_shape(opt.init, params_sds), opt_shardings,
+        )
+        params, opt_state = ckpt.restore(mgr, latest, params_abs, opt_abs)
+        start = latest
+    else:
+        params, opt_state, p_shardings = init_train_state(cfg, mesh, jax.random.PRNGKey(seed))
+    step_fn = make_train_step(cfg, mesh, p_shardings)
+
+    losses = []
+    for i in range(start, steps):
+        tokens = jax.device_put(synthetic_batch(cfg, i, seed), batch_shardings(mesh))
+        params, opt_state, loss_value = step_fn(params, opt_state, tokens)
+        losses.append(float(loss_value))
+        if mgr is not None and ((i + 1) % save_every == 0 or i + 1 == steps):
+            ckpt.save(mgr, i + 1, params, opt_state)
+    if mgr is not None:
+        mgr.wait_until_finished()
+    return losses
+
+
 def run_demo(num_devices: int | None = None, steps: int = 2, seed: int = 0):
     """Build a mesh over the available devices and run a few steps.
 
@@ -156,3 +225,38 @@ def run_demo(num_devices: int | None = None, steps: int = 2, seed: int = 0):
         params, opt_state, loss_value = train_step(params, opt_state, tokens)
         losses.append(float(loss_value))
     return losses
+
+
+def worker_main() -> None:
+    """JobSet worker entry: ``python -m tpu_bootstrap.workload.train``.
+
+    Each host on the slice runs this under the JobSet's indexed completion;
+    jax.distributed discovers coordinator/index from the GKE TPU env, the
+    mesh then spans every chip on the slice. Config via env:
+    WORKLOAD_STEPS, WORKLOAD_SAVE_EVERY, WORKLOAD_CHECKPOINT_DIR (shared
+    storage — resume-on-restart), WORKLOAD_SEED.
+    """
+    import os
+
+    if os.environ.get("MEGASCALE_COORDINATOR_ADDRESS") or os.environ.get(
+        "JOB_COMPLETION_INDEX"
+    ):
+        jax.distributed.initialize()
+
+    steps = int(os.environ.get("WORKLOAD_STEPS", "100"))
+    save_every = int(os.environ.get("WORKLOAD_SAVE_EVERY", "10"))
+    ckpt_dir = os.environ.get("WORKLOAD_CHECKPOINT_DIR") or None
+    seed = int(os.environ.get("WORKLOAD_SEED", "0"))
+
+    cfg = TrainConfig(mesh=MeshConfig.for_device_count(len(jax.devices())))
+    losses = train_loop(cfg, steps, checkpoint_dir=ckpt_dir,
+                        save_every=save_every, seed=seed)
+    if losses:
+        print(f"train_loop done: ran {len(losses)} steps, "
+              f"first={losses[0]:.4f} last={losses[-1]:.4f}")
+    else:
+        print("train_loop done: nothing to do (checkpoint already at target step)")
+
+
+if __name__ == "__main__":
+    worker_main()
