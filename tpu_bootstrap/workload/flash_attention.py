@@ -113,7 +113,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, block, causal,
     m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
 
     o_ref[:] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0, :] = (m + jnp.log(l))[:, 0]
+    lse_ref[:] = m + jnp.log(l)
 
 
 def _fwd(q3, k3, v3, sm_scale, block, causal, true_len, interpret):
@@ -131,11 +131,14 @@ def _fwd(q3, k3, v3, sm_scale, block, causal, true_len, interpret):
         ],
         out_specs=[
             pl.BlockSpec((None, block, hd), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block), lambda b, i: (b, i)),
+            # lse rides as (bh, seq, 1): a (block, 1) tile satisfies the
+            # Mosaic tiling rule (sublane multiple of 8, lane == array dim)
+            # where (1, block) did not.
+            pl.BlockSpec((None, block, 1), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, seq, hd), q3.dtype),
-            jax.ShapeDtypeStruct((bh, seq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, seq, 1), jnp.float32),
         ],
         interpret=interpret,
     )(q3, k3, v3)
@@ -153,8 +156,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
 
     q = q_ref[:].astype(jnp.float32) * sm_scale
     do = do_ref[:].astype(jnp.float32)
-    lse = lse_ref[0, :][:, None]
-    delta = delta_ref[0, :][:, None]
+    lse = lse_ref[:]
+    delta = delta_ref[:]
 
     def body(j, dq):
         k = k_ref[pl.ds(j * block, block), :].astype(jnp.float32)
@@ -187,8 +190,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         dk, dv = carry
         q = q_ref[pl.ds(i * block, block), :].astype(jnp.float32) * sm_scale
         do = do_ref[pl.ds(i * block, block), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * block, block)][:, None]
-        delta = delta_ref[0, pl.ds(i * block, block)][:, None]
+        lse = lse_ref[pl.ds(i * block, block), :]
+        delta = delta_ref[pl.ds(i * block, block), :]
         s = _dot(q, k, trans_b=True)  # (q block, kv block)
         mask = _tile_mask(i, kj, block, causal, true_len, seq)
         if mask is not None:
@@ -214,13 +217,14 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
 def _bwd(sm_scale, block, causal, true_len, interpret, residuals, dout3):
     q3, k3, v3, out3, lse = residuals
     bh, seq, hd = q3.shape
-    delta = jnp.sum(dout3.astype(jnp.float32) * out3.astype(jnp.float32), axis=-1)
+    delta = jnp.sum(dout3.astype(jnp.float32) * out3.astype(jnp.float32), axis=-1,
+                    keepdims=True)
 
     grid = (bh, seq // block)
     tile = lambda: pl.BlockSpec((None, block, hd), lambda b, i: (b, i, 0))  # noqa: E731
     slab = lambda: pl.BlockSpec((None, seq, hd), lambda b, i: (b, 0, 0))  # noqa: E731
-    rowblock = lambda: pl.BlockSpec((1, block), lambda b, i: (b, i))  # noqa: E731
-    rowslab = lambda: pl.BlockSpec((1, seq), lambda b, i: (b, 0))  # noqa: E731
+    rowblock = lambda: pl.BlockSpec((None, block, 1), lambda b, i: (b, i, 0))  # noqa: E731
+    rowslab = lambda: pl.BlockSpec((None, seq, 1), lambda b, i: (b, 0, 0))  # noqa: E731
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, sm_scale=sm_scale, block=block, causal=causal,

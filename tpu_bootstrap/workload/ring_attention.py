@@ -100,9 +100,23 @@ def _ring_attention_local(q, k, v, *, axis_name: str, n_shards: int):
         acc = acc * jnp.transpose(correction, (0, 2, 1))[..., None] + pv
         return acc, m_new, l
 
+    def fold_if_visible(k_blk, v_blk, acc, m, l, s):
+        # Causality at block granularity: the KV block from shard
+        # idx - s (mod n) is entirely in this device's future when its
+        # source index exceeds ours — every entry would be masked, so skip
+        # the two matmuls outright. The predicate varies per device, which
+        # is fine under shard_map (no collectives inside the cond); the
+        # ring itself still rotates uniformly every step.
+        src = (idx - s) % n_shards
+        return lax.cond(
+            src <= idx,
+            lambda: fold_block(k_blk, v_blk, acc, m, l, s),
+            lambda: (acc, m, l),
+        )
+
     def step(carry, s):
         k_blk, v_blk, acc, m, l = carry
-        acc, m, l = fold_block(k_blk, v_blk, acc, m, l, s)
+        acc, m, l = fold_if_visible(k_blk, v_blk, acc, m, l, s)
         # Rotate KV one hop around the ring (neighbor transfer on ICI).
         k_blk, v_blk = lax.ppermute((k_blk, v_blk), axis_name, perm=perm)
         return (k_blk, v_blk, acc, m, l), None
@@ -111,7 +125,7 @@ def _ring_attention_local(q, k, v, *, axis_name: str, n_shards: int):
     # scan saves one full KV neighbor transfer per call (the scan's final
     # ppermute result would be discarded, but scan can't DCE a collective).
     (k, v, acc, m, l), _ = lax.scan(step, (k, v, acc, m, l), jnp.arange(n_shards - 1))
-    acc, m, l = fold_block(k, v, acc, m, l, n_shards - 1)
+    acc, m, l = fold_if_visible(k, v, acc, m, l, n_shards - 1)
 
     # Every causal row sees at least its own position, so l > 0.
     out = acc / jnp.transpose(l, (0, 2, 1))[..., None]
