@@ -45,14 +45,14 @@ class LeaderElector {
   // Deadline-gated: true only while the last successful acquire/renew is
   // younger than the renew deadline (lease_duration - renew_period, i.e.
   // one renew period before a standby could legitimately take over). The
-  // gate is pure wall-clock — it does NOT depend on any in-flight renew
-  // request returning, so a hung/slow-dripping API server cannot extend
-  // this instance's claimed leadership past lease expiry. Callers must
-  // consult this per protected action (e.g. per reconcile pass), not
-  // cache it.
-  bool is_leader() const {
-    return is_leader_.load() && ::time(nullptr) < leader_until_.load();
-  }
+  // gate is a pure local clock read — it does NOT depend on any in-flight
+  // renew request returning, so a hung/slow-dripping API server cannot
+  // extend this instance's claimed leadership past lease expiry. Measured
+  // on CLOCK_MONOTONIC: an NTP step of the wall clock can neither extend
+  // claimed leadership past real expiry (backwards step) nor force a
+  // spurious step-down (forward step). Callers must consult this per
+  // protected action (e.g. per reconcile pass), not cache it.
+  bool is_leader() const;
 
  private:
   bool try_acquire_once();
@@ -65,8 +65,13 @@ class LeaderElector {
   KubeClient client_;
   LeaderConfig config_;
   std::atomic<bool> is_leader_{false};
-  std::atomic<int64_t> leader_until_{0};  // unix secs; see is_leader()
+  std::atomic<int64_t> leader_until_{0};  // monotonic ms; see is_leader()
 };
+
+// Milliseconds on CLOCK_MONOTONIC (std::chrono::steady_clock). Local
+// leadership deadlines are measured on this clock; wall clock is used only
+// for the RFC3339 timestamps the Lease object advertises.
+int64_t steady_now_ms();
 
 // RFC3339 micro-time helpers for Lease timestamps.
 std::string lease_now_rfc3339_micro();

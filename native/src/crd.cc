@@ -229,11 +229,30 @@ Json status_schema() {
                 {"type", "object"},
                 {"properties",
                  Json::object({
-                     {"phase", nullable_string_schema(
-                                   "Pending | Provisioning | Running | Failed | Absent.")},
+                     {"phase",
+                      nullable_string_schema(
+                          "Pending | Provisioning | Running | Succeeded | Failed | Absent.")},
                      {"chips", int_schema("Chips granted.")},
                      {"hosts", int_schema("Hosts granted.")},
                      {"jobset", nullable_string_schema("Name of the materialized JobSet.")},
+                     {"conditions",
+                      Json::object({
+                          {"description", "Slice-provisioning conditions "
+                                          "(SliceProvisioned, WorkersReady)."},
+                          {"nullable", true},
+                          {"type", "array"},
+                          {"items",
+                           Json::object({
+                               {"type", "object"},
+                               {"required", Json::array({Json("type"), Json("status")})},
+                               {"properties",
+                                Json::object({
+                                    {"type", Json::object({{"type", "string"}})},
+                                    {"status", Json::object({{"type", "string"}})},
+                                    {"reason", nullable_string_schema("Machine-readable cause.")},
+                                })},
+                           })},
+                      })},
                  })},
             })},
        })},

@@ -337,7 +337,18 @@ HttpResponse HttpClient::request(const std::string& method, const std::string& p
   for (int attempt = 0;; ++attempt) {
     auto conn = attempt == 0 ? take_pooled() : nullptr;
     const bool pooled = conn != nullptr;
-    if (!conn) conn = open(timeout_secs);
+    if (!conn) {
+      // Opening (TCP connect + TLS handshake) must also fit inside the
+      // whole-request deadline: on the fresh-connection retry the full
+      // timeout would otherwise let one request take ~2x timeout_secs.
+      auto now = std::chrono::steady_clock::now();
+      if (now >= deadline) throw ReadTimeout();
+      auto remaining_ms =
+          std::chrono::duration_cast<std::chrono::milliseconds>(deadline - now).count();
+      int open_secs = static_cast<int>(std::min<long long>(
+          timeout_secs, (remaining_ms + 999) / 1000));
+      conn = open(std::max(open_secs, 1));
+    }
     conn->set_timeout(timeout_secs);
     bool got_response_bytes = false;
     try {

@@ -1,6 +1,7 @@
 #include "tpubc/leader.h"
 
 #include <algorithm>
+#include <chrono>
 #include <cinttypes>
 #include <cstdio>
 #include <ctime>
@@ -15,6 +16,16 @@ namespace {
 constexpr const char* kLeaseApi = "coordination.k8s.io/v1";
 constexpr const char* kLeaseKind = "Lease";
 }  // namespace
+
+int64_t steady_now_ms() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+bool LeaderElector::is_leader() const {
+  return is_leader_.load() && steady_now_ms() < leader_until_.load();
+}
 
 std::string lease_now_rfc3339_micro() {
   struct timespec ts;
@@ -130,7 +141,7 @@ bool LeaderElector::acquire(std::atomic<bool>& stop) {
   while (!stop.load()) {
     try {
       if (try_acquire_once()) {
-        leader_until_.store(::time(nullptr) + renew_deadline_secs());
+        leader_until_.store(steady_now_ms() + renew_deadline_secs() * 1000);
         is_leader_.store(true);
         log_info("became leader", {{"identity", config_.identity},
                                    {"lease", config_.lease_namespace + "/" + config_.lease_name}});
@@ -160,16 +171,18 @@ bool LeaderElector::hold(std::atomic<bool>& stop) {
   // plus the lease client's whole-request deadline (request timeout
   // <= renew_period/2, DeadlineStream in http.cc) merely keep the loop
   // itself responsive so the daemon can wind down and restart promptly.
-  int64_t last_success = ::time(nullptr);
-  const int64_t renew_deadline = renew_deadline_secs();
+  int64_t last_success_ms = steady_now_ms();
+  const int64_t renew_deadline_ms = renew_deadline_secs() * 1000;
   int64_t wait_secs = config_.renew_period_secs;
   while (!stop.load()) {
     if (stop_wait_ms(wait_secs * 1000)) return true;
-    if (::time(nullptr) - last_success >= renew_deadline) {
-      log_error("renew deadline exceeded; stepping down before lease expiry", {});
-      is_leader_.store(false);
-      return false;
-    }
+    // Attempt the renew FIRST and judge the deadline only on failure:
+    // checking before the attempt makes any config with
+    // lease_duration <= 2*renew_period (renew_deadline <= renew_period)
+    // step down spuriously right after the first sleep, with a perfectly
+    // healthy API server. A hung renew cannot extend leadership either
+    // way — the request deadline is clamped to renew_period/2 and
+    // is_leader() flips on leader_until_ regardless of this loop.
     try {
       Json lease =
           client_.get(kLeaseApi, kLeaseKind, config_.lease_namespace, config_.lease_name);
@@ -187,14 +200,14 @@ bool LeaderElector::hold(std::atomic<bool>& stop) {
       // deadline (< renew_period), which the renew_deadline slack of one
       // full renew period absorbs — leader_until_ stays strictly earlier
       // than any standby's takeover time of renewTime + lease_duration.
-      last_success = ::time(nullptr);
-      leader_until_.store(last_success + renew_deadline);
+      last_success_ms = steady_now_ms();
+      leader_until_.store(last_success_ms + renew_deadline_ms);
       wait_secs = config_.renew_period_secs;
     } catch (const std::exception& e) {
       log_warn("lease renew failed", {{"error", e.what()}});
       // Retry fast: the remaining budget before the deadline is small.
       wait_secs = std::max<int64_t>(config_.retry_period_secs, 1);
-      if (::time(nullptr) - last_success >= renew_deadline) {
+      if (steady_now_ms() - last_success_ms >= renew_deadline_ms) {
         log_error("renew deadline exceeded; stepping down before lease expiry", {});
         is_leader_.store(false);
         return false;

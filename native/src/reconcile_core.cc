@@ -8,6 +8,14 @@ namespace tpubc {
 
 namespace {
 
+// The payload image built by CI (Dockerfile.workload): jax[tpu] + the
+// tpu_bootstrap package, entry point python -m tpu_bootstrap.workload.train.
+// Single source of truth for the default — the chart's workload_image value
+// stays empty unless an operator overrides it (ci.yml publishes to
+// ghcr.io/<owner>/<repo>-workload; forks must set the chart value).
+constexpr const char* kDefaultWorkloadImage =
+    "ghcr.io/tpu-bootstrap/tpu-bootstrap-workload:latest";
+
 Json meta(const std::string& name, const Json& oref) {
   return Json::object({{"name", name}, {"ownerReferences", Json::array({oref})}});
 }
@@ -40,7 +48,7 @@ Json default_controller_config() {
   return Json::object({
       {"requeue_secs", 30},
       {"error_requeue_secs", 3},
-      {"workload_image", "python:3.12-slim"},
+      {"workload_image", kDefaultWorkloadImage},
   });
 }
 
@@ -56,7 +64,23 @@ Json build_jobset(const Json& ub, const Json& config) {
   const std::string name = ns + "-slice";
 
   std::string image = tpu.get_string("image");
-  if (image.empty()) image = config.get_string("workload_image", "python:3.12-slim");
+  if (image.empty()) image = config.get_string("workload_image", kDefaultWorkloadImage);
+
+  // Multi-host JAX bootstrap contract (consumed by
+  // tpu_bootstrap/workload/train.py): every worker learns the coordinator's
+  // stable DNS name and the host count from env; its own index arrives via
+  // JOB_COMPLETION_INDEX, which Indexed Jobs inject automatically. With
+  // spec.network.enableDNSHostnames below, JobSet gives pod 0 of the
+  // "workers" job the hostname <name>-workers-0-0.<subdomain>, valid
+  // before the pod is Ready — exactly what jax.distributed.initialize
+  // needs to converge (SURVEY.md §7 "emitting the right subdomain so JAX
+  // initialization converges").
+  const std::string coordinator = name + "-workers-0-0." + name + ":8080";
+  Json env = Json::array({
+      Json::object({{"name", "TPUBC_COORDINATOR_ADDRESS"}, {"value", coordinator}}),
+      Json::object({{"name", "TPUBC_NUM_HOSTS"}, {"value", std::to_string(geom.hosts)}}),
+      Json::object({{"name", "TPUBC_JOBSET_NAME"}, {"value", name}}),
+  });
 
   Json container = Json::object({
       {"name", "tpu-worker"},
@@ -67,12 +91,19 @@ Json build_jobset(const Json& ub, const Json& config) {
                     Json::object({{"containerPort", 8471}, {"name", "tpu-runtime"}}),
                     Json::object({{"containerPort", 8080}, {"name", "coordinator"}}),
                 })},
+      {"env", env},
       {"resources", Json::object({
                         {"requests", Json::object({{kTpuResource, geom.chips_per_host}})},
                         {"limits", Json::object({{kTpuResource, geom.chips_per_host}})},
                     })},
   });
-  if (tpu.get("command").is_array()) container.set("command", tpu.get("command"));
+  if (tpu.get("command").is_array()) {
+    container.set("command", tpu.get("command"));
+  } else {
+    // Default payload: the framework's own train entry point, baked into
+    // the workload image (Dockerfile.workload).
+    container.set("command", Json::array({"python", "-m", "tpu_bootstrap.workload.train"}));
+  }
   if (tpu.get("args").is_array()) container.set("args", tpu.get("args"));
 
   Json pod_spec = Json::object({
@@ -111,6 +142,14 @@ Json build_jobset(const Json& ub, const Json& config) {
          return m;
        }()},
       {"spec", Json::object({
+                   // Headless-service wiring: JobSet creates a headless
+                   // Service named after the subdomain and publishes
+                   // not-ready addresses, giving every worker a stable DNS
+                   // name for rendezvous before readiness.
+                   {"network", Json::object({
+                                   {"enableDNSHostnames", true},
+                                   {"subdomain", name},
+                               })},
                    {"failurePolicy", Json::object({{"maxRestarts", max_restarts}})},
                    {"replicatedJobs", Json::array({Json::object({
                         {"name", "workers"},
@@ -216,31 +255,65 @@ Json slice_status(const Json& ub, const Json& observed_jobset) {
     }
   }
   Json st = Json::object({
-      {"phase", "Pending"},
       {"chips", chips},
       {"hosts", hosts},
   });
+
+  // Phase ladder: Pending (no JobSet yet) -> Provisioning (JobSet exists,
+  // gang not fully ready) -> Running (every host pod ready) -> Succeeded /
+  // Failed (terminal, from JobSet conditions). A finished slice must NOT
+  // read as live: JobSet condition Completed maps to Succeeded.
+  std::string phase = "Pending";
+  bool provisioned = false;
+  bool workers_ready = false;
   if (observed_jobset.is_object()) {
     st.set("jobset", observed_jobset.get("metadata").get_string("name"));
-    st.set("phase", "Provisioning");
+    provisioned = true;
+    phase = "Provisioning";
+
+    // The emitted JobSet has one replicated job ("workers", replicas=1)
+    // whose single child Job runs `hosts` indexed pods. JobSet counts a
+    // child Job as ready once ready+succeeded pods reach parallelism, so
+    // every replicated job reporting ready>=replicas(=1) means the whole
+    // gang is up.
+    const Json& rjs = observed_jobset.get("status").get("replicatedJobsStatus");
+    if (rjs.is_array() && rjs.size() > 0) {
+      workers_ready = true;
+      for (const auto& rj : rjs.items()) {
+        if (rj.get_int("ready", 0) < 1) workers_ready = false;
+      }
+    }
+    if (workers_ready) phase = "Running";
+
     const Json& conds = observed_jobset.get("status").get("conditions");
     if (conds.is_array()) {
       for (const auto& c : conds.items()) {
         const std::string type = c.get_string("type");
         if (c.get_string("status") == "True") {
-          if (type == "Completed") st.set("phase", "Running");
-          if (type == "Failed") st.set("phase", "Failed");
+          if (type == "Completed") phase = "Succeeded";
+          if (type == "Failed") phase = "Failed";
         }
       }
     }
-    // Any active replicated job counts as Running for the slice.
-    const Json& rjs = observed_jobset.get("status").get("replicatedJobsStatus");
-    if (rjs.is_array()) {
-      for (const auto& rj : rjs.items()) {
-        if (rj.get_int("active", 0) > 0 || rj.get_int("ready", 0) > 0) st.set("phase", "Running");
-      }
-    }
   }
+  st.set("phase", phase);
+
+  // Slice-provisioning conditions (SURVEY.md §7: "add slice-provisioning
+  // conditions"). Pure function of observed state — no timestamps, so the
+  // controller's desired-vs-current comparison stays stable across passes.
+  st.set("conditions",
+         Json::array({
+             Json::object({
+                 {"type", "SliceProvisioned"},
+                 {"status", provisioned ? "True" : "False"},
+                 {"reason", provisioned ? "JobSetCreated" : "JobSetNotFound"},
+             }),
+             Json::object({
+                 {"type", "WorkersReady"},
+                 {"status", workers_ready ? "True" : "False"},
+                 {"reason", workers_ready ? "AllHostsReady" : "WaitingForHosts"},
+             }),
+         }));
   return st;
 }
 

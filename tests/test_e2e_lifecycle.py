@@ -135,11 +135,20 @@ def test_full_onboarding_lifecycle(fake, tmp_path):
             jspec["template"]["spec"]["nodeSelector"]["cloud.google.com/gke-tpu-topology"]
             == "2x2x2"
         )
+        # A CR with no image/command must yield a runnable JobSet: the
+        # workload image runs the framework's own train entry point, wired
+        # for multi-host rendezvous via the headless service.
+        worker = jspec["template"]["spec"]["containers"][0]
+        assert worker["image"].endswith("tpu-bootstrap-workload:latest")
+        assert worker["command"] == ["python", "-m", "tpu_bootstrap.workload.train"]
+        assert js["spec"]["network"]["enableDNSHostnames"] is True
 
-        # -- 6. JobSet reports active -> slice status becomes Running --------
+        # -- 6. JobSet reports the gang ready -> slice status becomes Running
         with fake.store.lock:
             js_live = fake.store.objects[KEY_JS("alice")]["alice-slice"]
-            js_live["status"] = {"replicatedJobsStatus": [{"name": "workers", "active": 2}]}
+            js_live["status"] = {
+                "replicatedJobsStatus": [{"name": "workers", "active": 1, "ready": 1}]
+            }
         fake.store.upsert(KEY_JS("alice"), "alice-slice", js_live, preserve_status=False)
         ub = wait_for(
             lambda: (lambda u: u

@@ -1,0 +1,116 @@
+"""Multi-host JAX bootstrap: the emitted JobSet's env contract actually
+boots jax.distributed (SURVEY.md §7 "headless-service wiring for JAX
+coordinator bootstrap"; reference has no compute path — control-plane only,
+/root/reference/src/).
+
+Two layers:
+ * pure: bootstrap_from_env derives initialize() kwargs from exactly the
+   env entries build_jobset injects;
+ * process-level: two real processes rendezvous over the distributed
+   runtime on CPU using that env, proving the contract end-to-end without
+   hardware (only the DNS name is rewritten to loopback — DNS is JobSet's
+   job, not ours).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+from tpu_bootstrap.workload.train import bootstrap_from_env
+
+
+def ub(name="alice", spec=None, status=None):
+    return {
+        "apiVersion": "tpu.bacchus.io/v1",
+        "kind": "UserBootstrap",
+        "metadata": {"name": name, "uid": "u-1"},
+        "spec": spec or {},
+    }
+
+
+def jobset_env(lib, accel="tpu-v5p-slice", topo="2x2x2"):
+    js = lib.build_jobset(ub(spec={"tpu": {"accelerator": accel, "topology": topo}}))
+    c = js["spec"]["replicatedJobs"][0]["template"]["spec"]["template"]["spec"]["containers"][0]
+    return {e["name"]: e["value"] for e in c["env"]}
+
+
+def test_bootstrap_from_jobset_env(lib):
+    """bootstrap_from_env consumes the JobSet env verbatim; the host index
+    rides JOB_COMPLETION_INDEX exactly as an Indexed Job injects it."""
+    env = jobset_env(lib)
+    env["JOB_COMPLETION_INDEX"] = "1"  # kubelet-injected on host 1
+    boot = bootstrap_from_env(env)
+    assert boot == {
+        "coordinator_address": "alice-slice-workers-0-0.alice-slice:8080",
+        "num_processes": 2,  # v5p 2x2x2 = 8 chips / 4 per host
+        "process_id": 1,
+    }
+
+
+def test_bootstrap_absent_outside_jobset(lib):
+    assert bootstrap_from_env({}) is None
+    assert bootstrap_from_env({"JOB_COMPLETION_INDEX": "0"}) is None
+
+
+WORKER_SCRIPT = """
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from tpu_bootstrap.workload.train import bootstrap_from_env
+
+boot = bootstrap_from_env()
+assert boot is not None and boot["num_processes"] > 1
+jax.distributed.initialize(**boot)
+print("RESULT", jax.process_index(), jax.process_count(), jax.device_count(), flush=True)
+"""
+
+
+def test_two_processes_rendezvous_with_jobset_env(lib):
+    """Two OS processes boot jax.distributed using the JobSet's env. This
+    is the CPU stand-in for two slice hosts: same env names, same values,
+    coordinator DNS rewritten to loopback."""
+    env_contract = jobset_env(lib)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    # Keep the port the JobSet advertises unless loopback needs a free one;
+    # the name half of the address is JobSet-provided DNS either way.
+    coord = f"127.0.0.1:{port}"
+
+    procs = []
+    for idx in range(2):
+        env = {
+            **os.environ,
+            **env_contract,
+            "TPUBC_COORDINATOR_ADDRESS": coord,
+            "JOB_COMPLETION_INDEX": str(idx),
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "",  # one device per process: device_count proves fan-in
+        }
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", WORKER_SCRIPT.format(repo=str(REPO))],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+            )
+        )
+    results = {}
+    for idx, p in enumerate(procs):
+        out, err = p.communicate(timeout=120)
+        assert p.returncode == 0, f"worker {idx} failed:\n{err.decode()[-2000:]}"
+        line = [ln for ln in out.decode().splitlines() if ln.startswith("RESULT")][0]
+        _, pid, pcount, dcount = line.split()
+        results[idx] = (int(pid), int(pcount), int(dcount))
+
+    for idx in range(2):
+        pid, pcount, dcount = results[idx]
+        assert pid == idx, "process_id must follow JOB_COMPLETION_INDEX"
+        assert pcount == 2
+        assert dcount == 2, "each host must see every device across the slice"

@@ -127,6 +127,36 @@ def test_jobset_multi_host_v5p_4x4x4(lib):
     assert js["spec"]["failurePolicy"]["maxRestarts"] == 0
 
 
+def test_jobset_multihost_jax_bootstrap_wiring(lib):
+    """The emitted JobSet must let a multi-host slice rendezvous on its own:
+    headless-service DNS (spec.network) + coordinator/host-count env
+    (SURVEY.md §7 'emitting the right subdomain so JAX initialization
+    converges'). Worker index arrives via JOB_COMPLETION_INDEX, injected by
+    Indexed Jobs — no env entry needed."""
+    js = lib.build_jobset(ub(spec={"tpu": tpu_spec("tpu-v5p-slice", "4x4x4")}))
+    net = js["spec"]["network"]
+    assert net["enableDNSHostnames"] is True
+    assert net["subdomain"] == "alice-slice"
+    c = js["spec"]["replicatedJobs"][0]["template"]["spec"]["template"]["spec"]["containers"][0]
+    env = {e["name"]: e["value"] for e in c["env"]}
+    # worker 0's stable DNS name: <jobset>-<replicatedjob>-<jobindex>-<podindex>.<subdomain>
+    assert env["TPUBC_COORDINATOR_ADDRESS"] == "alice-slice-workers-0-0.alice-slice:8080"
+    assert env["TPUBC_NUM_HOSTS"] == "16"
+    assert env["TPUBC_JOBSET_NAME"] == "alice-slice"
+    # the coordinator port the address points at is actually exposed
+    ports = {p["name"]: p["containerPort"] for p in c["ports"]}
+    assert ports["coordinator"] == 8080
+
+
+def test_jobset_default_command_is_train_entry(lib):
+    """A CR without image/command must produce a runnable JobSet: the
+    workload image default + the framework's train entry point."""
+    js = lib.build_jobset(ub(spec={"tpu": tpu_spec()}))
+    c = js["spec"]["replicatedJobs"][0]["template"]["spec"]["template"]["spec"]["containers"][0]
+    assert c["image"] == "ghcr.io/tpu-bootstrap/tpu-bootstrap-workload:latest"
+    assert c["command"] == ["python", "-m", "tpu_bootstrap.workload.train"]
+
+
 def test_jobset_image_command_and_restarts(lib):
     js = lib.build_jobset(
         ub(
@@ -184,15 +214,42 @@ def test_full_slice_plan(lib):
         assert c["metadata"]["ownerReferences"][0]["uid"] == "u-1"
 
 
+def conds(st):
+    return {c["type"]: c["status"] for c in st["conditions"]}
+
+
 def test_slice_status_phases(lib):
     cr = ub(spec={"tpu": tpu_spec(chips=4, hosts=1)})
     assert lib.slice_status(ub(), None)["phase"] == "Absent"
-    assert lib.slice_status(cr, None)["phase"] == "Pending"
+
+    st = lib.slice_status(cr, None)
+    assert st["phase"] == "Pending"
+    assert conds(st) == {"SliceProvisioned": "False", "WorkersReady": "False"}
+
     js = {"metadata": {"name": "alice-slice"}, "status": {}}
+    st = lib.slice_status(cr, js)
+    assert st["phase"] == "Provisioning"
+    assert conds(st) == {"SliceProvisioned": "True", "WorkersReady": "False"}
+
+    # Pods scheduled but the gang not fully up: still Provisioning, not
+    # Running — active jobs are not ready jobs.
+    js["status"] = {"replicatedJobsStatus": [{"name": "workers", "active": 1, "ready": 0}]}
     assert lib.slice_status(cr, js)["phase"] == "Provisioning"
-    js["status"] = {"replicatedJobsStatus": [{"name": "workers", "active": 1}]}
+
+    # Every replicated job ready (JobSet counts a child Job ready once all
+    # `parallelism` pods are ready) -> Running.
+    js["status"] = {"replicatedJobsStatus": [{"name": "workers", "active": 1, "ready": 1}]}
     st = lib.slice_status(cr, js)
     assert st["phase"] == "Running"
     assert st["jobset"] == "alice-slice"
+    assert conds(st) == {"SliceProvisioned": "True", "WorkersReady": "True"}
+
+    # A finished slice must read Succeeded, not Running.
+    js["status"] = {
+        "replicatedJobsStatus": [{"name": "workers", "ready": 1}],
+        "conditions": [{"type": "Completed", "status": "True"}],
+    }
+    assert lib.slice_status(cr, js)["phase"] == "Succeeded"
+
     js["status"] = {"conditions": [{"type": "Failed", "status": "True"}]}
     assert lib.slice_status(cr, js)["phase"] == "Failed"

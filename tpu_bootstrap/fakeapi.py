@@ -15,6 +15,7 @@ from __future__ import annotations
 import argparse
 import copy
 import json
+import socket
 import threading
 import time
 import uuid
@@ -369,12 +370,44 @@ class FakeKubeHandler(BaseHTTPRequestHandler):
         return self.send_json(200, obj)
 
 
+class _TrackingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that remembers live client sockets so stop()
+    can sever them. Plain shutdown() only stops the accept loop; handler
+    threads keep serving keep-alive connections, so a daemon with a
+    pooled connection would still see a perfectly healthy "API server"
+    after the fake is nominally dead."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._conns = set()
+        self._conns_lock = threading.Lock()
+
+    def process_request(self, request, client_address):
+        with self._conns_lock:
+            self._conns.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        with self._conns_lock:
+            self._conns.discard(request)
+        super().shutdown_request(request)
+
+    def close_all_connections(self):
+        with self._conns_lock:
+            conns = list(self._conns)
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+
 class FakeKube:
     """In-process fake API server handle for tests."""
 
     def __init__(self, port: int = 0, latency_ms: float = 0):
         self.store = Store()
-        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), FakeKubeHandler)
+        self.httpd = _TrackingHTTPServer(("127.0.0.1", port), FakeKubeHandler)
         self.httpd.store = self.store  # type: ignore[attr-defined]
         self.httpd.latency_ms = latency_ms  # type: ignore[attr-defined]
         self.httpd.daemon_threads = True
@@ -395,6 +428,9 @@ class FakeKube:
     def stop(self):
         self.httpd.shutdown()
         self.httpd.server_close()
+        # Sever live keep-alive connections: a stopped API server must look
+        # dead to clients holding pooled connections, not half-alive.
+        self.httpd.close_all_connections()
 
     # -- convenience accessors for tests ------------------------------------
 

@@ -227,20 +227,47 @@ def run_demo(num_devices: int | None = None, steps: int = 2, seed: int = 0):
     return losses
 
 
+def bootstrap_from_env(environ=None) -> dict | None:
+    """Multi-host rendezvous parameters from the env the controller's
+    emitted JobSet injects (native/src/reconcile_core.cc build_jobset):
+
+      TPUBC_COORDINATOR_ADDRESS  worker 0's stable headless-service DNS
+                                 name + coordinator port
+      TPUBC_NUM_HOSTS            slice host count (JobSet parallelism)
+      JOB_COMPLETION_INDEX       this host's index, injected automatically
+                                 by the Indexed child Job
+
+    Returns jax.distributed.initialize kwargs, or None when not running
+    under a tpu-bootstrap JobSet (single-host dev runs, pytest)."""
+    import os
+
+    env = os.environ if environ is None else environ
+    addr = env.get("TPUBC_COORDINATOR_ADDRESS")
+    if not addr:
+        return None
+    return {
+        "coordinator_address": addr,
+        "num_processes": int(env.get("TPUBC_NUM_HOSTS", "1")),
+        "process_id": int(env.get("JOB_COMPLETION_INDEX", "0")),
+    }
+
+
 def worker_main() -> None:
     """JobSet worker entry: ``python -m tpu_bootstrap.workload.train``.
 
     Each host on the slice runs this under the JobSet's indexed completion;
-    jax.distributed discovers coordinator/index from the GKE TPU env, the
+    jax.distributed rendezvous comes from the env the JobSet injects (see
+    bootstrap_from_env), falling back to GKE megascale auto-discovery. The
     mesh then spans every chip on the slice. Config via env:
     WORKLOAD_STEPS, WORKLOAD_SAVE_EVERY, WORKLOAD_CHECKPOINT_DIR (shared
     storage — resume-on-restart), WORKLOAD_SEED.
     """
     import os
 
-    if os.environ.get("MEGASCALE_COORDINATOR_ADDRESS") or os.environ.get(
-        "JOB_COMPLETION_INDEX"
-    ):
+    boot = bootstrap_from_env()
+    if boot is not None and boot["num_processes"] > 1:
+        jax.distributed.initialize(**boot)
+    elif os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):
         jax.distributed.initialize()
 
     steps = int(os.environ.get("WORKLOAD_STEPS", "100"))
