@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Retry the tunneled TPU chip until it becomes claimable, then capture the
+# workload bench numbers. Backend init through the axon relay can block
+# for tens of minutes before failing UNAVAILABLE when the chip is held
+# elsewhere, so each attempt gets a hard timeout and results land in
+# .tpu_workload_probe.json the first time an attempt succeeds.
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+OUT="$REPO/.tpu_workload_probe.json"
+LOG="$REPO/.tpu_workload_probe.log"
+while true; do
+  echo "$(date -u +%FT%TZ) attempt start" >> "$LOG"
+  RESULT=$(timeout 1500 python - <<'EOF' 2>>"$LOG"
+import sys
+sys.path.insert(0, "/root/repo")
+import bench
+import json
+r = bench.workload_bench(timeout_secs=1200)
+print(json.dumps(r))
+EOF
+)
+  echo "$(date -u +%FT%TZ) attempt done: ${RESULT:0:300}" >> "$LOG"
+  if [ -n "$RESULT" ] && ! echo "$RESULT" | grep -q workload_bench_error; then
+    echo "$RESULT" > "$OUT"
+    echo "$(date -u +%FT%TZ) SUCCESS — wrote $OUT" >> "$LOG"
+    exit 0
+  fi
+  sleep 120
+done

@@ -55,6 +55,12 @@ int main() {
     }
     if (req.path == "/metrics") {
       resp.status = 200;
+      resp.headers["Content-Type"] = "text/plain; version=0.0.4";
+      resp.body = Metrics::instance().to_prometheus();
+      return resp;
+    }
+    if (req.path == "/metrics.json") {
+      resp.status = 200;
       resp.body = Metrics::instance().to_json().dump();
       return resp;
     }

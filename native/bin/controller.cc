@@ -13,6 +13,7 @@
 //  * per-object deduplication: a CR already queued is not queued twice;
 //  * /metrics endpoint with reconcile counters for the bench harness.
 #include <algorithm>
+#include <chrono>
 #include <condition_variable>
 #include <map>
 #include <mutex>
@@ -160,6 +161,18 @@ class WorkQueue {
 // plus JobSet + status.slice maintenance. Returns false when the CR is
 // gone (callers must not requeue it).
 bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::string& name) {
+  // Whole-pass latency histogram: the in-daemon half of the BASELINE
+  // metric surface, scrapeable at /metrics and read back by bench.py.
+  struct PassTimer {
+    std::chrono::steady_clock::time_point t0 = std::chrono::steady_clock::now();
+    ~PassTimer() {
+      Metrics::instance().observe(
+          "tpubc_reconcile_duration_ms",
+          std::chrono::duration<double, std::milli>(std::chrono::steady_clock::now() - t0)
+              .count());
+    }
+  } timer;
+
   Json ub;
   try {
     ub = client.get(kApiVersion, kKind, "", name);
@@ -221,6 +234,11 @@ int main() {
       resp.headers["Content-Type"] = "text/plain";
       resp.body = "pong";
     } else if (req.path == "/metrics") {
+      // Prometheus text exposition format (scrapeable in-cluster).
+      resp.status = 200;
+      resp.headers["Content-Type"] = "text/plain; version=0.0.4";
+      resp.body = Metrics::instance().to_prometheus();
+    } else if (req.path == "/metrics.json") {
       resp.status = 200;
       resp.body = Metrics::instance().to_json().dump();
     } else {

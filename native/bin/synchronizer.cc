@@ -173,6 +173,10 @@ int main() {
       resp.body = "pong";
     } else if (req.path == "/metrics") {
       resp.status = 200;
+      resp.headers["Content-Type"] = "text/plain; version=0.0.4";
+      resp.body = Metrics::instance().to_prometheus();
+    } else if (req.path == "/metrics.json") {
+      resp.status = 200;
       resp.body = Metrics::instance().to_json().dump();
     } else {
       resp.status = 404;

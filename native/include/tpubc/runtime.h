@@ -1,8 +1,8 @@
 // Shared daemon runtime skeleton: SIGINT/SIGTERM -> graceful-stop flag
 // (the reference's broadcast-channel/Stopper pattern, controller.rs:177-205)
-// plus simple process-wide metrics counters surfaced at /metrics — an
-// addition over the reference (SURVEY.md §5: "the build should add a
-// metrics endpoint").
+// plus process-wide metrics surfaced at /metrics in Prometheus text format
+// — an addition over the reference (SURVEY.md §5: "the build should add a
+// metrics endpoint to support the BASELINE metric").
 #pragma once
 
 #include <atomic>
@@ -24,17 +24,40 @@ bool stop_wait_ms(int64_t ms);
 // Wake all stop_wait_ms sleepers (used by signal handler and tests).
 void request_stop();
 
-// Named monotonically-increasing counters, rendered by /metrics.
+// Named counters/gauges plus fixed-bucket latency histograms.
+//
+// Rendered two ways: to_prometheus() (text exposition format, scrapeable
+// by a real cluster's Prometheus — names ending in _total become
+// counters, histograms get _bucket/_sum/_count series) and to_json()
+// (the bench/test surface; histograms appear as <name>_count, <name>_sum
+// and self-computed <name>_p50/_p99 so harnesses don't re-implement
+// bucket math).
 class Metrics {
  public:
   static Metrics& instance();
   void inc(const std::string& name, int64_t delta = 1);
   void set(const std::string& name, int64_t value);
+  // Record one observation (e.g. a duration in ms) into the named
+  // histogram. Buckets are fixed (1ms..10s, log-ish spacing) — right for
+  // control-plane latencies.
+  void observe(const std::string& name, double value);
+  // Quantile estimate from the histogram buckets (linear interpolation
+  // within the containing bucket). Returns -1 when the histogram is empty.
+  double quantile(const std::string& name, double q) const;
   Json to_json() const;
+  std::string to_prometheus() const;
 
  private:
+  struct Histogram {
+    std::vector<int64_t> bucket_counts;  // one per bucket bound + overflow
+    double sum = 0;
+    int64_t count = 0;
+  };
+  double quantile_locked(const Histogram& h, double q) const;
+
   mutable std::mutex mutex_;
   std::vector<std::pair<std::string, int64_t>> counters_;
+  std::vector<std::pair<std::string, Histogram>> histograms_;
 };
 
 }  // namespace tpubc

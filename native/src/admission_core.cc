@@ -168,12 +168,46 @@ Json mutate(const Json& request, const Json& config) {
     return deny(request, "rolebinding field is not empty. you are a normal user, so leave it empty");
   }
 
+  // ---- device section ----------------------------------------------------
+  // The blueprint CRD is device: nvidia|tpu (SURVEY.md §7). spec.tpu and
+  // spec.gpu are the two device sections; exactly one may be present.
+  const Json& tpu = spec.get("tpu");
+  const Json& gpu = spec.get("gpu");
+  if (tpu.is_object() && gpu.is_object()) {
+    return deny(request, "spec.tpu and spec.gpu are mutually exclusive; pick one device");
+  }
+
+  // ---- GPU path (reference parity) ---------------------------------------
+  // BASELINE config #1: a CR asking for nvidia.com/gpu must work without
+  // hand-written quota. The webhook defaults the count and injects the
+  // reference's exact quota keys (synchronizer.rs:268-278); the sheet
+  // synchronizer (device=gpu) later overwrites with the approved row.
+  if (gpu.is_object()) {
+    // Absent count defaults to 1; an explicit 0 is preserved (a valid
+    // "namespace only, no devices yet" request whose quota then denies
+    // GPU pods outright).
+    int64_t count;
+    if (gpu.get("count").is_null()) {
+      count = 1;
+      patches.push_back(patch_op("add", "/spec/gpu/count", Json(count)));
+    } else {
+      count = gpu.get_int("count", 0);
+      if (count < 0) return deny(request, "spec.gpu.count must be >= 0");
+    }
+    int64_t mig = gpu.get_int("mig_count", 0);
+    if (mig < 0) return deny(request, "spec.gpu.mig_count must be >= 0");
+    if (spec.get("quota").is_null()) {
+      Json hard = Json::object({{"requests.nvidia.com/gpu", std::to_string(count)}});
+      if (mig > 0) hard.set("requests.nvidia.com/mig-1g.10gb", std::to_string(mig));
+      patches.push_back(patch_op("add", "/spec/quota", Json::object({{"hard", hard}})));
+    }
+  }
+
   // ---- TPU extension -----------------------------------------------------
   // Validate the accelerator/topology pair and materialize derived slice
   // geometry into the spec, so the reconciler and quota system never have
   // to re-derive chip math (and invalid topologies die here, synchronously,
   // instead of at node-pool scheduling time).
-  const Json& tpu = spec.get("tpu");
   if (tpu.is_object()) {
     std::string accelerator = tpu.get_string("accelerator");
     if (accelerator.empty()) {

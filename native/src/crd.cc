@@ -211,6 +211,31 @@ Json tpu_schema() {
   });
 }
 
+Json gpu_schema() {
+  return Json::object({
+      {"description",
+       "GPU request (reference parity path). Mutually exclusive with spec.tpu. The "
+       "admission webhook defaults count and injects requests.nvidia.com/gpu (+ "
+       "requests.nvidia.com/mig-1g.10gb) quota — the reference's key set "
+       "(synchronizer.rs:268-278) — when spec.quota is absent."},
+      {"nullable", true},
+      {"type", "object"},
+      {"properties",
+       Json::object({
+           {"count", Json::object({{"description", "nvidia.com/gpu devices requested "
+                                                   "(defaulted to 1 by the webhook)."},
+                                   {"nullable", true},
+                                   {"format", "int64"},
+                                   {"type", "integer"}})},
+           {"mig_count", Json::object({{"description", "nvidia.com/mig-1g.10gb slices "
+                                                       "requested."},
+                                       {"nullable", true},
+                                       {"format", "int64"},
+                                       {"type", "integer"}})},
+       })},
+  });
+}
+
 Json status_schema() {
   return Json::object({
       {"nullable", true},
@@ -269,6 +294,7 @@ Json crd_definition() {
       {"role", role_schema()},
       {"rolebinding", rolebinding_schema()},
       {"tpu", tpu_schema()},
+      {"gpu", gpu_schema()},
   });
 
   Json schema = Json::object({

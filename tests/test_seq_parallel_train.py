@@ -45,9 +45,18 @@ def test_seq_parallel_matches_dense(sp_mesh):
     np.testing.assert_allclose(ring, dense, atol=1e-4, rtol=1e-4)
 
 
-def test_flash_with_seq_parallel_rejected():
-    cfg = TrainConfig(model=MODEL, mesh=MeshConfig(seq=2), attention="flash")
+def test_flash_composes_with_seq_parallel():
+    """attention="flash" under seq>1 runs the Pallas kernel as the ring's
+    block core; losses must match the dense factorization."""
+    cfg = TrainConfig(model=MODEL, mesh=MeshConfig(data=2, seq=2, tensor=2),
+                      attention="flash", attention_block=8)
     mesh = build_mesh(cfg.mesh)
     params, opt_state, p_sh = init_train_state(cfg, mesh, jax.random.PRNGKey(0))
-    with pytest.raises(ValueError, match="does not yet compose"):
-        make_train_step(cfg, mesh, p_sh)
+    step = make_train_step(cfg, mesh, p_sh)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, MODEL.max_seq_len), 0,
+                                MODEL.vocab_size)
+    tokens = jax.device_put(tokens, batch_shardings(mesh))
+    params, opt_state, l0 = step(params, opt_state, tokens)
+    _, _, l1 = step(params, opt_state, tokens)
+    dense = run_two_steps(MeshConfig(data=2, fsdp=2, tensor=2))
+    np.testing.assert_allclose((float(l0), float(l1)), dense, atol=1e-4, rtol=1e-4)

@@ -70,6 +70,78 @@ def merge_patch(target, patch):
     return target
 
 
+# ---- server-side apply (managed fields) ------------------------------------
+
+_MISSING = object()
+
+# Identity/server-owned paths: never part of an apply's managed field set.
+_UNMANAGED = {
+    ("apiVersion",),
+    ("kind",),
+    ("metadata", "name"),
+    ("metadata", "namespace"),
+    ("metadata", "resourceVersion"),
+    ("metadata", "uid"),
+    ("metadata", "creationTimestamp"),
+    ("metadata", "managedFields"),
+}
+
+
+def leaf_paths(obj, prefix=()):
+    """Leaf field paths of a dict tree. Lists are atomic leaves — the
+    daemons' objects use replace-semantics lists (ownerReferences, ports,
+    subjects), matching k8s' atomic list strategy for untyped CRs."""
+    paths = set()
+    for k, v in obj.items():
+        p = prefix + (k,)
+        if isinstance(v, dict) and v:
+            paths |= leaf_paths(v, p)
+        else:
+            paths.add(p)
+    return paths
+
+
+def get_path(obj, path):
+    node = obj
+    for seg in path:
+        if not isinstance(node, dict) or seg not in node:
+            return _MISSING
+        node = node[seg]
+    return node
+
+
+def set_path(obj, path, value):
+    node = obj
+    for seg in path[:-1]:
+        node = node.setdefault(seg, {})
+    node[path[-1]] = value
+
+
+def del_path(obj, path):
+    parents = []
+    node = obj
+    for seg in path[:-1]:
+        if not isinstance(node, dict) or seg not in node:
+            return
+        parents.append((node, seg))
+        node = node[seg]
+    if isinstance(node, dict):
+        node.pop(path[-1], None)
+    for parent, seg in reversed(parents):  # prune now-empty containers
+        if parent[seg] == {}:
+            del parent[seg]
+
+
+def fields_v1(paths):
+    """Render an owned path set in (simplified) fieldsV1 shape."""
+    root = {}
+    for p in sorted(paths):
+        node = root
+        for seg in p:
+            node = node.setdefault(f"f:{seg}", {})
+    return root
+
+
 class Store:
     """Object store keyed by (api_prefix, namespace, plural) -> name -> obj."""
 
@@ -79,6 +151,8 @@ class Store:
         self.rv = 100
         self.events: list[tuple[int, tuple, str, dict]] = []  # (rv, coll_key, type, obj)
         self.request_log: list[tuple[str, str]] = []
+        # (coll_key, name) -> field manager -> owned leaf-path set (SSA).
+        self.ownership: dict[tuple, dict[str, set]] = {}
 
     def next_rv(self):
         self.rv += 1
@@ -119,9 +193,93 @@ class Store:
             obj = coll.pop(name, None)
             if obj is None:
                 return None
+            self.ownership.pop((key, name), None)
             obj["metadata"]["resourceVersion"] = str(self.next_rv())
             self.record_event(key, "DELETED", obj)
             return obj
+
+    def server_side_apply(self, key, name, body, manager, force):
+        """Real(istic) SSA: per-manager field ownership, conflict
+        detection, forced transfer, and declarative removal of fields the
+        manager stopped applying. Returns (status_code, payload).
+
+        Differences an apply-everything fake hides and this surfaces:
+        a second manager applying a different value for an owned field
+        gets 409 unless force=true; re-applying identical intent is a
+        no-op (no resourceVersion bump, no watch event) — both exactly
+        what a real apiserver does with the daemons' .force() semantics.
+        """
+        with self.lock:
+            existing = self.collection(key).get(name)
+            owners = self.ownership.setdefault((key, name), {})
+            applied_paths = {p for p in leaf_paths(body) if p not in _UNMANAGED}
+
+            conflicts = {}  # other manager -> paths
+            if existing is not None:
+                for p in applied_paths:
+                    current = get_path(existing, p)
+                    wanted = get_path(body, p)
+                    if current is not _MISSING and current != wanted:
+                        for other, owned in owners.items():
+                            if other != manager and p in owned:
+                                conflicts.setdefault(other, set()).add(p)
+            if conflicts and not force:
+                detail = "; ".join(
+                    f'conflict with "{m}": {".".join(map(str, sorted(ps)[0]))}'
+                    + (f" (+{len(ps) - 1} more)" if len(ps) > 1 else "")
+                    for m, ps in sorted(conflicts.items())
+                )
+                return 409, {
+                    "kind": "Status",
+                    "apiVersion": "v1",
+                    "status": "Failure",
+                    "message": f"Apply failed with {sum(len(p) for p in conflicts.values())}"
+                               f" conflict(s): {detail}",
+                    "reason": "Conflict",
+                    "code": 409,
+                }
+
+            if existing is None:
+                new_obj = {
+                    "apiVersion": body.get("apiVersion"),
+                    "kind": body.get("kind"),
+                    "metadata": {"name": name},
+                }
+                if body.get("metadata", {}).get("namespace"):
+                    new_obj["metadata"]["namespace"] = body["metadata"]["namespace"]
+            else:
+                new_obj = copy.deepcopy(existing)
+                # Apply is declarative: fields this manager owned but no
+                # longer applies are removed (unless co-owned by another).
+                for p in owners.get(manager, set()) - applied_paths:
+                    if not any(p in owned for m, owned in owners.items() if m != manager):
+                        del_path(new_obj, p)
+            for p in applied_paths:
+                set_path(new_obj, p, copy.deepcopy(get_path(body, p)))
+
+            # Ownership: this manager owns what it applied; forced
+            # conflicts transfer those paths away from previous owners.
+            owners[manager] = set(applied_paths)
+            for other, taken in conflicts.items():
+                owners[other] -= taken
+            new_obj.setdefault("metadata", {})["managedFields"] = [
+                {"manager": m, "operation": "Apply", "fieldsV1": fields_v1(ps)}
+                for m, ps in sorted(owners.items()) if ps
+            ]
+
+            if existing is not None:
+                def strip_rv(o):
+                    o = copy.deepcopy(o)
+                    o.get("metadata", {}).pop("resourceVersion", None)
+                    return o
+
+                # Full-object comparison (metadata included — labels and
+                # ownerReferences changes are real changes) modulo the
+                # server-bumped resourceVersion.
+                if strip_rv(new_obj) == strip_rv(existing):
+                    return 200, copy.deepcopy(existing)  # no-op: rv unchanged
+            return (200 if existing is not None else 201,
+                    self.upsert(key, name, new_obj))
 
 
 class FakeKubeHandler(BaseHTTPRequestHandler):
@@ -284,7 +442,7 @@ class FakeKubeHandler(BaseHTTPRequestHandler):
         routed = self.route()
         if not routed:
             return self.send_status_error(404, f"unknown path {self.path}")
-        key, name, sub, _ = routed
+        key, name, sub, query = routed
         if not name:
             return self.send_status_error(405, "PATCH requires a name")
         ctype = self.headers.get("Content-Type", "")
@@ -304,9 +462,10 @@ class FakeKubeHandler(BaseHTTPRequestHandler):
             return self.send_json(200, self.store.upsert(key, name, existing, preserve_status=False))
 
         if "apply-patch" in ctype:
-            # Simplified SSA: the daemons always apply fully-specified
-            # objects, so upsert wholesale (status preserved).
-            return self.send_json(200 if existing else 201, self.store.upsert(key, name, body))
+            manager = query.get("fieldManager", ["unknown"])[0]
+            force = query.get("force", ["false"])[0] in ("true", "1")
+            code, payload = self.store.server_side_apply(key, name, body, manager, force)
+            return self.send_json(code, payload)
         if "json-patch" in ctype:
             if existing is None:
                 return self.send_status_error(404, f"{name} not found", "NotFound")

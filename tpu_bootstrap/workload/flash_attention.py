@@ -50,7 +50,13 @@ _NEG = -1e30  # finite stand-in for -inf: keeps exp()/max() NaN-free
 
 
 def _interpret_default() -> bool:
-    return jax.default_backend() != "tpu"
+    # "axon" is a tunneled TPU PJRT plugin (one real chip behind a relay);
+    # Mosaic compilation works there, so only genuinely non-TPU platforms
+    # fall back to interpret mode.
+    try:
+        return jax.default_backend() not in ("tpu", "axon")
+    except Exception:  # backend init failure: interpret still works on CPU
+        return True
 
 
 def _dot(a, b, trans_b=False):
@@ -214,11 +220,17 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dv_ref[:] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(sm_scale, block, causal, true_len, interpret, residuals, dout3):
+def _bwd(sm_scale, block, causal, true_len, interpret, residuals, cotangents):
     q3, k3, v3, out3, lse = residuals
+    dout3, dlse3 = cotangents
     bh, seq, hd = q3.shape
+    # d lse_i / d s_ij = p_ij, so a cotangent on lse folds into the kernels
+    # as ds = p * (dp - (delta - dlse)) — pass delta' = delta - dlse and the
+    # dq/dkv kernels need no changes. dlse is zero when only `out` is used
+    # (plain flash_attention); nonzero under the ring's logaddexp merge.
     delta = jnp.sum(dout3.astype(jnp.float32) * out3.astype(jnp.float32), axis=-1,
                     keepdims=True)
+    delta = delta - dlse3.astype(jnp.float32)
 
     grid = (bh, seq // block)
     tile = lambda: pl.BlockSpec((None, block, hd), lambda b, i: (b, i, 0))  # noqa: E731
@@ -257,13 +269,16 @@ def _bwd(sm_scale, block, causal, true_len, interpret, residuals, dout3):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash3(q3, k3, v3, sm_scale, block, causal, true_len, interpret):
-    out, _ = _fwd(q3, k3, v3, sm_scale, block, causal, true_len, interpret)
-    return out
+    """(out, lse) with full VJP support on both outputs. lse cotangents
+    arise when callers combine block results across devices (ring
+    attention's logaddexp merge); plain attention callers drop lse and its
+    cotangent is zero."""
+    return _fwd(q3, k3, v3, sm_scale, block, causal, true_len, interpret)
 
 
 def _flash3_fwd(q3, k3, v3, sm_scale, block, causal, true_len, interpret):
     out, lse = _fwd(q3, k3, v3, sm_scale, block, causal, true_len, interpret)
-    return out, (q3, k3, v3, out, lse)
+    return (out, lse), (q3, k3, v3, out, lse)
 
 
 _flash3.defvjp(_flash3_fwd, _bwd)
@@ -308,9 +323,51 @@ def flash_attention(
             x = jnp.pad(x, ((0, 0), (0, s_pad - s), (0, 0), (0, 0)))
         return x.transpose(0, 2, 1, 3).reshape(b * h, s_pad, d)
 
-    out3 = _flash3(fold(q), fold(k), fold(v), sm_scale, block, bool(causal), s, interpret)
+    out3, _ = _flash3(fold(q), fold(k), fold(v), sm_scale, block, bool(causal), s, interpret)
     out = out3.reshape(b, h, s_pad, d).transpose(0, 2, 1, 3)
     return out[:, :s] if s_pad != s else out
+
+
+def flash_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    sm_scale: float | None = None,
+    block_size: int = 128,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Like flash_attention but also returns the per-row logsumexp of the
+    scaled scores, shape (batch, seq, heads) float32 — the state a caller
+    needs to combine partial attention over KV blocks held elsewhere
+    (ring_attention's per-shard fold). Differentiable in both outputs."""
+    if q.shape != k.shape or q.shape != v.shape:
+        raise ValueError(f"q/k/v shapes must match, got {q.shape}/{k.shape}/{v.shape}")
+    if block_size % 8 != 0:
+        raise ValueError(f"block_size must be a multiple of 8, got {block_size}")
+    b, s, h, d = q.shape
+    if sm_scale is None:
+        sm_scale = float(d) ** -0.5
+    if interpret is None:
+        interpret = _interpret_default()
+
+    round8 = -(-s // 8) * 8
+    block = min(block_size, round8)
+    s_pad = -(-s // block) * block
+
+    def fold(x):
+        if s_pad != s:
+            x = jnp.pad(x, ((0, 0), (0, s_pad - s), (0, 0), (0, 0)))
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s_pad, d)
+
+    out3, lse3 = _flash3(fold(q), fold(k), fold(v), sm_scale, block, bool(causal), s,
+                         interpret)
+    out = out3.reshape(b, h, s_pad, d).transpose(0, 2, 1, 3)
+    lse = lse3.reshape(b, h, s_pad).transpose(0, 2, 1)
+    if s_pad != s:
+        out, lse = out[:, :s], lse[:, :s]
+    return out, lse
 
 
 def make_flash_attn_fn(*, block_size: int = 128, interpret: bool | None = None):
@@ -324,4 +381,4 @@ def make_flash_attn_fn(*, block_size: int = 128, interpret: bool | None = None):
     return attn_fn
 
 
-__all__ = ["flash_attention", "make_flash_attn_fn"]
+__all__ = ["flash_attention", "flash_attention_with_lse", "make_flash_attn_fn"]

@@ -67,12 +67,9 @@ def make_train_step(cfg: TrainConfig, mesh, p_shardings):
         # Sequence (context) parallelism: activations are sharded along
         # the sequence axis, so attention must see every earlier KV shard
         # — the ppermute ring provides that with O(seq/n) memory per
-        # device and neighbor-only ICI traffic.
-        if cfg.attention == "flash":
-            raise ValueError(
-                "attention='flash' does not yet compose with seq>1; use 'dense' "
-                "(the ring runs its own blockwise online-softmax core)"
-            )
+        # device and neighbor-only ICI traffic. attention="flash" swaps
+        # the ring's per-shard block core for the Pallas kernel, so the
+        # long-context path gets O(seq) memory inside each shard too.
         shifted = cfg.model.max_seq_len - 1
         if shifted % mesh.shape["seq"] != 0:
             raise ValueError(
@@ -83,7 +80,12 @@ def make_train_step(cfg: TrainConfig, mesh, p_shardings):
             )
         from tpu_bootstrap.workload.ring_attention import make_ring_attention
 
-        attn = make_ring_attention(mesh, head_axis="tensor")
+        attn = make_ring_attention(
+            mesh,
+            head_axis="tensor",
+            attention=cfg.attention,
+            block_size=cfg.attention_block,
+        )
     elif cfg.attention == "flash":
         from tpu_bootstrap.workload.flash_attention import make_flash_attn_fn
 
@@ -267,7 +269,12 @@ def worker_main() -> None:
     boot = bootstrap_from_env()
     if boot is not None and boot["num_processes"] > 1:
         jax.distributed.initialize(**boot)
-    elif os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):
+    elif os.environ.get("MEGASCALE_COORDINATOR_ADDRESS") or os.environ.get(
+        "JOB_COMPLETION_INDEX"
+    ):
+        # Not under a tpu-bootstrap JobSet but still an indexed multi-host
+        # run (plain Indexed Job on GKE): fall back to auto-discovery so
+        # each host doesn't silently train as an independent process.
         jax.distributed.initialize()
 
     steps = int(os.environ.get("WORKLOAD_STEPS", "100"))
