@@ -314,6 +314,11 @@ def main():
     # real latency to overlap.
     rtt_parallel_rate, _, rtt_parallel_p50, _ = run_config(workers=8, latency_ms=2)
     rtt_serial_rate, _, _, _ = run_config(workers=1, latency_ms=2)
+    # Scale config: 2,000 CRs (10x the headline burst) — reconciles/s must
+    # hold at one order of magnitude more objects (watch resume keeps
+    # steady-state O(events), not O(CRs)).
+    scale_rate, scale_elapsed, scale_p50, _ = run_config(
+        workers=8, n_burst=2000, k_latency=10)
 
     result = {
         "metric": "reconciles_per_sec",
@@ -334,6 +339,9 @@ def main():
         "rtt2ms_reconciles_per_sec": round(rtt_parallel_rate, 2),
         "rtt2ms_vs_serial": round(rtt_parallel_rate / rtt_serial_rate, 3),
         "rtt2ms_p50_ms": round(rtt_parallel_p50, 2),
+        "burst2000_reconciles_per_sec": round(scale_rate, 2),
+        "burst2000_elapsed_s": round(scale_elapsed, 3),
+        "burst2000_p50_ms": round(scale_p50, 2),
     }
     result.update(workload)
     print(json.dumps(result))

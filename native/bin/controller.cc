@@ -323,8 +323,14 @@ int main() {
             &stop_requested());
       } catch (const std::exception& e) {
         if (stop_requested().load()) break;
-        log_warn("watch stream failed; backing off", {{"error", e.what()}});
-        rv.clear();
+        // Transient stream failure (conn reset, timeout): resume the
+        // watch from the last seen resourceVersion — a full relist here
+        // is O(all CRs) for no reason. If that rv has expired, the server
+        // answers 410 and client.watch returns "", which IS the relist
+        // trigger (the empty-rv branch above).
+        log_warn("watch stream failed; resuming from last rv",
+                 {{"error", e.what()}, {"rv", rv}});
+        Metrics::instance().inc("watch_restarts_total");
         stop_wait_ms(2000);
       }
     }

@@ -293,6 +293,57 @@ def post_json(url, payload, ctx=None):
         return json.loads(r.read())
 
 
+def test_controller_watch_resumes_without_relist(fake):
+    """A benign stream failure (connection reset) must NOT trigger a full
+    relist: the watcher resumes from its last resourceVersion. O(all CRs)
+    relists on every hiccup don't scale past a few hundred CRs."""
+    fake.create_ub("alice", spec={})
+    port = free_port()
+    d = Daemon("tpubc-controller", controller_env(fake, port), port).wait_healthy()
+    try:
+        wait_for(lambda: fake.get(KEY_NS, "alice"), desc="initial converge")
+        assert d.metrics().get("relists_total") == 1
+
+        # Sever every live connection: the watch stream dies mid-flight,
+        # but the server stays up and history is intact.
+        fake.httpd.close_all_connections()
+        fake.create_ub("bob", spec={})
+        wait_for(lambda: fake.get(KEY_NS, "bob"), timeout=15, desc="post-sever converge")
+        # Whether the severed stream surfaced as a clean end or an error,
+        # the watcher must resume from its rv — never a full relist.
+        assert d.metrics().get("relists_total") == 1, "no relist on benign stream failure"
+    finally:
+        code, err = d.stop()
+        assert code == 0, err
+
+
+def test_controller_recovers_from_expired_resource_version(fake):
+    """410 Gone (history compacted past the watcher's rv) must trigger a
+    relist, after which reconciliation continues."""
+    port = free_port()
+    d = Daemon("tpubc-controller", controller_env(fake, port), port).wait_healthy()
+    try:
+        fake.create_ub("alice", spec={})
+        wait_for(lambda: fake.get(KEY_NS, "alice"), desc="initial converge")
+
+        # Compact ALL history (as hours of churn would), then sever the
+        # stream: the controller's reconnect rv is now behind the floor,
+        # so the server answers 410 and the only way forward is a relist.
+        with fake.store.lock:
+            fake.store.compacted_through = fake.store.rv
+            fake.store.events.clear()
+        fake.httpd.close_all_connections()
+        fake.create_ub("bob", spec={})
+
+        wait_for(lambda: fake.get(KEY_NS, "bob"), timeout=20,
+                 desc="converge after 410 recovery")
+        wait_for(lambda: d.metrics().get("relists_total", 0) >= 2,
+                 desc="410 forced a relist")
+    finally:
+        code, err = d.stop()
+        assert code == 0, err
+
+
 def test_admission_daemon_plain_http():
     port = free_port()
     d = Daemon(

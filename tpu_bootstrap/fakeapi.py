@@ -145,7 +145,7 @@ def fields_v1(paths):
 class Store:
     """Object store keyed by (api_prefix, namespace, plural) -> name -> obj."""
 
-    def __init__(self):
+    def __init__(self, event_horizon: int = 100_000):
         self.lock = threading.Condition()
         self.objects: dict[tuple, dict[str, dict]] = {}
         self.rv = 100
@@ -153,6 +153,11 @@ class Store:
         self.request_log: list[tuple[str, str]] = []
         # (coll_key, name) -> field manager -> owned leaf-path set (SSA).
         self.ownership: dict[tuple, dict[str, set]] = {}
+        # Bounded watch history, like a real apiserver/etcd: events older
+        # than the horizon are compacted away and a watch asking for a
+        # resourceVersion before the compaction floor gets 410 Gone.
+        self.event_horizon = event_horizon
+        self.compacted_through = 0  # rv of the newest discarded event
 
     def next_rv(self):
         self.rv += 1
@@ -163,6 +168,14 @@ class Store:
 
     def record_event(self, key, etype, obj):
         self.events.append((int(obj["metadata"]["resourceVersion"]), key, etype, obj))
+        # Trim with slack so the O(horizon) memmove happens once per
+        # slack-many events, not per event — all under the same store.lock
+        # every request contends on.
+        slack = max(self.event_horizon // 10, 64)
+        if len(self.events) > self.event_horizon + slack:
+            drop = len(self.events) - self.event_horizon
+            self.compacted_through = max(self.compacted_through, self.events[drop - 1][0])
+            del self.events[:drop]
         self.lock.notify_all()
 
     def upsert(self, key, name, obj, *, preserve_status=True):
@@ -402,16 +415,60 @@ class FakeKubeHandler(BaseHTTPRequestHandler):
             self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
             self.wfile.flush()
 
+        # History before the compaction floor is gone: the client cannot
+        # know what it missed and must re-list (apiserver 410 semantics,
+        # delivered as an ERROR event on the established stream).
+        with self.store.lock:
+            compacted = self.store.compacted_through
+        if since and since < compacted:
+            err = json.dumps({
+                "type": "ERROR",
+                "object": {"kind": "Status", "apiVersion": "v1", "status": "Failure",
+                           "reason": "Expired", "code": 410,
+                           "message": f"too old resource version: {since} ({compacted})"},
+            }) + "\n"
+            try:
+                write_chunk(err.encode())
+                write_chunk(b"")  # end chunked stream
+            except OSError:
+                pass
+            return
+
+        import bisect
+
         cursor = since
         try:
             while True:
                 batch = []
+                expired = False
                 with self.store.lock:
-                    for rv, ekey, etype, obj in self.store.events:
-                        if ekey == key and rv > cursor:
-                            batch.append((rv, etype, copy.deepcopy(obj)))
-                    if not batch:
-                        self.store.lock.wait(timeout=1.0)
+                    # A live-but-lagging watcher whose cursor fell behind
+                    # the compaction floor has missed events it can never
+                    # see — that is a mid-stream 410, same as at start.
+                    if cursor and cursor < self.store.compacted_through:
+                        expired = True
+                    else:
+                        # Events are append-only with increasing rv:
+                        # binary search the resume point instead of
+                        # scanning history on every wake (the fake must
+                        # not become the bottleneck at 2,000 CRs).
+                        events = self.store.events
+                        start = bisect.bisect_right(events, cursor, key=lambda e: e[0])
+                        for rv, ekey, etype, obj in events[start:]:
+                            if ekey == key:
+                                batch.append((rv, etype, copy.deepcopy(obj)))
+                        if not batch:
+                            self.store.lock.wait(timeout=1.0)
+                if expired:
+                    err = json.dumps({
+                        "type": "ERROR",
+                        "object": {"kind": "Status", "apiVersion": "v1",
+                                   "status": "Failure", "reason": "Expired", "code": 410,
+                                   "message": f"too old resource version: {cursor}"},
+                    }) + "\n"
+                    write_chunk(err.encode())
+                    write_chunk(b"")
+                    return
                 for rv, etype, obj in batch:
                     cursor = max(cursor, rv)
                     line = json.dumps({"type": etype, "object": obj}) + "\n"
@@ -564,8 +621,8 @@ class _TrackingHTTPServer(ThreadingHTTPServer):
 class FakeKube:
     """In-process fake API server handle for tests."""
 
-    def __init__(self, port: int = 0, latency_ms: float = 0):
-        self.store = Store()
+    def __init__(self, port: int = 0, latency_ms: float = 0, event_horizon: int = 100_000):
+        self.store = Store(event_horizon=event_horizon)
         self.httpd = _TrackingHTTPServer(("127.0.0.1", port), FakeKubeHandler)
         self.httpd.store = self.store  # type: ignore[attr-defined]
         self.httpd.latency_ms = latency_ms  # type: ignore[attr-defined]
