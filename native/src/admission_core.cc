@@ -229,9 +229,15 @@ Json mutate(const Json& request, const Json& config) {
     }
     SliceGeometry geom = slice_geometry(accelerator, topology);
 
+    // Multislice: N ICI-connected slices of this topology, data-parallel
+    // over DCN. The per-user ceiling applies to the TOTAL chip count.
+    int64_t slices = tpu.get_int("slices", 1);
+    if (slices < 1) return deny(request, "spec.tpu.slices must be >= 1");
+
     int64_t max_chips = config.get_int("max_chips_per_user", 0);
-    if (!username.is_admin && max_chips > 0 && geom.chips > max_chips) {
-      return deny(request, "requested slice has " + std::to_string(geom.chips) +
+    if (!username.is_admin && max_chips > 0 && geom.chips * slices > max_chips) {
+      return deny(request, "requested " + std::to_string(slices) + " slice(s) totalling " +
+                               std::to_string(geom.chips * slices) +
                                " chips, exceeding the per-user limit of " +
                                std::to_string(max_chips));
     }

@@ -178,6 +178,13 @@ Json tpu_schema() {
            {"topology", nullable_string_schema(
                             "Slice topology, e.g. \"2x2\" (v5e single host) or \"4x4x4\" "
                             "(64-chip v5p). Defaulted by the admission webhook when omitted.")},
+           {"slices", Json::object({{"description",
+                                     "Multislice: number of ICI-connected slices of this "
+                                     "topology, data-parallel over DCN (default 1). Each "
+                                     "slice is one replica of the JobSet's replicated job."},
+                                    {"nullable", true},
+                                    {"format", "int64"},
+                                    {"type", "integer"}})},
            {"image", nullable_string_schema("Container image for slice workers.")},
            {"command",
             Json::object({{"description", "Worker entrypoint override."},

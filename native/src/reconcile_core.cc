@@ -59,6 +59,8 @@ Json build_jobset(const Json& ub, const Json& config) {
   const std::string accelerator = tpu.get_string("accelerator");
   const std::string topology = tpu.get_string("topology");
   SliceGeometry geom = slice_geometry(accelerator, topology);
+  int64_t slices = tpu.get_int("slices", 1);
+  if (slices < 1) slices = 1;
 
   const std::string ns = target_namespace(ub);
   const std::string name = ns + "-slice";
@@ -81,6 +83,21 @@ Json build_jobset(const Json& ub, const Json& config) {
       Json::object({{"name", "TPUBC_NUM_HOSTS"}, {"value", std::to_string(geom.hosts)}}),
       Json::object({{"name", "TPUBC_JOBSET_NAME"}, {"value", name}}),
   });
+  if (slices > 1) {
+    // Multislice: the global process space is slices x hosts. Each child
+    // Job is one slice; JobSet stamps its index on every pod as the
+    // job-index label, surfaced here via the downward API so
+    // bootstrap_from_env can compute process_id = slice*hosts + host.
+    env.push_back(Json::object({{"name", "TPUBC_NUM_SLICES"},
+                                {"value", std::to_string(slices)}}));
+    env.push_back(Json::object({
+        {"name", "TPUBC_SLICE_ID"},
+        {"valueFrom",
+         Json::object({{"fieldRef",
+                        Json::object({{"fieldPath",
+                                       "metadata.labels['jobset.sigs.k8s.io/job-index']"}})}})},
+    }));
+  }
 
   Json container = Json::object({
       {"name", "tpu-worker"},
@@ -151,9 +168,12 @@ Json build_jobset(const Json& ub, const Json& config) {
                                    {"subdomain", name},
                                })},
                    {"failurePolicy", Json::object({{"maxRestarts", max_restarts}})},
+                   // One replica per slice: the exclusive-topology
+                   // annotation places each child job on its own
+                   // ICI-connected pool; slices talk over DCN.
                    {"replicatedJobs", Json::array({Json::object({
                         {"name", "workers"},
-                        {"replicas", 1},
+                        {"replicas", slices},
                         {"template", job_template},
                     })})},
                })},
@@ -254,9 +274,14 @@ Json slice_status(const Json& ub, const Json& observed_jobset) {
     } catch (const JsonError&) {
     }
   }
+  int64_t slices = tpu.get_int("slices", 1);
+  if (slices < 1) slices = 1;
+  // chips/hosts are TOTALS across the multislice set; per-slice geometry
+  // stays in spec.tpu.
   Json st = Json::object({
-      {"chips", chips},
-      {"hosts", hosts},
+      {"chips", chips * slices},
+      {"hosts", hosts * slices},
+      {"slices", slices},
   });
 
   // Phase ladder: Pending (no JobSet yet) -> Provisioning (JobSet exists,
@@ -271,16 +296,16 @@ Json slice_status(const Json& ub, const Json& observed_jobset) {
     provisioned = true;
     phase = "Provisioning";
 
-    // The emitted JobSet has one replicated job ("workers", replicas=1)
-    // whose single child Job runs `hosts` indexed pods. JobSet counts a
-    // child Job as ready once ready+succeeded pods reach parallelism, so
-    // every replicated job reporting ready>=replicas(=1) means the whole
-    // gang is up.
+    // The emitted JobSet has one replicated job ("workers") with one
+    // replica per slice; each child Job runs `hosts` indexed pods. JobSet
+    // counts a child Job as ready once ready+succeeded pods reach
+    // parallelism, so ready >= slices means every slice's whole gang is
+    // up.
     const Json& rjs = observed_jobset.get("status").get("replicatedJobsStatus");
     if (rjs.is_array() && rjs.size() > 0) {
       workers_ready = true;
       for (const auto& rj : rjs.items()) {
-        if (rj.get_int("ready", 0) < 1) workers_ready = false;
+        if (rj.get_int("ready", 0) < slices) workers_ready = false;
       }
     }
     if (workers_ready) phase = "Running";

@@ -285,6 +285,31 @@ def test_tpu_max_chips_limit_for_normal_users(lib):
     assert resp["allowed"] is True
 
 
+# -- multislice --------------------------------------------------------------
+
+
+def test_multislice_ceiling_counts_total_chips(lib):
+    cfg = lib.default_admission_config()
+    cfg["max_chips_per_user"] = 16
+    spec = {"tpu": {"accelerator": "tpu-v5-lite-podslice", "topology": "2x2", "slices": 4}}
+    resp = lib.mutate(req(spec=spec), cfg)
+    assert resp["allowed"] is True  # 4 slices x 4 chips = 16 <= 16
+    spec["tpu"]["slices"] = 5
+    resp = lib.mutate(req(spec=spec), cfg)
+    assert resp["allowed"] is False  # 20 > 16
+    assert "5 slice(s)" in resp["status"]["message"]
+
+
+def test_multislice_invalid_count_denied(lib):
+    resp = lib.mutate(
+        req(spec={"tpu": {"accelerator": "tpu-v5-lite-podslice", "topology": "2x2",
+                          "slices": 0}}),
+        lib.default_admission_config(),
+    )
+    assert resp["allowed"] is False
+    assert "slices" in resp["status"]["message"]
+
+
 # -- GPU device parity (BASELINE config #1) ---------------------------------
 
 

@@ -56,6 +56,22 @@ def test_bootstrap_absent_outside_jobset(lib):
     assert bootstrap_from_env({"JOB_COMPLETION_INDEX": "0"}) is None
 
 
+def test_bootstrap_multislice_process_space(lib):
+    """Multislice: process ids are slice-major (slice*hosts + host), so
+    jax.devices() comes back slice-major and the dcn mesh axis lands on
+    whole slices."""
+    js = lib.build_jobset(ub(spec={"tpu": {"accelerator": "tpu-v5p-slice",
+                                           "topology": "2x2x2", "slices": 3}}))
+    c = js["spec"]["replicatedJobs"][0]["template"]["spec"]["template"]["spec"]["containers"][0]
+    env = {e["name"]: e.get("value") for e in c["env"] if "value" in e}
+    # slice 2 (from the job-index label), host 1 (from the completion index)
+    env["TPUBC_SLICE_ID"] = "2"
+    env["JOB_COMPLETION_INDEX"] = "1"
+    boot = bootstrap_from_env(env)
+    assert boot["num_processes"] == 6  # 3 slices x 2 hosts
+    assert boot["process_id"] == 2 * 2 + 1
+
+
 WORKER_SCRIPT = """
 import os, sys
 sys.path.insert(0, {repo!r})

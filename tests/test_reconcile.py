@@ -157,6 +157,45 @@ def test_jobset_default_command_is_train_entry(lib):
     assert c["command"] == ["python", "-m", "tpu_bootstrap.workload.train"]
 
 
+def test_jobset_multislice(lib):
+    """spec.tpu.slices=4: one replicated-job replica per slice (each
+    pinned to its own ICI pool by exclusive-topology), multislice env for
+    the slice-major process space, totals in status."""
+    js = lib.build_jobset(ub(spec={"tpu": tpu_spec("tpu-v5p-slice", "2x2x2", slices=4)}))
+    job = js["spec"]["replicatedJobs"][0]
+    assert job["replicas"] == 4
+    jspec = job["template"]["spec"]
+    assert jspec["parallelism"] == 2  # hosts per slice, not total
+    c = jspec["template"]["spec"]["containers"][0]
+    env = {e["name"]: e.get("value") for e in c["env"]}
+    assert env["TPUBC_NUM_HOSTS"] == "2"
+    assert env["TPUBC_NUM_SLICES"] == "4"
+    slice_id = [e for e in c["env"] if e["name"] == "TPUBC_SLICE_ID"][0]
+    assert (slice_id["valueFrom"]["fieldRef"]["fieldPath"]
+            == "metadata.labels['jobset.sigs.k8s.io/job-index']")
+    # coordinator is slice 0 / worker 0
+    assert env["TPUBC_COORDINATOR_ADDRESS"] == "alice-slice-workers-0-0.alice-slice:8080"
+
+    # status: totals across slices; Running only when every slice's gang
+    # is ready
+    cr = ub(spec={"tpu": tpu_spec("tpu-v5p-slice", "2x2x2", slices=4, chips=8, hosts=2)})
+    obs = {"metadata": {"name": "alice-slice"},
+           "status": {"replicatedJobsStatus": [{"name": "workers", "ready": 3}]}}
+    st = lib.slice_status(cr, obs)
+    assert st["chips"] == 32 and st["hosts"] == 8 and st["slices"] == 4
+    assert st["phase"] == "Provisioning"
+    obs["status"]["replicatedJobsStatus"][0]["ready"] = 4
+    assert lib.slice_status(cr, obs)["phase"] == "Running"
+
+
+def test_jobset_single_slice_has_no_multislice_env(lib):
+    js = lib.build_jobset(ub(spec={"tpu": tpu_spec()}))
+    c = js["spec"]["replicatedJobs"][0]["template"]["spec"]["template"]["spec"]["containers"][0]
+    names = {e["name"] for e in c["env"]}
+    assert "TPUBC_NUM_SLICES" not in names
+    assert "TPUBC_SLICE_ID" not in names
+
+
 def test_jobset_image_command_and_restarts(lib):
     js = lib.build_jobset(
         ub(

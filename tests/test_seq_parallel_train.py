@@ -36,8 +36,12 @@ def run_two_steps(mesh_cfg):
         MeshConfig(data=2, seq=2, tensor=2),
         MeshConfig(data=1, fsdp=2, seq=2, tensor=2),
         MeshConfig(data=1, fsdp=1, seq=4, tensor=2),
+        # multislice: dcn (cross-slice data parallelism) composes with
+        # fsdp/tp and with the ring
+        MeshConfig(dcn=2, data=1, fsdp=2, seq=1, tensor=2),
+        MeshConfig(dcn=2, data=1, fsdp=1, seq=2, tensor=2),
     ],
-    ids=["dp-sp-tp", "fsdp-sp-tp", "sp4-tp"],
+    ids=["dp-sp-tp", "fsdp-sp-tp", "sp4-tp", "dcn-fsdp-tp", "dcn-sp-tp"],
 )
 def test_seq_parallel_matches_dense(sp_mesh):
     dense = run_two_steps(MeshConfig(data=2, fsdp=2, tensor=2))

@@ -183,7 +183,7 @@ def _ring_attention_local_flash(q, k, v, *, axis_name: str, n_shards: int,
 def make_ring_attention(
     mesh: Mesh,
     seq_axis: str = "seq",
-    batch_axes=("data", "fsdp"),
+    batch_axes=("dcn", "data", "fsdp"),
     head_axis: str | None = None,
     attention: str = "dense",
     block_size: int = 128,

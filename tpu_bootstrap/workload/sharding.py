@@ -4,7 +4,12 @@ The scaling-book recipe, applied: pick a mesh, annotate shardings on params
 and batch, let XLA insert the collectives, and keep them on ICI.
 
 Mesh axes:
-* ``data``   — pure data parallelism (gradient all-reduce).
+* ``dcn``    — data parallelism ACROSS slices (multislice): the only
+               collective that crosses the data-center network is the
+               per-step gradient all-reduce, which is exactly what DCN
+               bandwidth tolerates. Params/optimizer state replicated
+               along it.
+* ``data``   — pure data parallelism (gradient all-reduce) within a slice.
 * ``fsdp``   — data parallelism with parameters sharded along it
                (ZeRO-3 style: XLA all-gathers params per layer and
                reduce-scatters grads).
@@ -17,8 +22,9 @@ Mesh axes:
 For a GKE slice these axes map onto the physical topology so that `tensor`
 (highest-bandwidth, per-step all-reduces) rides intra-host ICI, `seq`
 (neighbor-only ring hops) and `fsdp` the slice's remaining ICI dims, and
-`data` may span slices over DCN — the mesh-axis ordering below encodes
-that priority.
+``dcn``/``data`` span slices over DCN — the mesh-axis ordering below
+(slowest network outermost) encodes that priority, matching
+mesh_utils.create_hybrid_device_mesh's convention.
 """
 
 from __future__ import annotations
@@ -34,6 +40,7 @@ from tpu_bootstrap.workload.model import ModelConfig, Params
 
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
+    dcn: int = 1  # slices (multislice data parallelism over DCN)
     data: int = 1
     fsdp: int = 1
     seq: int = 1
@@ -41,7 +48,7 @@ class MeshConfig:
 
     @property
     def size(self) -> int:
-        return self.data * self.fsdp * self.seq * self.tensor
+        return self.dcn * self.data * self.fsdp * self.seq * self.tensor
 
     @staticmethod
     def for_device_count(n: int) -> "MeshConfig":
@@ -58,11 +65,16 @@ class MeshConfig:
 
 
 def build_mesh(cfg: MeshConfig, devices=None) -> Mesh:
+    """dcn is the outermost (slowest-network) axis: with the device list
+    ordered slice-major — which jax.devices() is on GKE multislice (hosts
+    of slice 0 first) — reshaping puts whole slices into dcn rows, so
+    every other axis's collectives stay on ICI."""
     devices = devices if devices is not None else jax.devices()
     if len(devices) < cfg.size:
         raise ValueError(f"mesh needs {cfg.size} devices, have {len(devices)}")
-    grid = np.array(devices[: cfg.size]).reshape(cfg.data, cfg.fsdp, cfg.seq, cfg.tensor)
-    return Mesh(grid, ("data", "fsdp", "seq", "tensor"))
+    grid = np.array(devices[: cfg.size]).reshape(
+        cfg.dcn, cfg.data, cfg.fsdp, cfg.seq, cfg.tensor)
+    return Mesh(grid, ("dcn", "data", "fsdp", "seq", "tensor"))
 
 
 def param_shardings(mesh: Mesh, params: Params):
@@ -114,13 +126,13 @@ def param_shardings(mesh: Mesh, params: Params):
 
 
 def batch_shardings(mesh: Mesh) -> NamedSharding:
-    """Tokens: batch over both data-parallel axes. The raw token sequence
-    stays unsharded — its length (max_seq_len) is one more than the
-    activation length after loss_fn's shift, so it cannot tile evenly over
-    the seq axis; with seq>1 the ring-attention shard_map boundary pins
-    the activation sharding and GSPMD inserts the (tiny, int32) reshard of
-    the embedded tokens."""
-    return NamedSharding(mesh, P(("data", "fsdp"), None))
+    """Tokens: batch over every data-parallel axis (dcn slices included).
+    The raw token sequence stays unsharded — its length (max_seq_len) is
+    one more than the activation length after loss_fn's shift, so it
+    cannot tile evenly over the seq axis; with seq>1 the ring-attention
+    shard_map boundary pins the activation sharding and GSPMD inserts the
+    (tiny, int32) reshard of the embedded tokens."""
+    return NamedSharding(mesh, P(("dcn", "data", "fsdp"), None))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

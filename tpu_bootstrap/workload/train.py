@@ -94,7 +94,7 @@ def make_train_step(cfg: TrainConfig, mesh, p_shardings):
         # the Pallas kernel on its local shard. Without this, GSPMD has no
         # partitioning rule for pallas_call and would all-gather q/k/v and
         # run the kernel fully replicated.
-        spec = P(("data", "fsdp"), None, "tensor", None)
+        spec = P(("dcn", "data", "fsdp"), None, "tensor", None)
         attn = jax.shard_map(
             make_flash_attn_fn(block_size=cfg.attention_block),
             mesh=mesh,
@@ -116,7 +116,8 @@ def make_train_step(cfg: TrainConfig, mesh, p_shardings):
     # can be pinned to the seq axis; resharding a few int32 tokens is
     # cheap, whereas leaving the boundary to GSPMD made it rematerialize
     # full f32 activations at the ring's shard_map edge.
-    shifted_sharding = NamedSharding(mesh, P(("data", "fsdp"), "seq" if seq_parallel else None))
+    shifted_sharding = NamedSharding(
+        mesh, P(("dcn", "data", "fsdp"), "seq" if seq_parallel else None))
 
     def step(params, opt_state, tokens):
         inputs = jax.lax.with_sharding_constraint(tokens[:, :-1], shifted_sharding)
@@ -137,7 +138,7 @@ def make_train_step(cfg: TrainConfig, mesh, p_shardings):
 def synthetic_batch(cfg: TrainConfig, step_index: int, seed: int = 0):
     """Deterministic per-step token batch: resume from a checkpoint sees
     exactly the data an uninterrupted run would have seen."""
-    batch = max(2 * cfg.mesh.data * cfg.mesh.fsdp, 2)
+    batch = max(2 * cfg.mesh.dcn * cfg.mesh.data * cfg.mesh.fsdp, 2)
     return jax.random.randint(
         jax.random.PRNGKey(seed * 1_000_003 + step_index),
         (batch, cfg.model.max_seq_len), 0, cfg.model.vocab_size,
@@ -216,7 +217,7 @@ def run_demo(num_devices: int | None = None, steps: int = 2, seed: int = 0):
     params, opt_state, p_shardings = init_train_state(cfg, mesh, key)
     train_step = make_train_step(cfg, mesh, p_shardings)
 
-    batch = max(cfg.mesh.data * cfg.mesh.fsdp, 2)
+    batch = max(cfg.mesh.dcn * cfg.mesh.data * cfg.mesh.fsdp, 2)
     tokens = jax.random.randint(
         jax.random.PRNGKey(seed + 1), (batch, cfg.model.max_seq_len), 0, cfg.model.vocab_size
     )
@@ -233,24 +234,35 @@ def bootstrap_from_env(environ=None) -> dict | None:
     """Multi-host rendezvous parameters from the env the controller's
     emitted JobSet injects (native/src/reconcile_core.cc build_jobset):
 
-      TPUBC_COORDINATOR_ADDRESS  worker 0's stable headless-service DNS
-                                 name + coordinator port
-      TPUBC_NUM_HOSTS            slice host count (JobSet parallelism)
-      JOB_COMPLETION_INDEX       this host's index, injected automatically
-                                 by the Indexed child Job
+      TPUBC_COORDINATOR_ADDRESS  slice 0 / worker 0's stable
+                                 headless-service DNS name + port
+      TPUBC_NUM_HOSTS            hosts per slice (Job parallelism)
+      TPUBC_NUM_SLICES           multislice count (absent/1 = one slice)
+      TPUBC_SLICE_ID             this pod's slice, from the JobSet
+                                 job-index label via the downward API
+      JOB_COMPLETION_INDEX       this host's index within its slice,
+                                 injected automatically by the Indexed
+                                 child Job
 
-    Returns jax.distributed.initialize kwargs, or None when not running
-    under a tpu-bootstrap JobSet (single-host dev runs, pytest)."""
+    The global process space is slices x hosts, slice-major — matching
+    build_mesh's expectation that jax.devices() comes back slice-major so
+    the dcn mesh axis lands on whole slices. Returns
+    jax.distributed.initialize kwargs, or None when not running under a
+    tpu-bootstrap JobSet (single-host dev runs, pytest)."""
     import os
 
     env = os.environ if environ is None else environ
     addr = env.get("TPUBC_COORDINATOR_ADDRESS")
     if not addr:
         return None
+    hosts = int(env.get("TPUBC_NUM_HOSTS", "1"))
+    slices = int(env.get("TPUBC_NUM_SLICES", "1"))
+    slice_id = int(env.get("TPUBC_SLICE_ID", "0"))
+    host_id = int(env.get("JOB_COMPLETION_INDEX", "0"))
     return {
         "coordinator_address": addr,
-        "num_processes": int(env.get("TPUBC_NUM_HOSTS", "1")),
-        "process_id": int(env.get("JOB_COMPLETION_INDEX", "0")),
+        "num_processes": hosts * slices,
+        "process_id": slice_id * hosts + host_id,
     }
 
 
