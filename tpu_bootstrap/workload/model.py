@@ -26,6 +26,8 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from tpu_bootstrap.workload.moe import moe_mlp
+
 Params = dict[str, Any]
 
 
@@ -39,6 +41,13 @@ class ModelConfig:
     mlp_dim: int = 256
     max_seq_len: int = 128
     compute_dtype: Any = jnp.float32
+    # Mixture of experts: num_experts == 0 keeps the dense MLP; > 0 swaps
+    # every block's FFN for a top-k routed expert layer (workload/moe.py),
+    # shardable over the `expert` mesh axis.
+    num_experts: int = 0
+    expert_top_k: int = 2
+    expert_capacity_factor: float = 2.0
+    moe_aux_coef: float = 0.01
 
     @property
     def qkv_dim(self) -> int:
@@ -47,7 +56,7 @@ class ModelConfig:
 
 def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
     """Initialize float32 params as a nested pytree."""
-    keys = iter(jax.random.split(key, 4 + 6 * cfg.num_layers))
+    keys = iter(jax.random.split(key, 4 + 8 * cfg.num_layers))
 
     def dense(key, shape, scale=None):
         fan_in = shape[0] if scale is None else scale
@@ -59,19 +68,28 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
         "blocks": [],
     }
     for _ in range(cfg.num_layers):
-        params["blocks"].append(
-            {
-                "attn_norm": jnp.ones((cfg.embed_dim,), jnp.float32),
-                # (embed, heads, head_dim): heads axis shardable over `tensor`
-                "wq": dense(next(keys), (cfg.embed_dim, cfg.num_heads, cfg.head_dim), cfg.embed_dim),
-                "wk": dense(next(keys), (cfg.embed_dim, cfg.num_heads, cfg.head_dim), cfg.embed_dim),
-                "wv": dense(next(keys), (cfg.embed_dim, cfg.num_heads, cfg.head_dim), cfg.embed_dim),
-                "wo": dense(next(keys), (cfg.num_heads, cfg.head_dim, cfg.embed_dim), cfg.qkv_dim),
-                "mlp_norm": jnp.ones((cfg.embed_dim,), jnp.float32),
-                "w_up": dense(next(keys), (cfg.embed_dim, cfg.mlp_dim), cfg.embed_dim),
-                "w_down": dense(next(keys), (cfg.mlp_dim, cfg.embed_dim), cfg.mlp_dim),
-            }
-        )
+        block = {
+            "attn_norm": jnp.ones((cfg.embed_dim,), jnp.float32),
+            # (embed, heads, head_dim): heads axis shardable over `tensor`
+            "wq": dense(next(keys), (cfg.embed_dim, cfg.num_heads, cfg.head_dim), cfg.embed_dim),
+            "wk": dense(next(keys), (cfg.embed_dim, cfg.num_heads, cfg.head_dim), cfg.embed_dim),
+            "wv": dense(next(keys), (cfg.embed_dim, cfg.num_heads, cfg.head_dim), cfg.embed_dim),
+            "wo": dense(next(keys), (cfg.num_heads, cfg.head_dim, cfg.embed_dim), cfg.qkv_dim),
+            "mlp_norm": jnp.ones((cfg.embed_dim,), jnp.float32),
+        }
+        if cfg.num_experts > 0:
+            # Expert-stacked FFN weights: leading E axis shards over the
+            # `expert` mesh axis (sharding.py).
+            block["router"] = dense(
+                next(keys), (cfg.embed_dim, cfg.num_experts), cfg.embed_dim)
+            block["w_up"] = dense(
+                next(keys), (cfg.num_experts, cfg.embed_dim, cfg.mlp_dim), cfg.embed_dim)
+            block["w_down"] = dense(
+                next(keys), (cfg.num_experts, cfg.mlp_dim, cfg.embed_dim), cfg.mlp_dim)
+        else:
+            block["w_up"] = dense(next(keys), (cfg.embed_dim, cfg.mlp_dim), cfg.embed_dim)
+            block["w_down"] = dense(next(keys), (cfg.mlp_dim, cfg.embed_dim), cfg.mlp_dim)
+        params["blocks"].append(block)
     return params
 
 
@@ -135,30 +153,52 @@ def _mlp(block: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     return jnp.einsum("bsm,me->bse", h, block["w_down"].astype(dtype))
 
 
-def forward(params: Params, tokens: jax.Array, cfg: ModelConfig, attn_fn=None) -> jax.Array:
-    """tokens (batch, seq) int32 -> logits (batch, seq, vocab)."""
+def forward_with_aux(params: Params, tokens: jax.Array, cfg: ModelConfig,
+                     attn_fn=None) -> tuple[jax.Array, jax.Array]:
+    """tokens (batch, seq) int32 -> (logits (batch, seq, vocab), aux).
+
+    ``aux`` is the mean MoE load-balancing loss over blocks (0.0 for the
+    dense model) — kept separate from the logits so the dense-path API
+    (``forward``) stays unchanged."""
     dtype = cfg.compute_dtype
     x = params["embed"].astype(dtype)[tokens]
+    aux = jnp.zeros((), jnp.float32)
     for block in params["blocks"]:
         x = x + _attention(block, x, cfg, attn_fn)
-        x = x + _mlp(block, x, cfg)
+        if cfg.num_experts > 0:
+            h = _rms_norm(x, block["mlp_norm"])
+            out, aux_b = moe_mlp(block, h, cfg)
+            x = x + out
+            aux = aux + aux_b / len(params["blocks"])
+        else:
+            x = x + _mlp(block, x, cfg)
     x = _rms_norm(x, params["final_norm"])
     # logits in float32 for a numerically stable softmax/xent
-    return jnp.einsum("bse,ve->bsv", x.astype(jnp.float32), params["embed"])
+    logits = jnp.einsum("bse,ve->bsv", x.astype(jnp.float32), params["embed"])
+    return logits, aux
+
+
+def forward(params: Params, tokens: jax.Array, cfg: ModelConfig, attn_fn=None) -> jax.Array:
+    """tokens (batch, seq) int32 -> logits (batch, seq, vocab)."""
+    return forward_with_aux(params, tokens, cfg, attn_fn)[0]
 
 
 def loss_from_inputs(params: Params, inputs: jax.Array, targets: jax.Array,
                      cfg: ModelConfig, attn_fn=None) -> jax.Array:
-    """Cross-entropy of ``targets`` under the model run on ``inputs``.
+    """Cross-entropy of ``targets`` under the model run on ``inputs``,
+    plus the scaled MoE load-balancing aux loss when experts are enabled.
 
     Split out from loss_fn so the train step can shift tokens itself and
     pin shardings on the shifted int32 arrays (sequence parallelism needs
     inputs/targets sharded over the seq axis; the unshifted tokens are one
     element too long to tile)."""
-    logits = forward(params, inputs, cfg, attn_fn)
+    logits, aux = forward_with_aux(params, inputs, cfg, attn_fn)
     logprobs = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+    loss = jnp.mean(nll)
+    if cfg.num_experts > 0:
+        loss = loss + cfg.moe_aux_coef * aux
+    return loss
 
 
 def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig, attn_fn=None) -> jax.Array:

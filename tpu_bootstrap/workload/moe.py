@@ -1,0 +1,112 @@
+"""Mixture-of-experts MLP with expert parallelism — the ``expert`` mesh
+axis of the slice workload.
+
+TPU-first design: the whole layer is three einsums plus a static-shape
+dispatch, no scatter/gather and no data-dependent shapes, so XLA tiles
+every FLOP onto the MXU and GSPMD inserts the expert all-to-all on its
+own. The dispatch follows the GShard/Switch formulation:
+
+* The router scores every token against every expert (one matmul), takes
+  the top-k experts per token, and renormalizes their gates.
+* Each expert has a fixed **capacity** C = ceil(k * S / E * cf) slots per
+  batch row. Tokens claim slots in priority order (all 1st choices in
+  sequence order, then all 2nd choices...) via a cumsum over a one-hot
+  mask — pure vector math, static shapes. Tokens that overflow an
+  expert's capacity are *dropped* for that expert (their combine weight
+  is zero) and ride the residual connection instead, which bounds both
+  memory and compute per step no matter how unbalanced the router gets.
+* ``dispatch`` (B, S, E, C) one-hot routes token activations into a
+  dense (E, B, C, M) expert batch; every expert runs the same two-matmul
+  FFN on its C-slot batch; ``combine`` (B, S, E, C) carries the gate
+  weights back. einsum in, einsum out — the "sparse" layer is dense
+  linear algebra end to end.
+
+Sharding: expert weights are sharded over the ``expert`` mesh axis
+(sharding.py: P("expert", "fsdp", "tensor")); activations are
+batch-sharded over the data axes *including* ``expert`` (the expert axis
+does double duty as a data axis everywhere outside this layer, so no
+chip idles during attention). GSPMD turns the (B-sharded -> E-sharded)
+boundary around the expert FFN into exactly the all-to-all pair a
+hand-written MoE would use, riding ICI.
+
+The auxiliary load-balancing loss is the Switch Transformer one:
+``E * sum_e f_e * p_e`` where f_e is the fraction of tokens whose top-1
+choice is e and p_e the mean router probability of e; 1.0 == perfectly
+balanced. model.loss adds it scaled by ``moe_aux_coef``.
+
+Reference parity note: the reference (bacchus-gpu-controller) has no
+compute path (SURVEY.md §2); this module extends the JAX workload its
+JobSets launch with the expert-parallel axis the TPU build treats as
+first-class.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def expert_capacity(seq: int, num_experts: int, top_k: int,
+                    capacity_factor: float) -> int:
+    """Slots per expert per batch row. Static Python arithmetic — shapes
+    under jit must not depend on traced values."""
+    return max(1, math.ceil(seq * top_k / num_experts * capacity_factor))
+
+
+def moe_mlp(block, h, cfg):
+    """Top-k MoE FFN over pre-normalized activations.
+
+    block: {"router": (M, E), "w_up": (E, M, F), "w_down": (E, F, M)}
+    h: (B, S, M) — already RMS-normed by the caller (same contract as the
+    dense MLP: norm, then project).
+    Returns (out (B, S, M), aux_loss scalar f32).
+    """
+    dtype = cfg.compute_dtype
+    E, k = cfg.num_experts, cfg.expert_top_k
+    if not 1 <= k <= E:
+        raise ValueError(f"expert_top_k must be in [1, num_experts], got {k}/{E}")
+    B, S, M = h.shape
+    C = expert_capacity(S, E, k, cfg.expert_capacity_factor)
+
+    # Router in float32: tiny matmul, and gate renormalization is exactly
+    # the kind of arithmetic bf16 mangles.
+    logits = jnp.einsum("bsm,me->bse", h.astype(jnp.float32),
+                        block["router"].astype(jnp.float32))
+    gates = jax.nn.softmax(logits, axis=-1)  # (B, S, E)
+    gate_k, idx_k = lax.top_k(gates, k)  # (B, S, k)
+    gate_k = gate_k / jnp.sum(gate_k, axis=-1, keepdims=True)
+
+    # Slot assignment. Priority: choice rank first, then sequence order —
+    # every token's 1st choice beats any token's 2nd choice, so a single
+    # cumsum over the (k*S) flattened axis hands out 0-based slots.
+    mask = jax.nn.one_hot(idx_k, E, dtype=jnp.float32)  # (B, S, k, E)
+    flat = mask.transpose(0, 2, 1, 3).reshape(B, k * S, E)
+    pos = jnp.cumsum(flat, axis=1) - 1.0  # slot index where assigned
+    keep = (pos < C) & (flat > 0)  # overflow -> dropped
+    disp = jax.nn.one_hot(pos.astype(jnp.int32), C, dtype=jnp.float32)
+    disp = disp * keep[..., None].astype(jnp.float32)  # (B, kS, E, C)
+    disp = disp.reshape(B, k, S, E, C).transpose(0, 2, 1, 3, 4)  # (B,S,k,E,C)
+    combine = jnp.sum(disp * gate_k[..., None, None].astype(jnp.float32), axis=2)
+    dispatch = jnp.sum(disp, axis=2)  # (B, S, E, C) 0/1
+
+    # Expert FFN on the dense (E, B, C, M) batch. The E axis is sharded
+    # over the expert mesh axis (weights pin it), B over the data axes:
+    # GSPMD materializes the all-to-all at this boundary.
+    expert_in = jnp.einsum("bsec,bsm->ebcm", dispatch.astype(dtype), h)
+    hidden = jnp.einsum("ebcm,emf->ebcf", expert_in, block["w_up"].astype(dtype))
+    hidden = jax.nn.gelu(hidden)
+    expert_out = jnp.einsum("ebcf,efm->ebcm", hidden, block["w_down"].astype(dtype))
+    out = jnp.einsum("bsec,ebcm->bsm", combine.astype(dtype), expert_out)
+
+    # Switch-style load-balancing aux loss on top-1 assignments.
+    top1 = mask[:, :, 0]  # (B, S, E)
+    f = jnp.mean(top1, axis=(0, 1))  # fraction routed to each expert
+    p = jnp.mean(gates, axis=(0, 1))  # mean router prob per expert
+    aux = E * jnp.sum(f * p)
+    return out, aux
+
+
+__all__ = ["moe_mlp", "expert_capacity"]

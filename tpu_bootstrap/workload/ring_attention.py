@@ -183,7 +183,7 @@ def _ring_attention_local_flash(q, k, v, *, axis_name: str, n_shards: int,
 def make_ring_attention(
     mesh: Mesh,
     seq_axis: str = "seq",
-    batch_axes=("dcn", "data", "fsdp"),
+    batch_axes=None,
     head_axis: str | None = None,
     attention: str = "dense",
     block_size: int = 128,
@@ -203,6 +203,10 @@ def make_ring_attention(
     """
     if attention not in ("dense", "flash"):
         raise ValueError(f"unknown attention {attention!r}")
+    if batch_axes is None:
+        from tpu_bootstrap.workload.sharding import BATCH_AXES
+
+        batch_axes = BATCH_AXES  # the one authoritative batch-axis list
     batch_axes = tuple(a for a in batch_axes if a in mesh.axis_names)
     if head_axis is not None and head_axis not in mesh.axis_names:
         head_axis = None

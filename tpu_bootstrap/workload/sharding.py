@@ -43,12 +43,13 @@ class MeshConfig:
     dcn: int = 1  # slices (multislice data parallelism over DCN)
     data: int = 1
     fsdp: int = 1
+    expert: int = 1  # expert parallelism (MoE); doubles as a data axis
     seq: int = 1
     tensor: int = 1
 
     @property
     def size(self) -> int:
-        return self.dcn * self.data * self.fsdp * self.seq * self.tensor
+        return self.dcn * self.data * self.fsdp * self.expert * self.seq * self.tensor
 
     @staticmethod
     def for_device_count(n: int) -> "MeshConfig":
@@ -73,8 +74,8 @@ def build_mesh(cfg: MeshConfig, devices=None) -> Mesh:
     if len(devices) < cfg.size:
         raise ValueError(f"mesh needs {cfg.size} devices, have {len(devices)}")
     grid = np.array(devices[: cfg.size]).reshape(
-        cfg.dcn, cfg.data, cfg.fsdp, cfg.seq, cfg.tensor)
-    return Mesh(grid, ("dcn", "data", "fsdp", "seq", "tensor"))
+        cfg.dcn, cfg.data, cfg.fsdp, cfg.expert, cfg.seq, cfg.tensor)
+    return Mesh(grid, ("dcn", "data", "fsdp", "expert", "seq", "tensor"))
 
 
 def param_shardings(mesh: Mesh, params: Params):
@@ -109,10 +110,13 @@ def param_shardings(mesh: Mesh, params: Params):
             return P("fsdp", "tensor", None)
         if path.endswith("wo"):
             return P("tensor", None, "fsdp")
+        if path.endswith("router"):
+            return P("fsdp", None)
         if path.endswith("w_up"):
-            return P("fsdp", "tensor")
+            # 3-D: expert-stacked (E, embed, mlp) — E over the expert axis
+            return P("expert", "fsdp", "tensor") if ndim == 3 else P("fsdp", "tensor")
         if path.endswith("w_down"):
-            return P("tensor", "fsdp")
+            return P("expert", "tensor", "fsdp") if ndim == 3 else P("tensor", "fsdp")
         return P(*([None] * ndim))  # norms: replicated
 
     def walk(tree, path=""):
@@ -125,14 +129,19 @@ def param_shardings(mesh: Mesh, params: Params):
     return walk(params)
 
 
+BATCH_AXES = ("dcn", "data", "fsdp", "expert")
+
+
 def batch_shardings(mesh: Mesh) -> NamedSharding:
-    """Tokens: batch over every data-parallel axis (dcn slices included).
+    """Tokens: batch over every data-parallel axis (dcn slices and the
+    expert axis included — outside the MoE layer the expert axis is just
+    more data parallelism, so no chip idles during attention).
     The raw token sequence stays unsharded — its length (max_seq_len) is
     one more than the activation length after loss_fn's shift, so it
     cannot tile evenly over the seq axis; with seq>1 the ring-attention
     shard_map boundary pins the activation sharding and GSPMD inserts the
     (tiny, int32) reshard of the embedded tokens."""
-    return NamedSharding(mesh, P(("dcn", "data", "fsdp"), None))
+    return NamedSharding(mesh, P(BATCH_AXES, None))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
@@ -144,6 +153,7 @@ def shard_params(params: Params, shardings) -> Params:
 
 
 __all__ = [
+    "BATCH_AXES",
     "MeshConfig",
     "build_mesh",
     "param_shardings",

@@ -19,6 +19,7 @@ import optax
 
 from tpu_bootstrap.workload.model import ModelConfig, init_params, loss_from_inputs
 from tpu_bootstrap.workload.sharding import (
+    BATCH_AXES,
     MeshConfig,
     batch_shardings,
     build_mesh,
@@ -82,6 +83,7 @@ def make_train_step(cfg: TrainConfig, mesh, p_shardings):
 
         attn = make_ring_attention(
             mesh,
+            batch_axes=BATCH_AXES,
             head_axis="tensor",
             attention=cfg.attention,
             block_size=cfg.attention_block,
@@ -94,7 +96,7 @@ def make_train_step(cfg: TrainConfig, mesh, p_shardings):
         # the Pallas kernel on its local shard. Without this, GSPMD has no
         # partitioning rule for pallas_call and would all-gather q/k/v and
         # run the kernel fully replicated.
-        spec = P(("dcn", "data", "fsdp"), None, "tensor", None)
+        spec = P(BATCH_AXES, None, "tensor", None)
         attn = jax.shard_map(
             make_flash_attn_fn(block_size=cfg.attention_block),
             mesh=mesh,
@@ -117,7 +119,7 @@ def make_train_step(cfg: TrainConfig, mesh, p_shardings):
     # cheap, whereas leaving the boundary to GSPMD made it rematerialize
     # full f32 activations at the ring's shard_map edge.
     shifted_sharding = NamedSharding(
-        mesh, P(("dcn", "data", "fsdp"), "seq" if seq_parallel else None))
+        mesh, P(BATCH_AXES, "seq" if seq_parallel else None))
 
     def step(params, opt_state, tokens):
         inputs = jax.lax.with_sharding_constraint(tokens[:, :-1], shifted_sharding)
@@ -138,7 +140,7 @@ def make_train_step(cfg: TrainConfig, mesh, p_shardings):
 def synthetic_batch(cfg: TrainConfig, step_index: int, seed: int = 0):
     """Deterministic per-step token batch: resume from a checkpoint sees
     exactly the data an uninterrupted run would have seen."""
-    batch = max(2 * cfg.mesh.dcn * cfg.mesh.data * cfg.mesh.fsdp, 2)
+    batch = max(2 * cfg.mesh.dcn * cfg.mesh.data * cfg.mesh.fsdp * cfg.mesh.expert, 2)
     return jax.random.randint(
         jax.random.PRNGKey(seed * 1_000_003 + step_index),
         (batch, cfg.model.max_seq_len), 0, cfg.model.vocab_size,
@@ -217,7 +219,7 @@ def run_demo(num_devices: int | None = None, steps: int = 2, seed: int = 0):
     params, opt_state, p_shardings = init_train_state(cfg, mesh, key)
     train_step = make_train_step(cfg, mesh, p_shardings)
 
-    batch = max(cfg.mesh.dcn * cfg.mesh.data * cfg.mesh.fsdp, 2)
+    batch = max(cfg.mesh.dcn * cfg.mesh.data * cfg.mesh.fsdp * cfg.mesh.expert, 2)
     tokens = jax.random.randint(
         jax.random.PRNGKey(seed + 1), (batch, cfg.model.max_seq_len), 0, cfg.model.vocab_size
     )
