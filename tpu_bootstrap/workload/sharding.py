@@ -41,6 +41,7 @@ from tpu_bootstrap.workload.model import ModelConfig, Params
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
     dcn: int = 1  # slices (multislice data parallelism over DCN)
+    pipe: int = 1  # pipeline stages (GPipe schedule, workload/pipeline.py)
     data: int = 1
     fsdp: int = 1
     expert: int = 1  # expert parallelism (MoE); doubles as a data axis
@@ -49,7 +50,8 @@ class MeshConfig:
 
     @property
     def size(self) -> int:
-        return self.dcn * self.data * self.fsdp * self.expert * self.seq * self.tensor
+        return (self.dcn * self.pipe * self.data * self.fsdp * self.expert
+                * self.seq * self.tensor)
 
     @staticmethod
     def for_device_count(n: int) -> "MeshConfig":
@@ -74,8 +76,8 @@ def build_mesh(cfg: MeshConfig, devices=None) -> Mesh:
     if len(devices) < cfg.size:
         raise ValueError(f"mesh needs {cfg.size} devices, have {len(devices)}")
     grid = np.array(devices[: cfg.size]).reshape(
-        cfg.dcn, cfg.data, cfg.fsdp, cfg.expert, cfg.seq, cfg.tensor)
-    return Mesh(grid, ("dcn", "data", "fsdp", "expert", "seq", "tensor"))
+        cfg.dcn, cfg.pipe, cfg.data, cfg.fsdp, cfg.expert, cfg.seq, cfg.tensor)
+    return Mesh(grid, ("dcn", "pipe", "data", "fsdp", "expert", "seq", "tensor"))
 
 
 def param_shardings(mesh: Mesh, params: Params):
@@ -119,11 +121,20 @@ def param_shardings(mesh: Mesh, params: Params):
             return P("expert", "tensor", "fsdp") if ndim == 3 else P("tensor", "fsdp")
         return P(*([None] * ndim))  # norms: replicated
 
+    # Pipeline layout: params["blocks"] is a dict of stacked leaves with a
+    # leading (num_layers,) axis instead of a list of per-block dicts —
+    # shard that axis over `pipe` so each stage holds only its layers (the
+    # spec for the remaining dims is the per-block rule; pipeline.py
+    # restricts tensor/fsdp to 1 so those axis names are inert there).
+    stacked = isinstance(params.get("blocks"), dict) if isinstance(params, dict) else False
+
     def walk(tree, path=""):
         if isinstance(tree, dict):
             return {k: walk(v, f"{path}/{k}") for k, v in tree.items()}
         if isinstance(tree, list):
             return [walk(v, path) for v in tree]
+        if stacked and path.startswith("/blocks"):
+            return NamedSharding(mesh, P("pipe", *spec_for(path, tree.ndim - 1)))
         return NamedSharding(mesh, spec_for(path, tree.ndim))
 
     return walk(params)

@@ -10,7 +10,6 @@ and ``tensor``). No hand-written pmap/collectives anywhere.
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
 from typing import Any
 
 import jax
@@ -38,18 +37,46 @@ class TrainConfig:
     # Pallas kernel, O(seq) memory — see workload/flash_attention.py).
     attention: str = "dense"
     attention_block: int = 128
+    # Microbatches per step when mesh.pipe > 1 (0 = 2x the stage count,
+    # halving the pipeline bubble vs M == stages).
+    num_microbatches: int = 0
 
 
 def make_optimizer(cfg: TrainConfig):
     return optax.adamw(cfg.learning_rate)
 
 
+def _init_params_for_mesh(cfg: TrainConfig):
+    """key -> params in the layout the mesh requires: plain blocks-list,
+    or pipe-stacked blocks (leading num_layers axis over the `pipe` mesh
+    axis) when pipelined. Shared by fresh init AND the checkpoint-resume
+    abstract-state path so both always agree on the pytree structure."""
+
+    def init(key):
+        params = init_params(cfg.model, key)
+        if cfg.mesh.pipe > 1:
+            from tpu_bootstrap.workload.pipeline import stack_block_params
+
+            if cfg.model.num_layers % cfg.mesh.pipe != 0:
+                raise ValueError(
+                    f"num_layers ({cfg.model.num_layers}) must divide evenly over "
+                    f"pipe stages ({cfg.mesh.pipe})")
+            params = {**params, "blocks": stack_block_params(params["blocks"])}
+        return params
+
+    return init
+
+
 def init_train_state(cfg: TrainConfig, mesh, key: jax.Array):
     """Params + optimizer state, laid out onto the mesh at init time so no
     full replica ever materializes on one device. Optimizer moments are
     pytrees of the same shapes as params, so they inherit the param
-    shardings through opt.init's output."""
-    params = init_params(cfg.model, key)
+    shardings through opt.init's output.
+
+    With mesh.pipe > 1 the block params are stacked (leading num_layers
+    axis, sharded over `pipe`) so each stage holds only its layers — see
+    workload/pipeline.py."""
+    params = _init_params_for_mesh(cfg)(key)
     p_shardings = param_shardings(mesh, params)
     params = jax.tree.map(jax.device_put, params, p_shardings)
     opt_state = make_optimizer(cfg).init(params)
@@ -64,7 +91,20 @@ def make_train_step(cfg: TrainConfig, mesh, p_shardings):
         raise ValueError(f"unknown attention {cfg.attention!r}")
     opt = make_optimizer(cfg)
     seq_parallel = mesh.shape["seq"] > 1
-    if seq_parallel:
+    pipelined = mesh.shape["pipe"] > 1
+    if pipelined:
+        if cfg.attention != "dense":
+            raise ValueError(
+                "pipeline parallelism currently supports attention='dense' "
+                "(the flash shard_map and the ring cannot nest inside the "
+                "pipeline shard_map yet)")
+        from tpu_bootstrap.workload.pipeline import make_pipeline_loss
+
+        microbatches = cfg.num_microbatches or 2 * mesh.shape["pipe"]
+        loss = make_pipeline_loss(cfg, mesh, num_microbatches=microbatches,
+                                  remat=cfg.remat)
+        attn = None
+    elif seq_parallel:
         # Sequence (context) parallelism: activations are sharded along
         # the sequence axis, so attention must see every earlier KV shard
         # — the ppermute ring provides that with O(seq/n) memory per
@@ -83,7 +123,6 @@ def make_train_step(cfg: TrainConfig, mesh, p_shardings):
 
         attn = make_ring_attention(
             mesh,
-            batch_axes=BATCH_AXES,
             head_axis="tensor",
             attention=cfg.attention,
             block_size=cfg.attention_block,
@@ -107,11 +146,12 @@ def make_train_step(cfg: TrainConfig, mesh, p_shardings):
     else:
         attn = None
 
-    def loss(params, inputs, targets):
-        return loss_from_inputs(params, inputs, targets, cfg.model, attn_fn=attn)
+    if not pipelined:
+        def loss(params, inputs, targets):
+            return loss_from_inputs(params, inputs, targets, cfg.model, attn_fn=attn)
 
-    if cfg.remat:
-        loss = jax.checkpoint(loss)
+        if cfg.remat:
+            loss = jax.checkpoint(loss)
 
     # The next-token shift happens inside the step so the shifted int32
     # inputs/targets (length max_seq_len - 1, which DOES tile over seq)
@@ -141,6 +181,10 @@ def synthetic_batch(cfg: TrainConfig, step_index: int, seed: int = 0):
     """Deterministic per-step token batch: resume from a checkpoint sees
     exactly the data an uninterrupted run would have seen."""
     batch = max(2 * cfg.mesh.dcn * cfg.mesh.data * cfg.mesh.fsdp * cfg.mesh.expert, 2)
+    if cfg.mesh.pipe > 1:
+        # The pipeline splits the batch into microbatches; keep it an
+        # exact multiple so reshape(M, batch//M, ...) tiles.
+        batch *= cfg.num_microbatches or 2 * cfg.mesh.pipe
     return jax.random.randint(
         jax.random.PRNGKey(seed * 1_000_003 + step_index),
         (batch, cfg.model.max_seq_len), 0, cfg.model.vocab_size,
@@ -176,7 +220,7 @@ def train_loop(cfg: TrainConfig, steps: int, *, checkpoint_dir: str | None = Non
         # orbax place the restored shards directly onto the mesh. The
         # optimizer-state shardings come from compiling (not running)
         # opt.init on the sharded param avals.
-        params_sds = jax.eval_shape(partial(init_params, cfg.model), jax.random.PRNGKey(seed))
+        params_sds = jax.eval_shape(_init_params_for_mesh(cfg), jax.random.PRNGKey(seed))
         p_shardings = param_shardings(mesh, params_sds)
         params_abs = jax.tree.map(
             lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
