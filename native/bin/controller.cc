@@ -182,20 +182,87 @@ bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::s
   }
 
   log_info("reconciling", {{"name", name}});
-  for (const Json& child : desired_children(ub, cfg.core)) {
-    client.apply(child, kFieldManager, /*force=*/true);
-    Metrics::instance().inc("applies_total");
+  std::vector<Json> children = desired_children(ub, cfg.core);
+  Json applied_jobset;  // the apply response doubles as the observation
+  bool have_applied_jobset = false;
+
+  // The children have real creation-order dependencies on an actual API
+  // server: the Namespace must exist before anything namespaced; the
+  // RoleBinding references the Role (RBAC escalation check 403s on a
+  // dangling reference when the SA lacks bind/escalate); and the JobSet
+  // must not beat the ResourceQuota into existence (quota admission is
+  // not retroactive — pods admitted before the quota lands are never
+  // evicted). So: Namespace first, then two CONCURRENT waves that honor
+  // those edges — wave 1 = {ResourceQuota, Role}, wave 2 =
+  // {RoleBinding, JobSet}. Worst case cost is 3 API round-trips instead
+  // of the reference's 4-5 sequential ones (controller.rs:81-149), and
+  // within each wave the applies overlap on pooled connections.
+  auto apply_wave = [&](const std::vector<const Json*>& wave) {
+    if (wave.size() == 1) {  // no point paying a thread spawn for one call
+      Json resp = client.apply(*wave[0], kFieldManager, /*force=*/true);
+      Metrics::instance().inc("applies_total");
+      if (wave[0]->get("kind").as_string() == "JobSet") {
+        applied_jobset = std::move(resp);
+        have_applied_jobset = true;
+      }
+      return;
+    }
+    std::vector<std::thread> appliers;
+    std::vector<std::exception_ptr> errors(wave.size());
+    std::mutex jobset_mu;
+    auto apply_one = [&](size_t i) {
+      try {
+        Json resp = client.apply(*wave[i], kFieldManager, /*force=*/true);
+        Metrics::instance().inc("applies_total");
+        if (wave[i]->get("kind").as_string() == "JobSet") {
+          std::lock_guard<std::mutex> lock(jobset_mu);
+          applied_jobset = std::move(resp);
+          have_applied_jobset = true;
+        }
+      } catch (...) {
+        errors[i] = std::current_exception();
+      }
+    };
+    for (size_t i = 1; i < wave.size(); ++i) appliers.emplace_back(apply_one, i);
+    apply_one(0);  // the calling thread takes a share instead of idling
+    for (auto& t : appliers) t.join();
+    for (auto& err : errors) {
+      if (err) std::rethrow_exception(err);  // first failure -> error requeue
+    }
+  };
+
+  std::vector<const Json*> wave1, wave2;
+  for (const Json& child : children) {
+    const std::string kind = child.get("kind").as_string();
+    if (kind == "Namespace") {
+      client.apply(child, kFieldManager, /*force=*/true);
+      Metrics::instance().inc("applies_total");
+    } else if (kind == "RoleBinding" || kind == "JobSet") {
+      wave2.push_back(&child);
+    } else {
+      wave1.push_back(&child);
+    }
   }
+  if (!wave1.empty()) apply_wave(wave1);
+  if (!wave2.empty()) apply_wave(wave2);
 
   // Maintain status.slice for TPU CRs (merge-patch: never touches the
   // synchronizer-owned synchronized_with_sheet field).
   if (ub.get("spec").get("tpu").is_object()) {
     Json observed;  // null unless the JobSet exists
     const std::string ns = target_namespace(ub);
-    try {
-      observed = client.get("jobset.x-k8s.io/v1alpha2", "JobSet", ns, ns + "-slice");
-    } catch (const KubeError& e) {
-      if (e.status != 404) throw;
+    if (have_applied_jobset) {
+      // The SSA response is the server's current stored object (status
+      // included) — a free observation, no extra GET.
+      observed = std::move(applied_jobset);
+    } else {
+      // No JobSet child this pass (sheet gate closed / no tpu spec at
+      // emit time): one may still exist from an earlier approval.
+      try {
+        observed = client.get("jobset.x-k8s.io/v1alpha2", "JobSet", ns, ns + "-slice");
+      } catch (const KubeError& e) {
+        if (e.status != 404) throw;
+      }
     }
     Json desired_slice = slice_status(ub, observed);
     if (ub.get("status").get("slice") != desired_slice) {

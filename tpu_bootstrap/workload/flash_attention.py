@@ -122,6 +122,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, block, causal,
     lse_ref[:] = m + jnp.log(l)
 
 
+# Every grid step of every kernel here is independent (each (batch*head,
+# tile) pair owns its output slice and the online-softmax state lives in
+# registers/VMEM within one step), so tell Mosaic both grid axes are
+# parallel — it may then reorder/pipeline steps instead of assuming a
+# sequential carried dependency.
+_PARALLEL_GRID = pltpu.CompilerParams(dimension_semantics=("parallel", "parallel"))
+
+
 def _fwd(q3, k3, v3, sm_scale, block, causal, true_len, interpret):
     """q3/k3/v3: (bh, seq, head_dim) -> (out, lse)."""
     bh, seq, hd = q3.shape
@@ -130,6 +138,7 @@ def _fwd(q3, k3, v3, sm_scale, block, causal, true_len, interpret):
         functools.partial(_fwd_kernel, sm_scale=sm_scale, block=block, causal=causal,
                           true_len=true_len),
         grid=grid,
+        compiler_params=_PARALLEL_GRID,
         in_specs=[
             pl.BlockSpec((None, block, hd), lambda b, i: (b, i, 0)),
             pl.BlockSpec((None, seq, hd), lambda b, i: (b, 0, 0)),
@@ -242,6 +251,7 @@ def _bwd(sm_scale, block, causal, true_len, interpret, residuals, cotangents):
         functools.partial(_dq_kernel, sm_scale=sm_scale, block=block, causal=causal,
                           true_len=true_len),
         grid=grid,
+        compiler_params=_PARALLEL_GRID,
         in_specs=[tile(), slab(), slab(), tile(), rowblock(), rowblock()],
         out_specs=[tile()],
         out_shape=[jax.ShapeDtypeStruct((bh, seq, hd), q3.dtype)],
@@ -252,6 +262,7 @@ def _bwd(sm_scale, block, causal, true_len, interpret, residuals, cotangents):
         functools.partial(_dkv_kernel, sm_scale=sm_scale, block=block, causal=causal,
                           true_len=true_len),
         grid=grid,
+        compiler_params=_PARALLEL_GRID,
         in_specs=[slab(), tile(), tile(), slab(), rowslab(), rowslab()],
         out_specs=[tile(), tile()],
         out_shape=[
