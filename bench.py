@@ -288,6 +288,36 @@ try:
 except Exception as e:  # noqa: BLE001
     out["train_bench_error"] = f"{type(e).__name__}: {e}"[:400]
 emit()
+
+# Decode throughput: greedy generation with the KV cache (the serving
+# path) — tokens/sec at batch 8 on the single chip.
+try:
+    from tpu_bootstrap.workload.decode import generate
+    from tpu_bootstrap.workload.model import ModelConfig, init_params
+
+    dcfg = ModelConfig(vocab_size=512, num_layers=4, num_heads=8, head_dim=32,
+                       embed_dim=256, mlp_dim=1024, max_seq_len=512)
+    dparams = init_params(dcfg, jax.random.PRNGKey(0))
+    dbatch, d1, d2 = 8, 64, 192
+    dprompt = jax.random.randint(jax.random.PRNGKey(1), (dbatch, 64), 0, dcfg.vocab_size)
+
+    def timed_gen(steps):
+        generate(dparams, dprompt, dcfg, steps).block_until_ready()  # compile+warm
+        t0 = time.time()
+        generate(dparams, dprompt, dcfg, steps).block_until_ready()
+        return time.time() - t0
+
+    # Two-point measurement: the d2-d1 step difference cancels the prefill
+    # (and any fixed dispatch overhead), giving pure per-decode-step cost.
+    t1, t2 = timed_gen(d1), timed_gen(d2)
+    step_s = max((t2 - t1) / (d2 - d1), 1e-9)
+    out.update({
+        "decode_tokens_per_sec": round(dbatch / step_s, 1),
+        "decode_step_ms": round(step_s * 1e3, 3),
+    })
+except Exception as e:  # noqa: BLE001
+    out["decode_bench_error"] = f"{type(e).__name__}: {e}"[:400]
+emit()
 """
 
 

@@ -8,6 +8,7 @@ multi-host v5p slice purely by changing the mesh shape. The driver's
 `__graft_entry__.py` exercises exactly this code.
 """
 
+from tpu_bootstrap.workload.decode import generate
 from tpu_bootstrap.workload.model import ModelConfig, init_params, forward, loss_fn
 from tpu_bootstrap.workload.sharding import (
     MeshConfig,
@@ -23,6 +24,7 @@ from tpu_bootstrap.workload.train import (
 )
 
 __all__ = [
+    "generate",
     "ModelConfig",
     "init_params",
     "forward",

@@ -1,0 +1,176 @@
+"""Autoregressive decoding with a KV cache — the inference half of the
+slice workload.
+
+TPU-first design:
+* Static shapes everywhere: the cache is a fixed (batch, max_len, heads,
+  head_dim) buffer per block, written with `lax.dynamic_update_slice`;
+  the decode loop is a `lax.scan` over a fixed step count. One trace,
+  one compile, no shape churn.
+* Decode is HBM-bandwidth-bound (every step streams the whole cache),
+  so the per-step attention is a plain masked einsum — at query length
+  1 there is no score matrix to avoid, and XLA fuses the mask/softmax
+  into the two small matmuls. The flash kernel stays a training-path
+  tool.
+* Sharding falls out of the same rules as training: batch over the data
+  axes, heads over `tensor`, cache sharded like activations — run
+  `generate` under `jit` with sharded params and GSPMD partitions the
+  cache update and the cache-wide attention per device.
+
+MoE note: decoding routes each token with sequence length 1, so expert
+capacity is per-token (C = ceil(k/E * cf)); a full-sequence forward
+routes tokens in competition. Both are the standard semantics for their
+phase, but they are not bit-identical — greedy-parity tests use the
+dense model.
+
+Reference parity note: the reference (bacchus-gpu-controller) has no
+compute path (SURVEY.md §2); this module completes the train/serve pair
+of the JAX workload its JobSets launch.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_bootstrap.workload.model import (
+    ModelConfig,
+    Params,
+    _mlp,
+    _rms_norm,
+    _rotary,
+    moe_mlp,
+)
+
+
+def init_cache(cfg: ModelConfig, batch: int, max_len: int):
+    """One (k, v) buffer pair per block, model layout, compute dtype."""
+    shape = (batch, max_len, cfg.num_heads, cfg.head_dim)
+    return [
+        {"k": jnp.zeros(shape, cfg.compute_dtype), "v": jnp.zeros(shape, cfg.compute_dtype)}
+        for _ in range(cfg.num_layers)
+    ]
+
+
+def _project_kv(block: Params, h: jax.Array, positions: jax.Array, cfg: ModelConfig):
+    dtype = cfg.compute_dtype
+    k = jnp.einsum("bse,ehd->bshd", h, block["wk"].astype(dtype))
+    v = jnp.einsum("bse,ehd->bshd", h, block["wv"].astype(dtype))
+    return _rotary(k, positions), v
+
+
+def _attend(q: jax.Array, cache_k: jax.Array, cache_v: jax.Array,
+            valid: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """q: (B, S, H, D) against the full cache, masked to `valid` columns
+    (valid: (S, L) bool — which cache slots each query row may see)."""
+    dtype = cfg.compute_dtype
+    scale = jnp.asarray(cfg.head_dim, jnp.float32) ** -0.5
+    scores = jnp.einsum("bshd,blhd->bhsl", q.astype(jnp.float32),
+                        cache_k.astype(jnp.float32)) * scale
+    scores = jnp.where(valid[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+    return jnp.einsum("bhsl,blhd->bshd", probs, cache_v.astype(dtype))
+
+
+def _block_step(block: Params, x: jax.Array, cache: dict, positions: jax.Array,
+                valid: jax.Array, cfg: ModelConfig):
+    """One transformer block over x (B, S, E) with its KV written into the
+    cache at `positions` and attention over the whole cache."""
+    dtype = cfg.compute_dtype
+    h = _rms_norm(x, block["attn_norm"])
+    q = jnp.einsum("bse,ehd->bshd", h, block["wq"].astype(dtype))
+    q = _rotary(q, positions)
+    k, v = _project_kv(block, h, positions, cfg)
+    start = positions[0]
+    cache = {
+        "k": lax.dynamic_update_slice(cache["k"], k, (0, start, 0, 0)),
+        "v": lax.dynamic_update_slice(cache["v"], v, (0, start, 0, 0)),
+    }
+    out = _attend(q, cache["k"], cache["v"], valid, cfg)
+    x = x + jnp.einsum("bshd,hde->bse", out, block["wo"].astype(dtype))
+    if cfg.num_experts > 0:
+        h2 = _rms_norm(x, block["mlp_norm"])
+        moe_out, _ = moe_mlp(block, h2, cfg)
+        x = x + moe_out
+    else:
+        x = x + _mlp(block, x, cfg)
+    return x, cache
+
+
+def _logits(params: Params, x: jax.Array) -> jax.Array:
+    x = _rms_norm(x, params["final_norm"])
+    return jnp.einsum("bse,ve->bsv", x.astype(jnp.float32), params["embed"])
+
+
+def prefill(params: Params, tokens: jax.Array, caches: list, cfg: ModelConfig):
+    """Run the prompt (B, S) through the model, filling cache slots
+    [0, S). Returns (logits for the LAST prompt position (B, vocab),
+    updated caches)."""
+    b, s = tokens.shape
+    max_len = caches[0]["k"].shape[1]
+    positions = jnp.arange(s)
+    # Query row i may see cache columns 0..i (its own prefix).
+    valid = jnp.arange(max_len)[None, :] <= positions[:, None]
+    x = params["embed"].astype(cfg.compute_dtype)[tokens]
+    new_caches = []
+    for block, cache in zip(params["blocks"], caches):
+        x, cache = _block_step(block, x, cache, positions, valid, cfg)
+        new_caches.append(cache)
+    return _logits(params, x[:, -1:])[:, 0], new_caches
+
+
+def decode_step(params: Params, token: jax.Array, pos: jax.Array, caches: list,
+                cfg: ModelConfig):
+    """One token (B,) at position `pos` (traced scalar). Returns
+    (next-token logits (B, vocab), updated caches)."""
+    max_len = caches[0]["k"].shape[1]
+    positions = pos[None] if pos.ndim == 0 else pos
+    valid = (jnp.arange(max_len) <= positions[0])[None, :]
+    x = params["embed"].astype(cfg.compute_dtype)[token[:, None]]
+    new_caches = []
+    for block, cache in zip(params["blocks"], caches):
+        x, cache = _block_step(block, x, cache, positions, valid, cfg)
+        new_caches.append(cache)
+    return _logits(params, x)[:, 0], new_caches
+
+
+@partial(jax.jit, static_argnames=("cfg", "steps", "temperature"))
+def generate(params: Params, prompt: jax.Array, cfg: ModelConfig, steps: int,
+             temperature: float = 0.0, key: jax.Array | None = None):
+    """Greedy (temperature == 0) or sampled generation.
+
+    prompt: (B, S) int32; returns (B, steps) int32 continuations. The
+    cache is sized S + steps; the whole thing — prefill plus a
+    `lax.scan` of decode steps — is one jit (one compile per
+    (shape, steps) pair).
+    """
+    if steps < 1:
+        raise ValueError(f"steps must be >= 1, got {steps}")
+    b, s = prompt.shape
+    caches = init_cache(cfg, b, s + steps)
+    logits, caches = prefill(params, prompt, caches, cfg)
+    if key is None:
+        key = jax.random.PRNGKey(0)
+
+    def pick(logits, key):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+        return jax.random.categorical(key, logits / temperature, axis=-1).astype(prompt.dtype)
+
+    key, sub = jax.random.split(key)  # never reuse a consumed key
+    first = pick(logits, sub)
+
+    def step(carry, i):
+        token, caches, key = carry
+        key, sub = jax.random.split(key)
+        logits, caches = decode_step(params, token, s + i, caches, cfg)
+        nxt = pick(logits, sub)
+        return (nxt, caches, key), token
+
+    (last, _, _), toks = lax.scan(step, (first, caches, key), jnp.arange(steps - 1))
+    return jnp.concatenate([toks.swapaxes(0, 1), last[:, None]], axis=1)
+
+
+__all__ = ["init_cache", "prefill", "decode_step", "generate"]
