@@ -15,6 +15,7 @@
 #include <algorithm>
 #include <chrono>
 #include <condition_variable>
+#include <functional>
 #include <map>
 #include <mutex>
 #include <set>
@@ -43,6 +44,7 @@ struct ControllerConfig {
   int listen_port;
   int64_t requeue_secs;
   int64_t error_requeue_secs;
+  int64_t child_requeue_ms;
   int64_t workers;
   bool leader_elect;
   LeaderConfig leader;
@@ -56,6 +58,13 @@ ControllerConfig load_config() {
   c.listen_port = static_cast<int>(env.get_int("listen_port", 12322));
   c.requeue_secs = env.get_int("requeue_secs", 30);
   c.error_requeue_secs = env.get_int("error_requeue_secs", 3);
+  // Debounce for child-event requeues: our own applies echo back as
+  // child ADDED/MODIFIED events, so an immediate requeue would buy every
+  // reconcile a follow-up no-op pass right in the middle of a burst. A
+  // short delay coalesces all of a pass's child events into one
+  // follow-up after the dust settles (the queue keeps the earliest
+  // deadline, so genuine CR events at delay 0 are never held back).
+  c.child_requeue_ms = env.get_int("child_requeue_ms", 1000);
   c.workers = env.get_int("reconcile_workers", 4);
   c.leader_elect = env.get("leader_elect", "0") == "1";
   if (c.leader_elect) {
@@ -361,46 +370,90 @@ int main() {
     });
   }
 
-  // Watch thread: list -> enqueue everything -> watch from the list's
-  // resourceVersion; child-kind events also requeue their owner, the
-  // .owns() analogue (controller.rs:234-238).
-  std::thread watcher([&] {
+  // Shared watch-loop state machine (used by the CR watcher and every
+  // child-kind watcher): empty rv => cluster-wide list + per-item seed +
+  // cursor from the list, then watch from the cursor. On a transient
+  // stream failure, resume from the last seen resourceVersion — a full
+  // relist is O(all objects) for no reason. If that rv has expired the
+  // server answers 410, client.watch returns "", and the empty-rv branch
+  // IS the relist trigger.
+  auto run_watch_loop = [&](const std::string& api_version, const std::string& kind,
+                            const std::string& relist_metric,
+                            const std::function<void(const Json&)>& on_seed_item,
+                            const std::function<void(const std::string&, const Json&)>& on_event) {
     std::string rv;
     while (!stop_requested().load()) {
       try {
         if (rv.empty()) {
-          Json list = client.list(kApiVersion, kKind);
-          for (const auto& item : list.get("items").items())
-            queue.add(item.get("metadata").get_string("name"), 0);
+          Json list = client.list(api_version, kind);
+          for (const auto& item : list.get("items").items()) on_seed_item(item);
           rv = list.get("metadata").get_string("resourceVersion");
-          Metrics::instance().inc("relists_total");
+          Metrics::instance().inc(relist_metric);
         }
-        rv = client.watch(
-            kApiVersion, kKind, rv,
-            [&](const std::string& type, const Json& obj) {
-              const std::string name = obj.get("metadata").get_string("name");
-              if (name.empty()) return;
-              Metrics::instance().inc("watch_events_total");
-              if (type == "DELETED") {
-                queue.remove(name);  // GC handles children; stop requeueing
-                return;
-              }
-              queue.add(name, 0);
-            },
-            &stop_requested());
+        rv = client.watch(api_version, kind, rv, on_event, &stop_requested());
       } catch (const std::exception& e) {
         if (stop_requested().load()) break;
-        // Transient stream failure (conn reset, timeout): resume the
-        // watch from the last seen resourceVersion — a full relist here
-        // is O(all CRs) for no reason. If that rv has expired, the server
-        // answers 410 and client.watch returns "", which IS the relist
-        // trigger (the empty-rv branch above).
         log_warn("watch stream failed; resuming from last rv",
-                 {{"error", e.what()}, {"rv", rv}});
+                 {{"kind", kind}, {"error", e.what()}, {"rv", rv}});
         Metrics::instance().inc("watch_restarts_total");
         stop_wait_ms(2000);
       }
     }
+  };
+
+  // Child-kind watchers — the .owns() analogue (controller.rs:234-238):
+  // any mutation (or deletion) of an owned child requeues its owner CR,
+  // so child drift repairs and JobSet status changes propagate to
+  // status.slice event-driven instead of waiting out the 30s requeue.
+  // Steady state cannot self-oscillate: SSA of identical intent is a
+  // server-side no-op (no resourceVersion bump, no event).
+  auto requeue_owner = [&](const Json& obj, bool count_event) {
+    const Json& refs = obj.get("metadata").get("ownerReferences");
+    if (!refs.is_array()) return;
+    for (const Json& ref : refs.items()) {
+      if (ref.get_string("kind") == kKind && ref.get_string("apiVersion") == kApiVersion) {
+        if (count_event) Metrics::instance().inc("child_events_total");
+        queue.add(ref.get_string("name"), cfg.child_requeue_ms);
+        return;
+      }
+    }
+  };
+  const std::pair<const char*, const char*> kOwnedKinds[] = {
+      {"v1", "Namespace"},
+      {"v1", "ResourceQuota"},
+      {"rbac.authorization.k8s.io/v1", "Role"},
+      {"rbac.authorization.k8s.io/v1", "RoleBinding"},
+      {"jobset.x-k8s.io/v1alpha2", "JobSet"},
+  };
+  std::vector<std::thread> child_watchers;
+  for (const auto& owned : kOwnedKinds) {
+    child_watchers.emplace_back([&, api_version = std::string(owned.first),
+                                 kind = std::string(owned.second)] {
+      run_watch_loop(
+          api_version, kind, "child_relists_total",
+          // Seed requeues cover events missed across a 410/compaction
+          // gap; they are relist noise, not child events — don't count.
+          [&](const Json& item) { requeue_owner(item, /*count_event=*/false); },
+          [&](const std::string&, const Json& obj) { requeue_owner(obj, /*count_event=*/true); });
+    });
+  }
+
+  // CR watcher: list -> enqueue everything -> watch from the list's
+  // resourceVersion.
+  std::thread watcher([&] {
+    run_watch_loop(
+        kApiVersion, kKind, "relists_total",
+        [&](const Json& item) { queue.add(item.get("metadata").get_string("name"), 0); },
+        [&](const std::string& type, const Json& obj) {
+          const std::string name = obj.get("metadata").get_string("name");
+          if (name.empty()) return;
+          Metrics::instance().inc("watch_events_total");
+          if (type == "DELETED") {
+            queue.remove(name);  // GC handles children; stop requeueing
+            return;
+          }
+          queue.add(name, 0);
+        });
   });
 
   // Block until a signal arrives (reference: tokio::try_join over tasks),
@@ -419,6 +472,7 @@ int main() {
   queue.stop();
   for (auto& t : workers) t.join();
   watcher.join();
+  for (auto& t : child_watchers) t.join();
   if (elector && !lost_leadership) elector->release();
   health.stop();
   // Exit nonzero on leadership loss so the kubelet restarts the pod into

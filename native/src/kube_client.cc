@@ -52,8 +52,13 @@ std::string resource_path(const std::string& api_version, const std::string& kin
     path = "/apis/" + api_version;
   }
   if (info.namespaced) {
-    if (ns.empty()) throw std::runtime_error(kind + " is namespaced but no namespace given");
-    path += "/namespaces/" + ns;
+    // ns empty + no name = the cluster-wide collection (list/watch across
+    // all namespaces, e.g. GET /apis/jobset.x-k8s.io/v1alpha2/jobsets) —
+    // how the controller watches owned child kinds. A named get still
+    // requires the namespace.
+    if (ns.empty() && !name.empty())
+      throw std::runtime_error(kind + " is namespaced but no namespace given");
+    if (!ns.empty()) path += "/namespaces/" + ns;
   }
   path += "/" + std::string(info.plural);
   if (!name.empty()) path += "/" + name;
@@ -134,6 +139,11 @@ Json KubeClient::create(const Json& obj) {
   const std::string api_version = obj.get_string("apiVersion");
   const std::string kind = obj.get_string("kind");
   const std::string ns = obj.get("metadata").get_string("namespace");
+  // resource_path's empty-ns collection form is for cluster-wide
+  // list/watch; a create of a namespaced object must name its namespace
+  // (a real apiserver rejects the cluster-wide POST, fakes may not).
+  if (ns.empty() && kind_info(api_version, kind).namespaced)
+    throw std::runtime_error("create: " + kind + " object has no metadata.namespace");
   return check(http_->request("POST", resource_path(api_version, kind, ns, ""), obj.dump(),
                               "application/json", {}, config_.request_timeout_secs));
 }

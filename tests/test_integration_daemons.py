@@ -310,8 +310,12 @@ def test_controller_watch_resumes_without_relist(fake):
         fake.create_ub("bob", spec={})
         wait_for(lambda: fake.get(KEY_NS, "bob"), timeout=15, desc="post-sever converge")
         # Whether the severed stream surfaced as a clean end or an error,
-        # the watcher must resume from its rv — never a full relist.
+        # the watcher must resume from its rv — never a full relist. Same
+        # contract for all five child-kind watchers (they seed exactly
+        # once at startup).
         assert d.metrics().get("relists_total") == 1, "no relist on benign stream failure"
+        assert d.metrics().get("child_relists_total") == 5, \
+            "child watchers must resume, not relist, on benign stream failure"
     finally:
         code, err = d.stop()
         assert code == 0, err
@@ -550,3 +554,77 @@ def test_synchronizer_pool_capacity(fake, tmp_path):
     finally:
         code, err = d.stop()
         assert code == 0, err
+
+
+def test_controller_owns_children_event_driven(fake):
+    """The .owns() analogue (reference controller.rs:234-238): child
+    mutations requeue the owner CR event-driven. requeue_secs is cranked
+    to 600 so any convergence observed below MUST come from child watch
+    events, not the periodic resync."""
+    fake.create_ub("alice", spec=full_spec(), status=SYNCED)
+    port = free_port()
+    d = Daemon(
+        "tpubc-controller",
+        controller_env(fake, port, conf_requeue_secs=600),
+        port,
+    ).wait_healthy()
+    try:
+        js = wait_for(lambda: fake.get(KEY_JS("alice"), "alice-slice"), desc="jobset")
+
+        # 1. JobSet status change -> CR status.slice updates without resync.
+        js["status"] = {
+            "replicatedJobsStatus": [{"name": "workers", "active": 16, "ready": 16}]
+        }
+        fake.store.upsert(KEY_JS("alice"), "alice-slice", js, preserve_status=False)
+        ub = wait_for(
+            lambda: (lambda u: u
+                     if u.get("status", {}).get("slice", {}).get("phase") == "Running"
+                     else None)(fake.get(fake.KEY_UB, "alice")),
+            timeout=10,
+            desc="slice Running via JobSet watch",
+        )
+        assert ub["status"]["slice"]["hosts"] == 16
+
+        # 2. Drift repair: deleting the ResourceQuota recreates it without
+        # resync (the deletion event requeues the owner).
+        fake.store.delete(KEY_QUOTA("alice"), "alice")
+        wait_for(lambda: fake.get(KEY_QUOTA("alice"), "alice"), timeout=10,
+                 desc="quota recreated via child watch")
+    finally:
+        code, err = d.stop()
+        assert code == 0, err
+
+
+def test_fakeapi_cluster_wide_list_and_watch(fake):
+    """Cluster-wide collection semantics for namespaced kinds: LIST and
+    WATCH on /apis/G/V/PLURAL span every namespace (what the controller's
+    child watchers rely on)."""
+    import json as _json
+    import urllib.request
+
+    fake.store.upsert(KEY_QUOTA("ns-a"), "qa", {"spec": {"hard": {}}, "metadata": {"namespace": "ns-a"}})
+    fake.store.upsert(KEY_QUOTA("ns-b"), "qb", {"spec": {"hard": {}}, "metadata": {"namespace": "ns-b"}})
+    with urllib.request.urlopen(f"{fake.url}/api/v1/resourcequotas", timeout=5) as r:
+        body = _json.loads(r.read())
+    names = sorted(i["metadata"]["name"] for i in body["items"])
+    assert names == ["qa", "qb"]
+    rv = int(body["metadata"]["resourceVersion"])
+
+    # Watch cluster-wide from rv, then create in a third namespace.
+    results = []
+    import threading
+
+    def watch():
+        req = urllib.request.urlopen(
+            f"{fake.url}/api/v1/resourcequotas?watch=1&resourceVersion={rv}", timeout=10)
+        for line in req:
+            results.append(_json.loads(line))
+            break
+
+    t = threading.Thread(target=watch)
+    t.start()
+    time.sleep(0.3)
+    fake.store.upsert(KEY_QUOTA("ns-c"), "qc", {"spec": {"hard": {}}, "metadata": {"namespace": "ns-c"}})
+    t.join(timeout=10)
+    assert results and results[0]["type"] == "ADDED"
+    assert results[0]["object"]["metadata"]["name"] == "qc"

@@ -397,12 +397,25 @@ class FakeKubeHandler(BaseHTTPRequestHandler):
         if query.get("watch", ["0"])[0] in ("1", "true"):
             return self.serve_watch(key, query)
         with self.store.lock:
-            items = [copy.deepcopy(o) for o in self.store.collection(key).values()]
+            items = [copy.deepcopy(o)
+                     for coll_key, coll in sorted(self.store.objects.items())
+                     if self._key_matches(key, coll_key)
+                     for o in coll.values()]
             rv = str(self.store.rv)
         self.send_json(
             200,
             {"kind": "List", "apiVersion": "v1", "metadata": {"resourceVersion": rv}, "items": items},
         )
+
+    @staticmethod
+    def _key_matches(requested, stored):
+        """Collection match for a request key against a stored key. A
+        request with an empty namespace is the cluster-wide collection
+        (apiserver semantics: GET /apis/G/V/PLURAL spans all namespaces),
+        so it matches every namespace of that (api, plural) pair."""
+        if requested == stored:
+            return True
+        return not requested[1] and requested[0] == stored[0] and requested[2] == stored[2]
 
     def serve_watch(self, key, query):
         since = int(query.get("resourceVersion", ["0"])[0] or 0)
@@ -455,7 +468,7 @@ class FakeKubeHandler(BaseHTTPRequestHandler):
                         events = self.store.events
                         start = bisect.bisect_right(events, cursor, key=lambda e: e[0])
                         for rv, ekey, etype, obj in events[start:]:
-                            if ekey == key:
+                            if self._key_matches(key, ekey):
                                 batch.append((rv, etype, copy.deepcopy(obj)))
                         if not batch:
                             self.store.lock.wait(timeout=1.0)
