@@ -397,10 +397,13 @@ class FakeKubeHandler(BaseHTTPRequestHandler):
         if query.get("watch", ["0"])[0] in ("1", "true"):
             return self.serve_watch(key, query)
         with self.store.lock:
-            items = [copy.deepcopy(o)
-                     for coll_key, coll in sorted(self.store.objects.items())
-                     if self._key_matches(key, coll_key)
-                     for o in coll.values()]
+            if key[1]:  # exact namespaced collection: one dict lookup
+                items = [copy.deepcopy(o) for o in self.store.collection(key).values()]
+            else:  # cluster-wide: fan out over every matching namespace
+                items = [copy.deepcopy(o)
+                         for coll_key, coll in sorted(self.store.objects.items())
+                         if self._key_matches(key, coll_key)
+                         for o in coll.values()]
             rv = str(self.store.rv)
         self.send_json(
             200,

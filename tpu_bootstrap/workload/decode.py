@@ -46,8 +46,10 @@ from tpu_bootstrap.workload.model import (
 
 
 def init_cache(cfg: ModelConfig, batch: int, max_len: int):
-    """One (k, v) buffer pair per block, model layout, compute dtype."""
-    shape = (batch, max_len, cfg.num_heads, cfg.head_dim)
+    """One (k, v) buffer pair per block, model layout, compute dtype.
+    Sized at kv_heads: under GQA the cache — the thing decode streams
+    from HBM every step — shrinks by the query/KV group factor."""
+    shape = (batch, max_len, cfg.kv_heads, cfg.head_dim)
     return [
         {"k": jnp.zeros(shape, cfg.compute_dtype), "v": jnp.zeros(shape, cfg.compute_dtype)}
         for _ in range(cfg.num_layers)
@@ -63,15 +65,22 @@ def _project_kv(block: Params, h: jax.Array, positions: jax.Array, cfg: ModelCon
 
 def _attend(q: jax.Array, cache_k: jax.Array, cache_v: jax.Array,
             valid: jax.Array, cfg: ModelConfig) -> jax.Array:
-    """q: (B, S, H, D) against the full cache, masked to `valid` columns
-    (valid: (S, L) bool — which cache slots each query row may see)."""
+    """q: (B, S, H, D) against the (B, L, Hk, D) cache, masked to `valid`
+    columns (valid: (S, L) bool — which cache slots each query row may
+    see). GQA folds q into (Hk, group) so the cache is read once at its
+    small head count — no materialized repeat."""
     dtype = cfg.compute_dtype
+    b, s, heads, d = q.shape
+    kv_heads = cache_k.shape[2]
+    group = heads // kv_heads
+    qg = q.reshape(b, s, kv_heads, group, d)
     scale = jnp.asarray(cfg.head_dim, jnp.float32) ** -0.5
-    scores = jnp.einsum("bshd,blhd->bhsl", q.astype(jnp.float32),
+    scores = jnp.einsum("bskgd,blkd->bkgsl", qg.astype(jnp.float32),
                         cache_k.astype(jnp.float32)) * scale
-    scores = jnp.where(valid[None, None], scores, -1e30)
+    scores = jnp.where(valid[None, None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
-    return jnp.einsum("bhsl,blhd->bshd", probs, cache_v.astype(dtype))
+    out = jnp.einsum("bkgsl,blkd->bskgd", probs, cache_v.astype(dtype))
+    return out.reshape(b, s, heads, d)
 
 
 def _block_step(block: Params, x: jax.Array, cache: dict, positions: jax.Array,

@@ -295,6 +295,14 @@ def _flash3_fwd(q3, k3, v3, sm_scale, block, causal, true_len, interpret):
 _flash3.defvjp(_flash3_fwd, _bwd)
 
 
+def _expand_gqa(q, k, v):
+    """Repeat GQA KV heads up to the query head count (no-op for MHA)."""
+    from tpu_bootstrap.workload.model import repeat_kv
+
+    heads = q.shape[-2]
+    return repeat_kv(k, heads), repeat_kv(v, heads)
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -307,11 +315,15 @@ def flash_attention(
 ) -> jax.Array:
     """Flash attention over model-layout tensors.
 
-    q/k/v: (batch, seq, heads, head_dim); returns the same shape —
-    drop-in for the ``attn_fn`` hook of ``model._attention`` (which
-    applies no scaling itself, so the 1/sqrt(head_dim) default here
-    matches its dense path).
+    q: (batch, seq, heads, head_dim); k/v the same, or with fewer (GQA)
+    heads dividing q's — they are expanded to the query head count before
+    the kernel (the GQA memory win lives in params, the ring's ICI
+    transfers, and the decode cache; inside this kernel K/V ride VMEM
+    whole either way). Returns q's shape — drop-in for the ``attn_fn``
+    hook of ``model._attention`` (which applies no scaling itself, so the
+    1/sqrt(head_dim) default here matches its dense path).
     """
+    k, v = _expand_gqa(q, k, v)
     if q.shape != k.shape or q.shape != v.shape:
         raise ValueError(f"q/k/v shapes must match, got {q.shape}/{k.shape}/{v.shape}")
     if block_size % 8 != 0:
@@ -352,7 +364,9 @@ def flash_attention_with_lse(
     """Like flash_attention but also returns the per-row logsumexp of the
     scaled scores, shape (batch, seq, heads) float32 — the state a caller
     needs to combine partial attention over KV blocks held elsewhere
-    (ring_attention's per-shard fold). Differentiable in both outputs."""
+    (ring_attention's per-shard fold). Differentiable in both outputs.
+    Accepts GQA k/v (fewer heads) like flash_attention."""
+    k, v = _expand_gqa(q, k, v)
     if q.shape != k.shape or q.shape != v.shape:
         raise ValueError(f"q/k/v shapes must match, got {q.shape}/{k.shape}/{v.shape}")
     if block_size % 8 != 0:

@@ -41,6 +41,11 @@ class ModelConfig:
     mlp_dim: int = 256
     max_seq_len: int = 128
     compute_dtype: Any = jnp.float32
+    # Grouped-query attention: number of KV heads (None = num_heads, i.e.
+    # plain MHA; 1 = MQA). Q heads share KV heads in contiguous groups of
+    # num_heads / num_kv_heads — the standard memory-bandwidth lever for
+    # decode (the KV cache shrinks by the group factor).
+    num_kv_heads: int | None = None
     # Mixture of experts: num_experts == 0 keeps the dense MLP; > 0 swaps
     # every block's FFN for a top-k routed expert layer (workload/moe.py),
     # shardable over the `expert` mesh axis.
@@ -52,6 +57,14 @@ class ModelConfig:
     @property
     def qkv_dim(self) -> int:
         return self.num_heads * self.head_dim
+
+    @property
+    def kv_heads(self) -> int:
+        kv = self.num_kv_heads if self.num_kv_heads is not None else self.num_heads
+        if not 1 <= kv <= self.num_heads or self.num_heads % kv != 0:
+            raise ValueError(
+                f"num_kv_heads ({kv}) must divide num_heads ({self.num_heads})")
+        return kv
 
 
 def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
@@ -72,8 +85,8 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
             "attn_norm": jnp.ones((cfg.embed_dim,), jnp.float32),
             # (embed, heads, head_dim): heads axis shardable over `tensor`
             "wq": dense(next(keys), (cfg.embed_dim, cfg.num_heads, cfg.head_dim), cfg.embed_dim),
-            "wk": dense(next(keys), (cfg.embed_dim, cfg.num_heads, cfg.head_dim), cfg.embed_dim),
-            "wv": dense(next(keys), (cfg.embed_dim, cfg.num_heads, cfg.head_dim), cfg.embed_dim),
+            "wk": dense(next(keys), (cfg.embed_dim, cfg.kv_heads, cfg.head_dim), cfg.embed_dim),
+            "wv": dense(next(keys), (cfg.embed_dim, cfg.kv_heads, cfg.head_dim), cfg.embed_dim),
             "wo": dense(next(keys), (cfg.num_heads, cfg.head_dim, cfg.embed_dim), cfg.qkv_dim),
             "mlp_norm": jnp.ones((cfg.embed_dim,), jnp.float32),
         }
@@ -112,14 +125,28 @@ def _rotary(x: jax.Array, positions: jax.Array) -> jax.Array:
     return rotated.reshape(x.shape)
 
 
+def repeat_kv(k: jax.Array, num_heads: int) -> jax.Array:
+    """Expand (..., kv_heads, d) to (..., num_heads, d) by repeating each
+    KV head over its contiguous query group (GQA). The single definition
+    of the grouping — every attention path (dense, flash, ring, its test
+    oracle) expands through here so they cannot diverge."""
+    kv_heads = k.shape[-2]
+    if kv_heads == num_heads:
+        return k
+    if num_heads % kv_heads != 0:
+        raise ValueError(f"kv heads ({kv_heads}) must divide q heads ({num_heads})")
+    return jnp.repeat(k, num_heads // kv_heads, axis=-2)
+
+
 def _attention(block: Params, x: jax.Array, cfg: ModelConfig, attn_fn=None) -> jax.Array:
     """Causal multi-head attention. x: (batch, seq, embed).
 
-    ``attn_fn(q, k, v) -> out`` (all (batch, seq, heads, head_dim))
-    replaces the attention core when given — the hook through which ring
-    attention (sequence parallelism) and the pallas flash kernel plug in.
-    The QKV/rotary/output projections around it are per-token and need no
-    communication, so they work unchanged under any sequence sharding.
+    ``attn_fn(q, k, v) -> out`` (q: (batch, seq, heads, head_dim); k/v
+    may carry fewer (GQA) heads) replaces the attention core when given —
+    the hook through which ring attention (sequence parallelism) and the
+    pallas flash kernel plug in. The QKV/rotary/output projections around
+    it are per-token and need no communication, so they work unchanged
+    under any sequence sharding.
     """
     dtype = cfg.compute_dtype
     seq = x.shape[1]
@@ -135,6 +162,8 @@ def _attention(block: Params, x: jax.Array, cfg: ModelConfig, attn_fn=None) -> j
     if attn_fn is not None:
         out = attn_fn(q, k, v)
     else:
+        k = repeat_kv(k, cfg.num_heads)
+        v = repeat_kv(v, cfg.num_heads)
         scores = jnp.einsum("bshd,bthd->bhst", q, k) / jnp.sqrt(
             jnp.asarray(cfg.head_dim, jnp.float32)
         ).astype(dtype)

@@ -53,6 +53,9 @@ def _ring_attention_local(q, k, v, *, axis_name: str, n_shards: int):
     applied on global positions.
     """
     batch, block, heads, head_dim = q.shape
+    kv_rep = heads // k.shape[2]  # GQA: the ring rotates only kv_heads;
+    # each fold expands them locally, so ICI transfer stays at the small
+    # head count while the matmuls run at full query width.
     idx = lax.axis_index(axis_name)  # which sequence shard we hold
     scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, jnp.float32))
 
@@ -72,6 +75,9 @@ def _ring_attention_local(q, k, v, *, axis_name: str, n_shards: int):
         k_pos = src * block + jnp.arange(block)
         mask = k_pos[None, :] <= q_pos[:, None]  # (block_q, block_k)
 
+        if kv_rep > 1:
+            k_blk = jnp.repeat(k_blk, kv_rep, axis=2)
+            v_blk = jnp.repeat(v_blk, kv_rep, axis=2)
         scores = (
             jnp.einsum(
                 "bqhd,bkhd->bhqk",
@@ -230,7 +236,12 @@ def make_ring_attention(
 def reference_attention(q, k, v, causal=True):
     """Unsharded attention with identical semantics — the test oracle
     (shared with the flash-attention tests) and the single-device
-    fallback."""
+    fallback. Accepts GQA k/v (fewer heads than q)."""
+    if k.shape[-2] != q.shape[-2]:
+        from tpu_bootstrap.workload.model import repeat_kv
+
+        k = repeat_kv(k, q.shape[-2])
+        v = repeat_kv(v, q.shape[-2])
     head_dim = q.shape[-1]
     seq = q.shape[1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, jnp.float32))

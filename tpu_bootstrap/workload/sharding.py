@@ -121,6 +121,24 @@ def param_shardings(mesh: Mesh, params: Params):
             return P("expert", "tensor", "fsdp") if ndim == 3 else P("tensor", "fsdp")
         return P(*([None] * ndim))  # norms: replicated
 
+    def fit(spec: P, shape) -> P:
+        """Drop mesh axes a dimension cannot tile evenly over (e.g. GQA's
+        shrunken kv-heads axis vs the tensor axis: MQA wk is
+        (embed, 1, head_dim), which no tensor>1 axis can split) —
+        replicating such a dimension is always correct, just less
+        sharded."""
+        out = []
+        for dim, entry in zip(shape, spec):
+            if entry is None:
+                out.append(None)
+                continue
+            names = entry if isinstance(entry, tuple) else (entry,)
+            extent = 1
+            for n in names:
+                extent *= mesh.shape[n]
+            out.append(entry if dim % extent == 0 else None)
+        return P(*out)
+
     # Pipeline layout: params["blocks"] is a dict of stacked leaves with a
     # leading (num_layers,) axis instead of a list of per-block dicts —
     # shard that axis over `pipe` so each stage holds only its layers (the
@@ -134,8 +152,10 @@ def param_shardings(mesh: Mesh, params: Params):
         if isinstance(tree, list):
             return [walk(v, path) for v in tree]
         if stacked and path.startswith("/blocks"):
-            return NamedSharding(mesh, P("pipe", *spec_for(path, tree.ndim - 1)))
-        return NamedSharding(mesh, spec_for(path, tree.ndim))
+            spec = P("pipe", *spec_for(path, tree.ndim - 1))
+        else:
+            spec = spec_for(path, tree.ndim)
+        return NamedSharding(mesh, fit(spec, tree.shape))
 
     return walk(params)
 

@@ -146,6 +146,20 @@ def make_train_step(cfg: TrainConfig, mesh, p_shardings):
     else:
         attn = None
 
+    # GQA + tensor parallelism: the shard_map attention paths shard the
+    # heads axis over `tensor`, which requires kv_heads % tensor == 0.
+    # When it doesn't hold (e.g. MQA on a tensor>1 mesh), expand KV to the
+    # full query head count BEFORE the shard_map — expansion must happen
+    # while the head axis is still global or the contiguous grouping
+    # breaks per shard. Costs the GQA bandwidth saving in that config;
+    # always correct.
+    if attn is not None and cfg.model.kv_heads % mesh.shape["tensor"] != 0:
+        from tpu_bootstrap.workload.model import repeat_kv
+
+        inner_attn, n_heads = attn, cfg.model.num_heads
+        attn = lambda q, k, v: inner_attn(  # noqa: E731
+            q, repeat_kv(k, n_heads), repeat_kv(v, n_heads))
+
     if not pipelined:
         def loss(params, inputs, targets):
             return loss_from_inputs(params, inputs, targets, cfg.model, attn_fn=attn)
