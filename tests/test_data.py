@@ -1,0 +1,130 @@
+"""Input pipeline (workload/data.py): memmap token windows, step-addressed
+determinism, multi-host slicing, prefetch transparency, and train_loop
+integration with checkpoint-resume."""
+
+import numpy as np
+import pytest
+
+from tpu_bootstrap.workload.data import (
+    DataConfig,
+    TokenDataset,
+    host_rows,
+    make_batch_fn,
+    prefetched,
+    write_token_file,
+)
+from tpu_bootstrap.workload.model import ModelConfig
+from tpu_bootstrap.workload.sharding import MeshConfig
+from tpu_bootstrap.workload.train import TrainConfig, train_loop
+
+
+@pytest.fixture()
+def token_file(tmp_path):
+    path = tmp_path / "tokens.bin"
+    rng = np.random.default_rng(0)
+    write_token_file(path, rng.integers(0, 64, size=4096))
+    return str(path)
+
+
+def test_windows_and_determinism(token_file):
+    ds = TokenDataset(DataConfig(path=token_file), seq_len=16)
+    assert ds.num_windows == 256
+    a = ds.batch(3, batch_size=8)
+    b = ds.batch(3, batch_size=8)
+    np.testing.assert_array_equal(a, b)  # step-addressed: pure function
+    assert a.shape == (8, 16) and a.dtype == np.int32
+    # different steps draw different windows (permuted order)
+    assert not np.array_equal(a, ds.batch(4, batch_size=8))
+    # batches tile the permutation: one epoch covers every window once
+    seen = set()
+    for step in range(256 // 8):
+        for row in ds.batch(step, batch_size=8):
+            seen.add(int(row[0]) * 100000 + int(row[-1]))
+    assert len(seen) > 200  # windows are distinct (token-content proxy)
+
+
+def test_epoch_wraparound(token_file):
+    ds = TokenDataset(DataConfig(path=token_file), seq_len=16)
+    np.testing.assert_array_equal(
+        ds.batch(0, batch_size=8), ds.batch(256 // 8, batch_size=8))
+
+
+def test_too_short_file_errors(tmp_path):
+    path = tmp_path / "tiny.bin"
+    write_token_file(path, [1, 2, 3])
+    with pytest.raises(ValueError, match="shorter than one"):
+        TokenDataset(DataConfig(path=str(path)), seq_len=16)
+
+
+def test_host_rows_partition():
+    rows = [host_rows(8, process_index=p, process_count=4) for p in range(4)]
+    covered = []
+    for r in rows:
+        covered.extend(range(*r.indices(8)))
+    assert covered == list(range(8))  # disjoint, ordered, complete
+    with pytest.raises(ValueError, match="divide"):
+        host_rows(6, process_index=0, process_count=4)
+
+
+def test_host_slices_reassemble_global_batch(token_file):
+    ds = TokenDataset(DataConfig(path=token_file), seq_len=16)
+    full = ds.batch(5, batch_size=8)
+    parts = [ds.batch(5, batch_size=8, rows=host_rows(8, p, 2)) for p in range(2)]
+    np.testing.assert_array_equal(np.concatenate(parts), full)
+
+
+def test_prefetched_matches_direct(token_file):
+    ds = TokenDataset(DataConfig(path=token_file), seq_len=16)
+    direct = [(i, ds.batch(i, 4)) for i in range(3, 9)]
+    fetched = list(prefetched(lambda i: ds.batch(i, 4), 3, 9))
+    assert [i for i, _ in fetched] == [i for i, _ in direct]
+    for (_, a), (_, b) in zip(fetched, direct):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_prefetched_propagates_errors(token_file):
+    def bad(step):
+        if step == 2:
+            raise RuntimeError("boom")
+        return np.zeros((1,))
+
+    it = prefetched(bad, 0, 5)
+    next(it)
+    next(it)
+    with pytest.raises(RuntimeError, match="boom"):
+        list(it)
+
+
+def test_prefetched_abandoned_iterator_joins_worker(token_file):
+    """Breaking out of the loop early (consumer error path) must unblock
+    and join the worker thread instead of leaving it pinned on a full
+    queue holding staged batches."""
+    import threading
+
+    before = threading.active_count()
+    ds = TokenDataset(DataConfig(path=token_file), seq_len=16)
+    it = prefetched(lambda i: ds.batch(i, 4), 0, 1000, depth=2)
+    next(it)
+    it.close()  # what an exception in the consuming loop does
+    assert threading.active_count() == before
+
+
+def test_train_loop_on_file_data_resumes_exactly(token_file, tmp_path):
+    cfg = TrainConfig(
+        model=ModelConfig(vocab_size=64, num_layers=1, num_heads=2, head_dim=8,
+                          embed_dim=16, mlp_dim=32, max_seq_len=16),
+        mesh=MeshConfig(data=2, tensor=2),
+        data=DataConfig(path=token_file),
+        grad_clip_norm=1.0,
+        warmup_steps=2,
+        total_steps=6,
+    )
+    full = train_loop(cfg, 6, checkpoint_dir=str(tmp_path / "full"), save_every=2)
+    assert len(full) == 6 and np.isfinite(full).all()
+
+    part = str(tmp_path / "part")
+    first = train_loop(cfg, 3, checkpoint_dir=part, save_every=1)
+    resumed = train_loop(cfg, 6, checkpoint_dir=part, save_every=1)
+    # File-backed batches are step-addressed, so resume replays the exact
+    # continuation of the uninterrupted run.
+    np.testing.assert_array_equal(np.asarray(first + resumed), np.asarray(full))

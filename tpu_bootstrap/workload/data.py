@@ -1,0 +1,152 @@
+"""Input pipeline for the slice workload: memory-mapped token shards,
+deterministic multi-host batch slicing, and host->device prefetch.
+
+TPU-first design:
+* The dataset is a flat binary file of token ids (np.memmap) cut into
+  non-overlapping max_seq_len windows — no Python-object datasets, no
+  per-item dispatch; a batch is one fancy-index gather into the memmap.
+* Batch order is a seeded permutation of windows, addressed BY STEP
+  INDEX: batch(step) is a pure function, so checkpoint-resume replays
+  exactly the batch an uninterrupted run would have seen (the same
+  contract train.synthetic_batch keeps) with no iterator state to save.
+* Multi-host: every host computes the same global permutation but
+  gathers only its process's rows, then assembles the global array with
+  jax.make_array_from_process_local_data — data-parallel input without
+  a distributed filesystem coordinator or cross-host shuffle traffic.
+* Prefetch: a background thread stages the NEXT batch's gather + device
+  transfer while the current step runs, so input never sits on the
+  critical path (double buffering, the standard TPU input recipe).
+
+Reference parity note: the reference (bacchus-gpu-controller) schedules
+opaque pods and has no input pipeline (SURVEY.md §2); this module feeds
+the training workload its JobSets run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+
+import jax
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    path: str  # flat binary token file
+    dtype: str = "uint16"  # token storage dtype (uint16 covers vocab < 65536)
+    seed: int = 0
+
+
+class TokenDataset:
+    """Non-overlapping max_seq_len windows over a memory-mapped token
+    file, in a seeded permuted order, addressable by (epoch-folded) step."""
+
+    def __init__(self, cfg: DataConfig, seq_len: int):
+        self.tokens = np.memmap(cfg.path, dtype=np.dtype(cfg.dtype), mode="r")
+        self.seq_len = seq_len
+        self.num_windows = len(self.tokens) // seq_len
+        if self.num_windows < 1:
+            raise ValueError(
+                f"{cfg.path}: {len(self.tokens)} tokens is shorter than one "
+                f"window of {seq_len}")
+        self.perm = np.random.default_rng(cfg.seed).permutation(self.num_windows)
+
+    def batch(self, step: int, batch_size: int, *, rows: slice | None = None) -> np.ndarray:
+        """The global batch for ``step`` (or its ``rows`` sub-slice, for
+        the per-host cut): (batch_size | len(rows), seq_len) int32.
+        Wraps around the permutation at epoch boundaries."""
+        idx = (step * batch_size + np.arange(batch_size)) % self.num_windows
+        win = self.perm[idx]
+        if rows is not None:
+            win = win[rows]
+        starts = win * self.seq_len
+        gather = starts[:, None] + np.arange(self.seq_len)[None, :]
+        return np.asarray(self.tokens[gather], dtype=np.int32)
+
+
+def host_rows(batch_size: int, process_index: int | None = None,
+              process_count: int | None = None) -> slice:
+    """This host's contiguous row range of the global batch. Hosts must
+    divide the batch evenly (JobSet geometry guarantees equal hosts)."""
+    p = jax.process_index() if process_index is None else process_index
+    n = jax.process_count() if process_count is None else process_count
+    if batch_size % n != 0:
+        raise ValueError(f"batch size {batch_size} must divide over {n} hosts")
+    per = batch_size // n
+    return slice(p * per, (p + 1) * per)
+
+
+def make_batch_fn(cfg: DataConfig, seq_len: int, batch_size: int, sharding):
+    """step -> sharded device array (batch_size, seq_len), gathering only
+    this host's rows and assembling the global array across processes."""
+    ds = TokenDataset(cfg, seq_len)
+    global_shape = (batch_size, seq_len)
+
+    def get(step: int):
+        local = ds.batch(step, batch_size, rows=host_rows(batch_size))
+        return jax.make_array_from_process_local_data(sharding, local, global_shape)
+
+    return get
+
+
+def prefetched(batch_fn, start: int, stop: int, depth: int = 2):
+    """Iterate batch_fn(start..stop) with a background thread staging
+    ``depth`` batches ahead (gather + device transfer off the critical
+    path). Exceptions in the worker surface on the consuming side; an
+    abandoned iterator (consumer raised / broke early) unblocks and joins
+    the worker instead of leaving it pinned on a full queue."""
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    cancel = threading.Event()
+    _END, _ERR = object(), object()
+
+    def offer(item) -> bool:
+        while not cancel.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def worker():
+        try:
+            for step in range(start, stop):
+                if not offer((step, batch_fn(step))):
+                    return
+            offer(_END)
+        except BaseException as e:  # noqa: BLE001 — re-raised on the consumer side
+            offer((_ERR, e))
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                break
+            if isinstance(item, tuple) and item[0] is _ERR:
+                raise item[1]
+            yield item
+    finally:
+        cancel.set()
+        while not q.empty():  # drop staged batches so the worker can exit
+            q.get_nowait()
+        t.join()
+
+
+def write_token_file(path, tokens, dtype: str = "uint16") -> None:
+    """Helper for tests/tools: persist a token sequence as the flat
+    binary format TokenDataset reads."""
+    np.asarray(tokens).astype(np.dtype(dtype)).tofile(path)
+
+
+__all__ = [
+    "DataConfig",
+    "TokenDataset",
+    "host_rows",
+    "make_batch_fn",
+    "prefetched",
+    "write_token_file",
+]

@@ -32,6 +32,15 @@ class TrainConfig:
     model: ModelConfig = ModelConfig()
     mesh: MeshConfig = MeshConfig()
     learning_rate: float = 3e-4
+    # LR schedule: linear warmup over warmup_steps, then cosine decay to
+    # zero at total_steps. total_steps == 0 keeps a constant LR.
+    warmup_steps: int = 0
+    total_steps: int = 0
+    grad_clip_norm: float = 0.0  # 0 = no clipping
+    weight_decay: float = 1e-4
+    # Token source: None = deterministic synthetic batches; a DataConfig
+    # reads memory-mapped token shards (workload/data.py).
+    data: "object | None" = None
     remat: bool = False  # jax.checkpoint the loss to trade FLOPs for HBM
     # Attention core: "dense" (einsum path, XLA-fused) or "flash" (the
     # Pallas kernel, O(seq) memory — see workload/flash_attention.py).
@@ -43,7 +52,19 @@ class TrainConfig:
 
 
 def make_optimizer(cfg: TrainConfig):
-    return optax.adamw(cfg.learning_rate)
+    if cfg.total_steps > 0:
+        lr = optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=cfg.learning_rate,
+            warmup_steps=max(cfg.warmup_steps, 1),
+            decay_steps=cfg.total_steps,
+        )
+    else:
+        lr = cfg.learning_rate
+    opt = optax.adamw(lr, weight_decay=cfg.weight_decay)
+    if cfg.grad_clip_norm > 0:
+        opt = optax.chain(optax.clip_by_global_norm(cfg.grad_clip_norm), opt)
+    return opt
 
 
 def _init_params_for_mesh(cfg: TrainConfig):
@@ -191,17 +212,22 @@ def make_train_step(cfg: TrainConfig, mesh, p_shardings):
     )
 
 
+def global_batch_size(cfg: TrainConfig) -> int:
+    """The per-step token-batch row count for a mesh: 2 rows per
+    data-parallel slot, times the microbatch count when pipelined (the
+    pipeline reshape(M, batch//M, ...) must tile)."""
+    batch = max(2 * cfg.mesh.dcn * cfg.mesh.data * cfg.mesh.fsdp * cfg.mesh.expert, 2)
+    if cfg.mesh.pipe > 1:
+        batch *= cfg.num_microbatches or 2 * cfg.mesh.pipe
+    return batch
+
+
 def synthetic_batch(cfg: TrainConfig, step_index: int, seed: int = 0):
     """Deterministic per-step token batch: resume from a checkpoint sees
     exactly the data an uninterrupted run would have seen."""
-    batch = max(2 * cfg.mesh.dcn * cfg.mesh.data * cfg.mesh.fsdp * cfg.mesh.expert, 2)
-    if cfg.mesh.pipe > 1:
-        # The pipeline splits the batch into microbatches; keep it an
-        # exact multiple so reshape(M, batch//M, ...) tiles.
-        batch *= cfg.num_microbatches or 2 * cfg.mesh.pipe
     return jax.random.randint(
         jax.random.PRNGKey(seed * 1_000_003 + step_index),
-        (batch, cfg.model.max_seq_len), 0, cfg.model.vocab_size,
+        (global_batch_size(cfg), cfg.model.max_seq_len), 0, cfg.model.vocab_size,
     )
 
 
@@ -253,12 +279,29 @@ def train_loop(cfg: TrainConfig, steps: int, *, checkpoint_dir: str | None = Non
     step_fn = make_train_step(cfg, mesh, p_shardings)
 
     losses = []
-    for i in range(start, steps):
-        tokens = jax.device_put(synthetic_batch(cfg, i, seed), batch_shardings(mesh))
+
+    def run_step(i, tokens):
+        nonlocal params, opt_state
         params, opt_state, loss_value = step_fn(params, opt_state, tokens)
         losses.append(float(loss_value))
         if mgr is not None and ((i + 1) % save_every == 0 or i + 1 == steps):
             ckpt.save(mgr, i + 1, params, opt_state)
+
+    if cfg.data is not None:
+        from tpu_bootstrap.workload.data import make_batch_fn, prefetched
+
+        batch_fn = make_batch_fn(
+            cfg.data, cfg.model.max_seq_len,
+            batch_size=global_batch_size(cfg),
+            sharding=batch_shardings(mesh))
+        # step-addressed batches: resume replays exactly what an
+        # uninterrupted run would have seen, with prefetch staging the
+        # gather + transfer off the critical path.
+        for i, tokens in prefetched(batch_fn, start, steps):
+            run_step(i, tokens)
+    else:
+        for i in range(start, steps):
+            run_step(i, jax.device_put(synthetic_batch(cfg, i, seed), batch_shardings(mesh)))
     if mgr is not None:
         mgr.wait_until_finished()
     return losses
@@ -353,8 +396,26 @@ def worker_main() -> None:
     save_every = int(os.environ.get("WORKLOAD_SAVE_EVERY", "10"))
     ckpt_dir = os.environ.get("WORKLOAD_CHECKPOINT_DIR") or None
     seed = int(os.environ.get("WORKLOAD_SEED", "0"))
+    # Real token data (shared storage) instead of synthetic batches.
+    data = None
+    if os.environ.get("WORKLOAD_DATA_PATH"):
+        from tpu_bootstrap.workload.data import DataConfig
 
-    cfg = TrainConfig(mesh=MeshConfig.for_device_count(len(jax.devices())))
+        data = DataConfig(path=os.environ["WORKLOAD_DATA_PATH"],
+                          dtype=os.environ.get("WORKLOAD_DATA_DTYPE", "uint16"),
+                          seed=seed)
+
+    # WORKLOAD_TOTAL_STEPS: unset -> cosine decay over the run's steps
+    # (the sensible training default); explicitly "0" -> constant LR
+    # (TrainConfig's documented total_steps == 0 mode).
+    total_env = os.environ.get("WORKLOAD_TOTAL_STEPS")
+    cfg = TrainConfig(
+        mesh=MeshConfig.for_device_count(len(jax.devices())),
+        data=data,
+        warmup_steps=int(os.environ.get("WORKLOAD_WARMUP_STEPS", "0")),
+        total_steps=steps if total_env is None else int(total_env),
+        grad_clip_norm=float(os.environ.get("WORKLOAD_GRAD_CLIP", "1.0")),
+    )
     losses = train_loop(cfg, steps, checkpoint_dir=ckpt_dir,
                         save_every=save_every, seed=seed)
     if losses:
