@@ -301,19 +301,32 @@ try:
     dbatch, d1, d2 = 8, 64, 192
     dprompt = jax.random.randint(jax.random.PRNGKey(1), (dbatch, 64), 0, dcfg.vocab_size)
 
-    def timed_gen(steps):
-        generate(dparams, dprompt, dcfg, steps).block_until_ready()  # compile+warm
+    def timed_gen(params, steps):
+        generate(params, dprompt, dcfg, steps).block_until_ready()  # compile+warm
         t0 = time.time()
-        generate(dparams, dprompt, dcfg, steps).block_until_ready()
+        generate(params, dprompt, dcfg, steps).block_until_ready()
         return time.time() - t0
 
     # Two-point measurement: the d2-d1 step difference cancels the prefill
     # (and any fixed dispatch overhead), giving pure per-decode-step cost.
-    t1, t2 = timed_gen(d1), timed_gen(d2)
+    t1, t2 = timed_gen(dparams, d1), timed_gen(dparams, d2)
     step_s = max((t2 - t1) / (d2 - d1), 1e-9)
     out.update({
         "decode_tokens_per_sec": round(dbatch / step_s, 1),
         "decode_step_ms": round(step_s * 1e3, 3),
+    })
+    emit()
+
+    # Same measurement with int8 weight-only quantized blocks (the
+    # bandwidth-bound regime where halved weight bytes should show).
+    from tpu_bootstrap.workload.quant import quantize_params
+
+    qparams = quantize_params(dparams)
+    q1, q2 = timed_gen(qparams, d1), timed_gen(qparams, d2)
+    qstep_s = max((q2 - q1) / (d2 - d1), 1e-9)
+    out.update({
+        "decode_int8_tokens_per_sec": round(dbatch / qstep_s, 1),
+        "decode_int8_speedup": round(step_s / qstep_s, 3),
     })
 except Exception as e:  # noqa: BLE001
     out["decode_bench_error"] = f"{type(e).__name__}: {e}"[:400]

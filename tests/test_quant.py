@@ -1,0 +1,110 @@
+"""Weight-only int8 quantization (workload/quant.py) and its decode
+integration.
+
+Correctness strategy: the fused kernel must match dequantize-then-matmul
+exactly (same arithmetic, different fusion); quantize/dequantize error
+is bounded by the per-channel step size; and quantized decode must stay
+close to the float model — identical argmax tokens on a well-scaled
+model is the acceptance bar for weight-only int8.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_bootstrap.workload.model import ModelConfig, forward, init_params
+from tpu_bootstrap.workload.quant import (
+    dequantize_weight,
+    int8_matmul,
+    is_quantized,
+    quantize_params,
+    quantize_weight,
+    reference_int8_matmul,
+)
+
+CFG = ModelConfig(vocab_size=64, num_layers=2, num_heads=4, head_dim=8,
+                  embed_dim=32, mlp_dim=64, max_seq_len=32, num_kv_heads=2)
+
+
+def test_quantize_roundtrip_error_bound():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 48))
+    qw = quantize_weight(w)
+    assert qw.q.dtype == jnp.int8 and qw.s.shape == (48,)
+    err = jnp.abs(dequantize_weight(qw) - w)
+    # symmetric rounding: error <= scale/2 per element, per channel
+    assert float(jnp.max(err - qw.s[None, :] / 2)) <= 1e-6
+
+
+def test_quantize_zero_channel_is_safe():
+    w = jnp.zeros((16, 4)).at[:, 0].set(1.0)
+    qw = quantize_weight(w)
+    assert np.isfinite(np.asarray(qw.s)).all()
+    np.testing.assert_allclose(np.asarray(dequantize_weight(qw)), np.asarray(w),
+                               atol=1e-6)
+
+
+@pytest.mark.parametrize("t,k,n", [(8, 32, 128), (3, 64, 200), (1, 32, 512)])
+def test_kernel_matches_reference(t, k, n):
+    """The fused dequant-matmul (interpret mode on CPU) == dequantize
+    then matmul, including T/N padding paths."""
+    x = jax.random.normal(jax.random.PRNGKey(1), (t, k), jnp.float32)
+    qw = quantize_weight(jax.random.normal(jax.random.PRNGKey(2), (k, n)))
+    got = int8_matmul(x, qw, block_n=128)
+    want = reference_int8_matmul(x, qw)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-2, atol=2e-2)
+
+
+def test_kernel_rejects_contraction_mismatch():
+    x = jnp.zeros((4, 16))
+    qw = quantize_weight(jnp.zeros((32, 8)))
+    with pytest.raises(ValueError, match="contraction"):
+        int8_matmul(x, qw)
+
+
+def test_quantize_params_structure():
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    qp = quantize_params(params)
+    blk = qp["blocks"][0]
+    assert is_quantized(blk["wq"]) and blk["wq"].shape == (32, 4, 8)
+    assert is_quantized(blk["wo"]) and blk["wo"].q.shape == (32, 32)  # (H*d, E)
+    # embedding and norms untouched
+    assert qp["embed"] is params["embed"]
+    assert blk["attn_norm"] is params["blocks"][0]["attn_norm"]
+
+
+def test_quantized_prefill_close_to_float():
+    from tpu_bootstrap.workload.decode import init_cache, prefill
+
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    qp = quantize_params(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, CFG.vocab_size)
+    want, _ = prefill(params, tokens, init_cache(CFG, 2, 8), CFG)
+    got, _ = prefill(qp, tokens, init_cache(CFG, 2, 8), CFG)
+    # weight-only int8: logits drift bounded, ranking preserved
+    assert float(jnp.max(jnp.abs(got - want))) < 0.35
+    np.testing.assert_array_equal(np.asarray(jnp.argmax(got, -1)),
+                                  np.asarray(jnp.argmax(want, -1)))
+
+
+def test_quantized_generation_runs_and_tracks_float():
+    from tpu_bootstrap.workload.decode import generate
+
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    qp = quantize_params(params)
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 4), 0, CFG.vocab_size)
+    got = generate(qp, prompt, CFG, 6)
+    want = generate(params, prompt, CFG, 6)
+    assert got.shape == want.shape == (2, 6)
+    # int8 weight noise may flip a late low-margin pick; the first tokens
+    # (largest margins) must agree.
+    np.testing.assert_array_equal(np.asarray(got[:, 0]), np.asarray(want[:, 0]))
+
+
+def test_quantized_moe_blocks_left_alone():
+    cfg = ModelConfig(vocab_size=64, num_layers=1, num_heads=2, head_dim=8,
+                      embed_dim=32, mlp_dim=64, max_seq_len=16,
+                      num_experts=2, expert_top_k=1)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    qp = quantize_params(params)
+    assert not is_quantized(qp["blocks"][0]["w_up"])  # expert stack untouched

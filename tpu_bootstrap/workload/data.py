@@ -57,6 +57,10 @@ class TokenDataset:
         """The global batch for ``step`` (or its ``rows`` sub-slice, for
         the per-host cut): (batch_size | len(rows), seq_len) int32.
         Wraps around the permutation at epoch boundaries."""
+        if batch_size > self.num_windows:
+            raise ValueError(
+                f"batch size {batch_size} exceeds the file's {self.num_windows} "
+                f"windows of {self.seq_len} tokens — every batch would repeat rows")
         idx = (step * batch_size + np.arange(batch_size)) % self.num_windows
         win = self.perm[idx]
         if rows is not None:

@@ -35,6 +35,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+import math
+
+from tpu_bootstrap.workload import quant
 from tpu_bootstrap.workload.model import (
     ModelConfig,
     Params,
@@ -43,6 +46,20 @@ from tpu_bootstrap.workload.model import (
     _rotary,
     moe_mlp,
 )
+
+
+def _linear(x: jax.Array, w, contract_rank: int, dtype) -> jax.Array:
+    """Projection of x's trailing dims against w's leading dims, for
+    float weights or int8-quantized ones (workload/quant.py) — the one
+    seam through which weight-only quantization reaches every block
+    projection."""
+    k = math.prod(w.shape[:contract_rank])
+    x2 = x.reshape(-1, k).astype(dtype)
+    if quant.is_quantized(w):
+        y = quant.int8_matmul(x2, w)
+    else:
+        y = x2 @ w.astype(dtype).reshape(k, -1)
+    return y.reshape(*x.shape[: x.ndim - contract_rank], *w.shape[contract_rank:])
 
 
 def init_cache(cfg: ModelConfig, batch: int, max_len: int):
@@ -58,8 +75,8 @@ def init_cache(cfg: ModelConfig, batch: int, max_len: int):
 
 def _project_kv(block: Params, h: jax.Array, positions: jax.Array, cfg: ModelConfig):
     dtype = cfg.compute_dtype
-    k = jnp.einsum("bse,ehd->bshd", h, block["wk"].astype(dtype))
-    v = jnp.einsum("bse,ehd->bshd", h, block["wv"].astype(dtype))
+    k = _linear(h, block["wk"], 1, dtype)
+    v = _linear(h, block["wv"], 1, dtype)
     return _rotary(k, positions), v
 
 
@@ -89,7 +106,7 @@ def _block_step(block: Params, x: jax.Array, cache: dict, positions: jax.Array,
     cache at `positions` and attention over the whole cache."""
     dtype = cfg.compute_dtype
     h = _rms_norm(x, block["attn_norm"])
-    q = jnp.einsum("bse,ehd->bshd", h, block["wq"].astype(dtype))
+    q = _linear(h, block["wq"], 1, dtype)
     q = _rotary(q, positions)
     k, v = _project_kv(block, h, positions, cfg)
     start = positions[0]
@@ -98,13 +115,13 @@ def _block_step(block: Params, x: jax.Array, cache: dict, positions: jax.Array,
         "v": lax.dynamic_update_slice(cache["v"], v, (0, start, 0, 0)),
     }
     out = _attend(q, cache["k"], cache["v"], valid, cfg)
-    x = x + jnp.einsum("bshd,hde->bse", out, block["wo"].astype(dtype))
+    x = x + _linear(out, block["wo"], 2, dtype)
     if cfg.num_experts > 0:
         h2 = _rms_norm(x, block["mlp_norm"])
         moe_out, _ = moe_mlp(block, h2, cfg)
         x = x + moe_out
     else:
-        x = x + _mlp(block, x, cfg)
+        x = x + _mlp(block, x, cfg, linear=_linear)
     return x, cache
 
 

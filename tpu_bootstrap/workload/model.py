@@ -174,12 +174,24 @@ def _attention(block: Params, x: jax.Array, cfg: ModelConfig, attn_fn=None) -> j
     return jnp.einsum("bshd,hde->bse", out, block["wo"].astype(dtype))
 
 
-def _mlp(block: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
+def _default_linear(x: jax.Array, w: jax.Array, contract_rank: int, dtype) -> jax.Array:
+    """Plain matmul projection of x's trailing dims against w's leading
+    dims (the float counterpart of decode._linear's quantized path)."""
+    k = 1
+    for d in w.shape[:contract_rank]:
+        k *= d
+    y = x.reshape(-1, k).astype(dtype) @ w.astype(dtype).reshape(k, -1)
+    return y.reshape(*x.shape[: x.ndim - contract_rank], *w.shape[contract_rank:])
+
+
+def _mlp(block: Params, x: jax.Array, cfg: ModelConfig, linear=_default_linear) -> jax.Array:
+    """Dense FFN. ``linear(x, w, contract_rank, dtype)`` overrides the
+    projection — the seam decode uses to route through int8-quantized
+    weights — so the norm/gelu structure has exactly one definition."""
     dtype = cfg.compute_dtype
     h = _rms_norm(x, block["mlp_norm"])
-    h = jnp.einsum("bse,em->bsm", h, block["w_up"].astype(dtype))
-    h = jax.nn.gelu(h)
-    return jnp.einsum("bsm,me->bse", h, block["w_down"].astype(dtype))
+    h = jax.nn.gelu(linear(h, block["w_up"], 1, dtype))
+    return linear(h, block["w_down"], 1, dtype)
 
 
 def forward_with_aux(params: Params, tokens: jax.Array, cfg: ModelConfig,

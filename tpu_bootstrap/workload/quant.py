@@ -1,0 +1,182 @@
+"""Weight-only int8 quantization for serving — halve the HBM bytes the
+decode loop streams.
+
+Why weight-only, and why for decode: autoregressive decoding is
+bandwidth-bound — every step reads every weight once to produce one
+token, so step latency ~= model bytes / HBM bandwidth. Storing block
+weights as int8 (+ one float32 scale per output channel) halves those
+bytes vs bfloat16; activations are never quantized to int8 — they cross
+the MXU in bfloat16, the standard TPU matmul precision (an f32
+compute_dtype model does incur that bf16 rounding on the quantized
+path) — so no calibration data is needed.
+
+The compute path is a Pallas kernel fusing dequantization into the
+matmul: the int8 tile is cast to bfloat16 in VMEM (never materialized in
+HBM), fed to the MXU with float32 accumulation, and scaled per output
+channel on the way out. Grid over N tiles; the K axis rides whole —
+right for the few-thousand-wide projections decode runs. Symmetric
+per-output-channel scales (scale = absmax/127 over the contraction
+axis) keep the kernel a pure multiply — no zero points.
+
+Scope: the transformer block projections (wq/wk/wv/wo, w_up/w_down).
+The embedding stays float — it is both a gather table and the logits
+head, the two most precision-sensitive uses. MoE expert stacks keep
+their own layout and are left unquantized for now.
+
+Reference parity note: the reference (bacchus-gpu-controller) has no
+compute path (SURVEY.md §2); this module extends the serving half of
+the JAX workload its JobSets launch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret_default() -> bool:
+    try:
+        return jax.default_backend() not in ("tpu", "axon")
+    except Exception:
+        return True
+
+
+@dataclasses.dataclass
+class QuantizedWeight:
+    """int8 values + per-output-channel f32 scales, stored in 2-D matmul
+    layout. ``shape`` is the original weight's logical shape — STATIC
+    pytree metadata (ints must not become tracers under jit)."""
+
+    q: jax.Array  # int8 (K, N)
+    s: jax.Array  # f32 (N,)
+    shape: tuple  # original logical shape, static
+
+
+jax.tree_util.register_dataclass(
+    QuantizedWeight, data_fields=["q", "s"], meta_fields=["shape"])
+
+
+def quantize_weight(w: jax.Array) -> QuantizedWeight:
+    """w: (K, N) float -> int8 with symmetric per-output-channel scales
+    over the contraction axis K."""
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127).astype(jnp.int8)
+    return QuantizedWeight(q=q, s=scale, shape=tuple(w.shape))
+
+
+def dequantize_weight(qw: QuantizedWeight) -> jax.Array:
+    return qw.q.astype(jnp.float32) * qw.s
+
+
+def _matmul_kernel(x_ref, q_ref, s_ref, o_ref):
+    # Dequant fused into the matmul: int8 -> bf16 happens in VMEM, the
+    # MXU accumulates f32, per-channel scales apply on the way out.
+    w = q_ref[:].astype(jnp.bfloat16)
+    acc = jax.lax.dot_general(
+        x_ref[:].astype(jnp.bfloat16), w,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[:] = (acc * s_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def int8_matmul(x: jax.Array, qw: QuantizedWeight, *, block_n: int = 512,
+                interpret: bool | None = None) -> jax.Array:
+    """x (T, K) @ dequant(qw) (K, N) -> (T, N) in x.dtype.
+
+    Pads T up to the float32 sublane tile (8) and N up to a lane-aligned
+    block; K must match the stored weight. The weight never exists in HBM
+    at more than 1 byte/element."""
+    if interpret is None:
+        interpret = _interpret_default()
+    t, k = x.shape
+    kq, n = qw.q.shape
+    if k != kq:
+        raise ValueError(f"contraction mismatch: x has K={k}, weight has K={kq}")
+
+    t_pad = -(-t // 8) * 8
+    bn = min(block_n, -(-n // 128) * 128)
+    n_pad = -(-n // bn) * bn
+    xp = jnp.pad(x, ((0, t_pad - t), (0, 0))) if t_pad != t else x
+    q = qw.q
+    s = qw.s
+    if n_pad != n:
+        q = jnp.pad(q, ((0, 0), (0, n_pad - n)))
+        s = jnp.pad(s, (0, n_pad - n))
+    s2 = s.reshape(1, n_pad)  # 2-D so the lane dim tiles
+
+    out = pl.pallas_call(
+        _matmul_kernel,
+        grid=(n_pad // bn,),
+        in_specs=[
+            pl.BlockSpec((t_pad, k), lambda j: (0, 0)),
+            pl.BlockSpec((k, bn), lambda j: (0, j)),
+            pl.BlockSpec((1, bn), lambda j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((t_pad, bn), lambda j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((t_pad, n_pad), x.dtype),
+        compiler_params=pltpu.CompilerParams(dimension_semantics=("parallel",)),
+        interpret=interpret,
+    )(xp, q, s2)
+    return out[:t, :n]
+
+
+def reference_int8_matmul(x: jax.Array, qw: QuantizedWeight) -> jax.Array:
+    """Oracle mirroring the kernel's arithmetic order (bf16 operands,
+    f32 accumulation, per-channel scale applied after the matmul) —
+    differences vs the kernel are then purely accumulation-order noise."""
+    acc = jax.lax.dot_general(
+        x.astype(jnp.bfloat16), qw.q.astype(jnp.bfloat16),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return (acc * qw.s).astype(x.dtype)
+
+
+def quantize_block(block: dict) -> dict:
+    """Quantize one dense transformer block's projections, preserving the
+    pytree keys decode._block_step reads. Weights are stored 2-D in
+    matmul layout (contraction axis first); original shapes are kept in
+    the wrapper for the callers' reshapes."""
+    if "router" in block:  # MoE block: expert stacks stay unquantized
+        return block
+
+    def q2d(w, contract_rank):
+        k = 1
+        for d in w.shape[:contract_rank]:
+            k *= d
+        qw = quantize_weight(w.reshape(k, -1))
+        return dataclasses.replace(qw, shape=tuple(w.shape))
+
+    out = dict(block)
+    for name, contract_rank in (("wq", 1), ("wk", 1), ("wv", 1), ("wo", 2),
+                                ("w_up", 1), ("w_down", 1)):
+        out[name] = q2d(block[name], contract_rank)
+    return out
+
+
+def quantize_params(params: dict) -> dict:
+    """Params pytree -> the same tree with dense block projections
+    int8-quantized (decode.py detects the quantized leaves)."""
+    return {**params, "blocks": [quantize_block(b) for b in params["blocks"]]}
+
+
+def is_quantized(w) -> bool:
+    return isinstance(w, QuantizedWeight)
+
+
+__all__ = [
+    "QuantizedWeight",
+    "dequantize_weight",
+    "int8_matmul",
+    "is_quantized",
+    "quantize_block",
+    "quantize_params",
+    "quantize_weight",
+    "reference_int8_matmul",
+]
