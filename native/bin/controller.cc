@@ -297,6 +297,11 @@ int main() {
 
   ControllerConfig cfg = load_config();
   KubeClient client(kube_config_from_env());
+  // Shutdown promptness: once stop is requested, any in-flight API
+  // request fails within ~1s instead of running out its full deadline —
+  // the worker/watcher joins below stay bounded even against a
+  // black-holed API server.
+  client.set_cancel(&stop_requested());
   log_info("starting controller",
            {{"api", client.config().base_url}, {"workers", std::to_string(cfg.workers)}});
 

@@ -164,6 +164,11 @@ int main() {
   sync_config.set("pool_capacity_chips", env.get_int("pool_capacity_chips", 0));
 
   KubeClient client(kube_config_from_env());
+  // Shutdown promptness: once stop is requested, any in-flight API
+  // request fails within ~1s instead of running out its full deadline —
+  // the worker/watcher joins below stay bounded even against a
+  // black-holed API server.
+  client.set_cancel(&stop_requested());
 
   HttpServer health(listen_addr, listen_port, [](const HttpRequest& req) {
     HttpResponse resp;

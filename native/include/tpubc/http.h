@@ -70,8 +70,16 @@ class HttpClient {
 
   const Url& base() const { return base_; }
 
+  // Process-level cancel: while *cancel is true, requests waiting on a
+  // response fail within ~1s (the DeadlineStream read tick) instead of
+  // running out their full deadline — keeps shutdown joins prompt.
+  // (Writes keep the full deadline; they carry small bodies and
+  // effectively never block.)
+  void set_cancel(std::atomic<bool>* cancel) { cancel_ = cancel; }
+
  private:
   struct Conn;
+  std::atomic<bool>* cancel_ = nullptr;
   std::unique_ptr<Conn> open(int timeout_secs);
   std::unique_ptr<Conn> take_pooled();
   void pool(std::unique_ptr<Conn> conn);

@@ -99,6 +99,9 @@ class KubeClient {
 
   const KubeConfig& config() const { return config_; }
 
+  // Fail in-flight requests within ~1s while *cancel is true (shutdown).
+  void set_cancel(std::atomic<bool>* cancel);
+
  private:
   Json check(const HttpResponse& resp);
   KubeConfig config_;

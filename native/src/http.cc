@@ -297,29 +297,49 @@ namespace {
 class DeadlineStream : public Stream {
  public:
   DeadlineStream(Stream* inner, std::function<void(long)> set_timeout,
-                 std::chrono::steady_clock::time_point deadline)
-      : inner_(inner), set_timeout_(std::move(set_timeout)), deadline_(deadline) {}
+                 std::chrono::steady_clock::time_point deadline,
+                 std::atomic<bool>* cancel)
+      : inner_(inner), set_timeout_(std::move(set_timeout)), deadline_(deadline),
+        cancel_(cancel) {}
   size_t read_some(char* buf, size_t len) override {
-    arm();
-    return inner_->read_some(buf, len);
+    // Wait in <=1s ticks so a process-level cancel (SIGTERM shutdown,
+    // leadership loss) interrupts an in-flight request promptly instead
+    // of pinning a shutdown join for the full request deadline.
+    while (true) {
+      arm();
+      try {
+        return inner_->read_some(buf, len);
+      } catch (const ReadTimeout&) {
+        // tick: arm() re-checks cancel and the deadline, then we wait on
+      }
+    }
   }
   void write_all(const char* buf, size_t len) override {
-    arm();
+    // Writes get the FULL remaining deadline (no 1s tick): a blocked
+    // send throws the transport's own error, not ReadTimeout, so a tick
+    // loop cannot distinguish "slow peer" from "failed write" — and our
+    // request bodies are small enough that writes essentially never
+    // block. Cancel is still checked once on entry.
+    arm(/*tick=*/false);
     inner_->write_all(buf, len);
   }
 
  private:
-  void arm() {
+  void arm(bool tick = true) {
+    if (cancel_ && cancel_->load()) throw ReadTimeout();
     auto now = std::chrono::steady_clock::now();
     if (now >= deadline_) throw ReadTimeout();
     auto remaining_ms =
         std::chrono::duration_cast<std::chrono::milliseconds>(deadline_ - now).count();
-    // Ceil to avoid arming 0 (= "no timeout" to setsockopt).
-    set_timeout_(std::max<long>(static_cast<long>(remaining_ms), 10));
+    // Floor avoids arming 0 (= "no timeout" to setsockopt); the 1s
+    // ceiling on reads keeps the cancel flag polled every tick.
+    long capped = std::max<long>(static_cast<long>(remaining_ms), 10);
+    set_timeout_(tick ? std::min<long>(capped, 1000) : capped);
   }
   Stream* inner_;
   std::function<void(long)> set_timeout_;
   std::chrono::steady_clock::time_point deadline_;
+  std::atomic<bool>* cancel_;
 };
 
 }  // namespace
@@ -353,7 +373,7 @@ HttpResponse HttpClient::request(const std::string& method, const std::string& p
     bool got_response_bytes = false;
     try {
       DeadlineStream stream(
-          conn->stream.get(), [&](long ms) { conn->set_timeout_ms(ms); }, deadline);
+          conn->stream.get(), [&](long ms) { conn->set_timeout_ms(ms); }, deadline, cancel_);
       // One write per request: head+body split across two TCP segments
       // interacts badly with delayed ACK on the peer.
       std::string frame = head + body;
@@ -414,9 +434,10 @@ int HttpClient::stream_lines(const std::string& path,
                              const std::function<bool(const std::string&)>& on_line,
                              std::atomic<bool>* cancel, int connect_timeout_secs) {
   auto conn = open(connect_timeout_secs);
-  // Long receive timeout so watch connections survive idle periods but the
-  // cancel flag is still polled every timeout tick.
-  struct timeval tv{5, 0};
+  // Receive in 1s ticks: watch connections survive idle periods
+  // indefinitely, while the cancel flag is polled every tick so shutdown
+  // joins stay ~1s-bounded (matching DeadlineStream's cancel cadence).
+  struct timeval tv{1, 0};
   ::setsockopt(conn->fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
 
   std::string head =

@@ -95,6 +95,8 @@ KubeClient::KubeClient(KubeConfig config) : config_(std::move(config)) {
                                        config_.token);
 }
 
+void KubeClient::set_cancel(std::atomic<bool>* cancel) { http_->set_cancel(cancel); }
+
 Json KubeClient::check(const HttpResponse& resp) {
   if (!resp.ok()) {
     std::string message = resp.body;

@@ -114,8 +114,13 @@ def test_full_onboarding_lifecycle(fake, tmp_path):
         sheet.write_text(CSV_HEADER + "앨리스,CSE,alice,tpu-serv,8,16,64,200,o\n")
 
         # -- 4+5. synchronizer + controller converge the approved state ------
+        # The synchronizer writes status BEFORE the quota patch (reference
+        # ordering, synchronizer.rs:302 before :324), so wait for the
+        # LATER write — waiting on the flag alone races the quota patch.
         ub = wait_for(
-            lambda: (lambda u: u if u.get("status", {}).get("synchronized_with_sheet") else None)(
+            lambda: (lambda u: u
+                     if u.get("status", {}).get("synchronized_with_sheet")
+                     and u.get("spec", {}).get("quota") else None)(
                 fake.get(fake.KEY_UB, "alice")
             ),
             desc="sheet sync",
