@@ -478,14 +478,21 @@ int HttpClient::stream_lines(const std::string& path,
       in_headers = false;
       if (status >= 300) {
         // Error bodies are small; collect to EOF and deliver as one line
-        // for diagnostics (the connection is Connection: close).
-        try {
-          while (true) {
+        // for diagnostics (the connection is Connection: close). The 1s
+        // receive tick fires as ReadTimeout on any mid-body pause —
+        // keep reading through those up to a bounded drain window so a
+        // briefly-stalling server cannot truncate its own error message.
+        const auto drain_deadline = std::chrono::steady_clock::now() + std::chrono::seconds(5);
+        while (std::chrono::steady_clock::now() < drain_deadline) {
+          try {
             size_t more = conn->stream->read_some(tmp, sizeof(tmp));
             if (more == 0) break;
             buf.append(tmp, more);
+          } catch (const ReadTimeout&) {
+            continue;  // idle tick, not EOF
+          } catch (const std::exception&) {
+            break;
           }
-        } catch (const std::exception&) {
         }
         on_line(buf);
         return status;

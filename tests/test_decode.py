@@ -75,6 +75,37 @@ def test_sampled_generation_shape_and_determinism(params):
     assert (np.asarray(a) >= 0).all() and (np.asarray(a) < CFG.vocab_size).all()
 
 
+def test_top_k_and_top_p_filtering():
+    from tpu_bootstrap.workload.decode import _filter_logits
+
+    logits = jnp.log(jnp.asarray([[0.5, 0.25, 0.15, 0.07, 0.03]]))
+    # top_k=2: only the two largest survive
+    f = _filter_logits(logits, top_k=2, top_p=1.0)
+    assert np.isfinite(np.asarray(f)[0, :2]).all()
+    assert np.isneginf(np.asarray(f)[0, 2:]).all()
+    # top_p=0.7: 0.5 alone misses 0.7, 0.5+0.25 reaches it -> keep 2
+    f = _filter_logits(logits, top_k=0, top_p=0.7)
+    assert np.isfinite(np.asarray(f)[0, :2]).all()
+    assert np.isneginf(np.asarray(f)[0, 2:]).all()
+    # tiny top_p: the argmax always survives
+    f = _filter_logits(logits, top_k=0, top_p=1e-6)
+    assert np.isfinite(np.asarray(f)[0, 0])
+    assert np.isneginf(np.asarray(f)[0, 1:]).all()
+
+
+def test_sampled_generation_with_filters(params):
+    prompt = jax.random.randint(jax.random.PRNGKey(4), (2, 4), 0, CFG.vocab_size)
+    out = generate(params, prompt, CFG, 5, temperature=1.0,
+                   key=jax.random.PRNGKey(9), top_k=8, top_p=0.9)
+    assert out.shape == (2, 5)
+    assert (np.asarray(out) >= 0).all() and (np.asarray(out) < CFG.vocab_size).all()
+    # top_k=1 sampling degenerates to greedy regardless of temperature
+    greedy = generate(params, prompt, CFG, 5)
+    k1 = generate(params, prompt, CFG, 5, temperature=1.0,
+                  key=jax.random.PRNGKey(1), top_k=1)
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(greedy))
+
+
 def test_moe_decode_runs():
     cfg = ModelConfig(vocab_size=64, num_layers=2, num_heads=2, head_dim=8,
                       embed_dim=32, mlp_dim=64, max_seq_len=32,

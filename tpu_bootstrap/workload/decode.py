@@ -162,10 +162,34 @@ def decode_step(params: Params, token: jax.Array, pos: jax.Array, caches: list,
     return _logits(params, x)[:, 0], new_caches
 
 
-@partial(jax.jit, static_argnames=("cfg", "steps", "temperature"))
+def _filter_logits(logits: jax.Array, top_k: int, top_p: float) -> jax.Array:
+    """Standard sampling filters, static-shape (sort + mask, no gather of
+    dynamic extent). top_k > 0 keeps only the k highest logits; top_p < 1
+    keeps the smallest prefix of the probability-sorted vocab whose mass
+    reaches p (nucleus) — the top choice always survives."""
+    if top_k > 0:
+        k = min(top_k, logits.shape[-1])  # clamp: top_k >= vocab keeps all
+        kth = jnp.sort(logits, axis=-1)[..., -k][..., None]
+        logits = jnp.where(logits >= kth, logits, -jnp.inf)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep entries whose PRECEDING mass is < p (so the first is always kept)
+        keep_sorted = (cum - probs) < top_p
+        # the cutoff is the SMALLEST kept logit; everything below it drops
+        cutoff = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1,
+                         keepdims=True)
+        logits = jnp.where(logits >= cutoff, logits, -jnp.inf)
+    return logits
+
+
+@partial(jax.jit, static_argnames=("cfg", "steps", "temperature", "top_k", "top_p"))
 def generate(params: Params, prompt: jax.Array, cfg: ModelConfig, steps: int,
-             temperature: float = 0.0, key: jax.Array | None = None):
-    """Greedy (temperature == 0) or sampled generation.
+             temperature: float = 0.0, key: jax.Array | None = None,
+             top_k: int = 0, top_p: float = 1.0):
+    """Greedy (temperature == 0) or sampled generation, with optional
+    top-k and/or nucleus (top-p) filtering of the sampled distribution.
 
     prompt: (B, S) int32; returns (B, steps) int32 continuations. The
     cache is sized S + steps; the whole thing — prefill plus a
@@ -174,6 +198,8 @@ def generate(params: Params, prompt: jax.Array, cfg: ModelConfig, steps: int,
     """
     if steps < 1:
         raise ValueError(f"steps must be >= 1, got {steps}")
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     b, s = prompt.shape
     caches = init_cache(cfg, b, s + steps)
     logits, caches = prefill(params, prompt, caches, cfg)
@@ -183,7 +209,10 @@ def generate(params: Params, prompt: jax.Array, cfg: ModelConfig, steps: int,
     def pick(logits, key):
         if temperature == 0.0:
             return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
-        return jax.random.categorical(key, logits / temperature, axis=-1).astype(prompt.dtype)
+        # Temperature BEFORE the filters (the standard semantics): the
+        # nucleus must be the p-mass of the distribution actually sampled.
+        logits = _filter_logits(logits / temperature, top_k, top_p)
+        return jax.random.categorical(key, logits, axis=-1).astype(prompt.dtype)
 
     key, sub = jax.random.split(key)  # never reuse a consumed key
     first = pick(logits, sub)
