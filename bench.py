@@ -346,8 +346,10 @@ def _last_json_line(text: str):
 
 def workload_bench(timeout_secs: int = 600):
     """Run the TPU workload micro-bench in a subprocess, first and
-    isolated (VERDICT r1 item 1): explicit JAX_PLATFORMS passthrough, a
-    hard timeout against hung backend init, and one retry. The subprocess
+    isolated (VERDICT r1 item 1): explicit JAX_PLATFORMS passthrough and
+    a hard timeout. Fast failures (crash, no JSON) get one retry; a
+    timeout with ZERO output — hung backend init, i.e. a dead tunnel —
+    does NOT retry (it would hang just as long again). The subprocess
     emits its accumulated results after every milestone, so even a
     timeout or crash returns whatever was measured up to that point. On
     total failure returns the error string instead of raising — the
@@ -388,7 +390,12 @@ def workload_bench(timeout_secs: int = 600):
                     "workload_bench_error",
                     f"timed out after {timeout_secs}s with partial results")
                 return parsed
-            err = f"workload bench timed out after {timeout_secs}s (backend init hang?)"
+            # Zero output after the full window = backend init hung (dead
+            # tunnel/relay). A retry would hang just as long — don't burn
+            # another window; the control-plane bench is waiting.
+            return {"workload_bench_error":
+                    f"workload bench timed out after {timeout_secs}s with no "
+                    "output (backend init hang — tunnel down?)"}
         except Exception as e:  # noqa: BLE001
             err = str(e)[:400]
     return {"workload_bench_error": err}
