@@ -166,6 +166,21 @@ class WorkQueue {
   bool stopping_ = false;
 };
 
+// Apply an Event, carrying count/firstTimestamp over from any previously
+// stored Event with the same deterministic name so recurrence history
+// survives re-emission.
+void post_event(KubeClient& client, Json event) {
+  Json prev;
+  try {
+    prev = client.get("v1", "Event", event.get("metadata").get_string("namespace"),
+                      event.get("metadata").get_string("name"));
+  } catch (const KubeError& e) {
+    if (e.status != 404) throw;
+  }
+  client.apply(refresh_event(prev, std::move(event)), kFieldManager, /*force=*/true);
+  Metrics::instance().inc("events_emitted_total");
+}
+
 // One reconcile pass for one CR, mirroring reconcile() in controller.rs
 // plus JobSet + status.slice maintenance. Returns false when the CR is
 // gone (callers must not requeue it).
@@ -283,6 +298,18 @@ bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::s
         // converges.
         log_warn("slice status update failed", {{"name", name}, {"error", e.what()}});
       }
+      // Surface the phase transition as a core/v1 Event so `kubectl
+      // describe ub` shows slice history. Best-effort: an event that
+      // fails to post must never fail the reconcile.
+      Json event = slice_event(ub, ub.get("status").get("slice").get_string("phase"),
+                               desired_slice, now_rfc3339());
+      if (event.is_object()) {
+        try {
+          post_event(client, std::move(event));
+        } catch (const std::exception& e) {
+          log_warn("event post failed", {{"name", name}, {"error", e.what()}});
+        }
+      }
     }
   }
   Metrics::instance().inc("reconciles_total");
@@ -368,6 +395,24 @@ int main() {
         } catch (const std::exception& e) {
           log_error("reconcile failed", {{"name", name}, {"error", e.what()}});
           Metrics::instance().inc("reconcile_errors_total");
+          // Best-effort Warning event (deterministic name: repeated
+          // failures refresh one Event — count/firstTimestamp carry the
+          // recurrence history). kubectl matches events to the CR by
+          // involvedObject.uid, so resolve the real object if we can;
+          // if the CR itself is unreachable, post uid-less rather than
+          // not at all.
+          try {
+            Json subject = Json::object({{"metadata", Json::object({{"name", name}})}});
+            try {
+              subject = client.get(kApiVersion, kKind, "", name);
+            } catch (const std::exception&) {
+            }
+            post_event(client, build_event(subject, "ReconcileError", e.what(),
+                                           "Warning", now_rfc3339()));
+          } catch (const std::exception& ev_err) {
+            log_warn("error event post failed",
+                     {{"name", name}, {"error", ev_err.what()}});
+          }
           queue.done(name);
           queue.add(name, cfg.error_requeue_secs * 1000);  // controller.rs:174
         }

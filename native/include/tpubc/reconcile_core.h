@@ -47,4 +47,31 @@ Json build_jobset(const Json& ub, const Json& config);
 // Desired status.slice block given the CR and the observed JobSet (or null).
 Json slice_status(const Json& ub, const Json& observed_jobset);
 
+// A core/v1 Event attached to the CR (involvedObject), applied by the
+// daemons so `kubectl describe ub <name>` shows reconcile history. The
+// reference has no event recorder (its operators log only); a real
+// operator surfaces state transitions as Events, so the TPU build adds
+// one. Cluster-scoped CRs' events live in the "default" namespace by
+// convention (same as Node events). The name is deterministic on
+// (CR, reason), so re-emitting the same reason replaces one Event object
+// instead of piling up new ones; callers that want count/firstTimestamp
+// continuity across re-emissions thread the previously stored Event
+// through refresh_event before applying.
+// `type` is "Normal" or "Warning" (k8s event type contract).
+Json build_event(const Json& ub, const std::string& reason,
+                 const std::string& message, const std::string& type,
+                 const std::string& timestamp);
+
+// Carry recurrence history over from the previously stored Event with the
+// same name (or pass prev=null for first emission): bumps count and keeps
+// the original firstTimestamp, so kubectl shows "N times since T0" rather
+// than resetting on every transition.
+Json refresh_event(const Json& prev, Json fresh);
+
+// Event for a slice phase transition old_phase -> new_slice.phase, or null
+// when nothing changed (or the new phase is empty). Pure: timestamp is
+// threaded in so tests stay deterministic.
+Json slice_event(const Json& ub, const std::string& old_phase,
+                 const Json& new_slice, const std::string& timestamp);
+
 }  // namespace tpubc

@@ -133,6 +133,21 @@ char* tpubc_slice_status(const char* ub, const char* jobset) {
   });
 }
 
+char* tpubc_slice_event(const char* ub, const char* old_phase, const char* new_slice,
+                        const char* timestamp) {
+  return guarded([&] {
+    return tpubc::slice_event(tpubc::Json::parse(ub), old_phase,
+                              tpubc::Json::parse(new_slice), timestamp)
+        .dump();
+  });
+}
+
+char* tpubc_refresh_event(const char* prev, const char* fresh) {
+  return guarded([&] {
+    return tpubc::refresh_event(tpubc::Json::parse(prev), tpubc::Json::parse(fresh)).dump();
+  });
+}
+
 char* tpubc_infer_header(const char* header) {
   return guarded([&] { return tpubc::infer_header(header); });
 }

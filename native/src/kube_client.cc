@@ -26,6 +26,7 @@ const KindInfo kKinds[] = {
     {"v1", "Namespace", "namespaces", false},
     {"v1", "ResourceQuota", "resourcequotas", true},
     {"v1", "Pod", "pods", true},
+    {"v1", "Event", "events", true},
     {"coordination.k8s.io/v1", "Lease", "leases", true},
     {"rbac.authorization.k8s.io/v1", "Role", "roles", true},
     {"rbac.authorization.k8s.io/v1", "RoleBinding", "rolebindings", true},

@@ -342,4 +342,79 @@ Json slice_status(const Json& ub, const Json& observed_jobset) {
   return st;
 }
 
+Json build_event(const Json& ub, const std::string& reason,
+                 const std::string& message, const std::string& type,
+                 const std::string& timestamp) {
+  const Json& m = ub.get("metadata");
+  const std::string cr_name = m.get_string("name");
+  Json event_meta = Json::object({
+      // Deterministic name: one Event object per (CR, reason) pair,
+      // refreshed in place. Lowercased like target_namespace — CR names
+      // may be mixed-case, object names must be RFC-1123.
+      {"name", to_lower(cr_name) + "." + to_lower(reason)},
+      {"namespace", "default"},
+  });
+  // Owned by the CR so deletion cascades — only when the caller has the
+  // real object (an owner reference with an empty uid is invalid).
+  if (!m.get_string("uid").empty()) {
+    event_meta.set("ownerReferences", Json::array({owner_reference(ub)}));
+  }
+  return Json::object({
+      {"apiVersion", "v1"},
+      {"kind", "Event"},
+      {"metadata", event_meta},
+      {"involvedObject", Json::object({
+                             {"apiVersion", kApiVersion},
+                             {"kind", kKind},
+                             {"name", cr_name},
+                             {"uid", m.get_string("uid")},
+                         })},
+      {"reason", reason},
+      {"message", message},
+      {"type", type},
+      {"source", Json::object({{"component", "tpu-bootstrap-controller"}})},
+      {"reportingComponent", "tpu-bootstrap-controller"},
+      {"firstTimestamp", timestamp},
+      {"lastTimestamp", timestamp},
+      {"count", 1},
+  });
+}
+
+Json refresh_event(const Json& prev, Json fresh) {
+  if (prev.is_object()) {
+    fresh.set("count", prev.get_int("count", 1) + 1);
+    const std::string first = prev.get_string("firstTimestamp");
+    if (!first.empty()) fresh.set("firstTimestamp", first);
+  }
+  return fresh;
+}
+
+Json slice_event(const Json& ub, const std::string& old_phase,
+                 const Json& new_slice, const std::string& timestamp) {
+  const std::string phase = new_slice.get_string("phase");
+  if (phase.empty() || phase == old_phase || phase == "Absent") return Json();
+
+  const std::string jobset = new_slice.get_string("jobset");
+  const std::string chips = std::to_string(new_slice.get_int("chips", 0));
+  const std::string hosts = std::to_string(new_slice.get_int("hosts", 0));
+  std::string message;
+  std::string type = "Normal";
+  if (phase == "Pending") {
+    message = "TPU slice requested (" + chips + " chips); awaiting sheet approval";
+  } else if (phase == "Provisioning") {
+    message = "JobSet " + jobset + " created: " + chips + " chips across " +
+              hosts + " hosts, waiting for the gang to come up";
+  } else if (phase == "Running") {
+    message = "all " + hosts + " hosts ready; slice is running";
+  } else if (phase == "Succeeded") {
+    message = "slice workload completed";
+  } else if (phase == "Failed") {
+    message = "JobSet " + jobset + " failed";
+    type = "Warning";
+  } else {
+    message = "slice phase is now " + phase;
+  }
+  return build_event(ub, "Slice" + phase, message, type, timestamp);
+}
+
 }  // namespace tpubc

@@ -200,6 +200,18 @@ def test_controller_materializes_full_slice(fake):
         assert infs and infs[0].value == samples["tpubc_reconcile_duration_ms_count"].value
         # in-daemon p50 exposed via the JSON surface for the bench
         assert d.metrics()["tpubc_reconcile_duration_ms_p50"] > 0
+
+        # slice phase transitions surface as core/v1 Events on the CR
+        # (kubectl describe ub alice) — cluster-scoped CR, so they land in
+        # the "default" namespace with a deterministic per-reason name.
+        ev = wait_for(
+            lambda: fake.get(("api/v1", "default", "events"), "alice.sliceprovisioning"),
+            desc="slice provisioning event",
+        )
+        assert ev["involvedObject"]["name"] == "alice"
+        assert ev["involvedObject"]["uid"] == ub["metadata"]["uid"]
+        assert ev["type"] == "Normal"
+        assert "alice-slice" in ev["message"]
     finally:
         code, err = d.stop()
         assert code == 0, err

@@ -292,3 +292,54 @@ def test_slice_status_phases(lib):
 
     js["status"] = {"conditions": [{"type": "Failed", "status": "True"}]}
     assert lib.slice_status(cr, js)["phase"] == "Failed"
+
+
+def test_slice_event_on_phase_transition(lib):
+    cr = ub(spec={"tpu": tpu_spec(chips=4, hosts=1)})
+    new = {"phase": "Provisioning", "jobset": "alice-slice", "chips": 4, "hosts": 1}
+    ev = lib.slice_event(cr, "Pending", new, "2026-07-30T00:00:00Z")
+    assert ev["kind"] == "Event"
+    # lowercased like target_namespace: CR names may be mixed-case, object
+    # names must be RFC-1123
+    assert ev["metadata"]["name"] == "alice.sliceprovisioning"
+    assert ev["metadata"]["namespace"] == "default"
+    assert ev["involvedObject"] == {
+        "apiVersion": "tpu.bacchus.io/v1",
+        "kind": "UserBootstrap",
+        "name": "Alice",
+        "uid": "u-1",
+    }
+    assert ev["reason"] == "SliceProvisioning"
+    assert ev["type"] == "Normal"
+    assert "alice-slice" in ev["message"]
+    assert ev["firstTimestamp"] == "2026-07-30T00:00:00Z"
+    # Owned by the CR: cascade deletion cleans events up with the CR.
+    assert ev["metadata"]["ownerReferences"][0]["uid"] == "u-1"
+
+
+def test_slice_event_failed_is_warning(lib):
+    cr = ub(spec={"tpu": tpu_spec(chips=4, hosts=1)})
+    new = {"phase": "Failed", "jobset": "alice-slice", "chips": 4, "hosts": 1}
+    ev = lib.slice_event(cr, "Running", new, "2026-07-30T00:00:00Z")
+    assert ev["type"] == "Warning"
+    assert ev["reason"] == "SliceFailed"
+
+
+def test_slice_event_null_when_no_transition(lib):
+    cr = ub(spec={"tpu": tpu_spec(chips=4, hosts=1)})
+    same = {"phase": "Running", "chips": 4, "hosts": 1}
+    assert lib.slice_event(cr, "Running", same, "t") is None
+    # Absent (non-TPU CR) never emits.
+    assert lib.slice_event(cr, "", {"phase": "Absent"}, "t") is None
+
+
+def test_refresh_event_carries_recurrence_history(lib):
+    cr = ub(spec={"tpu": tpu_spec(chips=4, hosts=1)})
+    first = lib.slice_event(cr, "Running", {"phase": "Failed", "jobset": "j"}, "T0")
+    again = lib.slice_event(cr, "Running", {"phase": "Failed", "jobset": "j"}, "T5")
+    merged = lib.refresh_event(first, again)
+    assert merged["count"] == 2
+    assert merged["firstTimestamp"] == "T0"
+    assert merged["lastTimestamp"] == "T5"
+    # First emission: prev=null passes fresh through untouched.
+    assert lib.refresh_event(None, first) == first

@@ -135,6 +135,18 @@ class NativeLib:
     def slice_status(self, ub: Any, jobset: Any) -> dict:
         return self._call_json("tpubc_slice_status", ub, jobset)
 
+    def slice_event(
+        self, ub: Any, old_phase: str, new_slice: Any, timestamp: str
+    ) -> dict | None:
+        # ub must be passed as JSON even when callers hand over a dict with
+        # only metadata; old_phase/timestamp are raw strings.
+        return self._call_json(
+            "tpubc_slice_event", ub, old_phase, new_slice, timestamp
+        )
+
+    def refresh_event(self, prev: Any, fresh: Any) -> dict:
+        return self._call_json("tpubc_refresh_event", prev, fresh)
+
     def parse_sheet(self, csv_text: str) -> dict:
         return self._call_json("tpubc_parse_sheet", csv_text)
 
