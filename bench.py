@@ -205,16 +205,13 @@ float(jnp.sum(jnp.ones((128, 128), jnp.bfloat16) @ jnp.ones((128, 128), jnp.bflo
 out["chip_alive"] = True
 emit()
 
+PEAK_BF16 = 197e12  # v5e chip peak, bf16
+
 try:
     from tpu_bootstrap.workload.flash_attention import flash_attention
     from tpu_bootstrap.workload.ring_attention import reference_attention
 
-    shape = (4, 2048, 8, 64)
-    ks = jax.random.split(jax.random.PRNGKey(0), 3)
-    q, k, v = (jax.random.normal(kk, shape, jnp.bfloat16) for kk in ks)
-    iters = 10
-
-    def timed(core):
+    def timed(core, q, k, v, iters=10):
         # Loop on-device via scan: per-dispatch tunnel latency (ms-scale on
         # axon) would otherwise swamp the kernel time.
         @jax.jit
@@ -233,28 +230,37 @@ try:
         flash_attention(q, k, v, block_size=128, interpret=False).astype(jnp.float32)))
     g_dense = jax.grad(lambda q, k, v: jnp.sum(
         reference_attention(q, k, v).astype(jnp.float32)))
-    flash_ms = timed(g_flash)
-    out["flash_attn_fwd_bwd_ms_seq2048"] = round(flash_ms, 3)
-    emit()
-    dense_ms = timed(g_dense)
-    out.update({
-        "dense_attn_fwd_bwd_ms_seq2048": round(dense_ms, 3),
-        "flash_attn_speedup": round(dense_ms / flash_ms, 3),
-    })
+
+    # Fixed 32k tokens per measurement (batch*seq), so the seq sweep shows
+    # the O(seq^2)-HBM vs O(seq)-HBM scaling at equal work granularity.
+    for batch, seq in ((4, 2048), (2, 4096), (1, 8192)):
+        shape = (batch, seq, 8, 64)
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q, k, v = (jax.random.normal(kk, shape, jnp.bfloat16) for kk in ks)
+        flash_ms = timed(g_flash, q, k, v)
+        out[f"flash_attn_fwd_bwd_ms_seq{seq}"] = round(flash_ms, 3)
+        emit()
+        dense_ms = timed(g_dense, q, k, v)
+        out[f"dense_attn_fwd_bwd_ms_seq{seq}"] = round(dense_ms, 3)
+        key = "flash_attn_speedup" if seq == 2048 else f"flash_attn_speedup_seq{seq}"
+        out[key] = round(dense_ms / flash_ms, 3)
+        emit()
 except Exception as e:  # noqa: BLE001
     out["flash_bench_error"] = f"{type(e).__name__}: {e}"[:400]
 emit()
 
-# Train-step throughput + MFU on the single chip: the flagship config from
-# __graft_entry__.entry(), one full fwd+bwd+adamw step under jit.
+# Train-step throughput + MFU on the single chip: a ~134M-param LM (bf16
+# activations, flash attention) — big enough that the MXU, not dispatch,
+# dominates.
 try:
     from tpu_bootstrap.workload.model import ModelConfig
     from tpu_bootstrap.workload.sharding import MeshConfig, batch_shardings, build_mesh
     from tpu_bootstrap.workload.train import TrainConfig, init_train_state, make_train_step
 
     cfg = TrainConfig(
-        model=ModelConfig(vocab_size=512, num_layers=4, num_heads=8, head_dim=32,
-                          embed_dim=256, mlp_dim=1024, max_seq_len=256),
+        model=ModelConfig(vocab_size=32768, num_layers=8, num_heads=16, head_dim=64,
+                          embed_dim=1024, mlp_dim=4096, max_seq_len=1024,
+                          compute_dtype=jnp.bfloat16),
         mesh=MeshConfig(data=1, fsdp=1, seq=1, tensor=1),
         attention="flash",
     )
@@ -266,8 +272,14 @@ try:
         jax.random.randint(jax.random.PRNGKey(1), (batch, cfg.model.max_seq_len), 0,
                            cfg.model.vocab_size),
         batch_shardings(mesh))
-    params, opt_state, _ = step(params, opt_state, tokens)  # compile+warm
-    n_steps = 20
+    n_steps = 10
+
+    # Async dispatch loop with ONE host sync at the end: the device
+    # executes the steps back-to-back (donated buffers, no transfers), so
+    # elapsed/n is honest per-step time; a host sync per step would add a
+    # full tunnel round-trip each.
+    params, opt_state, loss = step(params, opt_state, tokens)  # compile+warm
+    float(loss)
     t0 = time.time()
     for _ in range(n_steps):
         params, opt_state, loss = step(params, opt_state, tokens)
@@ -275,28 +287,32 @@ try:
     step_ms = (time.time() - t0) / n_steps * 1e3
     n_params = sum(x.size for x in jax.tree.leaves(params))
     tokens_per_step = batch * (cfg.model.max_seq_len - 1)
-    # 6ND matmul flops + 12*B*H*S^2*D attention flops, fwd+bwd.
+    # 6ND matmul flops + 12*B*L*H*S^2*D attention flops, fwd+bwd.
     m = cfg.model
     attn_flops = 12 * batch * m.num_layers * m.num_heads * (m.max_seq_len - 1) ** 2 * m.head_dim
     flops_per_step = 6 * n_params * tokens_per_step + attn_flops
-    peak = 197e12  # v5e chip, bf16
     out.update({
         "train_step_ms": round(step_ms, 3),
+        "train_model_params_m": round(n_params / 1e6, 1),
         "train_tokens_per_sec": round(tokens_per_step / (step_ms / 1e3), 1),
-        "train_mfu_pct": round(100 * flops_per_step / (step_ms / 1e3) / peak, 2),
+        "train_mfu_pct": round(100 * flops_per_step / (step_ms / 1e3) / PEAK_BF16, 2),
     })
 except Exception as e:  # noqa: BLE001
     out["train_bench_error"] = f"{type(e).__name__}: {e}"[:400]
 emit()
 
 # Decode throughput: greedy generation with the KV cache (the serving
-# path) — tokens/sec at batch 8 on the single chip.
+# path) — tokens/sec at batch 8 on the single chip. Same ~134M-param
+# model as the train bench: decode is weight-bandwidth-bound, so the
+# model must be big enough that weight bytes (not dispatch noise)
+# dominate — also what makes the int8 comparison meaningful.
 try:
     from tpu_bootstrap.workload.decode import generate
     from tpu_bootstrap.workload.model import ModelConfig, init_params
 
-    dcfg = ModelConfig(vocab_size=512, num_layers=4, num_heads=8, head_dim=32,
-                       embed_dim=256, mlp_dim=1024, max_seq_len=512)
+    dcfg = ModelConfig(vocab_size=32768, num_layers=8, num_heads=16, head_dim=64,
+                       embed_dim=1024, mlp_dim=4096, max_seq_len=512,
+                       compute_dtype=jnp.bfloat16)
     dparams = init_params(dcfg, jax.random.PRNGKey(0))
     dbatch, d1, d2 = 8, 64, 192
     dprompt = jax.random.randint(jax.random.PRNGKey(1), (dbatch, 64), 0, dcfg.vocab_size)

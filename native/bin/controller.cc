@@ -166,21 +166,6 @@ class WorkQueue {
   bool stopping_ = false;
 };
 
-// Apply an Event, carrying count/firstTimestamp over from any previously
-// stored Event with the same deterministic name so recurrence history
-// survives re-emission.
-void post_event(KubeClient& client, Json event) {
-  Json prev;
-  try {
-    prev = client.get("v1", "Event", event.get("metadata").get_string("namespace"),
-                      event.get("metadata").get_string("name"));
-  } catch (const KubeError& e) {
-    if (e.status != 404) throw;
-  }
-  client.apply(refresh_event(prev, std::move(event)), kFieldManager, /*force=*/true);
-  Metrics::instance().inc("events_emitted_total");
-}
-
 // One reconcile pass for one CR, mirroring reconcile() in controller.rs
 // plus JobSet + status.slice maintenance. Returns false when the CR is
 // gone (callers must not requeue it).

@@ -13,6 +13,8 @@
 // inventory comes from CONF_POOL_CAPACITY_CHIPS or a CONF_INVENTORY_URL
 // returning {"capacity_chips": N}, and admission against capacity is
 // first-come (plan_sync in sheet_core.cc).
+#include <map>
+
 #include "tpubc/config.h"
 #include "tpubc/crd.h"
 #include "tpubc/google_auth.h"
@@ -20,6 +22,7 @@
 #include "tpubc/json.h"
 #include "tpubc/kube_client.h"
 #include "tpubc/log.h"
+#include "tpubc/reconcile_core.h"
 #include "tpubc/runtime.h"
 #include "tpubc/sheet_core.h"
 #include "tpubc/util.h"
@@ -85,6 +88,13 @@ void run_sync_once(KubeClient& client, const Json& sync_config, SheetSource& she
   Json list = client.list(kApiVersion, kKind);
   Json plan = plan_sync(list.get("items"), parsed.get("rows"), config);
 
+  // Prior per-CR state, for the QuotaSynchronized transition event: the
+  // interesting moment is the sheet-approval gate OPENING (first sync),
+  // not the steady-state re-sync every tick.
+  std::map<std::string, Json> prior;
+  for (const auto& item : list.get("items").items())
+    prior[item.get("metadata").get_string("name")] = item;
+
   for (const auto& s : plan.get("skipped").items())
     log_warn("sync skipped", {{"name", s.get_string("name")}, {"reason", s.get_string("reason")}});
 
@@ -114,6 +124,24 @@ void run_sync_once(KubeClient& client, const Json& sync_config, SheetSource& she
       }
       throw;
     }
+    // Gate-opening event (best-effort): kubectl describe shows when the
+    // admin's sheet approval landed and what it granted. Posted right
+    // after the status write — the moment the gate actually opened — so
+    // a quota-patch failure below cannot lose it for good (next tick's
+    // prior state would already read synchronized).
+    const Json& before = prior[name];
+    if (!before.get("status").get_bool("synchronized_with_sheet", false)) {
+      try {
+        post_event(client,
+                   build_event(before, "QuotaSynchronized",
+                               "sheet row approved: quota synchronized (" +
+                                   std::to_string(action.get_int("chips", 0)) + " chips)",
+                               "Normal", now_rfc3339(), "tpu-bootstrap-synchronizer"));
+      } catch (const std::exception& e) {
+        log_warn("event post failed", {{"name", name}, {"error", e.what()}});
+      }
+    }
+
     // 2. quota patch.
     log_info("updating quota", {{"name", name}, {"chips", std::to_string(action.get_int("chips", 0))}});
     client.json_patch(kApiVersion, kKind, "", name, action.get("patches"));

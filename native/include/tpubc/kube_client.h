@@ -108,4 +108,11 @@ class KubeClient {
   std::unique_ptr<HttpClient> http_;
 };
 
+// Apply a core/v1 Event (built by build_event), carrying count and
+// firstTimestamp over from any previously stored Event with the same
+// deterministic name so recurrence history survives re-emission. Bumps
+// the events_emitted_total metric. Shared by the controller (slice phase
+// transitions, reconcile errors) and the synchronizer (quota sync).
+void post_event(KubeClient& client, Json event);
+
 }  // namespace tpubc

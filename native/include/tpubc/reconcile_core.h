@@ -60,7 +60,8 @@ Json slice_status(const Json& ub, const Json& observed_jobset);
 // `type` is "Normal" or "Warning" (k8s event type contract).
 Json build_event(const Json& ub, const std::string& reason,
                  const std::string& message, const std::string& type,
-                 const std::string& timestamp);
+                 const std::string& timestamp,
+                 const std::string& component = "tpu-bootstrap-controller");
 
 // Carry recurrence history over from the previously stored Event with the
 // same name (or pass prev=null for first emission): bumps count and keeps

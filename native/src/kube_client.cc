@@ -4,6 +4,8 @@
 
 #include "tpubc/crd.h"
 #include "tpubc/log.h"
+#include "tpubc/reconcile_core.h"
+#include "tpubc/runtime.h"
 #include "tpubc/util.h"
 
 namespace tpubc {
@@ -238,6 +240,18 @@ std::string KubeClient::watch(const std::string& api_version, const std::string&
     // hot-looping on an instantly-failing stream.
     throw KubeError(status, error_body.empty() ? "watch failed" : error_body);
   return gone ? "" : last_rv;
+}
+
+void post_event(KubeClient& client, Json event) {
+  Json prev;
+  try {
+    prev = client.get("v1", "Event", event.get("metadata").get_string("namespace"),
+                      event.get("metadata").get_string("name"));
+  } catch (const KubeError& e) {
+    if (e.status != 404) throw;
+  }
+  client.apply(refresh_event(prev, std::move(event)), kFieldManager, /*force=*/true);
+  Metrics::instance().inc("events_emitted_total");
 }
 
 }  // namespace tpubc

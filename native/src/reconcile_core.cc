@@ -344,7 +344,7 @@ Json slice_status(const Json& ub, const Json& observed_jobset) {
 
 Json build_event(const Json& ub, const std::string& reason,
                  const std::string& message, const std::string& type,
-                 const std::string& timestamp) {
+                 const std::string& timestamp, const std::string& component) {
   const Json& m = ub.get("metadata");
   const std::string cr_name = m.get_string("name");
   Json event_meta = Json::object({
@@ -372,8 +372,8 @@ Json build_event(const Json& ub, const std::string& reason,
       {"reason", reason},
       {"message", message},
       {"type", type},
-      {"source", Json::object({{"component", "tpu-bootstrap-controller"}})},
-      {"reportingComponent", "tpu-bootstrap-controller"},
+      {"source", Json::object({{"component", component}})},
+      {"reportingComponent", component},
       {"firstTimestamp", timestamp},
       {"lastTimestamp", timestamp},
       {"count", 1},

@@ -99,8 +99,13 @@ def test_deployment_env_matches_daemon_config_surface():
 
 def test_webhook_registration():
     src = template_sources()["webhook.yaml"]
-    assert "failurePolicy: Fail" in src
-    assert "timeoutSeconds: 10" in src
+    # failurePolicy/timeout are values-driven; the safe defaults live in
+    # values.yaml (Fail: policy must not fail open)
+    assert "failurePolicy: {{ .Values.admission.webhook.failurePolicy }}" in src
+    assert "timeoutSeconds: {{ .Values.admission.webhook.timeoutSeconds }}" in src
+    values = load_values()
+    assert values["admission"]["webhook"]["failurePolicy"] == "Fail"
+    assert values["admission"]["webhook"]["timeoutSeconds"] == 10
     assert 'operations: ["CREATE", "UPDATE", "DELETE"]' in src
     assert "tpu.bacchus.io" in src
     assert "path: /mutate" in src

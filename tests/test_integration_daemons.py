@@ -524,6 +524,17 @@ def test_synchronizer_end_to_end(fake, tmp_path):
             == "32",
             desc="quota refresh",
         )
+
+        # the gate-opening transition surfaced as a core/v1 Event — once,
+        # not re-emitted by the steady-state re-sync every tick (count
+        # would exceed 1 only if a later tick saw the gate closed again)
+        ev = fake.get(("api/v1", "default", "events"), "alice.quotasynchronized")
+        assert ev is not None
+        assert ev["involvedObject"]["name"] == "alice"
+        assert ev["source"]["component"] == "tpu-bootstrap-synchronizer"
+        assert "16 chips" in ev["message"]
+        assert ev["count"] == 1
+        assert fake.get(("api/v1", "default", "events"), "bob.quotasynchronized") is None
     finally:
         code, err = d.stop()
         assert code == 0, err

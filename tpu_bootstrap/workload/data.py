@@ -90,6 +90,8 @@ def make_batch_fn(cfg: DataConfig, seq_len: int, batch_size: int, sharding):
 
     def get(step: int):
         local = ds.batch(step, batch_size, rows=host_rows(batch_size))
+        if sharding is None:  # degenerate 1-device mesh (see batch_shardings)
+            return jax.device_put(local)
         return jax.make_array_from_process_local_data(sharding, local, global_shape)
 
     return get

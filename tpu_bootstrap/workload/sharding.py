@@ -80,6 +80,17 @@ def build_mesh(cfg: MeshConfig, devices=None) -> Mesh:
     return Mesh(grid, ("dcn", "pipe", "data", "fsdp", "expert", "seq", "tensor"))
 
 
+def degenerate_mesh(mesh: Mesh) -> bool:
+    """A 1-device mesh whose device IS the process default device needs no
+    sharding machinery at all: skipping device_put / shard_map / jit
+    sharding annotations is semantically identical but keeps the plain
+    single-device executable — committed or explicitly-sharded inputs
+    force the SPMD path, which dispatches ~40x slower through tunneled
+    single-chip backends (axon). A 1-device mesh pinned to a NON-default
+    device is not degenerate: there the explicit placement is the point."""
+    return mesh.size == 1 and mesh.devices.flat[0] == jax.devices()[0]
+
+
 def param_shardings(mesh: Mesh, params: Params):
     """PartitionSpecs per parameter.
 
@@ -163,7 +174,7 @@ def param_shardings(mesh: Mesh, params: Params):
 BATCH_AXES = ("dcn", "data", "fsdp", "expert")
 
 
-def batch_shardings(mesh: Mesh) -> NamedSharding:
+def batch_shardings(mesh: Mesh):
     """Tokens: batch over every data-parallel axis (dcn slices and the
     expert axis included — outside the MoE layer the expert axis is just
     more data parallelism, so no chip idles during attention).
@@ -171,7 +182,13 @@ def batch_shardings(mesh: Mesh) -> NamedSharding:
     one more than the activation length after loss_fn's shift, so it
     cannot tile evenly over the seq axis; with seq>1 the ring-attention
     shard_map boundary pins the activation sharding and GSPMD inserts the
-    (tiny, int32) reshard of the embedded tokens."""
+    (tiny, int32) reshard of the embedded tokens.
+
+    Degenerate 1-device mesh (see degenerate_mesh): returns None
+    (jax.device_put's "default device, uncommitted" placement) so the
+    plain single-device executable path is preserved."""
+    if degenerate_mesh(mesh):
+        return None
     return NamedSharding(mesh, P(BATCH_AXES, None))
 
 
@@ -187,6 +204,7 @@ __all__ = [
     "BATCH_AXES",
     "MeshConfig",
     "build_mesh",
+    "degenerate_mesh",
     "param_shardings",
     "batch_shardings",
     "replicated",

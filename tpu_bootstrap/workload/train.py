@@ -22,6 +22,7 @@ from tpu_bootstrap.workload.sharding import (
     MeshConfig,
     batch_shardings,
     build_mesh,
+    degenerate_mesh,
     param_shardings,
     replicated,
 )
@@ -99,7 +100,8 @@ def init_train_state(cfg: TrainConfig, mesh, key: jax.Array):
     workload/pipeline.py."""
     params = _init_params_for_mesh(cfg)(key)
     p_shardings = param_shardings(mesh, params)
-    params = jax.tree.map(jax.device_put, params, p_shardings)
+    if not degenerate_mesh(mesh):
+        params = jax.tree.map(jax.device_put, params, p_shardings)
     opt_state = make_optimizer(cfg).init(params)
     return params, opt_state, p_shardings
 
@@ -155,15 +157,18 @@ def make_train_step(cfg: TrainConfig, mesh, p_shardings):
         # the batch (data+fsdp) and heads (tensor) axes: each device runs
         # the Pallas kernel on its local shard. Without this, GSPMD has no
         # partitioning rule for pallas_call and would all-gather q/k/v and
-        # run the kernel fully replicated.
-        spec = P(BATCH_AXES, None, "tensor", None)
-        attn = jax.shard_map(
-            make_flash_attn_fn(block_size=cfg.attention_block),
-            mesh=mesh,
-            in_specs=(spec, spec, spec),
-            out_specs=spec,
-            check_vma=False,
-        )
+        # run the kernel fully replicated. On a degenerate 1-device mesh
+        # there is nothing to partition — call the kernel directly.
+        attn = make_flash_attn_fn(block_size=cfg.attention_block)
+        if not degenerate_mesh(mesh):
+            spec = P(BATCH_AXES, None, "tensor", None)
+            attn = jax.shard_map(
+                attn,
+                mesh=mesh,
+                in_specs=(spec, spec, spec),
+                out_specs=spec,
+                check_vma=False,
+            )
     else:
         attn = None
 
@@ -193,17 +198,26 @@ def make_train_step(cfg: TrainConfig, mesh, p_shardings):
     # can be pinned to the seq axis; resharding a few int32 tokens is
     # cheap, whereas leaving the boundary to GSPMD made it rematerialize
     # full f32 activations at the ring's shard_map edge.
-    shifted_sharding = NamedSharding(
+    single_device = degenerate_mesh(mesh)
+    shifted_sharding = None if single_device else NamedSharding(
         mesh, P(BATCH_AXES, "seq" if seq_parallel else None))
 
     def step(params, opt_state, tokens):
-        inputs = jax.lax.with_sharding_constraint(tokens[:, :-1], shifted_sharding)
-        targets = jax.lax.with_sharding_constraint(tokens[:, 1:], shifted_sharding)
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        if shifted_sharding is not None:
+            inputs = jax.lax.with_sharding_constraint(inputs, shifted_sharding)
+            targets = jax.lax.with_sharding_constraint(targets, shifted_sharding)
         loss_value, grads = jax.value_and_grad(loss)(params, inputs, targets)
         updates, opt_state = opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss_value
 
+    if single_device:
+        # No sharding annotations at all: a 1-device mesh gets the plain
+        # single-device executable (annotations force the SPMD path — a
+        # no-op partition-wise, but ~40x slower to dispatch through
+        # tunneled single-chip backends like axon).
+        return jax.jit(step, donate_argnums=(0, 1))
     return jax.jit(
         step,
         in_shardings=(p_shardings, None, batch_shardings(mesh)),
