@@ -227,7 +227,7 @@ try:
         return (time.time() - t0) / iters * 1e3
 
     g_flash = jax.grad(lambda q, k, v: jnp.sum(
-        flash_attention(q, k, v, block_size=128, interpret=False).astype(jnp.float32)))
+        flash_attention(q, k, v, interpret=False).astype(jnp.float32)))
     g_dense = jax.grad(lambda q, k, v: jnp.sum(
         reference_attention(q, k, v).astype(jnp.float32)))
 

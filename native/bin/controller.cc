@@ -15,6 +15,7 @@
 #include <algorithm>
 #include <chrono>
 #include <condition_variable>
+#include <deque>
 #include <functional>
 #include <map>
 #include <mutex>
@@ -166,10 +167,121 @@ class WorkQueue {
   bool stopping_ = false;
 };
 
+// Informer-style object cache fed by the CR watch stream (the
+// client-go/kube-rs reflector pattern): reconcile passes read the CR from
+// here instead of paying a GET round-trip per pass. Level-triggered
+// semantics are preserved — a slightly stale read just means the watch
+// event that refreshed the cache has already requeued the CR.
+class ObjectCache {
+ public:
+  void put(const Json& obj) {
+    std::lock_guard<std::mutex> lock(mutex_);
+    objects_[obj.get("metadata").get_string("name")] = obj;
+  }
+
+  void remove(const std::string& name) {
+    std::lock_guard<std::mutex> lock(mutex_);
+    objects_.erase(name);
+  }
+
+  // Replace the whole cache from a fresh LIST (relist after watch-history
+  // expiry): objects deleted during the gap must not linger.
+  void reset(const Json& list) {
+    std::map<std::string, Json> fresh;
+    for (const auto& item : list.get("items").items())
+      fresh[item.get("metadata").get_string("name")] = item;
+    std::lock_guard<std::mutex> lock(mutex_);
+    objects_ = std::move(fresh);
+  }
+
+  bool get(const std::string& name, Json* out) const {
+    std::lock_guard<std::mutex> lock(mutex_);
+    auto it = objects_.find(name);
+    if (it == objects_.end()) return false;
+    *out = it->second;
+    return true;
+  }
+
+ private:
+  mutable std::mutex mutex_;
+  std::map<std::string, Json> objects_;
+};
+
+// Async event sink: reconcile workers enqueue, one drainer thread posts.
+// Events are best-effort operator telemetry — two API round-trips (prior
+// lookup + apply) must not ride the reconcile critical path (the
+// client-go event-broadcaster pattern). Bounded queue; overflow drops
+// the event and counts it.
+class EventSink {
+ public:
+  explicit EventSink(KubeClient& client) : client_(client) {
+    drainer_ = std::thread([this] { drain(); });
+  }
+
+  void enqueue(Json event) {
+    {
+      std::lock_guard<std::mutex> lock(mutex_);
+      if (stopping_) return;
+      if (queue_.size() >= kMaxQueued) {
+        Metrics::instance().inc("events_dropped_total");
+        return;
+      }
+      queue_.push_back(std::move(event));
+    }
+    cv_.notify_one();
+  }
+
+  // Stop the drainer, discarding anything still queued: events are
+  // best-effort telemetry, and draining a backlog against an unreachable
+  // API server (each post burning its full connect deadline) could
+  // outlive the pod's termination grace period and skip the
+  // leader-lease release that runs after us. A healthy drainer keeps
+  // the queue empty, so a clean shutdown loses nothing.
+  void stop() {
+    {
+      std::lock_guard<std::mutex> lock(mutex_);
+      stopping_ = true;
+      Metrics::instance().inc("events_dropped_total",
+                              static_cast<int64_t>(queue_.size()));
+      queue_.clear();
+    }
+    cv_.notify_all();
+    drainer_.join();
+  }
+
+ private:
+  static constexpr size_t kMaxQueued = 1024;
+
+  void drain() {
+    std::unique_lock<std::mutex> lock(mutex_);
+    while (true) {
+      cv_.wait(lock, [&] { return stopping_ || !queue_.empty(); });
+      if (queue_.empty() && stopping_) return;
+      Json event = std::move(queue_.front());
+      queue_.pop_front();
+      lock.unlock();
+      try {
+        post_event(client_, std::move(event));
+      } catch (const std::exception& e) {
+        log_warn("event post failed", {{"error", e.what()}});
+      }
+      lock.lock();
+    }
+  }
+
+  KubeClient& client_;
+  std::mutex mutex_;
+  std::condition_variable cv_;
+  std::deque<Json> queue_;
+  bool stopping_ = false;
+  std::thread drainer_;
+};
+
 // One reconcile pass for one CR, mirroring reconcile() in controller.rs
 // plus JobSet + status.slice maintenance. Returns false when the CR is
 // gone (callers must not requeue it).
-bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::string& name) {
+bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::string& name,
+                   EventSink& events, const ObjectCache& cache) {
   // Whole-pass latency histogram: the in-daemon half of the BASELINE
   // metric surface, scrapeable at /metrics and read back by bench.py.
   struct PassTimer {
@@ -182,13 +294,11 @@ bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::s
     }
   } timer;
 
+  // The CR comes from the watch-fed cache (informer pattern): no GET
+  // round-trip per pass. Absent from cache = deleted (the watch DELETED
+  // event removed it); owner refs GC the children.
   Json ub;
-  try {
-    ub = client.get(kApiVersion, kKind, "", name);
-  } catch (const KubeError& e) {
-    if (e.status == 404) return false;  // deleted; owner refs GC the children
-    throw;
-  }
+  if (!cache.get(name, &ub)) return false;
 
   log_info("reconciling", {{"name", name}});
   std::vector<Json> children = desired_children(ub, cfg.core);
@@ -284,17 +394,11 @@ bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::s
         log_warn("slice status update failed", {{"name", name}, {"error", e.what()}});
       }
       // Surface the phase transition as a core/v1 Event so `kubectl
-      // describe ub` shows slice history. Best-effort: an event that
-      // fails to post must never fail the reconcile.
+      // describe ub` shows slice history. Queued to the async sink:
+      // best-effort telemetry stays off the reconcile critical path.
       Json event = slice_event(ub, ub.get("status").get("slice").get_string("phase"),
                                desired_slice, now_rfc3339());
-      if (event.is_object()) {
-        try {
-          post_event(client, std::move(event));
-        } catch (const std::exception& e) {
-          log_warn("event post failed", {{"name", name}, {"error", e.what()}});
-        }
-      }
+      if (event.is_object()) events.enqueue(std::move(event));
     }
   }
   Metrics::instance().inc("reconciles_total");
@@ -356,6 +460,9 @@ int main() {
     }
   }
 
+  EventSink events(client);
+  ObjectCache cache;
+
   // Reconcile workers.
   std::vector<std::thread> workers;
   for (int64_t i = 0; i < cfg.workers; ++i) {
@@ -374,7 +481,7 @@ int main() {
           continue;
         }
         try {
-          bool exists = reconcile_one(client, cfg, name);
+          bool exists = reconcile_one(client, cfg, name, events, cache);
           queue.done(name);
           if (exists) queue.add(name, cfg.requeue_secs * 1000);  // controller.rs:154
         } catch (const std::exception& e) {
@@ -384,20 +491,13 @@ int main() {
           // failures refresh one Event — count/firstTimestamp carry the
           // recurrence history). kubectl matches events to the CR by
           // involvedObject.uid, so resolve the real object if we can;
-          // if the CR itself is unreachable, post uid-less rather than
+          // if the CR is not in the cache, post uid-less rather than
           // not at all.
-          try {
-            Json subject = Json::object({{"metadata", Json::object({{"name", name}})}});
-            try {
-              subject = client.get(kApiVersion, kKind, "", name);
-            } catch (const std::exception&) {
-            }
-            post_event(client, build_event(subject, "ReconcileError", e.what(),
-                                           "Warning", now_rfc3339()));
-          } catch (const std::exception& ev_err) {
-            log_warn("error event post failed",
-                     {{"name", name}, {"error", ev_err.what()}});
-          }
+          Json subject;
+          if (!cache.get(name, &subject))
+            subject = Json::object({{"metadata", Json::object({{"name", name}})}});
+          events.enqueue(build_event(subject, "ReconcileError", e.what(),
+                                     "Warning", now_rfc3339()));
           queue.done(name);
           queue.add(name, cfg.error_requeue_secs * 1000);  // controller.rs:174
         }
@@ -414,14 +514,14 @@ int main() {
   // IS the relist trigger.
   auto run_watch_loop = [&](const std::string& api_version, const std::string& kind,
                             const std::string& relist_metric,
-                            const std::function<void(const Json&)>& on_seed_item,
+                            const std::function<void(const Json&)>& on_list,
                             const std::function<void(const std::string&, const Json&)>& on_event) {
     std::string rv;
     while (!stop_requested().load()) {
       try {
         if (rv.empty()) {
           Json list = client.list(api_version, kind);
-          for (const auto& item : list.get("items").items()) on_seed_item(item);
+          on_list(list);
           rv = list.get("metadata").get_string("resourceVersion");
           Metrics::instance().inc(relist_metric);
         }
@@ -468,25 +568,36 @@ int main() {
           api_version, kind, "child_relists_total",
           // Seed requeues cover events missed across a 410/compaction
           // gap; they are relist noise, not child events — don't count.
-          [&](const Json& item) { requeue_owner(item, /*count_event=*/false); },
+          [&](const Json& list) {
+            for (const auto& item : list.get("items").items())
+              requeue_owner(item, /*count_event=*/false);
+          },
           [&](const std::string&, const Json& obj) { requeue_owner(obj, /*count_event=*/true); });
     });
   }
 
-  // CR watcher: list -> enqueue everything -> watch from the list's
-  // resourceVersion.
+  // CR watcher: list -> seed the informer cache + enqueue everything ->
+  // watch from the list's resourceVersion, keeping the cache current.
   std::thread watcher([&] {
     run_watch_loop(
         kApiVersion, kKind, "relists_total",
-        [&](const Json& item) { queue.add(item.get("metadata").get_string("name"), 0); },
+        [&](const Json& list) {
+          // Full replace, not merge: a relist after watch-history expiry
+          // must drop objects deleted during the gap.
+          cache.reset(list);
+          for (const auto& item : list.get("items").items())
+            queue.add(item.get("metadata").get_string("name"), 0);
+        },
         [&](const std::string& type, const Json& obj) {
           const std::string name = obj.get("metadata").get_string("name");
           if (name.empty()) return;
           Metrics::instance().inc("watch_events_total");
           if (type == "DELETED") {
+            cache.remove(name);
             queue.remove(name);  // GC handles children; stop requeueing
             return;
           }
+          cache.put(obj);
           queue.add(name, 0);
         });
   });
@@ -508,6 +619,10 @@ int main() {
   for (auto& t : workers) t.join();
   watcher.join();
   for (auto& t : child_watchers) t.join();
+  // After the workers: nothing enqueues anymore. stop() discards any
+  // backlog rather than draining it — the lease release below must not
+  // wait behind event I/O against a possibly-dead API server.
+  events.stop();
   if (elector && !lost_leadership) elector->release();
   health.stop();
   // Exit nonzero on leadership loss so the kubelet restarts the pod into

@@ -310,7 +310,7 @@ def flash_attention(
     *,
     causal: bool = True,
     sm_scale: float | None = None,
-    block_size: int = 128,
+    block_size: int = 512,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Flash attention over model-layout tensors.
@@ -358,7 +358,7 @@ def flash_attention_with_lse(
     *,
     causal: bool = True,
     sm_scale: float | None = None,
-    block_size: int = 128,
+    block_size: int = 512,
     interpret: bool | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Like flash_attention but also returns the per-row logsumexp of the
@@ -395,7 +395,7 @@ def flash_attention_with_lse(
     return out, lse
 
 
-def make_flash_attn_fn(*, block_size: int = 128, interpret: bool | None = None):
+def make_flash_attn_fn(*, block_size: int = 512, interpret: bool | None = None):
     """An ``attn_fn`` for ``model.forward``/``loss_fn`` backed by the kernel."""
 
     def attn_fn(q, k, v):

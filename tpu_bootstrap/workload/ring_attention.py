@@ -192,7 +192,7 @@ def make_ring_attention(
     batch_axes=None,
     head_axis: str | None = None,
     attention: str = "dense",
-    block_size: int = 128,
+    block_size: int = 512,
     interpret: bool | None = None,
 ):
     """Build an attention function (q, k, v) -> out for sequence-sharded

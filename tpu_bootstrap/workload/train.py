@@ -46,7 +46,7 @@ class TrainConfig:
     # Attention core: "dense" (einsum path, XLA-fused) or "flash" (the
     # Pallas kernel, O(seq) memory — see workload/flash_attention.py).
     attention: str = "dense"
-    attention_block: int = 128
+    attention_block: int = 512
     # Microbatches per step when mesh.pipe > 1 (0 = 2x the stage count,
     # halving the pipeline bubble vs M == stages).
     num_microbatches: int = 0
