@@ -618,6 +618,45 @@ def test_controller_owns_children_event_driven(fake):
         assert code == 0, err
 
 
+def test_controller_steady_state_does_not_oscillate(fake):
+    """After convergence the control loop must go quiet: SSA of identical
+    intent is a server no-op (no rv bump, no watch event), the informer
+    cache catches up with the controller's own status writes, and the
+    event sink's deterministic names stop re-posting once phases settle.
+    A self-oscillating loop (write -> watch echo -> requeue -> write)
+    would show unbounded reconciles/applies in a quiet window. requeue is
+    cranked to 600s so only echo loops could drive activity."""
+    for i in range(10):
+        fake.create_ub(f"user-{i}", spec=full_spec(), status=dict(SYNCED))
+    port = free_port()
+    d = Daemon(
+        "tpubc-controller",
+        controller_env(fake, port, conf_requeue_secs=600),
+        port,
+    ).wait_healthy()
+    try:
+        for i in range(10):
+            wait_for(lambda i=i: fake.get(KEY_JS(f"user-{i}"), f"user-{i}-slice"),
+                     desc="jobsets")
+        # Let the child-event debounce (1s) and any follow-up passes land.
+        time.sleep(2.5)
+        before = d.metrics()
+        time.sleep(3.0)
+        after = d.metrics()
+        delta = after["reconciles_total"] - before["reconciles_total"]
+        # A few stragglers are fine; per-CR-per-second churn is not.
+        assert delta <= 10, f"steady-state churn: {delta} reconciles in 3s quiet window"
+        assert after["applies_total"] - before["applies_total"] <= delta * 6
+        # The server also sees quiet: no write traffic in the window.
+        writes_before = sum(1 for m, _ in fake.store.request_log if m in ("PATCH", "PUT", "POST"))
+        time.sleep(1.0)
+        writes_after = sum(1 for m, _ in fake.store.request_log if m in ("PATCH", "PUT", "POST"))
+        assert writes_after - writes_before <= 2
+    finally:
+        code, err = d.stop()
+        assert code == 0, err
+
+
 def test_fakeapi_cluster_wide_list_and_watch(fake):
     """Cluster-wide collection semantics for namespaced kinds: LIST and
     WATCH on /apis/G/V/PLURAL span every namespace (what the controller's
