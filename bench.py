@@ -317,16 +317,26 @@ try:
     dbatch, d1, d2 = 8, 64, 192
     dprompt = jax.random.randint(jax.random.PRNGKey(1), (dbatch, 64), 0, dcfg.vocab_size)
 
-    def timed_gen(params, steps):
-        generate(params, dprompt, dcfg, steps).block_until_ready()  # compile+warm
+    def timed_gen(params, steps, cfg=dcfg):
+        # int(...) readback is the sync: block_until_ready can return
+        # before device completion on the tunneled backend.
+        int(generate(params, dprompt, cfg, steps)[0, -1])  # compile+warm
         t0 = time.time()
-        generate(params, dprompt, dcfg, steps).block_until_ready()
+        int(generate(params, dprompt, cfg, steps)[0, -1])
         return time.time() - t0
 
-    # Two-point measurement: the d2-d1 step difference cancels the prefill
-    # (and any fixed dispatch overhead), giving pure per-decode-step cost.
-    t1, t2 = timed_gen(dparams, d1), timed_gen(dparams, d2)
-    step_s = max((t2 - t1) / (d2 - d1), 1e-9)
+    def decode_step_s(params, cfg=dcfg):
+        # Two-point measurement: the d2-d1 step difference cancels the
+        # prefill (and any fixed dispatch overhead), giving pure
+        # per-decode-step cost. Best of 3 pairs: a single pair is noisy
+        # through the tunnel (one delayed readback skews the subtraction).
+        best = float("inf")
+        for _ in range(3):
+            t1, t2 = timed_gen(params, d1, cfg), timed_gen(params, d2, cfg)
+            best = min(best, max((t2 - t1) / (d2 - d1), 1e-9))
+        return best
+
+    step_s = decode_step_s(dparams)
     out.update({
         "decode_tokens_per_sec": round(dbatch / step_s, 1),
         "decode_step_ms": round(step_s * 1e3, 3),
@@ -338,14 +348,64 @@ try:
     from tpu_bootstrap.workload.quant import quantize_params
 
     qparams = quantize_params(dparams)
-    q1, q2 = timed_gen(qparams, d1), timed_gen(qparams, d2)
-    qstep_s = max((q2 - q1) / (d2 - d1), 1e-9)
+    qstep_s = decode_step_s(qparams)
     out.update({
         "decode_int8_tokens_per_sec": round(dbatch / qstep_s, 1),
         "decode_int8_speedup": round(step_s / qstep_s, 3),
     })
+    emit()
+
+    # Grouped-query attention: 4 KV heads instead of 16 shrinks the KV
+    # cache 4x — the other decode-bandwidth lever this framework ships.
+    import dataclasses
+    gcfg = dataclasses.replace(dcfg, num_kv_heads=4)
+    gparams = init_params(gcfg, jax.random.PRNGKey(0))
+    gstep_s = decode_step_s(gparams, gcfg)
+    out.update({
+        "decode_gqa4_tokens_per_sec": round(dbatch / gstep_s, 1),
+        "decode_gqa4_speedup": round(step_s / gstep_s, 3),
+    })
 except Exception as e:  # noqa: BLE001
     out["decode_bench_error"] = f"{type(e).__name__}: {e}"[:400]
+emit()
+
+# Long-context training on one chip: the same 134M model at seq 4096
+# with the flash kernel and rematerialization. (The standalone kernel
+# compiles and runs at seq 8192+ — see the attention sweep above — but
+# the axon tunnel's remote compile helper crashes on full train graphs
+# with both flash bwd kernels' cotangents consumed by matmuls at
+# seq >= ~6k, so the train-step config stays at 4096 where the whole
+# graph is proven.)
+try:
+    LSEQ = 4096
+    lcfg = TrainConfig(
+        model=ModelConfig(vocab_size=32768, num_layers=8, num_heads=16, head_dim=64,
+                          embed_dim=1024, mlp_dim=4096, max_seq_len=LSEQ,
+                          compute_dtype=jnp.bfloat16),
+        mesh=MeshConfig(), attention="flash", remat=True,
+    )
+    lmesh = build_mesh(lcfg.mesh, jax.devices()[:1])
+    lparams, lopt, lp_sh = init_train_state(lcfg, lmesh, jax.random.PRNGKey(0))
+    lstep = make_train_step(lcfg, lmesh, lp_sh)
+    lbatch = 4
+    ltokens = jax.random.randint(jax.random.PRNGKey(1), (lbatch, LSEQ), 0, 32768)
+    lparams, lopt, ll = lstep(lparams, lopt, ltokens); float(ll)
+    t0 = time.time()
+    for _ in range(5):
+        lparams, lopt, ll = lstep(lparams, lopt, ltokens)
+    float(ll)
+    lms = (time.time() - t0) / 5 * 1e3
+    ln = sum(x.size for x in jax.tree.leaves(lparams))
+    ltoks = lbatch * (LSEQ - 1)
+    lattn = 12 * lbatch * 8 * 16 * (LSEQ - 1) ** 2 * 64
+    out.update({
+        "train_seq4096_step_ms": round(lms, 3),
+        "train_seq4096_tokens_per_sec": round(ltoks / (lms / 1e3), 1),
+        "train_seq4096_mfu_pct": round(
+            100 * (6 * ln * ltoks + lattn) / (lms / 1e3) / PEAK_BF16, 2),
+    })
+except Exception as e:  # noqa: BLE001
+    out["longctx_bench_error"] = f"{type(e).__name__}: {e}"[:400]
 emit()
 """
 
@@ -447,6 +507,13 @@ def main():
         # the reference's serial one-reconcile-at-a-time architecture.
         "vs_baseline_definition": "8-worker vs same controller at 1 worker "
                                   "(reference architecture stand-in)",
+        # Absolute rates are bound by the in-process Python API server,
+        # which now implements real SSA (managedFields/conflicts), serves
+        # 5 child-kind watch streams, and absorbs Event posts — richer
+        # (and costlier) per CR than earlier rounds' fake. Compare rates
+        # only within one round; the architecture ratios are the signal.
+        "server_bound_note": "rates bound by the in-process fake API "
+                             "server (real SSA + child watches + events)",
         "p50_apply_to_slice_ms": round(parallel_p50, 2),
         "daemon_reconcile_p50_ms": round(daemon_p50, 2),
         "burst_n": N_BURST,
