@@ -60,8 +60,10 @@ def test_grads_match_dense(causal):
 
 def test_bad_shapes_rejected():
     q, k, v = make_qkv(jax.random.PRNGKey(4), seq=64)
-    with pytest.raises(ValueError, match="must match"):
-        flash_attention(q, k[:, :50], v)
+    with pytest.raises(ValueError, match="incompatible"):
+        flash_attention(q, k[:, :50], v[:, :50])
+    with pytest.raises(ValueError, match="must divide"):
+        flash_attention(q, k[:, :, :3], v[:, :, :3])  # 3 kv heads vs 4 q heads
     with pytest.raises(ValueError, match="multiple of 8"):
         flash_attention(q, k, v, block_size=60)
 

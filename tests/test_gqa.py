@@ -52,6 +52,31 @@ def test_flash_matches_expanded_reference(kv_heads):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.parametrize("kv_heads", [1, 2])
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_gqa_grads_match_expanded_reference(kv_heads, causal):
+    """The native-GQA backward (per-q-head dk/dv reduced per group) must
+    match grads of the trivially-correct expanded computation — with
+    group >= 2 this catches contiguous-vs-interleaved grouping bugs in
+    the kv-row index map and the reduce_groups reshape that the kv_heads
+    == 1 ring shards cannot."""
+    q, k, v = qkv(kv_heads, seq=24)  # unaligned: exercises padding too
+    w = jax.random.normal(jax.random.PRNGKey(7), q.shape)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal, block_size=8) * w)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, repeat_kv(k, 4), repeat_kv(v, 4),
+                                           causal=causal) * w)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   atol=5e-5, rtol=5e-5, err_msg=name)
+
+
 def test_gqa_forward_matches_expanded_mha():
     """A GQA model == the MHA model whose wk/wv are the GQA weights
     repeated per group (the defining identity)."""

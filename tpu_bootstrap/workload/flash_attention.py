@@ -149,9 +149,21 @@ _STREAM_GRID = pltpu.CompilerParams(
     dimension_semantics=("parallel", "parallel", "arbitrary"))
 
 
-def _fwd(q3, k3, v3, sm_scale, block, causal, true_len, interpret):
-    """q3/k3/v3: (bh, seq, head_dim) -> (out, lse)."""
+def _kv_row(heads, group):
+    """Map a q-row index (batch*heads axis) to its k/v row on the
+    (batch*kv_heads) axis — native GQA: K/V tiles stream at their true
+    head count instead of being pre-expanded, dividing KV HBM traffic by
+    the group factor. Identity when group == 1 (MHA)."""
+    if group == 1:
+        return lambda b: b
+    kv_heads = heads // group
+    return lambda b: (b // heads) * kv_heads + (b % heads) // group
+
+
+def _fwd(q3, k3, v3, sm_scale, block, causal, true_len, interpret, heads, group):
+    """q3: (b*heads, seq, hd); k3/v3: (b*heads//group, seq, hd)."""
     bh, seq, hd = q3.shape
+    kv = _kv_row(heads, group)
     grid = (bh, seq // block, seq // block)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, sm_scale=sm_scale, block=block, causal=causal,
@@ -160,8 +172,8 @@ def _fwd(q3, k3, v3, sm_scale, block, causal, true_len, interpret):
         compiler_params=_STREAM_GRID,
         in_specs=[
             pl.BlockSpec((None, block, hd), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, block, hd), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((None, block, hd), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block, hd), lambda b, i, j: (kv(b), j, 0)),
+            pl.BlockSpec((None, block, hd), lambda b, i, j: (kv(b), j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((None, block, hd), lambda b, i, j: (b, i, 0)),
@@ -261,7 +273,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd(sm_scale, block, causal, true_len, interpret, residuals, cotangents):
+def _bwd(sm_scale, block, causal, true_len, interpret, heads, group, residuals,
+         cotangents):
     q3, k3, v3, out3, lse = residuals
     dout3, dlse3 = cotangents
     bh, seq, hd = q3.shape
@@ -273,10 +286,13 @@ def _bwd(sm_scale, block, causal, true_len, interpret, residuals, cotangents):
                     keepdims=True)
     delta = delta - dlse3.astype(jnp.float32)
 
+    kv = _kv_row(heads, group)
     grid = (bh, seq // block, seq // block)
     # index_map args are (b, outer, inner); `outer` is the q tile for the
-    # dq kernel and the kv tile for the dkv kernel.
+    # dq kernel and the kv tile for the dkv kernel. K/V inputs stream at
+    # their native (GQA) head count via the kv-row mapping.
     q_tile = lambda sel: pl.BlockSpec((None, block, hd), lambda b, i, j: (b, sel(i, j), 0))  # noqa: E731
+    kv_tile = lambda sel: pl.BlockSpec((None, block, hd), lambda b, i, j: (kv(b), sel(i, j), 0))  # noqa: E731
     row_tile = lambda sel: pl.BlockSpec((None, block, 1), lambda b, i, j: (b, sel(i, j), 0))  # noqa: E731
     outer = lambda i, j: i  # noqa: E731
     inner = lambda i, j: j  # noqa: E731
@@ -286,7 +302,7 @@ def _bwd(sm_scale, block, causal, true_len, interpret, residuals, cotangents):
                           true_len=true_len, seq=seq),
         grid=grid,
         compiler_params=_STREAM_GRID,
-        in_specs=[q_tile(outer), q_tile(inner), q_tile(inner), q_tile(outer),
+        in_specs=[q_tile(outer), kv_tile(inner), kv_tile(inner), q_tile(outer),
                   row_tile(outer), row_tile(outer)],
         out_specs=[q_tile(outer)],
         out_shape=[jax.ShapeDtypeStruct((bh, seq, hd), q3.dtype)],
@@ -294,12 +310,16 @@ def _bwd(sm_scale, block, causal, true_len, interpret, residuals, cotangents):
         interpret=interpret,
     )(q3, k3, v3, dout3, lse, delta)[0]
 
-    dk, dv = pl.pallas_call(
+    # dk/dv come out PER Q HEAD (bh rows): each (kv tile, q-row) pair owns
+    # its slice, keeping every grid axis's output disjoint. The per-group
+    # reduction down to the true kv head count happens outside in XLA —
+    # one cheap reshape+sum, no repeated K/V ever materializes.
+    dk_e, dv_e = pl.pallas_call(
         functools.partial(_dkv_kernel, sm_scale=sm_scale, block=block, causal=causal,
                           true_len=true_len, seq=seq),
         grid=grid,
         compiler_params=_STREAM_GRID,
-        in_specs=[q_tile(inner), q_tile(outer), q_tile(outer), q_tile(inner),
+        in_specs=[q_tile(inner), kv_tile(outer), kv_tile(outer), q_tile(inner),
                   row_tile(inner), row_tile(inner)],
         out_specs=[q_tile(outer), q_tile(outer)],
         out_shape=[
@@ -313,35 +333,38 @@ def _bwd(sm_scale, block, causal, true_len, interpret, residuals, cotangents):
         interpret=interpret,
     )(q3, k3, v3, dout3, lse, delta)
 
-    return dq, dk, dv
+    if group > 1:
+        def reduce_groups(x):
+            # Row layout is b_i*heads + kv_i*group + g (matching
+            # repeat_kv's contiguous grouping): fold out g, sum it away
+            # in f32 (a bf16 tree-sum across the group would quantize).
+            batch = bh // heads
+            dtype = x.dtype
+            x = x.reshape(batch, heads // group, group, seq, hd)
+            return x.astype(jnp.float32).sum(axis=2).astype(dtype).reshape(
+                bh // group, seq, hd)
+        dk_e, dv_e = reduce_groups(dk_e), reduce_groups(dv_e)
+    return dq, dk_e, dv_e
 
 
 # ------------------------------------------------------------ public API
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash3(q3, k3, v3, sm_scale, block, causal, true_len, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash3(q3, k3, v3, sm_scale, block, causal, true_len, interpret, heads, group):
     """(out, lse) with full VJP support on both outputs. lse cotangents
     arise when callers combine block results across devices (ring
     attention's logaddexp merge); plain attention callers drop lse and its
     cotangent is zero."""
-    return _fwd(q3, k3, v3, sm_scale, block, causal, true_len, interpret)
+    return _fwd(q3, k3, v3, sm_scale, block, causal, true_len, interpret, heads, group)
 
 
-def _flash3_fwd(q3, k3, v3, sm_scale, block, causal, true_len, interpret):
-    out, lse = _fwd(q3, k3, v3, sm_scale, block, causal, true_len, interpret)
+def _flash3_fwd(q3, k3, v3, sm_scale, block, causal, true_len, interpret, heads, group):
+    out, lse = _fwd(q3, k3, v3, sm_scale, block, causal, true_len, interpret, heads, group)
     return (out, lse), (q3, k3, v3, out, lse)
 
 
 _flash3.defvjp(_flash3_fwd, _bwd)
-
-
-def _expand_gqa(q, k, v):
-    """Repeat GQA KV heads up to the query head count (no-op for MHA)."""
-    from tpu_bootstrap.workload.model import repeat_kv
-
-    heads = q.shape[-2]
-    return repeat_kv(k, heads), repeat_kv(v, heads)
 
 
 def flash_attention(
@@ -357,22 +380,33 @@ def flash_attention(
     """Flash attention over model-layout tensors.
 
     q: (batch, seq, heads, head_dim); k/v the same, or with fewer (GQA)
-    heads dividing q's — they are expanded to the query head count before
-    the kernel. That expansion materializes repeated K/V in HBM and
-    multiplies the streamed KV bytes by heads/kv_heads; the GQA win this
-    framework banks is in params, the ring's ICI transfers, and the
-    decode cache. A future native-GQA index map (k/v BlockSpec indexing
-    head h // group instead of pre-expanding) would reclaim the kernel's
-    KV traffic too. Returns q's shape — drop-in for the ``attn_fn`` hook
-    of ``model._attention`` (which applies no scaling itself, so the
-    1/sqrt(head_dim) default here matches its dense path).
+    heads dividing q's. GQA is native in the kernel: K/V tiles are read
+    through a h → h//group BlockSpec index map, so no expanded K/V copy
+    is ever allocated or written to HBM (the win over pre-expansion:
+    the extra arrays, their writes, and the repeat's memory). Tile READ
+    traffic still scales with q heads — each q-head grid row streams its
+    group's K/V tiles — and the backward's intermediate dk/dv buffers
+    are per-q-head before the group reduction; see _bwd. Returns q's
+    shape — drop-in for the ``attn_fn`` hook of ``model._attention``
+    (which applies no scaling itself, so the 1/sqrt(head_dim) default
+    here matches its dense path).
     """
-    k, v = _expand_gqa(q, k, v)
-    if q.shape != k.shape or q.shape != v.shape:
-        raise ValueError(f"q/k/v shapes must match, got {q.shape}/{k.shape}/{v.shape}")
+    out, _ = _flash_folded(q, k, v, causal, sm_scale, block_size, interpret)
+    return out
+
+
+def _flash_folded(q, k, v, causal, sm_scale, block_size, interpret):
+    """Shared fold/pad plumbing for both public entry points. Returns
+    (out, lse) in model layout: (b, s, h, d) and (b, s, h)."""
+    if q.shape[:2] != k.shape[:2] or q.shape[3:] != k.shape[3:] or k.shape != v.shape:
+        raise ValueError(f"q/k/v shapes incompatible: {q.shape}/{k.shape}/{v.shape}")
+    b, s, h, d = q.shape
+    kv_h = k.shape[2]
+    if h % kv_h != 0:
+        raise ValueError(f"kv heads ({kv_h}) must divide q heads ({h})")
+    group = h // kv_h
     if block_size % 8 != 0:
         raise ValueError(f"block_size must be a multiple of 8, got {block_size}")
-    b, s, h, d = q.shape
     if sm_scale is None:
         sm_scale = float(d) ** -0.5
     if interpret is None:
@@ -386,13 +420,18 @@ def flash_attention(
     s_pad = -(-s // block) * block
 
     def fold(x):
+        heads = x.shape[2]
         if s_pad != s:
             x = jnp.pad(x, ((0, 0), (0, s_pad - s), (0, 0), (0, 0)))
-        return x.transpose(0, 2, 1, 3).reshape(b * h, s_pad, d)
+        return x.transpose(0, 2, 1, 3).reshape(b * heads, s_pad, d)
 
-    out3, _ = _flash3(fold(q), fold(k), fold(v), sm_scale, block, bool(causal), s, interpret)
+    out3, lse3 = _flash3(fold(q), fold(k), fold(v), sm_scale, block, bool(causal), s,
+                         interpret, h, group)
     out = out3.reshape(b, h, s_pad, d).transpose(0, 2, 1, 3)
-    return out[:, :s] if s_pad != s else out
+    lse = lse3.reshape(b, h, s_pad).transpose(0, 2, 1)
+    if s_pad != s:
+        out, lse = out[:, :s], lse[:, :s]
+    return out, lse
 
 
 def flash_attention_with_lse(
@@ -409,34 +448,8 @@ def flash_attention_with_lse(
     scaled scores, shape (batch, seq, heads) float32 — the state a caller
     needs to combine partial attention over KV blocks held elsewhere
     (ring_attention's per-shard fold). Differentiable in both outputs.
-    Accepts GQA k/v (fewer heads) like flash_attention."""
-    k, v = _expand_gqa(q, k, v)
-    if q.shape != k.shape or q.shape != v.shape:
-        raise ValueError(f"q/k/v shapes must match, got {q.shape}/{k.shape}/{v.shape}")
-    if block_size % 8 != 0:
-        raise ValueError(f"block_size must be a multiple of 8, got {block_size}")
-    b, s, h, d = q.shape
-    if sm_scale is None:
-        sm_scale = float(d) ** -0.5
-    if interpret is None:
-        interpret = _interpret_default()
-
-    round8 = -(-s // 8) * 8
-    block = min(block_size, round8)
-    s_pad = -(-s // block) * block
-
-    def fold(x):
-        if s_pad != s:
-            x = jnp.pad(x, ((0, 0), (0, s_pad - s), (0, 0), (0, 0)))
-        return x.transpose(0, 2, 1, 3).reshape(b * h, s_pad, d)
-
-    out3, lse3 = _flash3(fold(q), fold(k), fold(v), sm_scale, block, bool(causal), s,
-                         interpret)
-    out = out3.reshape(b, h, s_pad, d).transpose(0, 2, 1, 3)
-    lse = lse3.reshape(b, h, s_pad).transpose(0, 2, 1)
-    if s_pad != s:
-        out, lse = out[:, :s], lse[:, :s]
-    return out, lse
+    Accepts GQA k/v (fewer heads) natively like flash_attention."""
+    return _flash_folded(q, k, v, causal, sm_scale, block_size, interpret)
 
 
 def make_flash_attn_fn(*, block_size: int = 512, interpret: bool | None = None):
