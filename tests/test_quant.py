@@ -108,3 +108,23 @@ def test_quantized_moe_blocks_left_alone():
     params = init_params(cfg, jax.random.PRNGKey(0))
     qp = quantize_params(params)
     assert not is_quantized(qp["blocks"][0]["w_up"])  # expert stack untouched
+
+
+def test_lm_head_quantization():
+    """head=True stores an int8 matmul-layout copy of the embedding; the
+    head path's logits stay close to the float head and (for this
+    well-separated case) pick the same argmax."""
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    qp = quantize_params(params)
+    assert is_quantized(qp["lm_head"])
+    assert qp["lm_head"].q.shape == (CFG.embed_dim, CFG.vocab_size)
+    assert qp["embed"] is params["embed"]  # gather table untouched
+    assert "lm_head" not in quantize_params(params, head=False)
+
+    from tpu_bootstrap.workload.decode import _logits
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 3, CFG.embed_dim)) * 0.3
+    got = _logits(qp, x)
+    want = _logits(params, x)
+    assert got.shape == want.shape
+    assert float(jnp.max(jnp.abs(got - want))) < 0.35

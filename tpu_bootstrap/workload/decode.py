@@ -127,6 +127,14 @@ def _block_step(block: Params, x: jax.Array, cache: dict, positions: jax.Array,
 
 def _logits(params: Params, x: jax.Array) -> jax.Array:
     x = _rms_norm(x, params["final_norm"])
+    head = params.get("lm_head")
+    if head is not None and quant.is_quantized(head):
+        # int8 head copy (quant.quantize_params(head=True)): the head
+        # matmul is the single biggest weight read of a decode step —
+        # vocab x embed bytes — so it streams at 1 byte/element.
+        b, s, e = x.shape
+        y = quant.int8_matmul(x.reshape(b * s, e).astype(jnp.float32), head)
+        return y.reshape(b, s, -1)
     return jnp.einsum("bse,ve->bsv", x.astype(jnp.float32), params["embed"])
 
 

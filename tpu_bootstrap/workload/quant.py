@@ -18,10 +18,14 @@ right for the few-thousand-wide projections decode runs. Symmetric
 per-output-channel scales (scale = absmax/127 over the contraction
 axis) keep the kernel a pure multiply — no zero points.
 
-Scope: the transformer block projections (wq/wk/wv/wo, w_up/w_down).
-The embedding stays float — it is both a gather table and the logits
-head, the two most precision-sensitive uses. MoE expert stacks keep
-their own layout and are left unquantized for now.
+Scope: the transformer block projections (wq/wk/wv/wo, w_up/w_down),
+plus — by default — a separate int8 copy of the logits head
+(``lm_head``, the embedding transposed into matmul layout). The head
+matmul reads vocab x embed bytes EVERY step (a quarter of this model
+family's weight traffic); the gather-table use of the embedding reads
+only batch rows, so the float embedding stays for gathers and the int8
+copy serves the head. MoE expert stacks keep their own layout and are
+left unquantized for now.
 
 Reference parity note: the reference (bacchus-gpu-controller) has no
 compute path (SURVEY.md §2); this module extends the serving half of
@@ -160,10 +164,19 @@ def quantize_block(block: dict) -> dict:
     return out
 
 
-def quantize_params(params: dict) -> dict:
+def quantize_params(params: dict, *, head: bool = True) -> dict:
     """Params pytree -> the same tree with dense block projections
-    int8-quantized (decode.py detects the quantized leaves)."""
-    return {**params, "blocks": [quantize_block(b) for b in params["blocks"]]}
+    int8-quantized (decode.py detects the quantized leaves).
+
+    head=True additionally stores ``lm_head``: the embedding transposed
+    to (embed, vocab) matmul layout and int8-quantized. The float
+    embedding stays in the tree untouched (gathers read it by row);
+    decode's logits head streams the 1-byte copy instead of the full
+    float matrix."""
+    out = {**params, "blocks": [quantize_block(b) for b in params["blocks"]]}
+    if head:
+        out["lm_head"] = quantize_weight(params["embed"].T)
+    return out
 
 
 def is_quantized(w) -> bool:
