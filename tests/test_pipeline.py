@@ -113,6 +113,31 @@ def test_pipelined_train_step_matches_single_device(mesh_cfg):
     np.testing.assert_allclose(got, want, rtol=2e-5)
 
 
+def test_pipelined_flash_matches_dense_pipeline():
+    """The flash kernel as each stage's attention core (called directly
+    inside the pipeline shard_map — each stage is fully local) must match
+    the dense pipelined loss to kernel tolerance."""
+    mesh_cfg = MeshConfig(pipe=2, data=4)
+    tokens = jax.random.randint(jax.random.PRNGKey(7), (16, MODEL.max_seq_len),
+                                0, MODEL.vocab_size)
+
+    def run(attention):
+        cfg = TrainConfig(model=MODEL, mesh=mesh_cfg, learning_rate=1e-2,
+                          num_microbatches=4, attention=attention,
+                          attention_block=8)
+        mesh = build_mesh(cfg.mesh)
+        params, opt_state, p_sh = init_train_state(cfg, mesh, jax.random.PRNGKey(0))
+        step = make_train_step(cfg, mesh, p_sh)
+        t = jax.device_put(tokens, batch_shardings(mesh))
+        losses = []
+        for _ in range(2):
+            params, opt_state, loss = step(params, opt_state, t)
+            losses.append(float(loss))
+        return losses
+
+    np.testing.assert_allclose(run("flash"), run("dense"), rtol=2e-4)
+
+
 def test_pipelined_checkpoint_resume_matches(tmp_path):
     """Resume of a pipelined run: the abstract restore state must use the
     same stacked-blocks layout the checkpoint was saved with."""
@@ -143,7 +168,10 @@ def test_pipeline_rejects_bad_configs():
     bad = TrainConfig(model=ModelConfig(num_layers=3), mesh=MeshConfig(pipe=2, data=4))
     with pytest.raises(ValueError, match="divide"):
         init_train_state(bad, build_mesh(bad.mesh), jax.random.PRNGKey(0))
-    # flash attention cannot nest inside the pipeline shard_map
-    fl = TrainConfig(model=MODEL, mesh=MeshConfig(pipe=2, data=4), attention="flash")
-    with pytest.raises(ValueError, match="dense"):
-        make_train_step(fl, build_mesh(fl.mesh), None)
+    # MoE blocks are not supported under pipeline parallelism —
+    # rejected at construction, not at first trace
+    moe = TrainConfig(
+        model=ModelConfig(**{**MODEL.__dict__, "num_experts": 2}),
+        mesh=MeshConfig(pipe=2, data=4), num_microbatches=2)
+    with pytest.raises(ValueError, match="MoE"):
+        make_pipeline_loss(moe, build_mesh(moe.mesh), num_microbatches=2)

@@ -131,10 +131,9 @@ def _logits(params: Params, x: jax.Array) -> jax.Array:
     if head is not None and quant.is_quantized(head):
         # int8 head copy (quant.quantize_params(head=True)): the head
         # matmul is the single biggest weight read of a decode step —
-        # vocab x embed bytes — so it streams at 1 byte/element.
-        b, s, e = x.shape
-        y = quant.int8_matmul(x.reshape(b * s, e).astype(jnp.float32), head)
-        return y.reshape(b, s, -1)
+        # vocab x embed bytes — so it streams at 1 byte/element, through
+        # the same _linear seam as every block projection.
+        return _linear(x, head, 1, jnp.float32)
     return jnp.einsum("bse,ve->bsv", x.astype(jnp.float32), params["embed"])
 
 

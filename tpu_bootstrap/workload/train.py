@@ -116,11 +116,6 @@ def make_train_step(cfg: TrainConfig, mesh, p_shardings):
     seq_parallel = mesh.shape["seq"] > 1
     pipelined = mesh.shape["pipe"] > 1
     if pipelined:
-        if cfg.attention != "dense":
-            raise ValueError(
-                "pipeline parallelism currently supports attention='dense' "
-                "(the flash shard_map and the ring cannot nest inside the "
-                "pipeline shard_map yet)")
         from tpu_bootstrap.workload.pipeline import make_pipeline_loss
 
         microbatches = cfg.num_microbatches or 2 * mesh.shape["pipe"]
