@@ -488,6 +488,90 @@ def workload_bench(timeout_secs: int = 600):
     return {"workload_bench_error": err}
 
 
+def admission_bench(n: int = 2000, threads: int = 4):
+    """Mutating-webhook throughput: AdmissionReview POSTs/sec against the
+    daemon over keep-alive HTTP (CONF_TLS_DISABLED — TLS termination is
+    cert-manager-standardized and not the interesting axis), plus p50
+    end-to-end latency. The reference serves this path from 2 axum
+    replicas with a 10s timeout; per-request policy cost is the metric
+    that bounds how hard the API server can hammer one replica."""
+    import http.client
+    import threading
+
+    port = free_port()
+    proc = subprocess.Popen(
+        [str(REPO / "native" / "build" / "tpubc-admission")],
+        env={
+            **os.environ,
+            "CONF_LISTEN_ADDR": "127.0.0.1",
+            "CONF_LISTEN_PORT": str(port),
+            "CONF_TLS_DISABLED": "1",
+            "CONF_AUTHORIZED_GROUP_NAMES": "tpu,admin",
+            "TPUBC_LOG": "error",
+        },
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE,
+    )
+    review = json.dumps({
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {
+            "uid": "bench",
+            "operation": "CREATE",
+            "userInfo": {"username": "oidc:alice", "groups": ["tpu"]},
+            "object": {
+                "apiVersion": "tpu.bacchus.io/v1",
+                "kind": "UserBootstrap",
+                "metadata": {"name": "alice"},
+                "spec": {"tpu": {"accelerator": "tpu-v5p-slice", "topology": "4x4x4"}},
+            },
+        },
+    }).encode()
+
+    try:
+        wait_health(port, proc)
+        latencies: list[float] = []
+        lock = threading.Lock()
+
+        def worker(count):
+            conn = http.client.HTTPConnection("127.0.0.1", port)
+            local = []
+            for _ in range(count):
+                t0 = time.time()
+                conn.request("POST", "/mutate", review,
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                body = resp.read()
+                assert resp.status == 200 and b'"allowed":true' in body.replace(b" ", b""), body[:200]
+                local.append((time.time() - t0) * 1000)
+            conn.close()
+            with lock:
+                latencies.extend(local)
+
+        worker(50)  # warm
+        latencies.clear()
+        t0 = time.time()
+        ts = [threading.Thread(target=worker, args=(n // threads,)) for _ in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        elapsed = time.time() - t0
+        latencies.sort()
+        return {
+            "admission_mutations_per_sec": round(len(latencies) / elapsed, 1),
+            "admission_p50_ms": round(latencies[len(latencies) // 2], 3),
+        }
+    except Exception as e:  # noqa: BLE001
+        return {"admission_bench_error": f"{type(e).__name__}: {e}"[:300]}
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
 def main():
     nativelib.build_native()
 
@@ -538,6 +622,7 @@ def main():
         "burst2000_elapsed_s": round(scale_elapsed, 3),
         "burst2000_p50_ms": round(scale_p50, 2),
     }
+    result.update(admission_bench())
     result.update(workload)
     print(json.dumps(result))
 
