@@ -531,32 +531,45 @@ def admission_bench(n: int = 2000, threads: int = 4):
     try:
         wait_health(port, proc)
         latencies: list[float] = []
+        errors: list[str] = []
         lock = threading.Lock()
 
         def worker(count):
-            conn = http.client.HTTPConnection("127.0.0.1", port)
-            local = []
-            for _ in range(count):
-                t0 = time.time()
-                conn.request("POST", "/mutate", review,
-                             {"Content-Type": "application/json"})
-                resp = conn.getresponse()
-                body = resp.read()
-                assert resp.status == 200 and b'"allowed":true' in body.replace(b" ", b""), body[:200]
-                local.append((time.time() - t0) * 1000)
-            conn.close()
-            with lock:
-                latencies.extend(local)
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+                local = []
+                for _ in range(count):
+                    t0 = time.time()
+                    conn.request("POST", "/mutate", review,
+                                 {"Content-Type": "application/json"})
+                    resp = conn.getresponse()
+                    body = resp.read()
+                    assert resp.status == 200 and b'"allowed":true' in body.replace(b" ", b""), \
+                        body[:200]
+                    local.append((time.time() - t0) * 1000)
+                conn.close()
+                with lock:
+                    latencies.extend(local)
+            except Exception as e:  # noqa: BLE001
+                # Surface worker failures instead of silently reporting a
+                # throughput computed from the surviving subset.
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}"[:200])
 
         worker(50)  # warm
+        if errors:
+            return {"admission_bench_error": errors[0]}
         latencies.clear()
         t0 = time.time()
         ts = [threading.Thread(target=worker, args=(n // threads,)) for _ in range(threads)]
         for t in ts:
             t.start()
         for t in ts:
-            t.join()
+            t.join(timeout=120)
         elapsed = time.time() - t0
+        if errors or any(t.is_alive() for t in ts):
+            return {"admission_bench_error":
+                    errors[0] if errors else "worker timed out after 120s"}
         latencies.sort()
         return {
             "admission_mutations_per_sec": round(len(latencies) / elapsed, 1),

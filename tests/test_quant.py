@@ -101,13 +101,40 @@ def test_quantized_generation_runs_and_tracks_float():
     np.testing.assert_array_equal(np.asarray(got[:, 0]), np.asarray(want[:, 0]))
 
 
-def test_quantized_moe_blocks_left_alone():
+def test_quantized_moe_expert_stacks():
+    """MoE blocks quantize attention projections and the (E, K, N) expert
+    stacks (per-expert, per-channel scales); the router stays float. The
+    expert kernel matches its dequantize-then-einsum oracle, and MoE
+    prefill logits track the float model."""
+    from tpu_bootstrap.workload.quant import int8_expert_matmul, quantize_expert_weight
+
     cfg = ModelConfig(vocab_size=64, num_layers=1, num_heads=2, head_dim=8,
                       embed_dim=32, mlp_dim=64, max_seq_len=16,
                       num_experts=2, expert_top_k=1)
     params = init_params(cfg, jax.random.PRNGKey(0))
     qp = quantize_params(params)
-    assert not is_quantized(qp["blocks"][0]["w_up"])  # expert stack untouched
+    blk = qp["blocks"][0]
+    assert is_quantized(blk["w_up"]) and blk["w_up"].q.shape == (2, 32, 64)
+    assert is_quantized(blk["wq"])
+    assert not is_quantized(blk["router"])
+
+    # kernel vs dequant oracle
+    w = jax.random.normal(jax.random.PRNGKey(3), (2, 32, 64))
+    qw = quantize_expert_weight(w)
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 5, 32))
+    got = int8_expert_matmul(x, qw)
+    want = jnp.einsum("etk,ekn->etn",
+                      x.astype(jnp.bfloat16).astype(jnp.float32),
+                      (qw.q.astype(jnp.float32) * qw.s))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-2, atol=2e-2)
+
+    # MoE prefill through the quantized path tracks float
+    from tpu_bootstrap.workload.decode import init_cache, prefill
+
+    tokens = jax.random.randint(jax.random.PRNGKey(5), (2, 8), 0, 64)
+    got_l, _ = prefill(qp, tokens, init_cache(cfg, 2, 8), cfg)
+    want_l, _ = prefill(params, tokens, init_cache(cfg, 2, 8), cfg)
+    assert float(jnp.max(jnp.abs(got_l - want_l))) < 0.5
 
 
 def test_lm_head_quantization():

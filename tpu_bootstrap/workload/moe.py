@@ -56,6 +56,20 @@ def expert_capacity(seq: int, num_experts: int, top_k: int,
     return max(1, math.ceil(seq * top_k / num_experts * capacity_factor))
 
 
+def _expert_linear(x, w, dtype):
+    """Per-expert batched projection x (E, B, C, K) @ w (E, K, N), for
+    float expert stacks or int8-quantized ones (workload/quant.py) — the
+    seam through which weight-only quantization reaches the expert FFN
+    on the serving path."""
+    from tpu_bootstrap.workload import quant
+
+    if quant.is_quantized(w):
+        e, b, c, k = x.shape
+        y = quant.int8_expert_matmul(x.reshape(e, b * c, k).astype(dtype), w)
+        return y.reshape(e, b, c, -1)
+    return jnp.einsum("ebck,ekn->ebcn", x, w.astype(dtype))
+
+
 def moe_mlp(block, h, cfg):
     """Top-k MoE FFN over pre-normalized activations.
 
@@ -96,9 +110,9 @@ def moe_mlp(block, h, cfg):
     # over the expert mesh axis (weights pin it), B over the data axes:
     # GSPMD materializes the all-to-all at this boundary.
     expert_in = jnp.einsum("bsec,bsm->ebcm", dispatch.astype(dtype), h)
-    hidden = jnp.einsum("ebcm,emf->ebcf", expert_in, block["w_up"].astype(dtype))
+    hidden = _expert_linear(expert_in, block["w_up"], dtype)
     hidden = jax.nn.gelu(hidden)
-    expert_out = jnp.einsum("ebcf,efm->ebcm", hidden, block["w_down"].astype(dtype))
+    expert_out = _expert_linear(hidden, block["w_down"], dtype)
     out = jnp.einsum("bsec,ebcm->bsm", combine.astype(dtype), expert_out)
 
     # Switch-style load-balancing aux loss on top-1 assignments.

@@ -24,8 +24,10 @@ plus — by default — a separate int8 copy of the logits head
 matmul reads vocab x embed bytes EVERY step (a quarter of this model
 family's weight traffic); the gather-table use of the embedding reads
 only batch rows, so the float embedding stays for gathers and the int8
-copy serves the head. MoE expert stacks keep their own layout and are
-left unquantized for now.
+copy serves the head. MoE blocks quantize their attention projections
+and (E, K, N) expert stacks — per (expert, output channel) scales, a
+grid axis over experts in the kernel — while the router (tiny,
+routing-critical) stays float.
 
 Reference parity note: the reference (bacchus-gpu-controller) has no
 compute path (SURVEY.md §2); this module extends the serving half of
@@ -130,6 +132,55 @@ def int8_matmul(x: jax.Array, qw: QuantizedWeight, *, block_n: int = 512,
     return out[:t, :n]
 
 
+def quantize_expert_weight(w: jax.Array) -> QuantizedWeight:
+    """Expert stack (E, K, N) float -> int8 with per-(expert, output
+    channel) scales, stored with s as (E, 1, N) so the scale tile rides
+    the same grid as the weight tile."""
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=1, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)  # (E, 1, N)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127).astype(jnp.int8)
+    return QuantizedWeight(q=q, s=scale, shape=tuple(w.shape))
+
+
+def int8_expert_matmul(x: jax.Array, qw: QuantizedWeight, *, block_n: int = 512,
+                       interpret: bool | None = None) -> jax.Array:
+    """Per-expert batched matmul: x (E, T, K) @ dequant(qw) (E, K, N) ->
+    (E, T, N) in x.dtype. Grid (E, N tiles); the leading None block dims
+    squeeze away, so the kernel body is the same 2-D fused-dequant matmul
+    as int8_matmul's."""
+    if interpret is None:
+        interpret = _interpret_default()
+    e, t, k = x.shape
+    eq, kq, n = qw.q.shape
+    if (e, k) != (eq, kq):
+        raise ValueError(f"expert/contraction mismatch: x {x.shape}, weight {qw.q.shape}")
+
+    t_pad = -(-t // 8) * 8
+    bn = min(block_n, -(-n // 128) * 128)
+    n_pad = -(-n // bn) * bn
+    xp = jnp.pad(x, ((0, 0), (0, t_pad - t), (0, 0))) if t_pad != t else x
+    q, s = qw.q, qw.s
+    if n_pad != n:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, n_pad - n)))
+        s = jnp.pad(s, ((0, 0), (0, 0), (0, n_pad - n)))
+
+    out = pl.pallas_call(
+        _matmul_kernel,
+        grid=(e, n_pad // bn),
+        in_specs=[
+            pl.BlockSpec((None, t_pad, k), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, k, bn), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((None, 1, bn), lambda i, j: (i, 0, j)),
+        ],
+        out_specs=pl.BlockSpec((None, t_pad, bn), lambda i, j: (i, 0, j)),
+        out_shape=jax.ShapeDtypeStruct((e, t_pad, n_pad), x.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
+        interpret=interpret,
+    )(xp, q, s)
+    return out[:, :t, :n]
+
+
 def reference_int8_matmul(x: jax.Array, qw: QuantizedWeight) -> jax.Array:
     """Oracle mirroring the kernel's arithmetic order (bf16 operands,
     f32 accumulation, per-channel scale applied after the matmul) —
@@ -142,25 +193,36 @@ def reference_int8_matmul(x: jax.Array, qw: QuantizedWeight) -> jax.Array:
     return (acc * qw.s).astype(x.dtype)
 
 
-def quantize_block(block: dict) -> dict:
-    """Quantize one dense transformer block's projections, preserving the
-    pytree keys decode._block_step reads. Weights are stored 2-D in
-    matmul layout (contraction axis first); original shapes are kept in
-    the wrapper for the callers' reshapes."""
-    if "router" in block:  # MoE block: expert stacks stay unquantized
-        return block
+def _q2d(w, contract_rank):
+    """Flatten a projection to 2-D matmul layout (contraction axes first)
+    and quantize; the original logical shape rides in the wrapper."""
+    k = 1
+    for d in w.shape[:contract_rank]:
+        k *= d
+    qw = quantize_weight(w.reshape(k, -1))
+    return dataclasses.replace(qw, shape=tuple(w.shape))
 
-    def q2d(w, contract_rank):
-        k = 1
-        for d in w.shape[:contract_rank]:
-            k *= d
-        qw = quantize_weight(w.reshape(k, -1))
-        return dataclasses.replace(qw, shape=tuple(w.shape))
+
+def quantize_block(block: dict) -> dict:
+    """Quantize one transformer block's projections, preserving the
+    pytree keys decode._block_step reads. Dense weights are stored 2-D in
+    matmul layout (contraction axis first); MoE blocks quantize their
+    attention projections the same way plus the (E, K, N) expert stacks
+    per (expert, channel), while the router — a tiny, routing-critical
+    matmul — stays float."""
+    if "router" in block:
+        out = dict(block)
+        for name in ("wq", "wk", "wv"):
+            out[name] = _q2d(block[name], 1)
+        out["wo"] = _q2d(block["wo"], 2)
+        out["w_up"] = quantize_expert_weight(block["w_up"])
+        out["w_down"] = quantize_expert_weight(block["w_down"])
+        return out
 
     out = dict(block)
     for name, contract_rank in (("wq", 1), ("wk", 1), ("wv", 1), ("wo", 2),
                                 ("w_up", 1), ("w_down", 1)):
-        out[name] = q2d(block[name], contract_rank)
+        out[name] = _q2d(block[name], contract_rank)
     return out
 
 
@@ -186,7 +248,9 @@ def is_quantized(w) -> bool:
 __all__ = [
     "QuantizedWeight",
     "dequantize_weight",
+    "int8_expert_matmul",
     "int8_matmul",
+    "quantize_expert_weight",
     "is_quantized",
     "quantize_block",
     "quantize_params",
