@@ -321,12 +321,15 @@ try:
     dcfg = ModelConfig(vocab_size=32768, num_layers=8, num_heads=16, head_dim=64,
                        embed_dim=1024, mlp_dim=4096, max_seq_len=512,
                        compute_dtype=jnp.bfloat16)
+    def to_bf16(params):
+        return jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x, params)
+
     dmaster = init_params(dcfg, jax.random.PRNGKey(0))
     # The bf16 baseline stores weights in bf16 (f32 masters would double
     # the streamed bytes and flatter the int8 comparison); quantization
     # happens from the f32 masters.
-    dparams = jax.tree.map(
-        lambda x: x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x, dmaster)
+    dparams = to_bf16(dmaster)
     dbatch, d1, d2 = 8, 64, 192
     dprompt = jax.random.randint(jax.random.PRNGKey(1), (dbatch, 64), 0, dcfg.vocab_size)
 
@@ -374,9 +377,7 @@ try:
     # cache 4x — the other decode-bandwidth lever this framework ships.
     import dataclasses
     gcfg = dataclasses.replace(dcfg, num_kv_heads=4)
-    gparams = jax.tree.map(
-        lambda x: x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x,
-        init_params(gcfg, jax.random.PRNGKey(0)))
+    gparams = to_bf16(init_params(gcfg, jax.random.PRNGKey(0)))
     gstep_s = decode_step_s(gparams, gcfg)
     out.update({
         "decode_gqa4_tokens_per_sec": round(dbatch / gstep_s, 1),

@@ -68,29 +68,7 @@ ControllerConfig load_config() {
   c.child_requeue_ms = env.get_int("child_requeue_ms", 1000);
   c.workers = env.get_int("reconcile_workers", 4);
   c.leader_elect = env.get("leader_elect", "0") == "1";
-  if (c.leader_elect) {
-    // lease namespace: explicit env > in-cluster SA namespace > default
-    std::string ns = env.get("lease_namespace", "");
-    if (ns.empty()) {
-      try {
-        ns = trim(read_file("/var/run/secrets/kubernetes.io/serviceaccount/namespace"));
-      } catch (const std::exception&) {
-        ns = "default";
-      }
-    }
-    c.leader.lease_namespace = ns;
-    c.leader.lease_name = env.get("lease_name", "tpu-bootstrap-controller");
-    std::string identity = env.get("lease_identity", "");
-    if (identity.empty()) {
-      char host[256] = {0};
-      gethostname(host, sizeof(host) - 1);
-      identity = std::string(host) + "-" + std::to_string(::getpid());
-    }
-    c.leader.identity = identity;
-    c.leader.lease_duration_secs = env.get_int("lease_duration_secs", 15);
-    c.leader.renew_period_secs = env.get_int("lease_renew_secs", 5);
-    c.leader.retry_period_secs = env.get_int("lease_retry_secs", 2);
-  }
+  if (c.leader_elect) c.leader = leader_config_from_env("tpu-bootstrap-controller");
   c.core = default_controller_config();
   c.core.set("requeue_secs", c.requeue_secs);
   c.core.set("error_requeue_secs", c.error_requeue_secs);

@@ -13,7 +13,10 @@
 // inventory comes from CONF_POOL_CAPACITY_CHIPS or a CONF_INVENTORY_URL
 // returning {"capacity_chips": N}, and admission against capacity is
 // first-come (plan_sync in sheet_core.cc).
+#include <atomic>
 #include <map>
+#include <memory>
+#include <thread>
 
 #include "tpubc/config.h"
 #include "tpubc/crd.h"
@@ -21,6 +24,7 @@
 #include "tpubc/http.h"
 #include "tpubc/json.h"
 #include "tpubc/kube_client.h"
+#include "tpubc/leader.h"
 #include "tpubc/log.h"
 #include "tpubc/reconcile_core.h"
 #include "tpubc/runtime.h"
@@ -222,8 +226,36 @@ int main() {
                                     {"port", std::to_string(health.bound_port())},
                                     {"interval_secs", std::to_string(interval_secs)}});
 
+  // Optional leader election (CONF_LEADER_ELECT=1): with replicas > 1
+  // only the lease holder syncs — a standby taking over mid-interval
+  // would otherwise double-patch quota and double-post events. Standbys
+  // serve /health while blocked in acquire().
+  std::unique_ptr<LeaderElector> elector;
+  std::thread holder;
+  std::atomic<bool> lost_leadership{false};
+  if (env.get("leader_elect", "0") == "1") {
+    elector = std::make_unique<LeaderElector>(
+        client, leader_config_from_env("tpu-bootstrap-synchronizer"));
+    if (!elector->acquire(stop_requested())) {
+      health.stop();
+      log_info("stopped before acquiring leadership");
+      return 0;
+    }
+    // The renew loop runs beside the tick loop; losing the lease stops
+    // the process (exit 1 -> kubelet restarts it into standby mode).
+    holder = std::thread([&] {
+      if (!elector->hold(stop_requested())) {
+        lost_leadership = true;
+        request_stop();
+      }
+    });
+  }
+
   // Tick immediately, then every interval (tokio interval fires at t=0 too).
   do {
+    // Per-tick leadership gate (wall-clock-deadline checked): a tick that
+    // starts after lease validity lapsed must not write.
+    if (elector && !elector->is_leader()) continue;
     try {
       run_sync_once(client, sync_config, sheet, inventory_url);
     } catch (const std::exception& e) {
@@ -232,8 +264,11 @@ int main() {
     }
   } while (!stop_wait_ms(interval_secs * 1000));
 
-  log_info("signal received, starting graceful shutdown");
+  log_info(lost_leadership ? "leadership lost, shutting down for restart"
+                           : "signal received, starting graceful shutdown");
+  if (holder.joinable()) holder.join();
+  if (elector && !lost_leadership) elector->release();
   health.stop();
   log_info("synchronizer gracefully shut down");
-  return 0;
+  return lost_leadership ? 1 : 0;
 }

@@ -26,6 +26,12 @@ struct LeaderConfig {
   int64_t retry_period_secs = 2;     // cadence after a failed renew
 };
 
+// Shared CONF_* surface for lease configuration (CONF_LEASE_NAMESPACE,
+// CONF_LEASE_NAME, CONF_LEASE_IDENTITY, CONF_LEASE_DURATION_SECS,
+// CONF_LEASE_RENEW_SECS, CONF_LEASE_RETRY_SECS), with the in-cluster SA
+// namespace and hostname-pid identity as fallbacks.
+LeaderConfig leader_config_from_env(const std::string& default_lease_name);
+
 class LeaderElector {
  public:
   LeaderElector(KubeClient& client, LeaderConfig config);

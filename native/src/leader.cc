@@ -6,6 +6,9 @@
 #include <cstdio>
 #include <ctime>
 
+#include <unistd.h>
+
+#include "tpubc/config.h"
 #include "tpubc/log.h"
 #include "tpubc/runtime.h"
 #include "tpubc/util.h"
@@ -16,6 +19,33 @@ namespace {
 constexpr const char* kLeaseApi = "coordination.k8s.io/v1";
 constexpr const char* kLeaseKind = "Lease";
 }  // namespace
+
+LeaderConfig leader_config_from_env(const std::string& default_lease_name) {
+  EnvConfig env;
+  LeaderConfig c;
+  // lease namespace: explicit env > in-cluster SA namespace > default
+  std::string ns = env.get("lease_namespace", "");
+  if (ns.empty()) {
+    try {
+      ns = trim(read_file("/var/run/secrets/kubernetes.io/serviceaccount/namespace"));
+    } catch (const std::exception&) {
+      ns = "default";
+    }
+  }
+  c.lease_namespace = ns;
+  c.lease_name = env.get("lease_name", default_lease_name);
+  std::string identity = env.get("lease_identity", "");
+  if (identity.empty()) {
+    char host[256] = {0};
+    gethostname(host, sizeof(host) - 1);
+    identity = std::string(host) + "-" + std::to_string(::getpid());
+  }
+  c.identity = identity;
+  c.lease_duration_secs = env.get_int("lease_duration_secs", 15);
+  c.renew_period_secs = env.get_int("lease_renew_secs", 5);
+  c.retry_period_secs = env.get_int("lease_retry_secs", 2);
+  return c;
+}
 
 int64_t steady_now_ms() {
   return std::chrono::duration_cast<std::chrono::milliseconds>(

@@ -88,7 +88,12 @@ def test_deployment_env_matches_daemon_config_surface():
     daemon_src = "".join(
         (repo / "native" / "bin" / f"{d}.cc").read_text()
         for d in ("controller", "admission", "synchronizer")
-    ) + (repo / "native" / "src" / "kube_client.cc").read_text()
+    ) + "".join(
+        # shared-lib config surfaces the daemons link (lease config lives
+        # in leader.cc's leader_config_from_env)
+        (repo / "native" / "src" / f"{d}.cc").read_text()
+        for d in ("kube_client", "leader")
+    )
     read_keys = set(re.findall(r'env\.(?:get|require|get_int|get_list)\("([a-z_]+)"', daemon_src))
     read_keys |= {"kube_api_url", "kube_insecure_tls", "kube_token", "kube_ca_file"}
 

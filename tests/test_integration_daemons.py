@@ -579,6 +579,58 @@ def test_synchronizer_pool_capacity(fake, tmp_path):
         assert code == 0, err
 
 
+def test_synchronizer_leader_election(fake, tmp_path):
+    """With CONF_LEADER_ELECT=1 and two replicas, only the lease holder
+    syncs — the standby serves /health but writes nothing until it wins."""
+    sheet = tmp_path / "sheet.csv"
+    sheet.write_text(CSV_HEADER + "앨리스,CSE,alice,tpu-serv,16,8,32,100,o\n")
+    fake.create_ub("alice", spec={"kube_username": "alice"})
+
+    def start(identity):
+        port = free_port()
+        return Daemon(
+            "tpubc-synchronizer",
+            {
+                "CONF_KUBE_API_URL": fake.url,
+                "CONF_LISTEN_ADDR": "127.0.0.1",
+                "CONF_LISTEN_PORT": str(port),
+                "CONF_SHEET_PATH": str(sheet),
+                "CONF_SYNC_INTERVAL_SECS": "1",
+                "CONF_SERVER_NAME": "tpu-serv",
+                "CONF_LEADER_ELECT": "1",
+                "CONF_LEASE_NAME": "sync-test",
+                "CONF_LEASE_IDENTITY": identity,
+                "CONF_LEASE_DURATION_SECS": "6",
+                "CONF_LEASE_RENEW_SECS": "1",
+                "CONF_LEASE_RETRY_SECS": "1",
+            },
+            port,
+        ).wait_healthy()
+
+    leader = start("sync-a")
+    try:
+        wait_for(
+            lambda: (fake.get(fake.KEY_UB, "alice") or {}).get("status", {}).get(
+                "synchronized_with_sheet"),
+            desc="leader synced",
+        )
+        lease = fake.get(("apis/coordination.k8s.io/v1", "default", "leases"), "sync-test")
+        assert lease["spec"]["holderIdentity"] == "sync-a"
+
+        standby = start("sync-b")
+        try:
+            time.sleep(2.5)  # a few ticks
+            assert standby.metrics().get("syncs_total", 0) == 0, "standby must not sync"
+            assert leader.metrics()["syncs_total"] >= 2
+        finally:
+            # The standby is blocked in acquire(); SIGTERM must stop it.
+            code, err = standby.stop()
+            assert code == 0, err
+    finally:
+        code, err = leader.stop()
+        assert code == 0, err
+
+
 def test_controller_owns_children_event_driven(fake):
     """The .owns() analogue (reference controller.rs:234-238): child
     mutations requeue the owner CR event-driven. requeue_secs is cranked
