@@ -165,6 +165,16 @@ def _fwd(q3, k3, v3, sm_scale, block, causal, true_len, interpret, heads, group)
     bh, seq, hd = q3.shape
     kv = _kv_row(heads, group)
     grid = (bh, seq // block, seq // block)
+    # Causal: grid steps with kj > qi are skipped by pl.when, but Mosaic
+    # would still DMA their K/V tiles. Clamping the index map to the
+    # diagonal makes the skipped steps "revisit" the already-resident
+    # block — same index, no refetch — cutting causal KV read traffic in
+    # half. The kernel body never reads the clamped block (it is inside
+    # the pl.when).
+    if causal:
+        kv_idx = lambda b, i, j: (kv(b), jnp.minimum(j, i), 0)  # noqa: E731
+    else:
+        kv_idx = lambda b, i, j: (kv(b), j, 0)  # noqa: E731
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, sm_scale=sm_scale, block=block, causal=causal,
                           true_len=true_len, seq=seq),
@@ -172,8 +182,8 @@ def _fwd(q3, k3, v3, sm_scale, block, causal, true_len, interpret, heads, group)
         compiler_params=_STREAM_GRID,
         in_specs=[
             pl.BlockSpec((None, block, hd), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, block, hd), lambda b, i, j: (kv(b), j, 0)),
-            pl.BlockSpec((None, block, hd), lambda b, i, j: (kv(b), j, 0)),
+            pl.BlockSpec((None, block, hd), kv_idx),
+            pl.BlockSpec((None, block, hd), kv_idx),
         ],
         out_specs=[
             pl.BlockSpec((None, block, hd), lambda b, i, j: (b, i, 0)),
@@ -290,12 +300,22 @@ def _bwd(sm_scale, block, causal, true_len, interpret, heads, group, residuals,
     grid = (bh, seq // block, seq // block)
     # index_map args are (b, outer, inner); `outer` is the q tile for the
     # dq kernel and the kv tile for the dkv kernel. K/V inputs stream at
-    # their native (GQA) head count via the kv-row mapping.
+    # their native (GQA) head count via the kv-row mapping. Under causal,
+    # skipped grid steps clamp their streamed-operand index to the
+    # diagonal so Mosaic revisits the resident block instead of fetching
+    # a tile the pl.when-gated body never reads (see _fwd).
     q_tile = lambda sel: pl.BlockSpec((None, block, hd), lambda b, i, j: (b, sel(i, j), 0))  # noqa: E731
     kv_tile = lambda sel: pl.BlockSpec((None, block, hd), lambda b, i, j: (kv(b), sel(i, j), 0))  # noqa: E731
     row_tile = lambda sel: pl.BlockSpec((None, block, 1), lambda b, i, j: (b, sel(i, j), 0))  # noqa: E731
     outer = lambda i, j: i  # noqa: E731
-    inner = lambda i, j: j  # noqa: E731
+    if causal:
+        # dq streams KV tiles j and needs only j <= i.
+        inner = lambda i, j: jnp.minimum(j, i)  # noqa: E731
+        # dkv streams Q-row tiles j and needs only j >= i (= its kv tile).
+        inner_ge = lambda i, j: jnp.maximum(j, i)  # noqa: E731
+    else:
+        inner = lambda i, j: j  # noqa: E731
+        inner_ge = inner
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, sm_scale=sm_scale, block=block, causal=causal,
@@ -319,8 +339,8 @@ def _bwd(sm_scale, block, causal, true_len, interpret, heads, group, residuals,
                           true_len=true_len, seq=seq),
         grid=grid,
         compiler_params=_STREAM_GRID,
-        in_specs=[q_tile(inner), kv_tile(outer), kv_tile(outer), q_tile(inner),
-                  row_tile(inner), row_tile(inner)],
+        in_specs=[q_tile(inner_ge), kv_tile(outer), kv_tile(outer), q_tile(inner_ge),
+                  row_tile(inner_ge), row_tile(inner_ge)],
         out_specs=[q_tile(outer), q_tile(outer)],
         out_shape=[
             jax.ShapeDtypeStruct((bh, seq, hd), k3.dtype),
