@@ -118,3 +118,21 @@ def test_remat_matches_no_remat():
         _, _, loss_value = step(params, opt_state, tokens)
         results.append(float(loss_value))
     assert abs(results[0] - results[1]) < 1e-5
+
+
+def test_train_loop_profile_capture(tmp_path):
+    """WORKLOAD_PROFILE_DIR-style profiling: a bounded trace of the steps
+    after compile lands on disk in TensorBoard/Perfetto layout."""
+    from tpu_bootstrap.workload.train import TrainConfig, train_loop
+
+    cfg = TrainConfig(
+        model=ModelConfig(vocab_size=64, num_layers=1, num_heads=2, head_dim=8,
+                          embed_dim=16, mlp_dim=32, max_seq_len=16),
+        mesh=MeshConfig(),
+    )
+    prof = tmp_path / "prof"
+    losses = train_loop(cfg, 3, mesh=build_mesh(cfg.mesh, jax.devices()[:1]),
+                        profile_dir=str(prof))
+    assert len(losses) == 3
+    traces = list(prof.rglob("*.trace.json.gz")) + list(prof.rglob("*.xplane.pb"))
+    assert traces, f"no trace files under {prof}"

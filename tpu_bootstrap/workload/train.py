@@ -241,7 +241,8 @@ def synthetic_batch(cfg: TrainConfig, step_index: int, seed: int = 0):
 
 
 def train_loop(cfg: TrainConfig, steps: int, *, checkpoint_dir: str | None = None,
-               save_every: int = 10, seed: int = 0, mesh=None):
+               save_every: int = 10, seed: int = 0, mesh=None,
+               profile_dir: str | None = None):
     """Run (or resume) training for ``steps`` total steps.
 
     With checkpoint_dir set, the latest checkpoint in it is restored and
@@ -249,6 +250,12 @@ def train_loop(cfg: TrainConfig, steps: int, *, checkpoint_dir: str | None = Non
     preempted slice re-runs this very function and picks up where the last
     completed save left off). Returns the losses of the steps actually
     executed this call.
+
+    profile_dir captures an XLA/device trace of steps 2-4 (past the
+    compile step) viewable in TensorBoard/Perfetto — the profiling hook
+    SURVEY §5 notes the reference lacks. Workers set it via
+    WORKLOAD_PROFILE_DIR; on multi-host runs each process writes its own
+    host's trace.
     """
     if save_every < 1:
         raise ValueError(f"save_every must be >= 1, got {save_every}")
@@ -288,29 +295,53 @@ def train_loop(cfg: TrainConfig, steps: int, *, checkpoint_dir: str | None = Non
     step_fn = make_train_step(cfg, mesh, p_shardings)
 
     losses = []
+    profiling = False
 
     def run_step(i, tokens):
-        nonlocal params, opt_state
+        nonlocal params, opt_state, profiling
+        # Trace steps start+1..start+3: step start is compile+warm, and a
+        # bounded window keeps the trace small enough to actually open.
+        if profile_dir is not None:
+            if i == start + 1 and not profiling:
+                jax.profiler.start_trace(profile_dir)
+                profiling = True
+            elif profiling and i == start + 4:
+                _close_trace()
         params, opt_state, loss_value = step_fn(params, opt_state, tokens)
         losses.append(float(loss_value))
         if mgr is not None and ((i + 1) % save_every == 0 or i + 1 == steps):
             ckpt.save(mgr, i + 1, params, opt_state)
 
-    if cfg.data is not None:
-        from tpu_bootstrap.workload.data import make_batch_fn, prefetched
+    def _close_trace():
+        nonlocal profiling
+        # Force pending dispatches into the trace window first.
+        jax.block_until_ready(params)
+        jax.profiler.stop_trace()
+        profiling = False
 
-        batch_fn = make_batch_fn(
-            cfg.data, cfg.model.max_seq_len,
-            batch_size=global_batch_size(cfg),
-            sharding=batch_shardings(mesh))
-        # step-addressed batches: resume replays exactly what an
-        # uninterrupted run would have seen, with prefetch staging the
-        # gather + transfer off the critical path.
-        for i, tokens in prefetched(batch_fn, start, steps):
-            run_step(i, tokens)
-    else:
-        for i in range(start, steps):
-            run_step(i, jax.device_put(synthetic_batch(cfg, i, seed), batch_shardings(mesh)))
+    try:
+        if cfg.data is not None:
+            from tpu_bootstrap.workload.data import make_batch_fn, prefetched
+
+            batch_fn = make_batch_fn(
+                cfg.data, cfg.model.max_seq_len,
+                batch_size=global_batch_size(cfg),
+                sharding=batch_shardings(mesh))
+            # step-addressed batches: resume replays exactly what an
+            # uninterrupted run would have seen, with prefetch staging the
+            # gather + transfer off the critical path.
+            for i, tokens in prefetched(batch_fn, start, steps):
+                run_step(i, tokens)
+        else:
+            for i in range(start, steps):
+                run_step(i, jax.device_put(synthetic_batch(cfg, i, seed),
+                                           batch_shardings(mesh)))
+    finally:
+        # Close an open trace even when a step raises (OOM, preemption):
+        # the partial trace is the artifact you want from a failing run,
+        # and a dangling profiler poisons later start_trace calls.
+        if profiling:
+            _close_trace()
     if mgr is not None:
         mgr.wait_until_finished()
     return losses
@@ -426,7 +457,8 @@ def worker_main() -> None:
         grad_clip_norm=float(os.environ.get("WORKLOAD_GRAD_CLIP", "1.0")),
     )
     losses = train_loop(cfg, steps, checkpoint_dir=ckpt_dir,
-                        save_every=save_every, seed=seed)
+                        save_every=save_every, seed=seed,
+                        profile_dir=os.environ.get("WORKLOAD_PROFILE_DIR") or None)
     if losses:
         print(f"train_loop done: ran {len(losses)} steps, "
               f"first={losses[0]:.4f} last={losses[-1]:.4f}")
