@@ -343,18 +343,71 @@ bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::s
   if (!wave1.empty()) apply_wave(wave1);
   if (!wave2.empty()) apply_wave(wave2);
 
-  // Maintain status.slice for TPU CRs (merge-patch: never touches the
-  // synchronizer-owned synchronized_with_sheet field).
-  if (ub.get("spec").get("tpu").is_object()) {
+  // Revocation teardown: the sheet gate closing (synchronizer revocation,
+  // or an admin clearing the status) must take back what it granted —
+  // the reference leaves RoleBindings in place forever because its sheet
+  // semantics never revoke. The RoleBinding delete fires whenever the
+  // gate is closed (a 404 for never-approved CRs is one cheap round trip
+  // per resync); the JobSet delete keys off status.slice.jobset, the
+  // controller's own record that a slice was provisioned.
+  const bool synchronized = ub.get("status").get_bool("synchronized_with_sheet", false);
+  const bool has_tpu = ub.get("spec").get("tpu").is_object();
+  const std::string ns = target_namespace(ub);
+  bool pruned_jobset = false;
+  if (!synchronized && ub.get("spec").get("rolebinding").is_object()) {
+    try {
+      client.remove("rbac.authorization.k8s.io/v1", "RoleBinding", ns, ns);
+      Metrics::instance().inc("prunes_total");
+      log_info("pruned rolebinding (sheet gate closed)", {{"name", name}});
+    } catch (const KubeError& e) {
+      if (e.status != 404) throw;
+    }
+  }
+  const Json& cached_slice = ub.get("status").get("slice");
+  const std::string cached_jobset = cached_slice.get_string("jobset");
+  const std::string cached_phase = cached_slice.get_string("phase");
+  // "A slice may exist" = the controller's own record says so. Phase
+  // Pending/Absent without a jobset name means nothing was provisioned,
+  // so the steady state of never-approved CRs costs no DELETE traffic.
+  const bool slice_may_exist =
+      !cached_jobset.empty() ||
+      (!cached_phase.empty() && cached_phase != "Pending" && cached_phase != "Absent");
+  if ((!has_tpu || !synchronized) && slice_may_exist) {
+    const std::string js_name = cached_jobset.empty() ? ns + "-slice" : cached_jobset;
+    try {
+      client.remove("jobset.x-k8s.io/v1alpha2", "JobSet", ns, js_name);
+      Metrics::instance().inc("prunes_total");
+      log_info("pruned jobset (revoked or tpu spec removed)",
+               {{"name", name}, {"jobset", js_name}});
+    } catch (const KubeError& e) {
+      if (e.status != 404) throw;
+    }
+    pruned_jobset = true;
+  }
+
+  // Maintain status.slice (merge-patch: never touches the
+  // synchronizer-owned synchronized_with_sheet field). Runs for TPU CRs
+  // and for CRs whose status still carries a slice (spec.tpu removed:
+  // the slice block must go away entirely — merging {"slice": null}
+  // rather than writing {"phase": "Absent"} leaves no residue to
+  // re-examine on later passes).
+  if (!has_tpu && cached_slice.is_object()) {
+    try {
+      client.merge_status(kApiVersion, kKind, "", name,
+                          Json::object({{"slice", Json()}}));
+    } catch (const KubeError& e) {
+      log_warn("slice status removal failed", {{"name", name}, {"error", e.what()}});
+    }
+  } else if (has_tpu) {
     Json observed;  // null unless the JobSet exists
-    const std::string ns = target_namespace(ub);
     if (have_applied_jobset) {
       // The SSA response is the server's current stored object (status
       // included) — a free observation, no extra GET.
       observed = std::move(applied_jobset);
-    } else {
-      // No JobSet child this pass (sheet gate closed / no tpu spec at
-      // emit time): one may still exist from an earlier approval.
+    } else if (!pruned_jobset) {
+      // No JobSet child this pass (sheet gate closed at emit time): one
+      // may still exist from an earlier approval — unless we just
+      // deleted it above.
       try {
         observed = client.get("jobset.x-k8s.io/v1alpha2", "JobSet", ns, ns + "-slice");
       } catch (const KubeError& e) {
@@ -362,7 +415,17 @@ bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::s
       }
     }
     Json desired_slice = slice_status(ub, observed);
-    if (ub.get("status").get("slice") != desired_slice) {
+    // Merge-patch is RFC 7386 (recursive): keys that should disappear
+    // (e.g. jobset after a prune) must be explicitly nulled or they
+    // linger in status and re-trigger this write — and the prune above —
+    // every pass.
+    if (cached_slice.is_object()) {
+      for (const auto& member : cached_slice.members()) {
+        if (desired_slice.get(member.first).is_null())
+          desired_slice.set(member.first, Json());
+      }
+    }
+    if (cached_slice != desired_slice) {
       try {
         client.merge_status(kApiVersion, kKind, "", name,
                             Json::object({{"slice", desired_slice}}));

@@ -152,6 +152,52 @@ void run_sync_once(KubeClient& client, const Json& sync_config, SheetSource& she
     Metrics::instance().inc("sync_actions_total");
     log_info("quota updated", {{"name", name}});
   }
+  // Revocations (opt-in, CONF_REVOKE_ON_UNAUTHORIZED=1): close the gate
+  // of previously synchronized CRs whose sheet approval was withdrawn;
+  // the controller's interlocks then tear down RoleBinding + JobSet.
+  // Degraded-read guard: rows that failed to parse were DROPPED, so a
+  // revocation this tick might be an admin mid-edit, not a decision —
+  // hold revocations until a clean read (plan_sync separately suppresses
+  // them when the server filter matches zero rows).
+  if (plan.get("revocations").size() > 0 && parsed.get("warnings").size() > 0) {
+    log_warn("suppressing revocations: sheet had row parse warnings",
+             {{"revocations", std::to_string(plan.get("revocations").size())}});
+    Metrics::instance().inc("sync_revocations_suppressed_total");
+    plan.set("revocations", Json::array());
+  }
+  for (const auto& rev : plan.get("revocations").items()) {
+    const std::string name = rev.get_string("name");
+    Json status_obj = Json::object({
+        {"apiVersion", kApiVersion},
+        {"kind", kKind},
+        {"metadata", Json::object({
+                         {"name", name},
+                         {"resourceVersion", rev.get_string("resource_version")},
+                     })},
+        {"status", rev.get("status")},
+    });
+    log_info("revoking sheet authorization", {{"name", name}});
+    try {
+      client.replace_status(kApiVersion, kKind, "", name, status_obj);
+    } catch (const KubeError& e) {
+      if (e.status == 409) {
+        log_warn("revocation status conflict; will retry next sync", {{"name", name}});
+        Metrics::instance().inc("sync_conflicts_total");
+        continue;
+      }
+      throw;
+    }
+    Metrics::instance().inc("sync_revocations_total");
+    try {
+      post_event(client,
+                 build_event(prior[name], "QuotaRevoked",
+                             "sheet authorization withdrawn: access and slice "
+                             "will be torn down",
+                             "Warning", now_rfc3339(), "tpu-bootstrap-synchronizer"));
+    } catch (const std::exception& e) {
+      log_warn("event post failed", {{"name", name}, {"error", e.what()}});
+    }
+  }
   Metrics::instance().inc("syncs_total");
   Metrics::instance().set("pool_chips_allocated", plan.get_int("total_chips", 0));
 }
@@ -194,6 +240,7 @@ int main() {
   sync_config.set("server_name", env.get("server_name", env.get("gpu_server_name", "")));
   sync_config.set("device", env.get("device", "tpu"));
   sync_config.set("pool_capacity_chips", env.get_int("pool_capacity_chips", 0));
+  sync_config.set("revoke_unauthorized", env.get("revoke_on_unauthorized", "0") == "1");
 
   KubeClient client(kube_config_from_env());
   // Shutdown promptness: once stop is requested, any in-flight API

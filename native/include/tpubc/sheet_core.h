@@ -56,7 +56,11 @@ Json build_quota(const Json& row, const std::string& device);
 //   {name, quota: <ResourceQuotaSpec>, patches: <JSON Patch ops>,
 //    status: {synchronized_with_sheet: true}, chips: N}
 // in list order. Rows that would overflow pool_capacity_chips are reported
-// in `skipped` instead. Result: {actions: [...], skipped: [...],
+// in `skipped` instead. With config.revoke_unauthorized, previously
+// synchronized CRs with no authorized row emit
+// {name, status: {synchronized_with_sheet: false}, resource_version}
+// in `revocations` (default keeps the reference's skipped-not-reverted
+// semantics). Result: {actions: [...], skipped: [...], revocations: [...],
 // total_chips: N}.
 Json plan_sync(const Json& ub_list, const Json& rows, const Json& config);
 

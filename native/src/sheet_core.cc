@@ -182,6 +182,10 @@ Json default_synchronizer_config() {
       {"server_name", ""},
       {"device", "tpu"},
       {"pool_capacity_chips", 0},
+      // Opt-in revocation: reference semantics leave unmatched CRs alone
+      // (skipped, not reverted); true closes a previously-synchronized
+      // CR's gate so the controller tears down RoleBinding + JobSet.
+      {"revoke_unauthorized", false},
   });
 }
 
@@ -216,6 +220,7 @@ Json plan_sync(const Json& ub_list, const Json& rows, const Json& config) {
 
   Json actions = Json::array();
   Json skipped = Json::array();
+  Json revocations = Json::array();
   int64_t used_chips = 0;
 
   for (const auto& ub : ub_list.items()) {
@@ -233,7 +238,33 @@ Json plan_sync(const Json& ub_list, const Json& rows, const Json& config) {
         break;
       }
     }
-    if (!match) continue;  // no row => leave the CR alone (sheet is source of truth)
+    if (!match) {
+      // No authorized row. Reference semantics: leave the CR alone
+      // (synchronizer.rs — skipped, not reverted). With
+      // revoke_unauthorized set, a CR that WAS synchronized gets its
+      // gate closed instead: approval withdrawn on the sheet must tear
+      // the slice down, not leave the chips allocated forever.
+      if (config.get_bool("revoke_unauthorized", false) &&
+          ub.get("status").get_bool("synchronized_with_sheet", false) &&
+          !filtered.empty()) {
+        // filtered.empty() guard: a sheet that lists NOBODY for this
+        // server while synchronized CRs exist smells like a truncated/
+        // corrupted export, not an admin decision — suppressing mass
+        // revocation there keeps a transient bad read from tearing down
+        // every running slice. Status is the CR's CURRENT status with
+        // only the flag flipped: this goes out via replace_status (whole
+        // subresource PUT), which must not wipe the controller-owned
+        // slice record.
+        Json st = ub.get("status").is_object() ? ub.get("status") : Json::object();
+        st.set("synchronized_with_sheet", false);
+        revocations.push_back(Json::object({
+            {"name", name},
+            {"status", st},
+            {"resource_version", ub.get("metadata").get_string("resourceVersion")},
+        }));
+      }
+      continue;
+    }
 
     const int64_t chips =
         device == "gpu" ? match->get_int("gpu_request") : match->get_int("tpu_request");
@@ -259,6 +290,13 @@ Json plan_sync(const Json& ub_list, const Json& rows, const Json& config) {
     }
     patches.push_back(Json::object({{"op", "replace"}, {"path", "/spec/quota"}, {"value", quota}}));
 
+    // Status = the CR's current status with only the flag set: the
+    // synchronizer applies it via replace_status (whole-subresource
+    // PUT), which would otherwise wipe the controller-owned slice
+    // record on every tick — churning status writes and losing the
+    // teardown path's memory of which JobSet exists.
+    Json st = ub.get("status").is_object() ? ub.get("status") : Json::object();
+    st.set("synchronized_with_sheet", true);
     actions.push_back(Json::object({
         {"name", name},
         {"chips", chips},
@@ -266,13 +304,15 @@ Json plan_sync(const Json& ub_list, const Json& rows, const Json& config) {
         {"patches", patches},
         // Status is written before the quota patch (synchronizer.rs:302 vs
         // :324) so the controller's interlocks open as soon as possible.
-        {"status", Json::object({{"synchronized_with_sheet", true}})},
+        {"status", st},
         {"resource_version", ub.get("metadata").get_string("resourceVersion")},
     }));
   }
 
-  return Json::object(
-      {{"actions", actions}, {"skipped", skipped}, {"total_chips", used_chips}});
+  return Json::object({{"actions", actions},
+                       {"skipped", skipped},
+                       {"revocations", revocations},
+                       {"total_chips", used_chips}});
 }
 
 }  // namespace tpubc

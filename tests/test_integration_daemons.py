@@ -579,6 +579,67 @@ def test_synchronizer_pool_capacity(fake, tmp_path):
         assert code == 0, err
 
 
+def test_revocation_tears_down_access_and_slice(fake, tmp_path):
+    """The full revocation path: sheet approval withdrawn -> synchronizer
+    (CONF_REVOKE_ON_UNAUTHORIZED=1) closes the gate + posts a Warning
+    event -> controller deletes the RoleBinding and JobSet and collapses
+    status.slice. The reference never revokes (skipped-not-reverted);
+    this is the TPU build's chips-must-come-back extension."""
+    sheet = tmp_path / "sheet.csv"
+    sheet.write_text(CSV_HEADER + "앨리스,CSE,alice,tpu-serv,16,8,32,100,o\n")
+    fake.create_ub("alice", spec=full_spec())
+
+    sport, cport = free_port(), free_port()
+    sd = Daemon(
+        "tpubc-synchronizer",
+        {
+            "CONF_KUBE_API_URL": fake.url,
+            "CONF_LISTEN_ADDR": "127.0.0.1",
+            "CONF_LISTEN_PORT": str(sport),
+            "CONF_SHEET_PATH": str(sheet),
+            "CONF_SYNC_INTERVAL_SECS": "1",
+            "CONF_SERVER_NAME": "tpu-serv",
+            "CONF_REVOKE_ON_UNAUTHORIZED": "1",
+        },
+        sport,
+    ).wait_healthy()
+    cd = Daemon("tpubc-controller", controller_env(fake, cport), cport).wait_healthy()
+    try:
+        # Approved: everything materializes.
+        wait_for(lambda: fake.get(KEY_RB("alice"), "alice"), desc="rolebinding")
+        wait_for(lambda: fake.get(KEY_JS("alice"), "alice-slice"), desc="jobset")
+
+        # Approval withdrawn on the sheet.
+        sheet.write_text(CSV_HEADER + "앨리스,CSE,alice,tpu-serv,16,8,32,100,x\n")
+        wait_for(
+            lambda: (fake.get(fake.KEY_UB, "alice") or {}).get("status", {}).get(
+                "synchronized_with_sheet") is False,
+            desc="gate closed",
+        )
+        wait_for(lambda: fake.get(KEY_RB("alice"), "alice") is None,
+                 desc="rolebinding pruned")
+        wait_for(lambda: fake.get(KEY_JS("alice"), "alice-slice") is None,
+                 desc="jobset pruned")
+        ub = wait_for(
+            lambda: (lambda u: u if u["status"].get("slice", {}).get("phase") == "Pending"
+                     and "jobset" not in u["status"]["slice"] else None)(
+                fake.get(fake.KEY_UB, "alice")),
+            desc="slice status collapsed",
+        )
+        ev = fake.get(("api/v1", "default", "events"), "alice.quotarevoked")
+        assert ev["type"] == "Warning"
+        assert ev["source"]["component"] == "tpu-bootstrap-synchronizer"
+
+        # Re-approval reopens everything.
+        sheet.write_text(CSV_HEADER + "앨리스,CSE,alice,tpu-serv,16,8,32,100,o\n")
+        wait_for(lambda: fake.get(KEY_RB("alice"), "alice"), desc="rolebinding back")
+        wait_for(lambda: fake.get(KEY_JS("alice"), "alice-slice"), desc="jobset back")
+    finally:
+        for d in (sd, cd):
+            code, err = d.stop()
+            assert code == 0, err
+
+
 def test_synchronizer_leader_election(fake, tmp_path):
     """With CONF_LEADER_ELECT=1 and two replicas, only the lease holder
     syncs — the standby serves /health but writes nothing until it wins."""

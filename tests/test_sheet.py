@@ -247,3 +247,52 @@ def test_plan_sync_gpu_device_uses_gpu_chips(lib):
     assert (
         plan["actions"][0]["quota"]["hard"]["requests.nvidia.com/gpu"] == "2"
     )
+
+
+def test_plan_sync_revocation_opt_in(lib):
+    """revoke_unauthorized: a previously synchronized CR with no
+    authorized row gets a gate-closing revocation; default (reference
+    semantics, synchronizer.rs skipped-not-reverted) leaves it alone."""
+    synced = {"metadata": {"name": "alice", "resourceVersion": "9"},
+              "spec": {}, "status": {"synchronized_with_sheet": True}}
+    rows = lib.parse_sheet(sheet(row(authorized="x")))["rows"]
+
+    plan = lib.plan_sync([synced], rows, cfg(lib))
+    assert plan["revocations"] == [] and plan["actions"] == []
+
+    plan = lib.plan_sync([synced], rows, cfg(lib, revoke_unauthorized=True))
+    [r] = plan["revocations"]
+    assert r["name"] == "alice"
+    assert r["status"] == {"synchronized_with_sheet": False}
+    assert r["resource_version"] == "9"
+    # never-synchronized CRs are not "revoked" — nothing to take back
+    fresh = {"metadata": {"name": "alice", "resourceVersion": "9"}, "spec": {}}
+    assert lib.plan_sync([fresh], rows, cfg(lib, revoke_unauthorized=True))["revocations"] == []
+    # an authorized row wins over revocation
+    rows2 = lib.parse_sheet(sheet(row()))["rows"]
+    plan = lib.plan_sync([synced], rows2, cfg(lib, revoke_unauthorized=True))
+    assert plan["revocations"] == [] and len(plan["actions"]) == 1
+
+
+def test_plan_sync_revocation_guards_and_status_preservation(lib):
+    """Mass-revocation guard: zero rows for this server => suppressed
+    (truncated export, not an admin decision). And both actions and
+    revocations carry the CR's CURRENT status with only the flag flipped
+    — replace_status must not wipe the controller-owned slice record."""
+    slice_block = {"phase": "Running", "jobset": "alice-slice", "chips": 4}
+    synced = {"metadata": {"name": "alice", "resourceVersion": "9"}, "spec": {},
+              "status": {"synchronized_with_sheet": True, "slice": slice_block}}
+
+    # no rows at all -> no revocations even with the flag on
+    plan = lib.plan_sync([synced], [], cfg(lib, revoke_unauthorized=True))
+    assert plan["revocations"] == []
+
+    # unauthorized row present -> revocation, status.slice preserved
+    rows = lib.parse_sheet(sheet(row(authorized="x")))["rows"]
+    [r] = lib.plan_sync([synced], rows, cfg(lib, revoke_unauthorized=True))["revocations"]
+    assert r["status"] == {"synchronized_with_sheet": False, "slice": slice_block}
+
+    # re-sync action also preserves status.slice
+    rows = lib.parse_sheet(sheet(row()))["rows"]
+    [a] = lib.plan_sync([synced], rows, cfg(lib))["actions"]
+    assert a["status"] == {"synchronized_with_sheet": True, "slice": slice_block}
