@@ -106,9 +106,21 @@ def _block_step(block: Params, x: jax.Array, cache: dict, positions: jax.Array,
     cache at `positions` and attention over the whole cache."""
     dtype = cfg.compute_dtype
     h = _rms_norm(x, block["attn_norm"])
-    q = _linear(h, block["wq"], 1, dtype)
-    q = _rotary(q, positions)
-    k, v = _project_kv(block, h, positions, cfg)
+    wqkv = block.get("wqkv")
+    if wqkv is not None and quant.is_quantized(wqkv):
+        # Fused int8 QKV (quant.quantize_block): one kernel launch for all
+        # three projections — decode at small batch is launch-bound.
+        fused = _linear(h, wqkv, 1, dtype)
+        nq = cfg.num_heads * cfg.head_dim
+        nk = cfg.kv_heads * cfg.head_dim
+        q = fused[..., :nq].reshape(*h.shape[:-1], cfg.num_heads, cfg.head_dim)
+        k = fused[..., nq:nq + nk].reshape(*h.shape[:-1], cfg.kv_heads, cfg.head_dim)
+        v = fused[..., nq + nk:].reshape(*h.shape[:-1], cfg.kv_heads, cfg.head_dim)
+        q, k = _rotary(q, positions), _rotary(k, positions)
+    else:
+        q = _linear(h, block["wq"], 1, dtype)
+        q = _rotary(q, positions)
+        k, v = _project_kv(block, h, positions, cfg)
     start = positions[0]
     cache = {
         "k": lax.dynamic_update_slice(cache["k"], k, (0, start, 0, 0)),

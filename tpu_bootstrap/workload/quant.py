@@ -223,6 +223,18 @@ def quantize_block(block: dict) -> dict:
     for name, contract_rank in (("wq", 1), ("wk", 1), ("wv", 1), ("wo", 2),
                                 ("w_up", 1), ("w_down", 1)):
         out[name] = _q2d(block[name], contract_rank)
+    # Fused QKV: the three projections share the input activation, so one
+    # kernel launch covers all three — decode at small batch is kernel-
+    # launch-bound (6 launches per layer per token otherwise). Scales are
+    # per-output-channel, so concatenating along N is exact. decode
+    # prefers this entry; wq/wk/wv stay for any per-projection reader
+    # (int8 storage is cheap next to the float master copy).
+    out["wqkv"] = QuantizedWeight(
+        q=jnp.concatenate([out[n].q for n in ("wq", "wk", "wv")], axis=1),
+        s=jnp.concatenate([out[n].s for n in ("wq", "wk", "wv")]),
+        shape=tuple(out["wq"].q.shape[:1]) + (
+            out["wq"].q.shape[1] + out["wk"].q.shape[1] + out["wv"].q.shape[1],),
+    )
     return out
 
 
