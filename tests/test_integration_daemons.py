@@ -770,6 +770,33 @@ def test_controller_steady_state_does_not_oscillate(fake):
         assert code == 0, err
 
 
+def test_controller_converges_through_injected_faults(tmp_path):
+    """Chaos: 20% of WRITES fail with 500. The controller's error requeue
+    (3s in prod, shortened here) plus idempotent SSA must still converge
+    every CR — fault recovery is statistical, not best-effort."""
+    chaos = FakeKube(error_rate=0.2, fault_seed=7).start()
+    try:
+        for i in range(20):
+            chaos.create_ub(f"c-{i:02d}", spec=full_spec(), status=dict(SYNCED))
+        port = free_port()
+        d = Daemon(
+            "tpubc-controller",
+            controller_env(chaos, port, conf_error_requeue_secs=1),
+            port,
+        ).wait_healthy()
+        try:
+            for i in range(20):
+                wait_for(lambda i=i: chaos.get(KEY_JS(f"c-{i:02d}"), f"c-{i:02d}-slice"),
+                         timeout=60, desc=f"jobset c-{i:02d} despite faults")
+            m = d.metrics()
+            assert m["reconcile_errors_total"] > 0, "chaos mode never fired"
+        finally:
+            code, err = d.stop()
+            assert code == 0, err
+    finally:
+        chaos.stop()
+
+
 def test_fakeapi_cluster_wide_list_and_watch(fake):
     """Cluster-wide collection semantics for namespaced kinds: LIST and
     WATCH on /apis/G/V/PLURAL span every namespace (what the controller's

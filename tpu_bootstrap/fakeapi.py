@@ -337,6 +337,22 @@ class FakeKubeHandler(BaseHTTPRequestHandler):
         if delay:
             time.sleep(delay / 1000.0)
 
+    def inject_fault(self) -> bool:
+        """Fault injection (FakeKube(error_rate=...)): with probability
+        error_rate, answer this WRITE with a 500 before touching the
+        store — the overloaded/flaky-apiserver chaos mode. Reads stay
+        clean (watch streams re-listing on every fault would test the
+        relist path, not error-requeue convergence). Deterministic per
+        construction seed so failures reproduce."""
+        rate = getattr(self.server, "error_rate", 0)
+        if not rate:
+            return False
+        rng = getattr(self.server, "fault_rng", None)
+        if rng is None or rng.random() >= rate:
+            return False
+        self.send_status_error(500, "injected fault", "InternalError")
+        return True
+
     def send_json(self, code, payload):
         body = json.dumps(payload).encode()
         self.send_response(code)
@@ -520,6 +536,8 @@ class FakeKubeHandler(BaseHTTPRequestHandler):
     def do_POST(self):
         self.simulate_latency()
         raw = self.read_body()  # drain before any error return (keep-alive)
+        if self.inject_fault():
+            return
         routed = self.route()
         if not routed:
             return self.send_status_error(404, f"unknown path {self.path}")
@@ -537,6 +555,8 @@ class FakeKubeHandler(BaseHTTPRequestHandler):
     def do_PATCH(self):
         self.simulate_latency()
         raw = self.read_body()  # drain before any error return (keep-alive)
+        if self.inject_fault():
+            return
         routed = self.route()
         if not routed:
             return self.send_status_error(404, f"unknown path {self.path}")
@@ -583,6 +603,8 @@ class FakeKubeHandler(BaseHTTPRequestHandler):
     def do_PUT(self):
         self.simulate_latency()
         raw = self.read_body()  # drain before any error return (keep-alive)
+        if self.inject_fault():
+            return
         routed = self.route()
         if not routed:
             return self.send_status_error(404, f"unknown path {self.path}")
@@ -616,6 +638,8 @@ class FakeKubeHandler(BaseHTTPRequestHandler):
 
     def do_DELETE(self):
         self.simulate_latency()
+        if self.inject_fault():
+            return
         routed = self.route()
         if not routed:
             return self.send_status_error(404, f"unknown path {self.path}")
@@ -662,11 +686,17 @@ class _TrackingHTTPServer(ThreadingHTTPServer):
 class FakeKube:
     """In-process fake API server handle for tests."""
 
-    def __init__(self, port: int = 0, latency_ms: float = 0, event_horizon: int = 100_000):
+    def __init__(self, port: int = 0, latency_ms: float = 0, event_horizon: int = 100_000,
+                 error_rate: float = 0.0, fault_seed: int = 0):
+        import random
+
         self.store = Store(event_horizon=event_horizon)
         self.httpd = _TrackingHTTPServer(("127.0.0.1", port), FakeKubeHandler)
         self.httpd.store = self.store  # type: ignore[attr-defined]
         self.httpd.latency_ms = latency_ms  # type: ignore[attr-defined]
+        # Chaos mode: writes fail with 500 at this rate (see inject_fault).
+        self.httpd.error_rate = error_rate  # type: ignore[attr-defined]
+        self.httpd.fault_rng = random.Random(fault_seed)  # type: ignore[attr-defined]
         self.httpd.daemon_threads = True
         self.thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
 
