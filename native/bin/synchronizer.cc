@@ -75,6 +75,30 @@ int64_t fetch_capacity(const std::string& inventory_url, int64_t fallback) {
   }
 }
 
+// resourceVersion-pinned status replace; false on a 409 conflict (the CR
+// moved under us — next tick re-plans from fresh state; the reference
+// aborts its whole loop on this, we keep going per-CR).
+bool write_status(KubeClient& client, const std::string& name, const std::string& rv,
+                  const Json& status) {
+  Json status_obj = Json::object({
+      {"apiVersion", kApiVersion},
+      {"kind", kKind},
+      {"metadata", Json::object({{"name", name}, {"resourceVersion", rv}})},
+      {"status", status},
+  });
+  try {
+    client.replace_status(kApiVersion, kKind, "", name, status_obj);
+    return true;
+  } catch (const KubeError& e) {
+    if (e.status == 409) {
+      log_warn("status conflict; will retry next sync", {{"name", name}});
+      Metrics::instance().inc("sync_conflicts_total");
+      return false;
+    }
+    throw;
+  }
+}
+
 void run_sync_once(KubeClient& client, const Json& sync_config, SheetSource& sheet,
                    const std::string& inventory_url) {
   log_info("starting synchronization");
@@ -105,28 +129,10 @@ void run_sync_once(KubeClient& client, const Json& sync_config, SheetSource& she
   for (const auto& action : plan.get("actions").items()) {
     const std::string name = action.get_string("name");
     // 1. status first (synchronizer.rs:302 before :324).
-    Json status_obj = Json::object({
-        {"apiVersion", kApiVersion},
-        {"kind", kKind},
-        {"metadata", Json::object({
-                         {"name", name},
-                         {"resourceVersion", action.get_string("resource_version")},
-                     })},
-        {"status", action.get("status")},
-    });
     log_info("updating status", {{"name", name}});
-    try {
-      client.replace_status(kApiVersion, kKind, "", name, status_obj);
-    } catch (const KubeError& e) {
-      if (e.status == 409) {
-        // resourceVersion conflict: the CR moved under us. Next tick
-        // re-plans from fresh state (reference surfaces the error and
-        // aborts the whole loop; we keep going per-CR).
-        log_warn("status conflict; will retry next sync", {{"name", name}});
-        Metrics::instance().inc("sync_conflicts_total");
-        continue;
-      }
-      throw;
+    if (!write_status(client, name, action.get_string("resource_version"),
+                      action.get("status"))) {
+      continue;
     }
     // Gate-opening event (best-effort): kubectl describe shows when the
     // admin's sheet approval landed and what it granted. Posted right
@@ -167,25 +173,10 @@ void run_sync_once(KubeClient& client, const Json& sync_config, SheetSource& she
   }
   for (const auto& rev : plan.get("revocations").items()) {
     const std::string name = rev.get_string("name");
-    Json status_obj = Json::object({
-        {"apiVersion", kApiVersion},
-        {"kind", kKind},
-        {"metadata", Json::object({
-                         {"name", name},
-                         {"resourceVersion", rev.get_string("resource_version")},
-                     })},
-        {"status", rev.get("status")},
-    });
     log_info("revoking sheet authorization", {{"name", name}});
-    try {
-      client.replace_status(kApiVersion, kKind, "", name, status_obj);
-    } catch (const KubeError& e) {
-      if (e.status == 409) {
-        log_warn("revocation status conflict; will retry next sync", {{"name", name}});
-        Metrics::instance().inc("sync_conflicts_total");
-        continue;
-      }
-      throw;
+    if (!write_status(client, name, rev.get_string("resource_version"),
+                      rev.get("status"))) {
+      continue;
     }
     Metrics::instance().inc("sync_revocations_total");
     try {

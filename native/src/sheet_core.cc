@@ -207,6 +207,19 @@ Json build_quota(const Json& row, const std::string& device) {
   return Json::object({{"hard", hard}});
 }
 
+namespace {
+
+// The CR's current status with only the sheet flag changed: sync status
+// goes out via replace_status (whole-subresource PUT), which must not
+// wipe the controller-owned slice record.
+Json status_with_flag(const Json& ub, bool synchronized) {
+  Json st = ub.get("status").is_object() ? ub.get("status") : Json::object();
+  st.set("synchronized_with_sheet", synchronized);
+  return st;
+}
+
+}  // namespace
+
 Json plan_sync(const Json& ub_list, const Json& rows, const Json& config) {
   const std::string server = config.get_string("server_name");
   const std::string device = config.get_string("device", "tpu");
@@ -251,15 +264,10 @@ Json plan_sync(const Json& ub_list, const Json& rows, const Json& config) {
         // server while synchronized CRs exist smells like a truncated/
         // corrupted export, not an admin decision — suppressing mass
         // revocation there keeps a transient bad read from tearing down
-        // every running slice. Status is the CR's CURRENT status with
-        // only the flag flipped: this goes out via replace_status (whole
-        // subresource PUT), which must not wipe the controller-owned
-        // slice record.
-        Json st = ub.get("status").is_object() ? ub.get("status") : Json::object();
-        st.set("synchronized_with_sheet", false);
+        // every running slice.
         revocations.push_back(Json::object({
             {"name", name},
-            {"status", st},
+            {"status", status_with_flag(ub, false)},
             {"resource_version", ub.get("metadata").get_string("resourceVersion")},
         }));
       }
@@ -290,13 +298,6 @@ Json plan_sync(const Json& ub_list, const Json& rows, const Json& config) {
     }
     patches.push_back(Json::object({{"op", "replace"}, {"path", "/spec/quota"}, {"value", quota}}));
 
-    // Status = the CR's current status with only the flag set: the
-    // synchronizer applies it via replace_status (whole-subresource
-    // PUT), which would otherwise wipe the controller-owned slice
-    // record on every tick — churning status writes and losing the
-    // teardown path's memory of which JobSet exists.
-    Json st = ub.get("status").is_object() ? ub.get("status") : Json::object();
-    st.set("synchronized_with_sheet", true);
     actions.push_back(Json::object({
         {"name", name},
         {"chips", chips},
@@ -304,7 +305,7 @@ Json plan_sync(const Json& ub_list, const Json& rows, const Json& config) {
         {"patches", patches},
         // Status is written before the quota patch (synchronizer.rs:302 vs
         // :324) so the controller's interlocks open as soon as possible.
-        {"status", st},
+        {"status", status_with_flag(ub, true)},
         {"resource_version", ub.get("metadata").get_string("resourceVersion")},
     }));
   }

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import argparse
 import copy
+import random
 import json
 import socket
 import threading
@@ -688,8 +689,6 @@ class FakeKube:
 
     def __init__(self, port: int = 0, latency_ms: float = 0, event_horizon: int = 100_000,
                  error_rate: float = 0.0, fault_seed: int = 0):
-        import random
-
         self.store = Store(event_horizon=event_horizon)
         self.httpd = _TrackingHTTPServer(("127.0.0.1", port), FakeKubeHandler)
         self.httpd.store = self.store  # type: ignore[attr-defined]

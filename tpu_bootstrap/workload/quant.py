@@ -232,8 +232,8 @@ def quantize_block(block: dict) -> dict:
     out["wqkv"] = QuantizedWeight(
         q=jnp.concatenate([out[n].q for n in ("wq", "wk", "wv")], axis=1),
         s=jnp.concatenate([out[n].s for n in ("wq", "wk", "wv")]),
-        shape=tuple(out["wq"].q.shape[:1]) + (
-            out["wq"].q.shape[1] + out["wk"].q.shape[1] + out["wv"].q.shape[1],),
+        shape=(out["wq"].q.shape[0],
+               out["wq"].q.shape[1] + out["wk"].q.shape[1] + out["wv"].q.shape[1]),
     )
     return out
 
