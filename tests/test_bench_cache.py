@@ -1,0 +1,40 @@
+"""The bench's chip-unavailable fallback: clean on-chip results persist
+to .workload_last_good.json; failed runs return them under cached_* keys
+with the measurement time — labeled, never mixed with live keys."""
+
+import json
+
+import bench
+
+
+def test_cache_round_trip(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "WORKLOAD_CACHE", tmp_path / "cache.json")
+    good = {"chip_alive": True, "train_mfu_pct": 50.0}
+    bench._cache_workload(good)
+    out = bench._attach_cached_workload({"workload_bench_error": "tunnel down"})
+    assert out["workload_bench_error"] == "tunnel down"
+    assert out["cached_train_mfu_pct"] == 50.0
+    assert "measured on this build at" in out["workload_cached_note"]
+    # live keys never collide with cached ones
+    assert "train_mfu_pct" not in out
+
+
+def test_cache_skips_failed_runs(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "WORKLOAD_CACHE", tmp_path / "cache.json")
+    bench._cache_workload({"workload_bench_error": "x", "chip_alive": True})
+    bench._cache_workload({"chip_alive": False})
+    assert not (tmp_path / "cache.json").exists()
+    # no cache -> the error result passes through untouched
+    err = {"workload_bench_error": "y"}
+    assert bench._attach_cached_workload(dict(err)) == err
+
+
+def test_committed_cache_is_fresh_and_complete():
+    """The repo ships a seeded cache so a chip-held bench run still
+    carries real numbers; it must parse and cover the headline metrics."""
+    cache = json.loads(bench.WORKLOAD_CACHE.read_text())
+    r = cache["results"]
+    assert r["chip_alive"] is True
+    for key in ("train_mfu_pct", "train_seq8192_mfu_pct", "flash_attn_speedup",
+                "decode_int8_speedup", "decode_gqa4_speedup"):
+        assert key in r, key
