@@ -138,6 +138,90 @@ def test_pipelined_flash_matches_dense_pipeline():
     np.testing.assert_allclose(run("flash"), run("dense"), rtol=2e-4)
 
 
+@pytest.mark.parametrize("mesh_cfg", [
+    MeshConfig(pipe=2, data=2, tensor=2),   # pp x dp x tp
+    MeshConfig(pipe=2, data=2, fsdp=2),     # pp x dp x fsdp (ZeRO-3 in-stage)
+    MeshConfig(pipe=2, fsdp=2, tensor=2),   # pp x fsdp x tp — both memory axes
+])
+def test_pipeline_composed_loss_and_grads_match_sequential(mesh_cfg):
+    """pp composed with tensor (in-stage Megatron psums) and fsdp
+    (in-stage just-in-time all-gathers): loss AND every block gradient
+    must match the plain sequential model."""
+    mesh = build_mesh(mesh_cfg)
+    params, stacked = stacked_state(MODEL, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, MODEL.max_seq_len),
+                                0, MODEL.vocab_size)
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    expected = float(loss_fn(params, tokens, MODEL))
+
+    cfg = TrainConfig(model=MODEL, mesh=mesh_cfg)
+    loss = make_pipeline_loss(cfg, mesh, num_microbatches=2)
+    got = float(jax.jit(loss)(stacked, inputs, targets))
+    assert got == pytest.approx(expected, rel=1e-5)
+
+    g_seq = stack_block_params(jax.grad(lambda p: loss_fn(p, tokens, MODEL))(params)["blocks"])
+    g_pipe = jax.grad(lambda p: loss(p, inputs, targets))(stacked)
+    for name in ("wq", "wk", "wv", "wo", "w_up", "w_down", "attn_norm", "mlp_norm"):
+        np.testing.assert_allclose(np.asarray(g_pipe["blocks"][name]),
+                                   np.asarray(g_seq[name]),
+                                   rtol=1e-4, atol=1e-6, err_msg=name)
+
+
+def test_pipeline_tp_gqa_fallback_matches():
+    """GQA with kv_heads not divisible by tensor under pp x tp: wk/wv stay
+    replicated over tensor and each device slices its query-head group
+    from the expanded KV — must still match the sequential model."""
+    model = ModelConfig(**{**MODEL.__dict__, "num_kv_heads": 1})
+    mesh_cfg = MeshConfig(pipe=2, data=2, tensor=2)
+    mesh = build_mesh(mesh_cfg)
+    params, stacked = stacked_state(model, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, model.max_seq_len),
+                                0, model.vocab_size)
+    expected = float(loss_fn(params, tokens, model))
+
+    cfg = TrainConfig(model=model, mesh=mesh_cfg)
+    loss = make_pipeline_loss(cfg, mesh, num_microbatches=2)
+    got = float(jax.jit(loss)(stacked, tokens[:, :-1], tokens[:, 1:]))
+    assert got == pytest.approx(expected, rel=1e-5)
+
+    g_seq = stack_block_params(jax.grad(lambda p: loss_fn(p, tokens, model))(params)["blocks"])
+    g_pipe = jax.grad(lambda p: loss(p, tokens[:, :-1], tokens[:, 1:]))(stacked)
+    for name in ("wq", "wk", "wv", "wo"):
+        np.testing.assert_allclose(np.asarray(g_pipe["blocks"][name]),
+                                   np.asarray(g_seq[name]),
+                                   rtol=1e-4, atol=1e-6, err_msg=name)
+
+
+@pytest.mark.parametrize("mesh_cfg,attention", [
+    (MeshConfig(pipe=2, data=2, tensor=2), "dense"),
+    (MeshConfig(pipe=2, data=2, tensor=2), "flash"),
+    (MeshConfig(pipe=2, fsdp=2, tensor=2), "flash"),
+])
+def test_composed_pipelined_train_step_matches_single_device(mesh_cfg, attention):
+    """The FULL train step (grads + Adam) on a pp x tp (x fsdp) mesh must
+    reproduce single-device training step-for-step."""
+    cfg = TrainConfig(model=MODEL, mesh=mesh_cfg, learning_rate=1e-2,
+                      num_microbatches=4, attention=attention, attention_block=8)
+    single_cfg = TrainConfig(model=MODEL, mesh=MeshConfig(), learning_rate=1e-2)
+
+    def run(c, stacked_batch):
+        mesh = build_mesh(c.mesh)
+        params, opt_state, p_sh = init_train_state(c, mesh, jax.random.PRNGKey(0))
+        step = make_train_step(c, mesh, p_sh)
+        tokens = jax.device_put(stacked_batch, batch_shardings(mesh))
+        losses = []
+        for _ in range(2):
+            params, opt_state, loss = step(params, opt_state, tokens)
+            losses.append(float(loss))
+        return losses
+
+    tokens = jax.random.randint(jax.random.PRNGKey(7), (16, MODEL.max_seq_len),
+                                0, MODEL.vocab_size)
+    got = run(cfg, tokens)
+    want = run(single_cfg, tokens)
+    np.testing.assert_allclose(got, want, rtol=2e-4 if attention == "flash" else 2e-5)
+
+
 def test_pipelined_checkpoint_resume_matches(tmp_path):
     """Resume of a pipelined run: the abstract restore state must use the
     same stacked-blocks layout the checkpoint was saved with."""
@@ -157,10 +241,18 @@ def test_pipelined_checkpoint_resume_matches(tmp_path):
 
 
 def test_pipeline_rejects_bad_configs():
-    mesh = build_mesh(MeshConfig(pipe=2, data=2, tensor=2))
-    cfg = TrainConfig(model=MODEL, mesh=MeshConfig(pipe=2, data=2, tensor=2))
-    with pytest.raises(ValueError, match="tensor"):
+    mesh = build_mesh(MeshConfig(pipe=2, data=2, seq=2))
+    cfg = TrainConfig(model=MODEL, mesh=MeshConfig(pipe=2, data=2, seq=2))
+    with pytest.raises(ValueError, match="seq"):
         make_pipeline_loss(cfg, mesh, num_microbatches=2)
+    # tp inside the pipeline needs the head/hidden dims actually sharded —
+    # non-divisible counts would silently replicate and the psum would
+    # overcount, so they must be rejected at construction.
+    odd = TrainConfig(
+        model=ModelConfig(**{**MODEL.__dict__, "num_heads": 3, "mlp_dim": 66}),
+        mesh=MeshConfig(pipe=2, data=2, tensor=2))
+    with pytest.raises(ValueError, match="divisible"):
+        make_pipeline_loss(odd, build_mesh(odd.mesh), num_microbatches=2)
     with pytest.raises(ValueError, match="microbatches"):
         make_pipeline_loss(cfg, build_mesh(MeshConfig(pipe=4, data=2)),
                            num_microbatches=2)
@@ -168,6 +260,19 @@ def test_pipeline_rejects_bad_configs():
     bad = TrainConfig(model=ModelConfig(num_layers=3), mesh=MeshConfig(pipe=2, data=4))
     with pytest.raises(ValueError, match="divide"):
         init_train_state(bad, build_mesh(bad.mesh), jax.random.PRNGKey(0))
+    # ... and the pipeline apply itself guards it too (fit() would
+    # silently replicate a non-divisible layer axis: every stage would
+    # then apply ALL layers — the model run twice, no error).
+    bad_loss = make_pipeline_loss(
+        TrainConfig(model=ModelConfig(**{**MODEL.__dict__, "num_layers": 3}),
+                    mesh=MeshConfig(pipe=2, data=4)),
+        build_mesh(MeshConfig(pipe=2, data=4)), num_microbatches=2)
+    odd_params, odd_stacked = stacked_state(
+        ModelConfig(**{**MODEL.__dict__, "num_layers": 3}), jax.random.PRNGKey(0))
+    odd_tokens = jax.random.randint(jax.random.PRNGKey(1), (8, MODEL.max_seq_len),
+                                    0, MODEL.vocab_size)
+    with pytest.raises(ValueError, match="divide"):
+        bad_loss(odd_stacked, odd_tokens[:, :-1], odd_tokens[:, 1:])
     # MoE blocks are not supported under pipeline parallelism —
     # rejected at construction, not at first trace
     moe = TrainConfig(

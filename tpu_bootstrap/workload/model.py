@@ -138,6 +138,25 @@ def repeat_kv(k: jax.Array, num_heads: int) -> jax.Array:
     return jnp.repeat(k, num_heads // kv_heads, axis=-2)
 
 
+def dense_attn_core(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    """Plain causal softmax attention on (batch, seq, heads, head_dim);
+    k/v may carry fewer (GQA) heads and are expanded against q's head
+    count. Shape-driven on purpose — under tensor parallelism the head
+    axis arrives pre-sharded (pipeline.py calls this on H/tp local heads
+    inside shard_map) and the local repeat factor is still H/KV."""
+    num_heads, head_dim, seq = q.shape[-2], q.shape[-1], q.shape[1]
+    dtype = q.dtype
+    k = repeat_kv(k, num_heads)
+    v = repeat_kv(v, num_heads)
+    scores = jnp.einsum("bshd,bthd->bhst", q, k) / jnp.sqrt(
+        jnp.asarray(head_dim, jnp.float32)
+    ).astype(dtype)
+    causal = jnp.tril(jnp.ones((seq, seq), jnp.bool_))
+    scores = jnp.where(causal[None, None, :, :], scores, jnp.asarray(-1e30, dtype))
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dtype)
+    return jnp.einsum("bhst,bthd->bshd", probs, v)
+
+
 def _attention(block: Params, x: jax.Array, cfg: ModelConfig, attn_fn=None) -> jax.Array:
     """Causal multi-head attention. x: (batch, seq, embed).
 
@@ -159,18 +178,7 @@ def _attention(block: Params, x: jax.Array, cfg: ModelConfig, attn_fn=None) -> j
     q = _rotary(q, positions)
     k = _rotary(k, positions)
 
-    if attn_fn is not None:
-        out = attn_fn(q, k, v)
-    else:
-        k = repeat_kv(k, cfg.num_heads)
-        v = repeat_kv(v, cfg.num_heads)
-        scores = jnp.einsum("bshd,bthd->bhst", q, k) / jnp.sqrt(
-            jnp.asarray(cfg.head_dim, jnp.float32)
-        ).astype(dtype)
-        causal = jnp.tril(jnp.ones((seq, seq), jnp.bool_))
-        scores = jnp.where(causal[None, None, :, :], scores, jnp.asarray(-1e30, dtype))
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dtype)
-        out = jnp.einsum("bhst,bthd->bshd", probs, v)
+    out = (attn_fn or dense_attn_core)(q, k, v)
     return jnp.einsum("bshd,hde->bse", out, block["wo"].astype(dtype))
 
 

@@ -152,9 +152,10 @@ def param_shardings(mesh: Mesh, params: Params):
 
     # Pipeline layout: params["blocks"] is a dict of stacked leaves with a
     # leading (num_layers,) axis instead of a list of per-block dicts —
-    # shard that axis over `pipe` so each stage holds only its layers (the
-    # spec for the remaining dims is the per-block rule; pipeline.py
-    # restricts tensor/fsdp to 1 so those axis names are inert there).
+    # shard that axis over `pipe` so each stage holds only its layers. The
+    # remaining dims keep the per-block rule: tensor/fsdp shardings are
+    # live inside the pipeline too (pipeline.py reuses these very specs as
+    # its shard_map in_specs and implements the tp psums / fsdp gathers).
     stacked = isinstance(params.get("blocks"), dict) if isinstance(params, dict) else False
 
     def walk(tree, path=""):
