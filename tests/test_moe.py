@@ -171,18 +171,34 @@ def test_expert_parallel_matches_single_device(mesh_cfg):
     np.testing.assert_allclose(sharded, single, rtol=2e-5)
 
 
-def test_moe_composes_with_ring_and_flash_attention():
-    """ep x sp x tp: the expert layer under sequence-parallel ring
-    attention with the Pallas flash core (interpret on CPU)."""
+@pytest.mark.parametrize("mesh_cfg,attention", [
+    (MeshConfig(expert=2, seq=2, tensor=2), "flash"),  # ep x sp x tp
+    (MeshConfig(data=2, expert=2, seq=2), "dense"),    # dp x ep x sp
+    (MeshConfig(data=2, expert=2, seq=2), "flash"),
+])
+def test_moe_composes_with_ring_attention(mesh_cfg, attention):
+    """expert>1 with seq>1: the MoE dispatch (GSPMD all-to-all over
+    `expert`) under sequence-parallel ring attention (shard_map over
+    `seq`) — the two shard different dims, so the composed step must
+    reproduce single-device training, not just produce a finite loss."""
     model = moe_cfg(max_seq_len=17, num_experts=2, expert_top_k=1)
-    cfg = TrainConfig(model=model, mesh=MeshConfig(expert=2, seq=2, tensor=2),
-                      attention="flash", attention_block=8, learning_rate=1e-2)
-    mesh = build_mesh(cfg.mesh)
-    params, opt_state, p_sh = init_train_state(cfg, mesh, jax.random.PRNGKey(0))
-    step = make_train_step(cfg, mesh, p_sh)
-    tokens = jax.device_put(
-        jax.random.randint(jax.random.PRNGKey(7), (4, model.max_seq_len),
-                           0, model.vocab_size),
-        batch_shardings(mesh))
-    _, _, loss = step(params, opt_state, tokens)
-    assert np.isfinite(float(loss))
+    seed_tokens = jax.random.randint(jax.random.PRNGKey(7), (8, model.max_seq_len),
+                                     0, model.vocab_size)
+
+    def two_losses(mc, attn):
+        cfg = TrainConfig(model=model, mesh=mc, learning_rate=1e-2,
+                          attention=attn, attention_block=8)
+        mesh = build_mesh(cfg.mesh)
+        params, opt_state, p_sh = init_train_state(cfg, mesh, jax.random.PRNGKey(0))
+        step = make_train_step(cfg, mesh, p_sh)
+        tokens = jax.device_put(seed_tokens, batch_shardings(mesh))
+        losses = []
+        for _ in range(2):
+            params, opt_state, loss = step(params, opt_state, tokens)
+            losses.append(float(loss))
+        return losses
+
+    single = two_losses(MeshConfig(), "dense")
+    composed = two_losses(mesh_cfg, attention)
+    np.testing.assert_allclose(composed, single,
+                               rtol=2e-3 if attention == "flash" else 2e-5)
