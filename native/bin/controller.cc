@@ -185,6 +185,35 @@ class ObjectCache {
   std::map<std::string, Json> objects_;
 };
 
+// Process-lifetime record of CRs whose RoleBinding is known absent. The
+// sheet-gate-closed prune must fire when a RoleBinding MAY exist, but a
+// never-approved CR would otherwise buy a 404ing DELETE every resync.
+// Unlike the JobSet prune there is no status record of the grant, so
+// absence is learned: the first gate-closed prune (hit or 404) marks the
+// CR, later passes skip the DELETE until a RoleBinding is applied again.
+// A fresh process re-learns with at most one DELETE per gate-closed CR.
+class KnownAbsent {
+ public:
+  bool contains(const std::string& name) const {
+    std::lock_guard<std::mutex> lock(mutex_);
+    return names_.count(name) > 0;
+  }
+
+  void insert(const std::string& name) {
+    std::lock_guard<std::mutex> lock(mutex_);
+    names_.insert(name);
+  }
+
+  void erase(const std::string& name) {
+    std::lock_guard<std::mutex> lock(mutex_);
+    names_.erase(name);
+  }
+
+ private:
+  mutable std::mutex mutex_;
+  std::set<std::string> names_;
+};
+
 // Async event sink: reconcile workers enqueue, one drainer thread posts.
 // Events are best-effort operator telemetry — two API round-trips (prior
 // lookup + apply) must not ride the reconcile critical path (the
@@ -259,7 +288,7 @@ class EventSink {
 // plus JobSet + status.slice maintenance. Returns false when the CR is
 // gone (callers must not requeue it).
 bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::string& name,
-                   EventSink& events, const ObjectCache& cache) {
+                   EventSink& events, const ObjectCache& cache, KnownAbsent& rb_absent) {
   // Whole-pass latency histogram: the in-daemon half of the BASELINE
   // metric surface, scrapeable at /metrics and read back by bench.py.
   struct PassTimer {
@@ -329,32 +358,39 @@ bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::s
   };
 
   std::vector<const Json*> wave1, wave2;
+  bool applying_rolebinding = false;
   for (const Json& child : children) {
     const std::string kind = child.get("kind").as_string();
     if (kind == "Namespace") {
       client.apply(child, kFieldManager, /*force=*/true);
       Metrics::instance().inc("applies_total");
     } else if (kind == "RoleBinding" || kind == "JobSet") {
+      if (kind == "RoleBinding") applying_rolebinding = true;
       wave2.push_back(&child);
     } else {
       wave1.push_back(&child);
     }
   }
+  // Clear the known-absent record BEFORE the applies: once a RoleBinding
+  // apply is attempted it may exist server-side even if this pass throws.
+  if (applying_rolebinding) rb_absent.erase(name);
   if (!wave1.empty()) apply_wave(wave1);
   if (!wave2.empty()) apply_wave(wave2);
 
   // Revocation teardown: the sheet gate closing (synchronizer revocation,
   // or an admin clearing the status) must take back what it granted —
   // the reference leaves RoleBindings in place forever because its sheet
-  // semantics never revoke. The RoleBinding delete fires whenever the
-  // gate is closed (a 404 for never-approved CRs is one cheap round trip
-  // per resync); the JobSet delete keys off status.slice.jobset, the
+  // semantics never revoke. The RoleBinding delete fires when one MAY
+  // exist (gated by the learned rb_absent record, so never-approved CRs
+  // cost at most one 404 per process lifetime instead of one per
+  // resync); the JobSet delete keys off status.slice.jobset, the
   // controller's own record that a slice was provisioned.
   const bool synchronized = ub.get("status").get_bool("synchronized_with_sheet", false);
   const bool has_tpu = ub.get("spec").get("tpu").is_object();
   const std::string ns = target_namespace(ub);
   bool pruned_jobset = false;
-  if (!synchronized && ub.get("spec").get("rolebinding").is_object()) {
+  if (!synchronized && ub.get("spec").get("rolebinding").is_object() &&
+      !rb_absent.contains(name)) {
     try {
       client.remove("rbac.authorization.k8s.io/v1", "RoleBinding", ns, ns);
       Metrics::instance().inc("prunes_total");
@@ -362,6 +398,7 @@ bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::s
     } catch (const KubeError& e) {
       if (e.status != 404) throw;
     }
+    rb_absent.insert(name);
   }
   const Json& cached_slice = ub.get("status").get("slice");
   const std::string cached_jobset = cached_slice.get_string("jobset");
@@ -503,6 +540,7 @@ int main() {
 
   EventSink events(client);
   ObjectCache cache;
+  KnownAbsent rb_absent;
 
   // Reconcile workers.
   std::vector<std::thread> workers;
@@ -522,7 +560,7 @@ int main() {
           continue;
         }
         try {
-          bool exists = reconcile_one(client, cfg, name, events, cache);
+          bool exists = reconcile_one(client, cfg, name, events, cache, rb_absent);
           queue.done(name);
           if (exists) queue.add(name, cfg.requeue_secs * 1000);  // controller.rs:154
         } catch (const std::exception& e) {
@@ -636,6 +674,7 @@ int main() {
           if (type == "DELETED") {
             cache.remove(name);
             queue.remove(name);  // GC handles children; stop requeueing
+            rb_absent.erase(name);  // don't grow unbounded across CR churn
             return;
           }
           cache.put(obj);

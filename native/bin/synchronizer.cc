@@ -10,9 +10,11 @@
 // TPU re-grounding: quota keys target requests.google.com/tpu; the sheet
 // source is pluggable (CONF_SHEET_PATH file or CONF_SHEET_URL endpoint —
 // the Drive CSV-export URL works here once fronted with auth); chip
-// inventory comes from CONF_POOL_CAPACITY_CHIPS or a CONF_INVENTORY_URL
-// returning {"capacity_chips": N}, and admission against capacity is
-// first-come (plan_sync in sheet_core.cc).
+// inventory comes from the cluster's nodes (CONF_INVENTORY_FROM_NODES=1:
+// sum of allocatable google.com/tpu over CONF_NODE_SELECTOR-matched
+// nodes, tracking autoscaler/repair churn), else a CONF_INVENTORY_URL
+// returning {"capacity_chips": N}, else static CONF_POOL_CAPACITY_CHIPS;
+// admission against capacity is first-come (plan_sync in sheet_core.cc).
 #include <atomic>
 #include <map>
 #include <memory>
@@ -59,19 +61,51 @@ struct SheetSource {
   }
 };
 
-int64_t fetch_capacity(const std::string& inventory_url, int64_t fallback) {
-  if (inventory_url.empty()) return fallback;
+// Chip-inventory sources, priority: kube nodes > inventory URL > the
+// static CONF_POOL_CAPACITY_CHIPS number.
+struct InventorySource {
+  bool from_nodes = false;       // CONF_INVENTORY_FROM_NODES=1
+  std::string node_selector;     // CONF_NODE_SELECTOR ("k=v,k2=v2")
+  std::string url;               // CONF_INVENTORY_URL
+  std::string device = "tpu";
+};
+
+// Always returns through the gauge so /metrics reports the capacity the
+// sync plan ACTUALLY applied this tick, whichever source produced it
+// (an operator debugging admission must not read a stale node-derived
+// number while the clamp is running on the fallback).
+int64_t applied_capacity(int64_t cap) {
+  Metrics::instance().set("pool_chips_capacity", cap);
+  return cap;
+}
+
+int64_t fetch_capacity(KubeClient& client, const InventorySource& inv, int64_t fallback) {
+  if (inv.from_nodes) {
+    // Kubernetes-native inventory: the pool IS the cluster — sum node
+    // allocatable for the accelerator resource (label-selected to the
+    // TPU pool). Capacity then tracks node churn (autoscaling, repair)
+    // with no external endpoint to stand up.
+    try {
+      Json nodes = client.list("v1", "Node", "", inv.node_selector);
+      return applied_capacity(node_pool_capacity(nodes.get("items"), inv.device));
+    } catch (const std::exception& e) {
+      log_warn("node inventory failed; using configured capacity",
+               {{"error", e.what()}, {"capacity", std::to_string(fallback)}});
+      return applied_capacity(fallback);
+    }
+  }
+  if (inv.url.empty()) return applied_capacity(fallback);
   try {
-    HttpClient client(inventory_url);
-    Url u = parse_url(inventory_url);
+    HttpClient client(inv.url);
+    Url u = parse_url(inv.url);
     HttpResponse resp = client.request("GET", u.path);
     if (!resp.ok()) throw std::runtime_error("HTTP " + std::to_string(resp.status));
-    Json inv = Json::parse(resp.body);
-    return inv.get_int("capacity_chips", fallback);
+    Json parsed = Json::parse(resp.body);
+    return applied_capacity(parsed.get_int("capacity_chips", fallback));
   } catch (const std::exception& e) {
     log_warn("inventory poll failed; using configured capacity",
              {{"error", e.what()}, {"capacity", std::to_string(fallback)}});
-    return fallback;
+    return applied_capacity(fallback);
   }
 }
 
@@ -100,7 +134,7 @@ bool write_status(KubeClient& client, const std::string& name, const std::string
 }
 
 void run_sync_once(KubeClient& client, const Json& sync_config, SheetSource& sheet,
-                   const std::string& inventory_url) {
+                   const InventorySource& inventory) {
   log_info("starting synchronization");
   std::string csv = sheet.fetch();
   log_info("downloaded csv file", {{"bytes", std::to_string(csv.size())}});
@@ -111,7 +145,7 @@ void run_sync_once(KubeClient& client, const Json& sync_config, SheetSource& she
 
   Json config = sync_config;
   config.set("pool_capacity_chips",
-             fetch_capacity(inventory_url, config.get_int("pool_capacity_chips", 0)));
+             fetch_capacity(client, inventory, config.get_int("pool_capacity_chips", 0)));
 
   Json list = client.list(kApiVersion, kKind);
   Json plan = plan_sync(list.get("items"), parsed.get("rows"), config);
@@ -209,7 +243,11 @@ int main() {
   sheet.google_file_id = env.get("google_file_id", "");
   sheet.google_api_base = env.get("google_api_base", "");
   const std::string sa_key_path = env.get("google_service_account_json_path", "");
-  const std::string inventory_url = env.get("inventory_url", "");
+  InventorySource inventory;
+  inventory.from_nodes = env.get("inventory_from_nodes", "0") == "1";
+  inventory.node_selector = env.get("node_selector", "");
+  inventory.url = env.get("inventory_url", "");
+  inventory.device = env.get("device", "tpu");
   if (!sheet.google_file_id.empty()) {
     if (sa_key_path.empty()) {
       log_error("CONF_GOOGLE_FILE_ID requires CONF_GOOGLE_SERVICE_ACCOUNT_JSON_PATH");
@@ -295,7 +333,7 @@ int main() {
     // starts after lease validity lapsed must not write.
     if (elector && !elector->is_leader()) continue;
     try {
-      run_sync_once(client, sync_config, sheet, inventory_url);
+      run_sync_once(client, sync_config, sheet, inventory);
     } catch (const std::exception& e) {
       log_error("synchronization failed", {{"error", e.what()}});
       Metrics::instance().inc("sync_errors_total");

@@ -53,9 +53,10 @@ class KubeClient {
  public:
   explicit KubeClient(KubeConfig config);
 
-  // GET collection; returns the List object.
+  // GET collection; returns the List object. label_selector (optional)
+  // filters server-side, k8s syntax ("k=v,k2=v2").
   Json list(const std::string& api_version, const std::string& kind,
-            const std::string& ns = "");
+            const std::string& ns = "", const std::string& label_selector = "");
   Json get(const std::string& api_version, const std::string& kind, const std::string& ns,
            const std::string& name);
 

@@ -51,8 +51,11 @@ Json slice_status(const Json& ub, const Json& observed_jobset);
 // daemons so `kubectl describe ub <name>` shows reconcile history. The
 // reference has no event recorder (its operators log only); a real
 // operator surfaces state transitions as Events, so the TPU build adds
-// one. Cluster-scoped CRs' events live in the "default" namespace by
-// convention (same as Node events). The name is deterministic on
+// one. Cluster-scoped CRs' events live in event_namespace() — "default"
+// by convention (same as Node events), overridable via
+// CONF_EVENT_NAMESPACE or the downward-API POD_NAMESPACE so a
+// non-default install keeps its events next to the deployment. The name
+// is deterministic on
 // (CR, reason), so re-emitting the same reason replaces one Event object
 // instead of piling up new ones; callers that want count/firstTimestamp
 // continuity across re-emissions thread the previously stored Event
@@ -62,6 +65,10 @@ Json build_event(const Json& ub, const std::string& reason,
                  const std::string& message, const std::string& type,
                  const std::string& timestamp,
                  const std::string& component = "tpu-bootstrap-controller");
+
+// Namespace the daemons post Events into: CONF_EVENT_NAMESPACE, else
+// POD_NAMESPACE (downward API), else "default".
+std::string event_namespace();
 
 // Carry recurrence history over from the previously stored Event with the
 // same name (or pass prev=null for first emission): bumps count and keeps

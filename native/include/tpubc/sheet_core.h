@@ -64,4 +64,10 @@ Json build_quota(const Json& row, const std::string& device);
 // total_chips: N}.
 Json plan_sync(const Json& ub_list, const Json& rows, const Json& config);
 
+// Kubernetes-native chip inventory: sum of status.allocatable over a node
+// list's items for the device's accelerator resource (google.com/tpu, or
+// nvidia.com/gpu for device=gpu). String and integer quantity forms both
+// count; malformed values skip their node.
+int64_t node_pool_capacity(const Json& nodes, const std::string& device);
+
 }  // namespace tpubc

@@ -172,6 +172,12 @@ char* tpubc_plan_sync(const char* ub_list, const char* rows, const char* config)
   });
 }
 
+char* tpubc_node_pool_capacity(const char* nodes, const char* device) {
+  return guarded([&] {
+    return std::to_string(tpubc::node_pool_capacity(tpubc::Json::parse(nodes), device));
+  });
+}
+
 char* tpubc_base64url_encode(const char* data) {
   return guarded([&] { return tpubc::base64url_encode(data); });
 }

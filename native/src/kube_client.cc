@@ -26,6 +26,7 @@ struct KindInfo {
 // children + the CRD + JobSet).
 const KindInfo kKinds[] = {
     {"v1", "Namespace", "namespaces", false},
+    {"v1", "Node", "nodes", false},
     {"v1", "ResourceQuota", "resourcequotas", true},
     {"v1", "Pod", "pods", true},
     {"v1", "Event", "events", true},
@@ -116,9 +117,27 @@ Json KubeClient::check(const HttpResponse& resp) {
 }
 
 Json KubeClient::list(const std::string& api_version, const std::string& kind,
-                      const std::string& ns) {
-  return check(http_->request("GET", resource_path(api_version, kind, ns, ""), "", "", {},
-                              config_.request_timeout_secs));
+                      const std::string& ns, const std::string& label_selector) {
+  std::string path = resource_path(api_version, kind, ns, "");
+  if (!label_selector.empty()) {
+    // Server-side filtering: percent-encode everything outside the RFC
+    // 3986 unreserved set — selectors may carry '=', ',', '!', spaces
+    // ("pool = tpu") and set syntax ("env in (a,b)"), and a raw space
+    // would truncate the HTTP request line at the path.
+    static const char* hex = "0123456789ABCDEF";
+    std::string enc;
+    for (unsigned char c : label_selector) {
+      if (std::isalnum(c) || c == '-' || c == '.' || c == '_' || c == '~') {
+        enc += static_cast<char>(c);
+      } else {
+        enc += '%';
+        enc += hex[c >> 4];
+        enc += hex[c & 0xF];
+      }
+    }
+    path += "?labelSelector=" + enc;
+  }
+  return check(http_->request("GET", path, "", "", {}, config_.request_timeout_secs));
 }
 
 Json KubeClient::get(const std::string& api_version, const std::string& kind,

@@ -1,5 +1,7 @@
 #include "tpubc/reconcile_core.h"
 
+#include <cstdlib>
+
 #include "tpubc/crd.h"
 #include "tpubc/topology.h"
 #include "tpubc/util.h"
@@ -342,6 +344,19 @@ Json slice_status(const Json& ub, const Json& observed_jobset) {
   return st;
 }
 
+std::string event_namespace() {
+  // Where the daemons' Events for the cluster-scoped CR live. Default
+  // "default" (the Node-events convention), overridable so a non-default
+  // install keeps operator-visible events next to the deployment:
+  // CONF_EVENT_NAMESPACE explicitly, else POD_NAMESPACE (the chart wires
+  // it from the downward API).
+  const char* v = std::getenv("CONF_EVENT_NAMESPACE");
+  if (v != nullptr && *v != '\0') return v;
+  v = std::getenv("POD_NAMESPACE");
+  if (v != nullptr && *v != '\0') return v;
+  return "default";
+}
+
 Json build_event(const Json& ub, const std::string& reason,
                  const std::string& message, const std::string& type,
                  const std::string& timestamp, const std::string& component) {
@@ -352,7 +367,7 @@ Json build_event(const Json& ub, const std::string& reason,
       // refreshed in place. Lowercased like target_namespace — CR names
       // may be mixed-case, object names must be RFC-1123.
       {"name", to_lower(cr_name) + "." + to_lower(reason)},
-      {"namespace", "default"},
+      {"namespace", event_namespace()},
   });
   // Owned by the CR so deletion cascades — only when the caller has the
   // real object (an owner reference with an empty uid is invalid).

@@ -316,4 +316,34 @@ Json plan_sync(const Json& ub_list, const Json& rows, const Json& config) {
                        {"total_chips", used_chips}});
 }
 
+int64_t node_pool_capacity(const Json& nodes, const std::string& device) {
+  // Sum of the accelerator resource across node allocatable — the
+  // Kubernetes-native chip inventory (SURVEY §0: "the synchronizer polls
+  // TPU chip inventory"; kube analogue of the reference's NVML-style GPU
+  // counts). Quantities for extended resources are integral; they arrive
+  // as strings ("4") or numbers depending on the serializer.
+  const std::string key = device == "gpu" ? "nvidia.com/gpu" : "google.com/tpu";
+  int64_t total = 0;
+  for (const Json& node : nodes.items()) {
+    const Json& alloc = node.get("status").get("allocatable");
+    const Json& v = alloc.get(key);
+    if (v.is_number()) {
+      total += v.as_int();
+    } else if (v.is_string()) {
+      const std::string& s = v.as_string();
+      try {
+        size_t pos = 0;
+        int64_t n = std::stoll(s, &pos);
+        // Whole-string check: "4Ki" would otherwise count as 4. Suffixed
+        // quantities are malformed for an extended resource — skip the
+        // node rather than guessing.
+        if (pos == s.size()) total += n;
+      } catch (const std::exception&) {
+        // Non-numeric quantity: skip the node.
+      }
+    }
+  }
+  return total;
+}
+
 }  // namespace tpubc

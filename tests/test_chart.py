@@ -90,11 +90,14 @@ def test_deployment_env_matches_daemon_config_surface():
         for d in ("controller", "admission", "synchronizer")
     ) + "".join(
         # shared-lib config surfaces the daemons link (lease config lives
-        # in leader.cc's leader_config_from_env)
+        # in leader.cc's leader_config_from_env; the event namespace in
+        # reconcile_core.cc's event_namespace)
         (repo / "native" / "src" / f"{d}.cc").read_text()
-        for d in ("kube_client", "leader")
+        for d in ("kube_client", "leader", "reconcile_core")
     )
     read_keys = set(re.findall(r'env\.(?:get|require|get_int|get_list)\("([a-z_]+)"', daemon_src))
+    # direct getenv reads in the shared lib (prefix already in the name)
+    read_keys |= {m.lower() for m in re.findall(r'getenv\("CONF_([A-Z_]+)"\)', daemon_src)}
     read_keys |= {"kube_api_url", "kube_insecure_tls", "kube_token", "kube_ca_file"}
 
     src = template_sources()["deployment.yaml"]

@@ -167,3 +167,8 @@ def test_block_k_validation():
         flash_attention(q, k, v, block_k=0)
     with pytest.raises(ValueError, match="must divide"):
         flash_attention(q, k, v, block_size=64, block_k=48)
+    # Larger-than-q-block KV tiles cannot tile the padded q axis: reject
+    # rather than silently clamping to square tiles (a user would believe
+    # they benchmarked a tiling they never ran).
+    with pytest.raises(ValueError, match="must not exceed"):
+        flash_attention(q, k, v, block_size=64, block_k=128)

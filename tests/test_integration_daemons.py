@@ -239,6 +239,57 @@ def test_controller_sheet_gate_holds_back_rolebinding_and_jobset(fake):
         assert code == 0, err
 
 
+def test_rolebinding_prune_gated_after_absence_learned(fake):
+    """A never-approved CR with spec.rolebinding must not buy a 404ing
+    RoleBinding DELETE on every resync: the first gate-closed pass learns
+    absence and later passes skip the DELETE. Reopening the gate (apply)
+    re-arms the prune so revocation still tears the grant down."""
+    fake.create_ub("carol", spec=full_spec())  # no status => gate closed
+    port = free_port()
+    d = Daemon("tpubc-controller",
+               controller_env(fake, port, conf_requeue_secs=1), port).wait_healthy()
+    try:
+        wait_for(lambda: fake.get(KEY_NS, "carol"), desc="namespace")
+        time.sleep(3.5)  # several 1s resyncs on the closed gate
+        rb_deletes = [p for m, p in fake.store.request_log
+                      if m == "DELETE" and "rolebindings" in p]
+        assert len(rb_deletes) <= 1, f"prune not gated: {rb_deletes}"
+
+        # Gate opens -> RoleBinding applied -> the prune is re-armed, so
+        # closing the gate again deletes the real grant exactly once more.
+        ub = fake.get(fake.KEY_UB, "carol")
+        ub["status"] = dict(SYNCED)
+        fake.store.upsert(fake.KEY_UB, "carol", ub, preserve_status=False)
+        wait_for(lambda: fake.get(KEY_RB("carol"), "carol"), desc="rolebinding")
+        ub = fake.get(fake.KEY_UB, "carol")
+        ub["status"] = {"synchronized_with_sheet": False}
+        fake.store.upsert(fake.KEY_UB, "carol", ub, preserve_status=False)
+        wait_for(lambda: fake.get(KEY_RB("carol"), "carol") is None,
+                 desc="rolebinding pruned after revocation")
+    finally:
+        code, err = d.stop()
+        assert code == 0, err
+
+
+def test_controller_events_follow_configured_namespace(fake):
+    """CONF_EVENT_NAMESPACE moves the daemons' Events out of "default" —
+    a non-default install sees slice history next to its deployment."""
+    fake.create_ub("dave", spec=full_spec(), status=dict(SYNCED))
+    port = free_port()
+    d = Daemon("tpubc-controller",
+               controller_env(fake, port, conf_event_namespace="tpu-system"),
+               port).wait_healthy()
+    try:
+        wait_for(lambda: fake.get(KEY_JS("dave"), "dave-slice"), desc="jobset")
+        wait_for(lambda: fake.store.objects.get(("api/v1", "tpu-system", "events")),
+                 desc="events in tpu-system")
+        with fake.store.lock:
+            assert not fake.store.objects.get(("api/v1", "default", "events"))
+    finally:
+        code, err = d.stop()
+        assert code == 0, err
+
+
 def test_controller_event_driven_latency(fake):
     """A CR created while the controller runs must materialize fast (watch
     path, not the 30s resync — the perf story of this build)."""
@@ -574,6 +625,73 @@ def test_synchronizer_pool_capacity(fake, tmp_path):
         ), "bob exceeds pool capacity and must not be authorized"
         m = d.metrics()
         assert m["pool_chips_allocated"] == 16
+    finally:
+        code, err = d.stop()
+        assert code == 0, err
+
+
+def test_synchronizer_inventory_from_nodes(fake, tmp_path):
+    """CONF_INVENTORY_FROM_NODES=1: pool capacity = sum of allocatable
+    google.com/tpu over label-selected nodes, so the capacity clamp
+    follows node churn — adding a pool node admits the request that was
+    over capacity the tick before."""
+    sheet = tmp_path / "sheet.csv"
+    sheet.write_text(
+        CSV_HEADER
+        + "a,CSE,alice,tpu-serv,16,8,32,100,o\n"
+        + "b,CSE,bob,tpu-serv,16,8,32,100,o\n"
+    )
+    fake.create_ub("alice", spec={})
+    fake.create_ub("bob", spec={})
+
+    def node(name, chips, pool="tpu"):
+        return {
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": name, "labels": {"pool": pool}},
+            "status": {"allocatable": {"google.com/tpu": str(chips)}},
+        }
+
+    key_nodes = ("api/v1", "", "nodes")
+    fake.store.upsert(key_nodes, "n0", node("n0", 16))
+    # A non-pool node's chips must NOT count (label selector).
+    fake.store.upsert(key_nodes, "gpu0", node("gpu0", 16, pool="gpu"))
+
+    port = free_port()
+    d = Daemon(
+        "tpubc-synchronizer",
+        {
+            "CONF_KUBE_API_URL": fake.url,
+            "CONF_LISTEN_ADDR": "127.0.0.1",
+            "CONF_LISTEN_PORT": str(port),
+            "CONF_SHEET_PATH": str(sheet),
+            "CONF_SYNC_INTERVAL_SECS": "1",
+            "CONF_SERVER_NAME": "tpu-serv",
+            "CONF_INVENTORY_FROM_NODES": "1",
+            "CONF_NODE_SELECTOR": "pool=tpu",
+            # static number would allow both: nodes must override it
+            "CONF_POOL_CAPACITY_CHIPS": "64",
+        },
+        port,
+    ).wait_healthy()
+    try:
+        wait_for(
+            lambda: fake.get(fake.KEY_UB, "alice").get("status", {}).get("synchronized_with_sheet"),
+            desc="alice within node capacity",
+        )
+        time.sleep(1.5)
+        assert not fake.get(fake.KEY_UB, "bob").get("status", {}).get(
+            "synchronized_with_sheet"
+        ), "bob exceeds the 16-chip node inventory and must wait"
+        assert d.metrics()["pool_chips_capacity"] == 16
+
+        # Node churn: the pool scales up -> next tick's capacity follows
+        # -> bob is admitted.
+        fake.store.upsert(key_nodes, "n1", node("n1", 16))
+        wait_for(
+            lambda: fake.get(fake.KEY_UB, "bob").get("status", {}).get("synchronized_with_sheet"),
+            desc="bob admitted after node scale-up",
+        )
+        assert d.metrics()["pool_chips_capacity"] == 32
     finally:
         code, err = d.stop()
         assert code == 0, err

@@ -317,6 +317,23 @@ def test_slice_event_on_phase_transition(lib):
     assert ev["metadata"]["ownerReferences"][0]["uid"] == "u-1"
 
 
+def test_event_namespace_configurable(lib, monkeypatch):
+    """Events default to the "default" namespace (Node-events convention)
+    but follow CONF_EVENT_NAMESPACE, else the downward-API POD_NAMESPACE,
+    so a non-default install keeps its events next to the deployment."""
+    cr = ub(spec={"tpu": tpu_spec(chips=4, hosts=1)})
+    new = {"phase": "Provisioning", "jobset": "alice-slice", "chips": 4, "hosts": 1}
+
+    monkeypatch.setenv("POD_NAMESPACE", "tpu-system")
+    ev = lib.slice_event(cr, "Pending", new, "2026-07-30T00:00:00Z")
+    assert ev["metadata"]["namespace"] == "tpu-system"
+
+    # Explicit CONF_EVENT_NAMESPACE beats the downward-API value.
+    monkeypatch.setenv("CONF_EVENT_NAMESPACE", "ops")
+    ev = lib.slice_event(cr, "Pending", new, "2026-07-30T00:00:00Z")
+    assert ev["metadata"]["namespace"] == "ops"
+
+
 def test_slice_event_failed_is_warning(lib):
     cr = ub(spec={"tpu": tpu_spec(chips=4, hosts=1)})
     new = {"phase": "Failed", "jobset": "alice-slice", "chips": 4, "hosts": 1}

@@ -296,3 +296,28 @@ def test_plan_sync_revocation_guards_and_status_preservation(lib):
     rows = lib.parse_sheet(sheet(row()))["rows"]
     [a] = lib.plan_sync([synced], rows, cfg(lib))["actions"]
     assert a["status"] == {"synchronized_with_sheet": True, "slice": slice_block}
+
+
+def test_node_pool_capacity(lib):
+    """Kubernetes-native inventory: capacity = sum of node allocatable for
+    the device's accelerator resource; string and integer quantity forms
+    both count, malformed values skip their node, other resources are
+    ignored."""
+    nodes = [
+        {"metadata": {"name": "n0"},
+         "status": {"allocatable": {"google.com/tpu": "4", "cpu": "96"}}},
+        {"metadata": {"name": "n1"},
+         "status": {"allocatable": {"google.com/tpu": 8}}},
+        {"metadata": {"name": "n2"},  # no TPUs on this node
+         "status": {"allocatable": {"cpu": "8"}}},
+        {"metadata": {"name": "n3"},  # malformed quantity: skipped
+         "status": {"allocatable": {"google.com/tpu": "lots"}}},
+        {"metadata": {"name": "n4"},  # suffixed quantity: also skipped,
+         # NOT counted as 4 (stoll would otherwise stop at the suffix)
+         "status": {"allocatable": {"google.com/tpu": "4Ki"}}},
+    ]
+    assert lib.node_pool_capacity(nodes) == 12
+    assert lib.node_pool_capacity(nodes, device="gpu") == 0
+    gpu_nodes = [{"status": {"allocatable": {"nvidia.com/gpu": "2"}}}]
+    assert lib.node_pool_capacity(gpu_nodes, device="gpu") == 2
+    assert lib.node_pool_capacity([]) == 0

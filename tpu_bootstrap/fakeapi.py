@@ -442,10 +442,35 @@ class FakeKubeHandler(BaseHTTPRequestHandler):
                          if self._key_matches(key, coll_key)
                          for o in coll.values()]
             rv = str(self.store.rv)
+        selector = query.get("labelSelector", [""])[0]
+        if selector:
+            items = [o for o in items if self._labels_match(o, selector)]
         self.send_json(
             200,
             {"kind": "List", "apiVersion": "v1", "metadata": {"resourceVersion": rv}, "items": items},
         )
+
+    @staticmethod
+    def _labels_match(obj, selector):
+        """Equality-based label selector semantics (k=v, k!=v, bare k
+        existence; comma = AND) — the subset the apiserver guarantees and
+        the synchronizer's node-inventory path uses."""
+        labels = (obj.get("metadata") or {}).get("labels") or {}
+        for term in selector.split(","):
+            term = term.strip()
+            if not term:
+                continue
+            if "!=" in term:
+                k, v = term.split("!=", 1)
+                if labels.get(k) == v:
+                    return False
+            elif "=" in term:
+                k, v = term.split("==", 1) if "==" in term else term.split("=", 1)
+                if labels.get(k) != v:
+                    return False
+            elif term not in labels:
+                return False
+        return True
 
     @staticmethod
     def _key_matches(requested, stored):

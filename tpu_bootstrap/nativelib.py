@@ -156,6 +156,9 @@ class NativeLib:
     def build_quota(self, row: Any, device: str = "tpu") -> dict:
         return self._call_json("tpubc_build_quota", row, device)
 
+    def node_pool_capacity(self, nodes: Any, device: str = "tpu") -> int:
+        return int(self._call("tpubc_node_pool_capacity", json.dumps(nodes), device))
+
     def plan_sync(self, ub_list: Any, rows: Any, config: Any | None = None) -> dict:
         return self._call_json(
             "tpubc_plan_sync", ub_list, rows, config or self.default_synchronizer_config()

@@ -473,9 +473,15 @@ def _flash_folded(q, k, v, causal, sm_scale, block_size, block_k, interpret):
     else:
         # Explicit block_k is honored or rejected — silently coercing it
         # would make a user believe they benchmarked a tiling they never
-        # ran. The auto-shrink of bq (short sequences) can break
-        # divisibility for configs that were valid at full length, so the
-        # error names both values.
+        # ran. KV tiles larger than the q block are an invalid request
+        # (they cannot tile the padded q axis), so reject; the only clamp
+        # is the short-seq auto-shrink of bq, where the tiling the user
+        # asked for does not exist at this length. The auto-shrink can
+        # also break divisibility for configs that were valid at full
+        # length, so the error names both values.
+        if block_k > block_size:
+            raise ValueError(
+                f"block_k ({block_k}) must not exceed block_size ({block_size})")
         bk = min(block_k, bq)
         if bq % bk != 0:
             raise ValueError(
