@@ -38,7 +38,7 @@ REPO = Path(__file__).resolve().parent
 sys.path.insert(0, str(REPO))
 
 from tpu_bootstrap import nativelib  # noqa: E402
-from tpu_bootstrap.fakeapi import FakeKube  # noqa: E402
+from tpu_bootstrap.fakeapi import FAKEAPI_VERSION, FakeKube  # noqa: E402
 
 N_BURST = 200
 K_LATENCY = 40
@@ -443,8 +443,37 @@ def _last_json_line(text: str):
 # single-tenant and can be held elsewhere for hours (round 1 lost its
 # whole TPU half to this). When the live bench can't claim the chip, the
 # cached numbers ride along under cached_* keys with their measurement
-# time — clearly labeled, never mixed with live keys.
+# time AND git commit — clearly labeled, never mixed with live keys, and
+# flagged stale when the cache predates the current tree (round 2 shipped
+# "measured on this build" numbers that actually predated four commits).
 WORKLOAD_CACHE = REPO / ".workload_last_good.json"
+
+
+def _git_fingerprint() -> str:
+    """Current commit (short); uncommitted changes append a digest of the
+    tracked-file diff, so two different dirty states of the same HEAD do
+    NOT collide (a bare -dirty suffix would mark a cache measured on one
+    uncommitted kernel as fresh for a different uncommitted kernel).
+    'unknown' outside a git tree."""
+    import hashlib
+
+    try:
+        head = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                              capture_output=True, text=True, cwd=REPO,
+                              timeout=10).stdout.strip()
+        if not head:
+            return "unknown"
+        # PROGRESS.jsonl is driver telemetry appended continuously — not a
+        # build input; including it would flip the fingerprint (and flag
+        # caches stale) with zero source change.
+        diff = subprocess.run(["git", "diff", "HEAD", "--", ".", ":!PROGRESS.jsonl"],
+                              capture_output=True, text=True, cwd=REPO,
+                              timeout=10).stdout
+        if diff:
+            head += "-dirty-" + hashlib.sha256(diff.encode()).hexdigest()[:8]
+        return head
+    except Exception:  # noqa: BLE001
+        return "unknown"
 
 
 def _cache_workload(parsed: dict) -> None:
@@ -452,6 +481,7 @@ def _cache_workload(parsed: dict) -> None:
         try:
             WORKLOAD_CACHE.write_text(json.dumps(
                 {"measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                 "commit": _git_fingerprint(),
                  "results": parsed}))
         except OSError:
             pass
@@ -462,9 +492,17 @@ def _attach_cached_workload(err_result: dict) -> dict:
         cache = json.loads(WORKLOAD_CACHE.read_text())
     except (OSError, json.JSONDecodeError):
         return err_result
+    commit = cache.get("commit", "unknown")
+    head = _git_fingerprint()
     err_result["workload_cached_note"] = (
-        "chip unavailable at bench time; cached_* keys were measured on "
-        "this build at " + cache.get("measured_at", "?"))
+        "chip unavailable at bench time; cached_* keys were measured at "
+        f"commit {commit} ({cache.get('measured_at', '?')})")
+    if commit != head:
+        # The honest label: these numbers are from a DIFFERENT build.
+        err_result["workload_cache_stale"] = True
+        err_result["workload_cached_note"] += (
+            f" — STALE: current tree is {head}; kernels changed since the "
+            "cache was measured may be unproven on the chip")
     for k, v in cache.get("results", {}).items():
         err_result[f"cached_{k}"] = v
     return err_result
@@ -659,13 +697,17 @@ def main():
         # the reference's serial one-reconcile-at-a-time architecture.
         "vs_baseline_definition": "8-worker vs same controller at 1 worker "
                                   "(reference architecture stand-in)",
-        # Absolute rates are bound by the in-process Python API server,
-        # which now implements real SSA (managedFields/conflicts), serves
-        # 5 child-kind watch streams, and absorbs Event posts — richer
-        # (and costlier) per CR than earlier rounds' fake. Compare rates
-        # only within one round; the architecture ratios are the signal.
+        # Absolute rates are bound by the in-process Python API server.
+        # fakeapi_version pins its cost profile: rates are comparable
+        # across rounds ONLY at equal versions (v2 = real SSA with
+        # managedFields/conflicts + 5 child-kind watch streams + Event
+        # absorption; v1 was the thin pre-SSA fake, ~2x faster per CR).
+        # The architecture ratios (vs_baseline, rtt2ms_vs_serial) are
+        # version-independent signal.
         "server_bound_note": "rates bound by the in-process fake API "
                              "server (real SSA + child watches + events)",
+        "fakeapi_version": FAKEAPI_VERSION,
+        "bench_commit": _git_fingerprint(),
         "p50_apply_to_slice_ms": round(parallel_p50, 2),
         "daemon_reconcile_p50_ms": round(daemon_p50, 2),
         "burst_n": N_BURST,

@@ -1,5 +1,7 @@
 #include "tpubc/kube_client.h"
 
+#include <cctype>
+
 #include <cstdlib>
 
 #include "tpubc/crd.h"

@@ -14,9 +14,28 @@ def test_cache_round_trip(tmp_path, monkeypatch):
     out = bench._attach_cached_workload({"workload_bench_error": "tunnel down"})
     assert out["workload_bench_error"] == "tunnel down"
     assert out["cached_train_mfu_pct"] == 50.0
-    assert "measured on this build at" in out["workload_cached_note"]
+    # The cache was written at the current fingerprint, so it is NOT stale
+    # and the note names the commit it was measured at.
+    assert "measured at commit" in out["workload_cached_note"]
+    assert "workload_cache_stale" not in out
     # live keys never collide with cached ones
     assert "train_mfu_pct" not in out
+
+
+def test_cache_from_other_commit_is_flagged_stale(tmp_path, monkeypatch):
+    """A cache written at a different commit must not be relabeled as
+    'this build' — round 2 shipped cached numbers that silently predated
+    four kernel commits; the fingerprint makes that visible."""
+    monkeypatch.setattr(bench, "WORKLOAD_CACHE", tmp_path / "cache.json")
+    bench._cache_workload({"chip_alive": True, "train_mfu_pct": 50.0})
+    cache = json.loads((tmp_path / "cache.json").read_text())
+    assert cache["commit"] == bench._git_fingerprint()
+    cache["commit"] = "0000000"
+    (tmp_path / "cache.json").write_text(json.dumps(cache))
+    out = bench._attach_cached_workload({"workload_bench_error": "tunnel down"})
+    assert out["workload_cache_stale"] is True
+    assert "STALE" in out["workload_cached_note"]
+    assert "0000000" in out["workload_cached_note"]
 
 
 def test_cache_skips_failed_runs(tmp_path, monkeypatch):

@@ -23,6 +23,13 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
+# Cost-profile version of this fake, recorded in BENCH output so
+# round-over-round reconciles/s numbers are only compared like-for-like.
+# Bump whenever per-request work changes materially (round 1 -> 2 added
+# real SSA managedFields, child-kind watch fan-out, and Event absorption,
+# which cut the headline burst rate ~2x and made r01/r02 incomparable).
+FAKEAPI_VERSION = 2
+
 
 def apply_json_patch(doc, patch):
     """Minimal RFC 6902 (add/replace/remove only — what the daemons emit)."""
@@ -453,20 +460,26 @@ class FakeKubeHandler(BaseHTTPRequestHandler):
     @staticmethod
     def _labels_match(obj, selector):
         """Equality-based label selector semantics (k=v, k!=v, bare k
-        existence; comma = AND) — the subset the apiserver guarantees and
-        the synchronizer's node-inventory path uses."""
+        existence; comma = AND; whitespace around operators tolerated,
+        as on the real apiserver) — the subset the synchronizer's
+        node-inventory path uses. Set-based syntax (in/notin) is NOT
+        implemented and rejects loudly rather than silently filtering
+        everything out."""
         labels = (obj.get("metadata") or {}).get("labels") or {}
         for term in selector.split(","):
             term = term.strip()
             if not term:
                 continue
+            if " in " in term or " notin " in term or term.endswith((" in", " notin")):
+                raise ValueError(
+                    f"set-based label selector not implemented by the fake: {term!r}")
             if "!=" in term:
                 k, v = term.split("!=", 1)
-                if labels.get(k) == v:
+                if labels.get(k.strip()) == v.strip():
                     return False
             elif "=" in term:
                 k, v = term.split("==", 1) if "==" in term else term.split("=", 1)
-                if labels.get(k) != v:
+                if labels.get(k.strip()) != v.strip():
                     return False
             elif term not in labels:
                 return False
