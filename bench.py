@@ -463,12 +463,14 @@ def _git_fingerprint() -> str:
                               timeout=10).stdout.strip()
         if not head:
             return "unknown"
-        # PROGRESS.jsonl is driver telemetry appended continuously — not a
-        # build input; including it would flip the fingerprint (and flag
-        # caches stale) with zero source change.
-        diff = subprocess.run(["git", "diff", "HEAD", "--", ".", ":!PROGRESS.jsonl"],
-                              capture_output=True, text=True, cwd=REPO,
-                              timeout=10).stdout
+        # PROGRESS.jsonl is driver telemetry appended continuously, and
+        # .workload_last_good.json is the cache THIS fingerprint guards
+        # (writing it would otherwise dirty the tree and self-invalidate
+        # the cache just written) — neither is a build input.
+        diff = subprocess.run(
+            ["git", "diff", "HEAD", "--", ".",
+             ":!PROGRESS.jsonl", ":!.workload_last_good.json"],
+            capture_output=True, text=True, cwd=REPO, timeout=10).stdout
         if diff:
             head += "-dirty-" + hashlib.sha256(diff.encode()).hexdigest()[:8]
         return head

@@ -1,0 +1,73 @@
+#!/usr/bin/env bash
+# Stand up a kind cluster and run the real-apiserver e2e suite
+# (tests/test_e2e_real_apiserver.py) against it — BASELINE config #1:
+# "kind cluster (CPU-only reconcile, fake extended resource)".
+#
+# Prereqs on the host: kind, kubectl, a built native tree
+# (ninja -C native/build), python with the test deps. CI wires these in
+# .github/workflows/e2e-kind.yml; locally:  ./hack/e2e-kind.sh
+#
+# The daemons run on the HOST against the kind apiserver (token auth via
+# a ServiceAccount), mirroring how the fake-API suite runs them — the
+# delta under test is the API server, not the deployment topology. The
+# in-cluster deployment path (images, chart, webhook registration) is
+# covered by the chart tests and the image build.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+CLUSTER=${TPUBC_E2E_CLUSTER:-tpubc-e2e}
+JOBSET_VERSION=${JOBSET_VERSION:-v0.8.0}
+KEEP=${TPUBC_E2E_KEEP:-0}
+
+cleanup() {
+  if [ "$KEEP" != "1" ]; then
+    kind delete cluster --name "$CLUSTER" >/dev/null 2>&1 || true
+  fi
+}
+trap cleanup EXIT
+
+if ! kind get clusters 2>/dev/null | grep -qx "$CLUSTER"; then
+  kind create cluster --name "$CLUSTER" --wait 120s
+fi
+kubectl config use-context "kind-$CLUSTER" >/dev/null
+
+# 1. Our CRD, straight from the generator (drift against the chart copy
+#    is CI-checked separately).
+./native/build/tpubc-crdgen | kubectl apply -f -
+
+# 2. The JobSet CRD (just the API type; no JobSet controller needed —
+#    the e2e asserts on emitted objects, mirroring SURVEY §4).
+kubectl apply --server-side -f \
+  "https://github.com/kubernetes-sigs/jobset/releases/download/${JOBSET_VERSION}/manifests.yaml"
+
+# 3. Fake TPU extended resource on the control-plane node (the standard
+#    no-hardware trick: extended resources are opaque counters to the
+#    scheduler). 8 chips total; the node-inventory test relies on it.
+NODE=$(kubectl get nodes -o jsonpath='{.items[0].metadata.name}')
+kubectl label node "$NODE" pool=tpu --overwrite
+# Extended resources must be patched through the status subresource.
+kubectl patch node "$NODE" --subresource=status --type=json -p '[
+  {"op": "add", "path": "/status/capacity/google.com~1tpu", "value": "8"}
+]'
+
+# 4. ServiceAccount + token for the host-run daemons. cluster-admin is
+#    fine for a throwaway test cluster; production RBAC is the chart's.
+kubectl create serviceaccount tpubc-e2e --dry-run=client -o yaml | kubectl apply -f -
+kubectl create clusterrolebinding tpubc-e2e --clusterrole=cluster-admin \
+  --serviceaccount=default:tpubc-e2e --dry-run=client -o yaml | kubectl apply -f -
+
+# Declaration split from assignment: `export V=$(cmd)` would mask a
+# kubectl failure from set -e, leaving V empty — and the pytest module
+# skips (exits green) when TPUBC_E2E_API_URL is unset.
+TPUBC_E2E_API_URL=$(kubectl config view --minify -o jsonpath='{.clusters[0].cluster.server}')
+TPUBC_E2E_TOKEN=$(kubectl create token tpubc-e2e --duration=2h)
+export TPUBC_E2E_API_URL TPUBC_E2E_TOKEN
+CA_FILE=$(mktemp)
+kubectl config view --minify --raw -o jsonpath='{.clusters[0].cluster.certificate-authority-data}' \
+  | base64 -d > "$CA_FILE"
+export TPUBC_E2E_CA_FILE="$CA_FILE"
+
+# Wait until the CRD is served before the suite creates CRs.
+kubectl wait --for=condition=Established crd/userbootstraps.tpu.bacchus.io --timeout=60s
+
+python -m pytest tests/test_e2e_real_apiserver.py -v "$@"

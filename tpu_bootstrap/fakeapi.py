@@ -451,7 +451,12 @@ class FakeKubeHandler(BaseHTTPRequestHandler):
             rv = str(self.store.rv)
         selector = query.get("labelSelector", [""])[0]
         if selector:
-            items = [o for o in items if self._labels_match(o, selector)]
+            try:
+                items = [o for o in items if self._labels_match(o, selector)]
+            except ValueError as e:
+                # Loud HTTP 400, not a dropped connection: the C++ client
+                # would retry a reset as transient and mask the bad config.
+                return self.send_status_error(400, str(e), "BadRequest")
         self.send_json(
             200,
             {"kind": "List", "apiVersion": "v1", "metadata": {"resourceVersion": rv}, "items": items},
@@ -470,7 +475,11 @@ class FakeKubeHandler(BaseHTTPRequestHandler):
             term = term.strip()
             if not term:
                 continue
-            if " in " in term or " notin " in term or term.endswith((" in", " notin")):
+            # '(' catches the no-space forms ("env in(prod)") the real
+            # apiserver's lexer accepts; without it they would fall
+            # through to the bare-key check and silently match nothing.
+            if (" in " in term or " notin " in term
+                    or term.endswith((" in", " notin")) or "(" in term):
                 raise ValueError(
                     f"set-based label selector not implemented by the fake: {term!r}")
             if "!=" in term:
