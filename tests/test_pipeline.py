@@ -222,6 +222,97 @@ def test_composed_pipelined_train_step_matches_single_device(mesh_cfg, attention
     np.testing.assert_allclose(got, want, rtol=2e-4 if attention == "flash" else 2e-5)
 
 
+@pytest.mark.parametrize("pipe,data,microbatches", [(2, 4, 4), (4, 2, 8)])
+def test_1f1b_loss_and_grads_match_sequential(pipe, data, microbatches):
+    """The manual 1F1B schedule (fwd/bwd interleaved in one scan,
+    vjp-recompute, in-schedule loss head) must reproduce the sequential
+    model's loss AND every gradient — embed and final_norm included,
+    since their grads come from the manual head/lookup backward — with
+    exact tick accounting (2M active turns per stage)."""
+    from tpu_bootstrap.workload.pipeline import make_pipeline_1f1b_grad
+
+    mesh = build_mesh(MeshConfig(pipe=pipe, data=data))
+    cfg = TrainConfig(model=MODEL, mesh=MeshConfig(pipe=pipe, data=data))
+    params, stacked = stacked_state(MODEL, jax.random.PRNGKey(0))
+    batch = microbatches * data
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, MODEL.max_seq_len),
+                                0, MODEL.vocab_size)
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+
+    want_loss, g_seq = jax.value_and_grad(lambda p: loss_fn(p, tokens, MODEL))(params)
+    grad_fn = make_pipeline_1f1b_grad(cfg, mesh, num_microbatches=microbatches)
+    loss, grads, stats = jax.jit(grad_fn)(stacked, inputs, targets)
+
+    assert float(loss) == pytest.approx(float(want_loss), rel=1e-5)
+    # Tick accounting: every stage takes exactly M forward and M backward
+    # turns; the rest of T*P device-ticks is the measured bubble.
+    assert float(stats["active_ticks"]) == 2 * microbatches * pipe
+    expected_bubble = (pipe - 1) / (microbatches + pipe - 1)
+    measured_bubble = 1 - float(stats["active_ticks"]) / stats["total_ticks"]
+    assert measured_bubble == pytest.approx(expected_bubble)
+
+    g_seq_stacked = stack_block_params(g_seq["blocks"])
+    for name in ("wq", "wk", "wv", "wo", "w_up", "w_down", "attn_norm", "mlp_norm"):
+        np.testing.assert_allclose(np.asarray(grads["blocks"][name]),
+                                   np.asarray(g_seq_stacked[name]),
+                                   rtol=1e-4, atol=1e-6, err_msg=name)
+    np.testing.assert_allclose(np.asarray(grads["embed"]), np.asarray(g_seq["embed"]),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(grads["final_norm"]),
+                               np.asarray(g_seq["final_norm"]), rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.parametrize("attention", ["dense", "flash"])
+def test_1f1b_train_step_matches_gpipe_and_single_device(attention):
+    """Full train steps under pipeline_schedule='1f1b' must track both
+    the GPipe schedule and single-device training step-for-step — the
+    two schedules are different executions of the same math."""
+    mesh_cfg = MeshConfig(pipe=2, data=4)
+
+    def run(schedule_or_single, stacked_batch):
+        if schedule_or_single == "single":
+            c = TrainConfig(model=MODEL, mesh=MeshConfig(), learning_rate=1e-2)
+        else:
+            c = TrainConfig(model=MODEL, mesh=mesh_cfg, learning_rate=1e-2,
+                            num_microbatches=4, attention=attention,
+                            attention_block=8,
+                            pipeline_schedule=schedule_or_single)
+        mesh = build_mesh(c.mesh)
+        params, opt_state, p_sh = init_train_state(c, mesh, jax.random.PRNGKey(0))
+        step = make_train_step(c, mesh, p_sh)
+        tokens = jax.device_put(stacked_batch, batch_shardings(mesh))
+        losses = []
+        for _ in range(2):
+            params, opt_state, loss = step(params, opt_state, tokens)
+            losses.append(float(loss))
+        return losses
+
+    tokens = jax.random.randint(jax.random.PRNGKey(7), (16, MODEL.max_seq_len),
+                                0, MODEL.vocab_size)
+    got = run("1f1b", tokens)
+    np.testing.assert_allclose(got, run("gpipe", tokens), rtol=2e-5)
+    np.testing.assert_allclose(got, run("single", tokens),
+                               rtol=2e-4 if attention == "flash" else 2e-5)
+
+
+def test_1f1b_rejects_non_data_axes():
+    """1F1B is data-parallel-only (the Megatron/ZeRO collectives are not
+    inlined into its cond branches); tensor/fsdp meshes must be told to
+    use the GPipe schedule, loudly."""
+    from tpu_bootstrap.workload.pipeline import make_pipeline_1f1b_grad
+
+    cfg = TrainConfig(model=MODEL, mesh=MeshConfig(pipe=2, data=2, tensor=2))
+    with pytest.raises(ValueError, match="gpipe"):
+        make_pipeline_1f1b_grad(cfg, build_mesh(cfg.mesh), num_microbatches=2)
+    # ... and make_train_step rejects unknown schedule names.
+    bad = TrainConfig(model=MODEL, mesh=MeshConfig(pipe=2, data=4),
+                      pipeline_schedule="zigzag")
+    mesh = build_mesh(bad.mesh)
+    params, opt_state, p_sh = init_train_state(bad, mesh, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="pipeline_schedule"):
+        make_train_step(bad, mesh, p_sh)
+
+
 def test_pipelined_checkpoint_resume_matches(tmp_path):
     """Resume of a pipelined run: the abstract restore state must use the
     same stacked-blocks layout the checkpoint was saved with."""

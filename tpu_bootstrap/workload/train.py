@@ -50,6 +50,12 @@ class TrainConfig:
     # Microbatches per step when mesh.pipe > 1 (0 = 2x the stage count,
     # halving the pipeline bubble vs M == stages).
     num_microbatches: int = 0
+    # Pipeline schedule: "gpipe" (AD-generated backward; composes with
+    # tensor/fsdp) or "1f1b" (manual PipeDream-flush schedule with
+    # activation recompute — O(P) instead of O(M+P) stashed microbatch
+    # activations per stage; data-parallel meshes only). See
+    # workload/pipeline.py.
+    pipeline_schedule: str = "gpipe"
 
 
 def make_optimizer(cfg: TrainConfig):
@@ -115,12 +121,25 @@ def make_train_step(cfg: TrainConfig, mesh, p_shardings):
     opt = make_optimizer(cfg)
     seq_parallel = mesh.shape["seq"] > 1
     pipelined = mesh.shape["pipe"] > 1
+    pipeline_grad = None
     if pipelined:
-        from tpu_bootstrap.workload.pipeline import make_pipeline_loss
-
         microbatches = cfg.num_microbatches or 2 * mesh.shape["pipe"]
-        loss = make_pipeline_loss(cfg, mesh, num_microbatches=microbatches,
-                                  remat=cfg.remat)
+        if cfg.pipeline_schedule == "1f1b":
+            from tpu_bootstrap.workload.pipeline import make_pipeline_1f1b_grad
+
+            # Manual-gradient schedule: replaces value_and_grad entirely.
+            pipeline_grad = make_pipeline_1f1b_grad(
+                cfg, mesh, num_microbatches=microbatches, remat=cfg.remat)
+            loss = None
+        elif cfg.pipeline_schedule == "gpipe":
+            from tpu_bootstrap.workload.pipeline import make_pipeline_loss
+
+            loss = make_pipeline_loss(cfg, mesh, num_microbatches=microbatches,
+                                      remat=cfg.remat)
+        else:
+            raise ValueError(
+                f"unknown pipeline_schedule {cfg.pipeline_schedule!r} "
+                "(expected 'gpipe' or '1f1b')")
         attn = None
     elif seq_parallel:
         # Sequence (context) parallelism: activations are sharded along
@@ -202,7 +221,10 @@ def make_train_step(cfg: TrainConfig, mesh, p_shardings):
         if shifted_sharding is not None:
             inputs = jax.lax.with_sharding_constraint(inputs, shifted_sharding)
             targets = jax.lax.with_sharding_constraint(targets, shifted_sharding)
-        loss_value, grads = jax.value_and_grad(loss)(params, inputs, targets)
+        if pipeline_grad is not None:
+            loss_value, grads, _stats = pipeline_grad(params, inputs, targets)
+        else:
+            loss_value, grads = jax.value_and_grad(loss)(params, inputs, targets)
         updates, opt_state = opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss_value
