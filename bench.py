@@ -333,24 +333,25 @@ try:
     dbatch, d1, d2 = 8, 64, 192
     dprompt = jax.random.randint(jax.random.PRNGKey(1), (dbatch, 64), 0, dcfg.vocab_size)
 
-    def timed_gen(params, steps, cfg=dcfg):
+    def timed_gen(params, steps, cfg=dcfg, kv_quant=False):
         # int(...) readback is the sync: block_until_ready can return
         # before device completion on the tunneled backend. Callers warm
         # each (params, cfg, steps) once before sampling.
         t0 = time.time()
-        int(generate(params, dprompt, cfg, steps)[0, -1])
+        int(generate(params, dprompt, cfg, steps, kv_quant=kv_quant)[0, -1])
         return time.time() - t0
 
-    def decode_step_s(params, cfg=dcfg):
+    def decode_step_s(params, cfg=dcfg, kv_quant=False):
         # Two-point measurement: the d2-d1 step difference cancels the
         # prefill (and any fixed dispatch overhead), giving pure
         # per-decode-step cost. Median of 3 pairs: a single pair is noisy
         # through the tunnel (a delayed readback skews the subtraction in
         # either direction, so min would report optimistic outliers).
-        timed_gen(params, d1, cfg), timed_gen(params, d2, cfg)  # compile+warm
+        timed_gen(params, d1, cfg, kv_quant), timed_gen(params, d2, cfg, kv_quant)
         samples = []
         for _ in range(3):
-            t1, t2 = timed_gen(params, d1, cfg), timed_gen(params, d2, cfg)
+            t1 = timed_gen(params, d1, cfg, kv_quant)
+            t2 = timed_gen(params, d2, cfg, kv_quant)
             samples.append(max((t2 - t1) / (d2 - d1), 1e-9))
         return sorted(samples)[len(samples) // 2]
 
@@ -370,6 +371,16 @@ try:
     out.update({
         "decode_int8_tokens_per_sec": round(dbatch / qstep_s, 1),
         "decode_int8_speedup": round(step_s / qstep_s, 3),
+    })
+    emit()
+
+    # int8 KV cache ON TOP of int8 weights: after weight quantization the
+    # remaining per-step HBM read is the cache; int8 KV halves it (the
+    # decode.init_cache quantized layout).
+    kvstep_s = decode_step_s(qparams, kv_quant=True)
+    out.update({
+        "decode_int8kv_tokens_per_sec": round(dbatch / kvstep_s, 1),
+        "decode_int8kv_speedup": round(step_s / kvstep_s, 3),
     })
     emit()
 

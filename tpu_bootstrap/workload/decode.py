@@ -10,7 +10,10 @@ TPU-first design:
   so the per-step attention is a plain masked einsum — at query length
   1 there is no score matrix to avoid, and XLA fuses the mask/softmax
   into the two small matmuls. The flash kernel stays a training-path
-  tool.
+  tool. The bandwidth levers stack: GQA shrinks the cache by the
+  query/KV group factor, int8 weight-only quantization halves the
+  weight stream, and the int8 KV cache (init_cache quantized=True /
+  generate kv_quant=True) halves the cache stream.
 * Sharding falls out of the same rules as training: batch over the data
   axes, heads over `tensor`, cache sharded like activations — run
   `generate` under `jit` with sharded params and GSPMD partitions the
@@ -62,15 +65,43 @@ def _linear(x: jax.Array, w, contract_rank: int, dtype) -> jax.Array:
     return y.reshape(*x.shape[: x.ndim - contract_rank], *w.shape[contract_rank:])
 
 
-def init_cache(cfg: ModelConfig, batch: int, max_len: int):
-    """One (k, v) buffer pair per block, model layout, compute dtype.
-    Sized at kv_heads: under GQA the cache — the thing decode streams
-    from HBM every step — shrinks by the query/KV group factor."""
+def init_cache(cfg: ModelConfig, batch: int, max_len: int, quantized: bool = False):
+    """One (k, v) buffer pair per block, model layout. Sized at kv_heads:
+    under GQA the cache — the thing decode streams from HBM every step —
+    shrinks by the query/KV group factor.
+
+    quantized=True stores int8 values with per-(position, kv-head)
+    scales: decode streams 1 byte/element instead of 2 (bf16), the other
+    half of the decode-bandwidth budget after weight-only quantization.
+    The cache's own structure ("k_scale" present) routes every consumer,
+    so prefill/decode_step need no flag."""
     shape = (batch, max_len, cfg.kv_heads, cfg.head_dim)
+    if quantized:
+        sshape = shape[:-1]
+        return [
+            {"k": jnp.zeros(shape, jnp.int8), "k_scale": jnp.zeros(sshape, jnp.float32),
+             "v": jnp.zeros(shape, jnp.int8), "v_scale": jnp.zeros(sshape, jnp.float32)}
+            for _ in range(cfg.num_layers)
+        ]
     return [
         {"k": jnp.zeros(shape, cfg.compute_dtype), "v": jnp.zeros(shape, cfg.compute_dtype)}
         for _ in range(cfg.num_layers)
     ]
+
+
+def _quantize_kv(x: jax.Array):
+    """(B, S, Hk, D) -> int8 values + per-(B, S, Hk) scales. Symmetric
+    max-abs scaling over the head_dim axis — one scale per cached vector,
+    so dequant is a fused broadcast-multiply on the way into the
+    attention einsum and the HBM read stays 1 byte/element."""
+    scale = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1) / 127.0
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.round(x.astype(jnp.float32) / scale[..., None])
+    return q.astype(jnp.int8), scale
+
+
+def _dequantize_kv(q: jax.Array, scale: jax.Array, dtype):
+    return q.astype(dtype) * scale[..., None].astype(dtype)
 
 
 def _project_kv(block: Params, h: jax.Array, positions: jax.Array, cfg: ModelConfig):
@@ -122,11 +153,26 @@ def _block_step(block: Params, x: jax.Array, cache: dict, positions: jax.Array,
         q = _rotary(q, positions)
         k, v = _project_kv(block, h, positions, cfg)
     start = positions[0]
-    cache = {
-        "k": lax.dynamic_update_slice(cache["k"], k, (0, start, 0, 0)),
-        "v": lax.dynamic_update_slice(cache["v"], v, (0, start, 0, 0)),
-    }
-    out = _attend(q, cache["k"], cache["v"], valid, cfg)
+    if "k_scale" in cache:
+        kq, ks = _quantize_kv(k)
+        vq, vs = _quantize_kv(v)
+        cache = {
+            "k": lax.dynamic_update_slice(cache["k"], kq, (0, start, 0, 0)),
+            "k_scale": lax.dynamic_update_slice(cache["k_scale"], ks, (0, start, 0)),
+            "v": lax.dynamic_update_slice(cache["v"], vq, (0, start, 0, 0)),
+            "v_scale": lax.dynamic_update_slice(cache["v_scale"], vs, (0, start, 0)),
+        }
+        # Dequant fuses into the attention einsums' operand reads; the
+        # materialized-in-HBM tensors stay int8.
+        cache_k = _dequantize_kv(cache["k"], cache["k_scale"], dtype)
+        cache_v = _dequantize_kv(cache["v"], cache["v_scale"], dtype)
+    else:
+        cache = {
+            "k": lax.dynamic_update_slice(cache["k"], k, (0, start, 0, 0)),
+            "v": lax.dynamic_update_slice(cache["v"], v, (0, start, 0, 0)),
+        }
+        cache_k, cache_v = cache["k"], cache["v"]
+    out = _attend(q, cache_k, cache_v, valid, cfg)
     x = x + _linear(out, block["wo"], 2, dtype)
     if cfg.num_experts > 0:
         h2 = _rms_norm(x, block["mlp_norm"])
@@ -203,24 +249,26 @@ def _filter_logits(logits: jax.Array, top_k: int, top_p: float) -> jax.Array:
     return logits
 
 
-@partial(jax.jit, static_argnames=("cfg", "steps", "temperature", "top_k", "top_p"))
+@partial(jax.jit, static_argnames=("cfg", "steps", "temperature", "top_k", "top_p",
+                                   "kv_quant"))
 def generate(params: Params, prompt: jax.Array, cfg: ModelConfig, steps: int,
              temperature: float = 0.0, key: jax.Array | None = None,
-             top_k: int = 0, top_p: float = 1.0):
+             top_k: int = 0, top_p: float = 1.0, kv_quant: bool = False):
     """Greedy (temperature == 0) or sampled generation, with optional
     top-k and/or nucleus (top-p) filtering of the sampled distribution.
 
     prompt: (B, S) int32; returns (B, steps) int32 continuations. The
     cache is sized S + steps; the whole thing — prefill plus a
     `lax.scan` of decode steps — is one jit (one compile per
-    (shape, steps) pair).
+    (shape, steps) pair). kv_quant=True decodes from an int8 KV cache
+    (see init_cache) — half the cache bandwidth per step.
     """
     if steps < 1:
         raise ValueError(f"steps must be >= 1, got {steps}")
     if not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     b, s = prompt.shape
-    caches = init_cache(cfg, b, s + steps)
+    caches = init_cache(cfg, b, s + steps, quantized=kv_quant)
     logits, caches = prefill(params, prompt, caches, cfg)
     if key is None:
         key = jax.random.PRNGKey(0)
