@@ -295,13 +295,53 @@ def test_1f1b_train_step_matches_gpipe_and_single_device(attention):
                                rtol=2e-4 if attention == "flash" else 2e-5)
 
 
-def test_1f1b_rejects_non_data_axes():
-    """1F1B is data-parallel-only (the Megatron/ZeRO collectives are not
-    inlined into its cond branches); tensor/fsdp meshes must be told to
-    use the GPipe schedule, loudly."""
+@pytest.mark.parametrize("num_kv_heads", [None, 1])
+def test_1f1b_with_tensor_parallelism_matches_sequential(num_kv_heads):
+    """1F1B composed with tensor parallelism: the Megatron regions inside
+    the stage body use the f/g custom_vjp pair (in-body AD of a raw psum
+    under check_vma=False transposes WRONG — measured), and
+    tensor-replicated leaves' partial grads are explicitly psummed.
+    num_kv_heads=1 exercises the GQA expand-then-slice fallback under the
+    manual backward. Every gradient must match the sequential model."""
     from tpu_bootstrap.workload.pipeline import make_pipeline_1f1b_grad
 
-    cfg = TrainConfig(model=MODEL, mesh=MeshConfig(pipe=2, data=2, tensor=2))
+    import dataclasses
+
+    model = (MODEL if num_kv_heads is None
+             else dataclasses.replace(MODEL, num_kv_heads=num_kv_heads))
+    mesh_cfg = MeshConfig(pipe=2, data=2, tensor=2)
+    mesh = build_mesh(mesh_cfg)
+    cfg = TrainConfig(model=model, mesh=mesh_cfg)
+    params, stacked = stacked_state(model, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, model.max_seq_len),
+                                0, model.vocab_size)
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+
+    want_loss, g_seq = jax.value_and_grad(lambda p: loss_fn(p, tokens, model))(params)
+    grad_fn = make_pipeline_1f1b_grad(cfg, mesh, num_microbatches=4)
+    loss, grads, _ = jax.jit(grad_fn)(stacked, inputs, targets)
+    assert float(loss) == pytest.approx(float(want_loss), rel=1e-5)
+
+    g_seq_stacked = stack_block_params(g_seq["blocks"])
+    # norms are the tensor-REPLICATED leaves (partial-grad psum path);
+    # wq/wo/w_up/w_down the tensor-sharded ones; wk/wv flip between the
+    # two depending on the GQA fallback.
+    for name in ("wq", "wk", "wv", "wo", "w_up", "w_down", "attn_norm", "mlp_norm"):
+        np.testing.assert_allclose(np.asarray(grads["blocks"][name]),
+                                   np.asarray(g_seq_stacked[name]),
+                                   rtol=1e-4, atol=1e-6, err_msg=name)
+    np.testing.assert_allclose(np.asarray(grads["embed"]), np.asarray(g_seq["embed"]),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(grads["final_norm"]),
+                               np.asarray(g_seq["final_norm"]), rtol=1e-4, atol=1e-6)
+
+
+def test_1f1b_rejects_fsdp_and_unknown_schedules():
+    """1F1B composes with data and tensor axes; fsdp meshes must be told
+    to use the GPipe schedule, loudly."""
+    from tpu_bootstrap.workload.pipeline import make_pipeline_1f1b_grad
+
+    cfg = TrainConfig(model=MODEL, mesh=MeshConfig(pipe=2, data=2, fsdp=2))
     with pytest.raises(ValueError, match="gpipe"):
         make_pipeline_1f1b_grad(cfg, build_mesh(cfg.mesh), num_microbatches=2)
     # ... and make_train_step rejects unknown schedule names.

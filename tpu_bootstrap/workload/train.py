@@ -53,8 +53,8 @@ class TrainConfig:
     # Pipeline schedule: "gpipe" (AD-generated backward; composes with
     # tensor/fsdp) or "1f1b" (manual PipeDream-flush schedule with
     # activation recompute — O(P) instead of O(M+P) stashed microbatch
-    # activations per stage; data-parallel meshes only). See
-    # workload/pipeline.py.
+    # activations per stage; composes with data and tensor axes, not
+    # fsdp). See workload/pipeline.py.
     pipeline_schedule: str = "gpipe"
 
 
