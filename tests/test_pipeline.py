@@ -336,15 +336,49 @@ def test_1f1b_with_tensor_parallelism_matches_sequential(num_kv_heads):
                                np.asarray(g_seq["final_norm"]), rtol=1e-4, atol=1e-6)
 
 
-def test_1f1b_rejects_fsdp_and_unknown_schedules():
-    """1F1B composes with data and tensor axes; fsdp meshes must be told
-    to use the GPipe schedule, loudly."""
+@pytest.mark.parametrize("mesh_cfg", [
+    MeshConfig(pipe=2, data=2, fsdp=2),     # ZeRO-3 gathers in-stage
+    MeshConfig(pipe=2, fsdp=2, tensor=2),   # both memory axes, manual bwd
+])
+def test_1f1b_with_fsdp_matches_sequential(mesh_cfg):
+    """1F1B composed with fsdp: just-in-time gathers through the ZeRO-3
+    custom_vjp pair (all_gather fwd, reduce-scatter bwd) inside the
+    manual backward; fsdp-sharded leaf grads come back shard-local and
+    are scaled to the global mean. Every gradient must match the
+    sequential model."""
     from tpu_bootstrap.workload.pipeline import make_pipeline_1f1b_grad
 
-    cfg = TrainConfig(model=MODEL, mesh=MeshConfig(pipe=2, data=2, fsdp=2))
-    with pytest.raises(ValueError, match="gpipe"):
+    mesh = build_mesh(mesh_cfg)
+    cfg = TrainConfig(model=MODEL, mesh=mesh_cfg)
+    params, stacked = stacked_state(MODEL, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (16, MODEL.max_seq_len),
+                                0, MODEL.vocab_size)
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+
+    want_loss, g_seq = jax.value_and_grad(lambda p: loss_fn(p, tokens, MODEL))(params)
+    grad_fn = make_pipeline_1f1b_grad(cfg, mesh, num_microbatches=4)
+    loss, grads, _ = jax.jit(grad_fn)(stacked, inputs, targets)
+    assert float(loss) == pytest.approx(float(want_loss), rel=1e-5)
+
+    g_seq_stacked = stack_block_params(g_seq["blocks"])
+    for name in ("wq", "wk", "wv", "wo", "w_up", "w_down", "attn_norm", "mlp_norm"):
+        np.testing.assert_allclose(np.asarray(grads["blocks"][name]),
+                                   np.asarray(g_seq_stacked[name]),
+                                   rtol=1e-4, atol=1e-6, err_msg=name)
+    np.testing.assert_allclose(np.asarray(grads["embed"]), np.asarray(g_seq["embed"]),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(grads["final_norm"]),
+                               np.asarray(g_seq["final_norm"]), rtol=1e-4, atol=1e-6)
+
+
+def test_1f1b_rejects_seq_and_unknown_schedules():
+    """1F1B covers dcn/data/fsdp/tensor; seq (ring attention's own
+    shard_map) is rejected loudly, as are unknown schedule names."""
+    from tpu_bootstrap.workload.pipeline import make_pipeline_1f1b_grad
+
+    cfg = TrainConfig(model=MODEL, mesh=MeshConfig(pipe=2, data=2, seq=2))
+    with pytest.raises(ValueError, match="seq"):
         make_pipeline_1f1b_grad(cfg, build_mesh(cfg.mesh), num_microbatches=2)
-    # ... and make_train_step rejects unknown schedule names.
     bad = TrainConfig(model=MODEL, mesh=MeshConfig(pipe=2, data=4),
                       pipeline_schedule="zigzag")
     mesh = build_mesh(bad.mesh)

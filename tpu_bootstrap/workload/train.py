@@ -50,11 +50,11 @@ class TrainConfig:
     # Microbatches per step when mesh.pipe > 1 (0 = 2x the stage count,
     # halving the pipeline bubble vs M == stages).
     num_microbatches: int = 0
-    # Pipeline schedule: "gpipe" (AD-generated backward; composes with
-    # tensor/fsdp) or "1f1b" (manual PipeDream-flush schedule with
-    # activation recompute — O(P) instead of O(M+P) stashed microbatch
-    # activations per stage; composes with data and tensor axes, not
-    # fsdp). See workload/pipeline.py.
+    # Pipeline schedule: "gpipe" (AD-generated backward) or "1f1b"
+    # (manual PipeDream-flush schedule with activation recompute — O(P)
+    # instead of O(M+P) stashed microbatch activations per stage). Both
+    # compose with the dcn/data/fsdp/tensor axes. See
+    # workload/pipeline.py.
     pipeline_schedule: str = "gpipe"
 
 
