@@ -129,6 +129,12 @@ def test_sharded_decode_matches_single_device(params):
     want = generate(params, prompt, CFG, 5)
     got = generate(sharded, prompt, CFG, 5)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # The int8 KV cache shards like the fp one (GSPMD partitions the
+    # quantize/dequantize elementwise with the cache layout): sharded
+    # int8-cache decode must reproduce the single-device int8 tokens.
+    want_q = generate(params, prompt, CFG, 5, kv_quant=True)
+    got_q = generate(sharded, prompt, CFG, 5, kv_quant=True)
+    np.testing.assert_array_equal(np.asarray(got_q), np.asarray(want_q))
 
 
 def test_int8_kv_cache_matches_fp_cache(params):

@@ -339,6 +339,7 @@ def test_1f1b_with_tensor_parallelism_matches_sequential(num_kv_heads):
 @pytest.mark.parametrize("mesh_cfg", [
     MeshConfig(pipe=2, data=2, fsdp=2),     # ZeRO-3 gathers in-stage
     MeshConfig(pipe=2, fsdp=2, tensor=2),   # both memory axes, manual bwd
+    MeshConfig(dcn=2, pipe=2, fsdp=2),      # multislice: dcn over DCN
 ])
 def test_1f1b_with_fsdp_matches_sequential(mesh_cfg):
     """1F1B composed with fsdp: just-in-time gathers through the ZeRO-3
