@@ -12,6 +12,22 @@ Json base_response(const Json& request, bool allowed) {
   return Json::object({{"uid", request.get_string("uid")}, {"allowed", allowed}});
 }
 
+// Kubernetes EnvVar name rule (C_IDENTIFIER relaxed with '-' and '.'):
+// nonempty, [-._a-zA-Z] first, [-._a-zA-Z0-9] after.
+bool valid_env_name(const std::string& name) {
+  if (name.empty()) return false;
+  auto ok = [](char c, bool first) {
+    if (c == '-' || c == '_' || c == '.') return true;
+    if (c >= 'a' && c <= 'z') return true;
+    if (c >= 'A' && c <= 'Z') return true;
+    return !first && c >= '0' && c <= '9';
+  };
+  for (size_t i = 0; i < name.size(); ++i) {
+    if (!ok(name[i], i == 0)) return false;
+  }
+  return true;
+}
+
 // Policy denial (admission.rs `resp.deny(e)` analogue): 403 with message.
 Json deny(const Json& request, const std::string& message) {
   Json r = base_response(request, false);
@@ -240,6 +256,29 @@ Json mutate(const Json& request, const Json& config) {
                                std::to_string(geom.chips * slices) +
                                " chips, exceeding the per-user limit of " +
                                std::to_string(max_chips));
+    }
+
+    // Worker env passthrough (spec.tpu.env): free-form WORKLOAD_* knobs,
+    // with two synchronous checks the CRD schema cannot express —
+    // (a) names must be valid Kubernetes EnvVar identifiers, or the
+    // JobSet would be rejected on every reconcile (a silent 3s
+    // error-requeue loop instead of this loud deny); (b) the TPUBC_* /
+    // MEGASCALE_* names and JOB_COMPLETION_INDEX are the multi-host
+    // bootstrap contract (controller-injected / platform-injected) — a
+    // user overriding them breaks rendezvous for the whole gang.
+    const Json& user_env = tpu.get("env");
+    if (user_env.is_object()) {
+      for (const auto& kv : user_env.members()) {
+        if (!valid_env_name(kv.first)) {
+          return deny(request, "spec.tpu.env name \"" + kv.first +
+                                   "\" is not a valid environment variable name");
+        }
+        if (kv.first.rfind("TPUBC_", 0) == 0 || kv.first.rfind("MEGASCALE_", 0) == 0 ||
+            kv.first == "JOB_COMPLETION_INDEX") {
+          return deny(request, "spec.tpu.env name \"" + kv.first +
+                                   "\" is reserved for the slice bootstrap contract");
+        }
+      }
     }
 
     // JSON Patch "add" on an object member upserts, so these also correct

@@ -214,6 +214,16 @@ Json tpu_schema() {
                                           {"nullable", true},
                                           {"format", "int64"},
                                           {"type", "integer"}})},
+           {"env", Json::object({{"description",
+                                  "Extra environment for slice workers — the workload "
+                                  "config surface (WORKLOAD_MESH, WORKLOAD_SCHEDULE, "
+                                  "WORKLOAD_STEPS, ...). Names starting with TPUBC_ are "
+                                  "reserved for the bootstrap contract and rejected by "
+                                  "admission."},
+                                 {"nullable", true},
+                                 {"type", "object"},
+                                 {"additionalProperties",
+                                  Json::object({{"type", "string"}})}})},
        })},
   });
 }

@@ -100,6 +100,24 @@ Json build_jobset(const Json& ub, const Json& config) {
                                        "metadata.labels['jobset.sigs.k8s.io/job-index']"}})}})},
     }));
   }
+  // User workload config (spec.tpu.env): how a CR selects the workload's
+  // mesh/schedule/steps (WORKLOAD_* in tpu_bootstrap/workload/train.py)
+  // without overriding the whole command. Json objects preserve insertion
+  // order, which here is the stored-object key order — stable per CR, so
+  // repeated SSA of the same spec is a server-side no-op. Admission
+  // rejects reserved TPUBC_* names; skip them here too (defense in depth
+  // for CRs written before the webhook was installed).
+  const Json& user_env = tpu.get("env");
+  if (user_env.is_object()) {
+    for (const auto& kv : user_env.members()) {
+      if (kv.first.rfind("TPUBC_", 0) == 0 || kv.first.rfind("MEGASCALE_", 0) == 0 ||
+          kv.first == "JOB_COMPLETION_INDEX") {
+        continue;
+      }
+      env.push_back(Json::object({{"name", kv.first},
+                                  {"value", kv.second.as_string()}}));
+    }
+  }
 
   Json container = Json::object({
       {"name", "tpu-worker"},

@@ -310,6 +310,32 @@ def test_multislice_invalid_count_denied(lib):
     assert "slices" in resp["status"]["message"]
 
 
+def test_workload_env_reserved_names_denied(lib):
+    """spec.tpu.env is the workload config surface (WORKLOAD_*), but the
+    TPUBC_* names and JOB_COMPLETION_INDEX are the bootstrap contract the
+    controller injects — overriding them would break rendezvous for the
+    whole gang, so admission rejects them by name."""
+    cfg = lib.default_admission_config()
+    ok = {"tpu": {"accelerator": "tpu-v5-lite-podslice", "topology": "2x2",
+                  "env": {"WORKLOAD_MESH": "data=4", "WORKLOAD_SCHEDULE": "1f1b"}}}
+    assert lib.mutate(req(spec=ok), cfg)["allowed"] is True
+    for bad_name in ("TPUBC_COORDINATOR_ADDRESS", "TPUBC_ANYTHING",
+                     "JOB_COMPLETION_INDEX", "MEGASCALE_COORDINATOR_ADDRESS"):
+        bad = {"tpu": {"accelerator": "tpu-v5-lite-podslice", "topology": "2x2",
+                       "env": {bad_name: "x"}}}
+        resp = lib.mutate(req(spec=bad), cfg)
+        assert resp["allowed"] is False
+        assert bad_name in resp["status"]["message"]
+    # ... and names a real apiserver would reject on the JobSet must fail
+    # HERE (synchronously), not as a reconcile error-requeue loop.
+    for invalid in ("", "9LEADING_DIGIT", "HAS SPACE", "HAS=EQ"):
+        bad = {"tpu": {"accelerator": "tpu-v5-lite-podslice", "topology": "2x2",
+                       "env": {invalid: "x"}}}
+        resp = lib.mutate(req(spec=bad), cfg)
+        assert resp["allowed"] is False, invalid
+        assert "environment variable" in resp["status"]["message"]
+
+
 # -- GPU device parity (BASELINE config #1) ---------------------------------
 
 

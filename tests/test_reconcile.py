@@ -157,6 +157,25 @@ def test_jobset_default_command_is_train_entry(lib):
     assert c["command"] == ["python", "-m", "tpu_bootstrap.workload.train"]
 
 
+def test_jobset_user_env_passthrough(lib):
+    """spec.tpu.env lands on the worker container — the CR-level knob for
+    the workload's mesh/schedule (WORKLOAD_* in workload/train.py) — while
+    reserved bootstrap names are dropped even if a pre-webhook CR carries
+    them (admission already denies new ones)."""
+    js = lib.build_jobset(ub(spec={"tpu": tpu_spec(env={
+        "WORKLOAD_MESH": "pipe=2,data=2",
+        "WORKLOAD_SCHEDULE": "1f1b",
+        "TPUBC_NUM_HOSTS": "999",          # reserved: must be dropped
+        "JOB_COMPLETION_INDEX": "7",       # reserved: must be dropped
+    })}))
+    c = js["spec"]["replicatedJobs"][0]["template"]["spec"]["template"]["spec"]["containers"][0]
+    env = {e["name"]: e.get("value") for e in c["env"]}
+    assert env["WORKLOAD_MESH"] == "pipe=2,data=2"
+    assert env["WORKLOAD_SCHEDULE"] == "1f1b"
+    assert env["TPUBC_NUM_HOSTS"] == "1"  # the controller's own value wins
+    assert "JOB_COMPLETION_INDEX" not in env
+
+
 def test_jobset_multislice(lib):
     """spec.tpu.slices=4: one replicated-job replica per slice (each
     pinned to its own ICI pool by exclusive-topology), multislice env for

@@ -136,3 +136,27 @@ def test_train_loop_profile_capture(tmp_path):
     assert len(losses) == 3
     traces = list(prof.rglob("*.trace.json.gz")) + list(prof.rglob("*.xplane.pb"))
     assert traces, f"no trace files under {prof}"
+
+
+def test_parse_mesh_env():
+    """WORKLOAD_MESH — the CR-to-workload topology knob (spec.tpu.env ->
+    JobSet env -> worker_main): axis=extent terms, unnamed axes default
+    to 1, must multiply out to the slice's device count, bad input fails
+    loudly at startup."""
+    import pytest
+
+    from tpu_bootstrap.workload.train import parse_mesh_env
+
+    cfg = parse_mesh_env("pipe=2,data=4", 8)
+    assert (cfg.pipe, cfg.data, cfg.tensor) == (2, 4, 1)
+    assert parse_mesh_env(" seq = 2 , data = 2 ", 4).seq == 2  # whitespace ok
+    # empty -> the for_device_count default
+    assert parse_mesh_env("", 8) == MeshConfig.for_device_count(8)
+    with pytest.raises(ValueError, match="devices"):
+        parse_mesh_env("data=2", 8)  # size 2 != 8 devices
+    with pytest.raises(ValueError, match="unknown"):
+        parse_mesh_env("rows=8", 8)
+    with pytest.raises(ValueError, match="axis=extent"):
+        parse_mesh_env("data", 8)
+    with pytest.raises(ValueError, match=">= 1"):
+        parse_mesh_env("pipe=-2,data=-4", 8)  # sign-cancel must not pass

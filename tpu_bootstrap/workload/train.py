@@ -431,15 +431,55 @@ def bootstrap_from_env(environ=None) -> dict | None:
     }
 
 
+def parse_mesh_env(value: str, n_devices: int) -> MeshConfig:
+    """WORKLOAD_MESH: "pipe=2,data=4" (unnamed axes default to 1) or the
+    empty string for the for_device_count default. The CR's spec.tpu.env
+    carries this through the JobSet (reconcile_core build_jobset), so the
+    operator-facing resource selects the workload topology — validated
+    here so a bad value fails the worker loudly at startup, not as an
+    obscure mesh-shape error mid-init."""
+    if not value.strip():
+        return MeshConfig.for_device_count(n_devices)
+    fields = {}
+    valid = {f.name for f in dataclasses.fields(MeshConfig)}
+    for term in value.split(","):
+        term = term.strip()
+        if not term:
+            continue
+        if "=" not in term:
+            raise ValueError(f"WORKLOAD_MESH term {term!r} is not axis=extent")
+        k, v = term.split("=", 1)
+        k = k.strip()
+        if k not in valid:
+            raise ValueError(
+                f"WORKLOAD_MESH axis {k!r} unknown (valid: {sorted(valid)})")
+        extent = int(v)
+        if extent < 1:
+            # A negative pair can sign-cancel through the size check and
+            # die deep inside mesh reshape instead of here.
+            raise ValueError(f"WORKLOAD_MESH axis {k} extent must be >= 1, got {extent}")
+        fields[k] = extent
+    cfg = MeshConfig(**fields)
+    if cfg.size != n_devices:
+        raise ValueError(
+            f"WORKLOAD_MESH {value!r} needs {cfg.size} devices; this run "
+            f"has {n_devices} (the product over ALL slices — multislice "
+            f"meshes must include the dcn axis)")
+    return cfg
+
+
 def worker_main() -> None:
     """JobSet worker entry: ``python -m tpu_bootstrap.workload.train``.
 
     Each host on the slice runs this under the JobSet's indexed completion;
     jax.distributed rendezvous comes from the env the JobSet injects (see
     bootstrap_from_env), falling back to GKE megascale auto-discovery. The
-    mesh then spans every chip on the slice. Config via env:
-    WORKLOAD_STEPS, WORKLOAD_SAVE_EVERY, WORKLOAD_CHECKPOINT_DIR (shared
-    storage — resume-on-restart), WORKLOAD_SEED.
+    mesh then spans every chip on the slice. Config via env (settable per
+    CR through spec.tpu.env): WORKLOAD_STEPS, WORKLOAD_SAVE_EVERY,
+    WORKLOAD_CHECKPOINT_DIR (shared storage — resume-on-restart),
+    WORKLOAD_SEED, WORKLOAD_MESH ("pipe=2,data=4" — the slice's
+    parallelism layout), WORKLOAD_ATTENTION (dense|flash),
+    WORKLOAD_SCHEDULE (gpipe|1f1b), WORKLOAD_MICROBATCHES.
     """
     import os
 
@@ -472,11 +512,14 @@ def worker_main() -> None:
     # (TrainConfig's documented total_steps == 0 mode).
     total_env = os.environ.get("WORKLOAD_TOTAL_STEPS")
     cfg = TrainConfig(
-        mesh=MeshConfig.for_device_count(len(jax.devices())),
+        mesh=parse_mesh_env(os.environ.get("WORKLOAD_MESH", ""), len(jax.devices())),
         data=data,
         warmup_steps=int(os.environ.get("WORKLOAD_WARMUP_STEPS", "0")),
         total_steps=steps if total_env is None else int(total_env),
         grad_clip_norm=float(os.environ.get("WORKLOAD_GRAD_CLIP", "1.0")),
+        attention=os.environ.get("WORKLOAD_ATTENTION", "dense"),
+        pipeline_schedule=os.environ.get("WORKLOAD_SCHEDULE", "gpipe"),
+        num_microbatches=int(os.environ.get("WORKLOAD_MICROBATCHES", "0")),
     )
     losses = train_loop(cfg, steps, checkpoint_dir=ckpt_dir,
                         save_every=save_every, seed=seed,
