@@ -27,6 +27,11 @@ std::string sha256_hex(const std::string& data);
 
 std::vector<std::string> split(const std::string& s, char sep);
 std::string to_lower(const std::string& s);
+
+// True for env names reserved by the slice bootstrap contract
+// (TPUBC_*, MEGASCALE_*, JOB_COMPLETION_INDEX) — admission denies them
+// in spec.tpu.env, the JobSet builder drops them defensively.
+bool reserved_worker_env_name(const std::string& name);
 std::string trim(const std::string& s);
 bool starts_with(const std::string& s, const std::string& prefix);
 bool contains(const std::string& s, const std::string& needle);

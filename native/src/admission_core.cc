@@ -273,8 +273,7 @@ Json mutate(const Json& request, const Json& config) {
           return deny(request, "spec.tpu.env name \"" + kv.first +
                                    "\" is not a valid environment variable name");
         }
-        if (kv.first.rfind("TPUBC_", 0) == 0 || kv.first.rfind("MEGASCALE_", 0) == 0 ||
-            kv.first == "JOB_COMPLETION_INDEX") {
+        if (reserved_worker_env_name(kv.first)) {
           return deny(request, "spec.tpu.env name \"" + kv.first +
                                    "\" is reserved for the slice bootstrap contract");
         }

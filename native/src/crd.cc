@@ -217,8 +217,9 @@ Json tpu_schema() {
            {"env", Json::object({{"description",
                                   "Extra environment for slice workers — the workload "
                                   "config surface (WORKLOAD_MESH, WORKLOAD_SCHEDULE, "
-                                  "WORKLOAD_STEPS, ...). Names starting with TPUBC_ are "
-                                  "reserved for the bootstrap contract and rejected by "
+                                  "WORKLOAD_STEPS, ...). Names starting with TPUBC_ or "
+                                  "MEGASCALE_, and JOB_COMPLETION_INDEX, are reserved "
+                                  "for the slice bootstrap contract and rejected by "
                                   "admission."},
                                  {"nullable", true},
                                  {"type", "object"},

@@ -110,10 +110,11 @@ Json build_jobset(const Json& ub, const Json& config) {
   const Json& user_env = tpu.get("env");
   if (user_env.is_object()) {
     for (const auto& kv : user_env.members()) {
-      if (kv.first.rfind("TPUBC_", 0) == 0 || kv.first.rfind("MEGASCALE_", 0) == 0 ||
-          kv.first == "JOB_COMPLETION_INDEX") {
-        continue;
-      }
+      // Non-string values can only arrive through pre-schema skew (the
+      // CRD types this map string->string); skip rather than throw —
+      // throwing here would wedge the CR in a reconcile error-requeue
+      // loop, the failure mode the admission check exists to prevent.
+      if (reserved_worker_env_name(kv.first) || !kv.second.is_string()) continue;
       env.push_back(Json::object({{"name", kv.first},
                                   {"value", kv.second.as_string()}}));
     }

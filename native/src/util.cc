@@ -168,6 +168,15 @@ std::string to_lower(const std::string& s) {
   return out;
 }
 
+bool reserved_worker_env_name(const std::string& name) {
+  // The slice bootstrap contract: controller-injected (TPUBC_*),
+  // platform-injected (MEGASCALE_*), and the Indexed-Job index. One
+  // definition shared by admission (deny) and the JobSet builder (drop,
+  // defense in depth for pre-webhook CRs) so the two cannot drift.
+  return name.rfind("TPUBC_", 0) == 0 || name.rfind("MEGASCALE_", 0) == 0 ||
+         name == "JOB_COMPLETION_INDEX";
+}
+
 std::string trim(const std::string& s) {
   size_t b = 0, e = s.size();
   while (b < e && std::isspace(static_cast<unsigned char>(s[b]))) ++b;

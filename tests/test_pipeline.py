@@ -446,3 +446,31 @@ def test_pipeline_rejects_bad_configs():
         mesh=MeshConfig(pipe=2, data=4), num_microbatches=2)
     with pytest.raises(ValueError, match="MoE"):
         make_pipeline_loss(moe, build_mesh(moe.mesh), num_microbatches=2)
+
+
+def test_1f1b_uses_less_activation_memory_than_gpipe():
+    """The point of 1F1B: O(P) instead of O(M+P) stashed microbatch
+    activations per stage. Proven by the compiler's own accounting —
+    XLA's memory analysis of the compiled train step shows the 1f1b
+    schedule's temp allocation far below GPipe's at a microbatch count
+    well beyond the stage count (measured ~11x at M=16, P=2; asserted
+    conservatively at 3x to stay robust across XLA versions)."""
+    from tpu_bootstrap.workload.train import synthetic_batch
+
+    model = ModelConfig(vocab_size=256, num_layers=4, num_heads=4, head_dim=16,
+                        embed_dim=128, mlp_dim=512, max_seq_len=128)
+
+    def temp_bytes(schedule, M=16):
+        cfg = TrainConfig(model=model, mesh=MeshConfig(pipe=2, data=4),
+                          num_microbatches=M, pipeline_schedule=schedule)
+        mesh = build_mesh(cfg.mesh)
+        params, opt_state, p_sh = init_train_state(cfg, mesh, jax.random.PRNGKey(0))
+        step = make_train_step(cfg, mesh, p_sh)
+        tokens = jax.device_put(synthetic_batch(cfg, 0), batch_shardings(mesh))
+        compiled = step.lower(params, opt_state, tokens).compile()
+        return compiled.memory_analysis().temp_size_in_bytes
+
+    gpipe, f1b = temp_bytes("gpipe"), temp_bytes("1f1b")
+    assert f1b * 3 < gpipe, (
+        f"1f1b temp {f1b/1e6:.1f} MB not meaningfully below gpipe "
+        f"{gpipe/1e6:.1f} MB")

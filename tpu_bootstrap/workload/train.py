@@ -453,6 +453,9 @@ def parse_mesh_env(value: str, n_devices: int) -> MeshConfig:
         if k not in valid:
             raise ValueError(
                 f"WORKLOAD_MESH axis {k!r} unknown (valid: {sorted(valid)})")
+        if k in fields:
+            # Last-wins would let a typo silently train the wrong layout.
+            raise ValueError(f"WORKLOAD_MESH axis {k} specified twice")
         extent = int(v)
         if extent < 1:
             # A negative pair can sign-cancel through the size check and
