@@ -160,3 +160,18 @@ def test_parse_mesh_env():
         parse_mesh_env("data", 8)
     with pytest.raises(ValueError, match=">= 1"):
         parse_mesh_env("pipe=-2,data=-4", 8)  # sign-cancel must not pass
+
+
+def test_train_loop_progress_logging(capsys):
+    """log_every prints the operator-facing progress line (loss +
+    tokens/s) — what `kubectl logs` of a slice worker shows."""
+    from tpu_bootstrap.workload.train import TrainConfig, train_loop
+
+    cfg = TrainConfig(
+        model=ModelConfig(vocab_size=32, num_layers=1, num_heads=2, head_dim=4,
+                          embed_dim=8, mlp_dim=16, max_seq_len=8),
+        mesh=MeshConfig(data=2))
+    train_loop(cfg, 4, log_every=2)
+    out = capsys.readouterr().out
+    assert "step 2/4: loss " in out and "step 4/4: loss " in out
+    assert "tokens/s" in out
