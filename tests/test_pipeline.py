@@ -372,6 +372,65 @@ def test_1f1b_with_fsdp_matches_sequential(mesh_cfg):
                                np.asarray(g_seq["final_norm"]), rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.parametrize("mesh_cfg,attention,num_kv_heads", [
+    (MeshConfig(pipe=2, data=2, seq=2), "dense", None),
+    (MeshConfig(pipe=2, data=2, seq=2), "flash", None),
+    (MeshConfig(pipe=2, seq=2, tensor=2), "dense", None),  # pp x sp x tp
+    (MeshConfig(pipe=2, data=2, seq=2), "dense", 1),       # MQA in the ring
+])
+def test_pipeline_with_seq_parallelism_matches_sequential(mesh_cfg, attention,
+                                                          num_kv_heads):
+    """pp x sp (GPipe): the ring-attention local body runs INSIDE the
+    pipeline stage (the pipeline's shard_map already spans the seq axis),
+    with rotary phases on global positions per shard. Loss and every
+    block gradient must match the plain sequential model."""
+    import dataclasses
+
+    model = dataclasses.replace(
+        ModelConfig(vocab_size=64, num_layers=4, num_heads=2, head_dim=8,
+                    embed_dim=32, mlp_dim=64, max_seq_len=17),  # shifts to 16
+        num_kv_heads=num_kv_heads)
+    mesh = build_mesh(mesh_cfg)
+    cfg = TrainConfig(model=model, mesh=mesh_cfg, attention=attention,
+                      attention_block=8)
+    params, stacked = stacked_state(model, jax.random.PRNGKey(0))
+    dsz = mesh_cfg.dcn * mesh_cfg.data * mesh_cfg.fsdp
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4 * dsz, model.max_seq_len),
+                                0, model.vocab_size)
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+
+    want_loss, g_seq = jax.value_and_grad(lambda p: loss_fn(p, tokens, model))(params)
+    loss = make_pipeline_loss(cfg, mesh, num_microbatches=2)
+    got = float(jax.jit(loss)(stacked, inputs, targets))
+    tol = 2e-4 if attention == "flash" else 1e-5
+    assert got == pytest.approx(float(want_loss), rel=tol)
+
+    g_pipe = jax.grad(lambda p: loss(p, inputs, targets))(stacked)
+    g_seq_stacked = stack_block_params(g_seq["blocks"])
+    gtol = 5e-4 if attention == "flash" else 1e-4
+    for name in ("wq", "wk", "wv", "wo", "w_up", "w_down", "attn_norm", "mlp_norm"):
+        np.testing.assert_allclose(np.asarray(g_pipe["blocks"][name]),
+                                   np.asarray(g_seq_stacked[name]),
+                                   rtol=gtol, atol=1e-5, err_msg=name)
+    np.testing.assert_allclose(np.asarray(g_pipe["embed"]), np.asarray(g_seq["embed"]),
+                               rtol=gtol, atol=1e-5)
+
+
+def test_pipeline_seq_requires_divisible_length():
+    """The shifted sequence length must tile over the seq axis — reject
+    with the fix spelled out, not a shape error mid-trace."""
+    model = ModelConfig(vocab_size=64, num_layers=4, num_heads=2, head_dim=8,
+                        embed_dim=32, mlp_dim=64, max_seq_len=16)  # shifts to 15
+    mesh_cfg = MeshConfig(pipe=2, data=2, seq=2)
+    cfg = TrainConfig(model=model, mesh=mesh_cfg)
+    loss = make_pipeline_loss(cfg, build_mesh(mesh_cfg), num_microbatches=2)
+    params, stacked = stacked_state(model, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, model.max_seq_len),
+                                0, model.vocab_size)
+    with pytest.raises(ValueError, match="divisible by the seq"):
+        loss(stacked, tokens[:, :-1], tokens[:, 1:])
+
+
 def test_1f1b_rejects_seq_and_unknown_schedules():
     """1F1B covers dcn/data/fsdp/tensor; seq (ring attention's own
     shard_map) is rejected loudly, as are unknown schedule names."""
@@ -407,9 +466,10 @@ def test_pipelined_checkpoint_resume_matches(tmp_path):
 
 
 def test_pipeline_rejects_bad_configs():
-    mesh = build_mesh(MeshConfig(pipe=2, data=2, seq=2))
-    cfg = TrainConfig(model=MODEL, mesh=MeshConfig(pipe=2, data=2, seq=2))
-    with pytest.raises(ValueError, match="seq"):
+    # expert is the one axis neither schedule inlines into the stage body
+    mesh = build_mesh(MeshConfig(pipe=2, data=2, expert=2))
+    cfg = TrainConfig(model=MODEL, mesh=MeshConfig(pipe=2, data=2, expert=2))
+    with pytest.raises(ValueError, match="expert"):
         make_pipeline_loss(cfg, mesh, num_microbatches=2)
     # tp inside the pipeline needs the head/hidden dims actually sharded —
     # non-divisible counts would silently replicate and the psum would

@@ -157,7 +157,8 @@ def dense_attn_core(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
     return jnp.einsum("bhst,bthd->bshd", probs, v)
 
 
-def _attention(block: Params, x: jax.Array, cfg: ModelConfig, attn_fn=None) -> jax.Array:
+def _attention(block: Params, x: jax.Array, cfg: ModelConfig, attn_fn=None,
+               positions: jax.Array | None = None) -> jax.Array:
     """Causal multi-head attention. x: (batch, seq, embed).
 
     ``attn_fn(q, k, v) -> out`` (q: (batch, seq, heads, head_dim); k/v
@@ -165,11 +166,14 @@ def _attention(block: Params, x: jax.Array, cfg: ModelConfig, attn_fn=None) -> j
     the hook through which ring attention (sequence parallelism) and the
     pallas flash kernel plug in. The QKV/rotary/output projections around
     it are per-token and need no communication, so they work unchanged
-    under any sequence sharding.
+    under any sequence sharding — ``positions`` supplies the GLOBAL token
+    positions when x is a sequence shard (rotary phases depend on them);
+    default arange(seq) is the unsharded case.
     """
     dtype = cfg.compute_dtype
     seq = x.shape[1]
-    positions = jnp.arange(seq)
+    if positions is None:
+        positions = jnp.arange(seq)
 
     h = _rms_norm(x, block["attn_norm"])
     q = jnp.einsum("bse,ehd->bshd", h, block["wq"].astype(dtype))
