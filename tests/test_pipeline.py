@@ -466,11 +466,15 @@ def test_pipelined_checkpoint_resume_matches(tmp_path):
 
 
 def test_pipeline_rejects_bad_configs():
-    # expert is the one axis neither schedule inlines into the stage body
-    mesh = build_mesh(MeshConfig(pipe=2, data=2, expert=2))
-    cfg = TrainConfig(model=MODEL, mesh=MeshConfig(pipe=2, data=2, expert=2))
-    with pytest.raises(ValueError, match="expert"):
+    # seq x expert in one pipeline: per-row routing would see only a
+    # sequence shard — rejected rather than subtly divergent.
+    mesh = build_mesh(MeshConfig(pipe=2, seq=2, expert=2))
+    cfg = TrainConfig(
+        model=ModelConfig(**{**MODEL.__dict__, "num_experts": 2, "max_seq_len": 17}),
+        mesh=MeshConfig(pipe=2, seq=2, expert=2))
+    with pytest.raises(ValueError, match="routing"):
         make_pipeline_loss(cfg, mesh, num_microbatches=2)
+    cfg = TrainConfig(model=MODEL, mesh=MeshConfig(pipe=2, data=2, expert=2))
     # tp inside the pipeline needs the head/hidden dims actually sharded —
     # non-divisible counts would silently replicate and the psum would
     # overcount, so they must be rejected at construction.
@@ -499,13 +503,15 @@ def test_pipeline_rejects_bad_configs():
                                     0, MODEL.vocab_size)
     with pytest.raises(ValueError, match="divide"):
         bad_loss(odd_stacked, odd_tokens[:, :-1], odd_tokens[:, 1:])
-    # MoE blocks are not supported under pipeline parallelism —
-    # rejected at construction, not at first trace
+    # MoE is GPipe-only: the 1F1B manual backward rejects it at
+    # construction, not at first trace.
+    from tpu_bootstrap.workload.pipeline import make_pipeline_1f1b_grad
+
     moe = TrainConfig(
         model=ModelConfig(**{**MODEL.__dict__, "num_experts": 2}),
         mesh=MeshConfig(pipe=2, data=4), num_microbatches=2)
     with pytest.raises(ValueError, match="MoE"):
-        make_pipeline_loss(moe, build_mesh(moe.mesh), num_microbatches=2)
+        make_pipeline_1f1b_grad(moe, build_mesh(moe.mesh), num_microbatches=2)
 
 
 def test_1f1b_uses_less_activation_memory_than_gpipe():
@@ -534,3 +540,86 @@ def test_1f1b_uses_less_activation_memory_than_gpipe():
     assert f1b * 3 < gpipe, (
         f"1f1b temp {f1b/1e6:.1f} MB not meaningfully below gpipe "
         f"{gpipe/1e6:.1f} MB")
+
+
+@pytest.mark.parametrize("mesh_cfg", [
+    MeshConfig(pipe=2, data=2, expert=2),    # pp x dp x ep
+    MeshConfig(pipe=2, expert=2, tensor=2),  # pp x ep x tp
+    MeshConfig(pipe=2, data=4),              # MoE blocks, expert axis = 1
+])
+def test_pipeline_with_moe_matches_sequential(mesh_cfg):
+    """pp x ep (GPipe): moe_mlp_manual routes per LOCAL batch row (slot
+    competition is per-row, so sharded routing is bit-identical to the
+    global routing) with explicit GShard all-to-alls over `expert`. With
+    a capacity factor high enough to avoid drops and aux_coef=0, loss
+    and every gradient — router and expert stacks included — must match
+    the sequential model."""
+    model = ModelConfig(vocab_size=64, num_layers=4, num_heads=2, head_dim=8,
+                        embed_dim=32, mlp_dim=64, max_seq_len=16, num_experts=4,
+                        expert_top_k=2, expert_capacity_factor=4.0,
+                        moe_aux_coef=0.0)
+    mesh = build_mesh(mesh_cfg)
+    cfg = TrainConfig(model=model, mesh=mesh_cfg)
+    params, stacked = stacked_state(model, jax.random.PRNGKey(0))
+    dsz = mesh_cfg.dcn * mesh_cfg.data * mesh_cfg.fsdp * mesh_cfg.expert
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2 * dsz, model.max_seq_len),
+                                0, model.vocab_size)
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+
+    want_loss, g_seq = jax.value_and_grad(lambda p: loss_fn(p, tokens, model))(params)
+    loss = make_pipeline_loss(cfg, mesh, num_microbatches=2)
+    got = float(jax.jit(loss)(stacked, inputs, targets))
+    assert got == pytest.approx(float(want_loss), rel=1e-5)
+
+    g_pipe = jax.grad(lambda p: loss(p, inputs, targets))(stacked)
+    g_seq_stacked = stack_block_params(g_seq["blocks"])
+    for name in ("wq", "wo", "router", "w_up", "w_down", "attn_norm", "mlp_norm"):
+        np.testing.assert_allclose(np.asarray(g_pipe["blocks"][name]),
+                                   np.asarray(g_seq_stacked[name]),
+                                   rtol=2e-4, atol=1e-5, err_msg=name)
+    np.testing.assert_allclose(np.asarray(g_pipe["embed"]), np.asarray(g_seq["embed"]),
+                               rtol=2e-4, atol=1e-5)
+
+
+def test_pipeline_moe_aux_matches_per_shard_oracle():
+    """With aux_coef > 0: the pipelined MoE aux is the standard
+    microbatched estimator — the load-balancing loss averaged per
+    (microbatch, data shard) — which differs from the one-global-batch
+    aux only through the bilinear f*p term. Pinned against an explicit
+    oracle that runs each shard's rows separately."""
+    from tpu_bootstrap.workload.model import _attention, _rms_norm
+    from tpu_bootstrap.workload.moe import moe_mlp
+    from tpu_bootstrap.workload.pipeline import _head_nll
+
+    model = ModelConfig(vocab_size=64, num_layers=2, num_heads=2, head_dim=8,
+                        embed_dim=32, mlp_dim=64, max_seq_len=16, num_experts=4,
+                        expert_top_k=2, expert_capacity_factor=4.0,
+                        moe_aux_coef=0.1)
+    mesh_cfg = MeshConfig(pipe=2, data=2, expert=2)
+    mesh = build_mesh(mesh_cfg)
+    cfg = TrainConfig(model=model, mesh=mesh_cfg)
+    params, stacked = stacked_state(model, jax.random.PRNGKey(0))
+    M_mb, dsz = 2, 4
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (M_mb * dsz, model.max_seq_len),
+                                0, model.vocab_size)
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    loss = make_pipeline_loss(cfg, mesh, num_microbatches=M_mb)
+    got = float(jax.jit(loss)(stacked, inputs, targets))
+
+    def run_blocks(x):
+        aux_total = 0.0
+        for blk in params["blocks"]:
+            x = x + _attention(blk, x, model)
+            out, aux = moe_mlp(blk, _rms_norm(x, blk["mlp_norm"]), model)
+            x = x + out
+            aux_total += float(aux)
+        return x, aux_total / len(params["blocks"])
+
+    x_full = params["embed"][inputs]
+    y_full, _ = run_blocks(x_full)
+    nll = float(_head_nll(y_full, params["final_norm"], params["embed"], targets))
+    # microbatch m = rows {i*M + m}; per-shard groups are single rows here
+    aux_vals = [run_blocks(x_full[r:r + 1])[1]
+                for m in range(M_mb) for r in range(m, M_mb * dsz, M_mb)]
+    want = nll + model.moe_aux_coef * float(np.mean(aux_vals))
+    assert got == pytest.approx(want, rel=2e-5)

@@ -70,15 +70,12 @@ def _expert_linear(x, w, dtype):
     return jnp.einsum("ebck,ekn->ebcn", x, w.astype(dtype))
 
 
-def moe_mlp(block, h, cfg):
-    """Top-k MoE FFN over pre-normalized activations.
-
-    block: {"router": (M, E), "w_up": (E, M, F), "w_down": (E, F, M)}
-    h: (B, S, M) — already RMS-normed by the caller (same contract as the
-    dense MLP: norm, then project).
-    Returns (out (B, S, M), aux_loss scalar f32).
-    """
-    dtype = cfg.compute_dtype
+def _route(block, h, cfg):
+    """Router + slot assignment: (dispatch (B,S,E,C), combine (B,S,E,C),
+    aux scalar). Capacity competition is PER BATCH ROW (the slot cumsum
+    runs within each row), so routing computed on a batch SHARD is
+    bit-identical to the same rows' routing in the full batch — the fact
+    the manual expert-parallel path (moe_mlp_manual) relies on."""
     E, k = cfg.num_experts, cfg.expert_top_k
     if not 1 <= k <= E:
         raise ValueError(f"expert_top_k must be in [1, num_experts], got {k}/{E}")
@@ -106,6 +103,25 @@ def moe_mlp(block, h, cfg):
     combine = jnp.sum(disp * gate_k[..., None, None].astype(jnp.float32), axis=2)
     dispatch = jnp.sum(disp, axis=2)  # (B, S, E, C) 0/1
 
+    # Switch-style load-balancing aux loss on top-1 assignments.
+    top1 = mask[:, :, 0]  # (B, S, E)
+    f = jnp.mean(top1, axis=(0, 1))  # fraction routed to each expert
+    p = jnp.mean(gates, axis=(0, 1))  # mean router prob per expert
+    aux = E * jnp.sum(f * p)
+    return dispatch, combine, aux
+
+
+def moe_mlp(block, h, cfg):
+    """Top-k MoE FFN over pre-normalized activations.
+
+    block: {"router": (M, E), "w_up": (E, M, F), "w_down": (E, F, M)}
+    h: (B, S, M) — already RMS-normed by the caller (same contract as the
+    dense MLP: norm, then project).
+    Returns (out (B, S, M), aux_loss scalar f32).
+    """
+    dtype = cfg.compute_dtype
+    dispatch, combine, aux = _route(block, h, cfg)
+
     # Expert FFN on the dense (E, B, C, M) batch. The E axis is sharded
     # over the expert mesh axis (weights pin it), B over the data axes:
     # GSPMD materializes the all-to-all at this boundary.
@@ -114,13 +130,35 @@ def moe_mlp(block, h, cfg):
     hidden = jax.nn.gelu(hidden)
     expert_out = _expert_linear(hidden, block["w_down"], dtype)
     out = jnp.einsum("bsec,ebcm->bsm", combine.astype(dtype), expert_out)
-
-    # Switch-style load-balancing aux loss on top-1 assignments.
-    top1 = mask[:, :, 0]  # (B, S, E)
-    f = jnp.mean(top1, axis=(0, 1))  # fraction routed to each expert
-    p = jnp.mean(gates, axis=(0, 1))  # mean router prob per expert
-    aux = E * jnp.sum(f * p)
     return out, aux
 
 
-__all__ = ["moe_mlp", "expert_capacity"]
+def moe_mlp_manual(block, h, cfg, axis_name: str = "expert", n_expert: int = 1):
+    """moe_mlp for MANUAL-SPMD contexts (inside a shard_map body, e.g. a
+    pipeline stage): same per-row routing on the local batch shard, with
+    the GShard all-to-all pair written explicitly over ``axis_name``
+    instead of left to GSPMD. block's w_up/w_down arrive expert-SHARDED
+    ((E/n, ...) local stacks); the router is replicated. Outside AD
+    differentiates the all-to-alls exactly (their transpose is the
+    inverse all-to-all — a data permutation, independent of replication).
+    """
+    dtype = cfg.compute_dtype
+    dispatch, combine, aux = _route(block, h, cfg)
+
+    expert_in = jnp.einsum("bsec,bsm->ebcm", dispatch.astype(dtype), h)
+    if n_expert > 1:
+        # (E, b, C, M) -> (E/n, b*n, C, M): each member keeps its own
+        # experts' slots for every member's rows.
+        expert_in = lax.all_to_all(expert_in, axis_name, split_axis=0,
+                                   concat_axis=1, tiled=True)
+    hidden = jax.nn.gelu(_expert_linear(expert_in, block["w_up"], dtype))
+    expert_out = _expert_linear(hidden, block["w_down"], dtype)
+    if n_expert > 1:
+        # Inverse: (E/n, b*n, C, M) -> (E, b, C, M), rows home again.
+        expert_out = lax.all_to_all(expert_out, axis_name, split_axis=1,
+                                    concat_axis=0, tiled=True)
+    out = jnp.einsum("bsec,ebcm->bsm", combine.astype(dtype), expert_out)
+    return out, aux
+
+
+__all__ = ["moe_mlp", "moe_mlp_manual", "expert_capacity"]
