@@ -214,6 +214,44 @@ class KnownAbsent {
   std::set<std::string> names_;
 };
 
+// Last slice phase THIS process emitted an Event for, per CR. The
+// informer cache can lag the controller's own status merge from the
+// previous pass, so deriving old_phase from it can re-emit a transition
+// (count inflated) or skip a fast intermediate phase. The process's own
+// emission record is exact for dedup; a fresh process falls back to the
+// cached status (at worst one duplicate per restart).
+class EmittedPhases {
+ public:
+  // Records are keyed by (name, uid): an in-flight reconcile of a JUST
+  // deleted CR can set() after the watch thread's erase(), and without
+  // the uid that resurrected record would suppress a recreated CR's
+  // first Event whenever its phase matches the dead CR's last one.
+  // A uid mismatch simply reads as "no record".
+  bool get(const std::string& name, const std::string& uid,
+           std::string* out) const {
+    std::lock_guard<std::mutex> lock(mutex_);
+    auto it = phases_.find(name);
+    if (it == phases_.end() || it->second.first != uid) return false;
+    *out = it->second.second;
+    return true;
+  }
+
+  void set(const std::string& name, const std::string& uid,
+           const std::string& phase) {
+    std::lock_guard<std::mutex> lock(mutex_);
+    phases_[name] = {uid, phase};
+  }
+
+  void erase(const std::string& name) {
+    std::lock_guard<std::mutex> lock(mutex_);
+    phases_.erase(name);
+  }
+
+ private:
+  mutable std::mutex mutex_;
+  std::map<std::string, std::pair<std::string, std::string>> phases_;
+};
+
 // Async event sink: reconcile workers enqueue, one drainer thread posts.
 // Events are best-effort operator telemetry — two API round-trips (prior
 // lookup + apply) must not ride the reconcile critical path (the
@@ -288,7 +326,8 @@ class EventSink {
 // plus JobSet + status.slice maintenance. Returns false when the CR is
 // gone (callers must not requeue it).
 bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::string& name,
-                   EventSink& events, const ObjectCache& cache, KnownAbsent& rb_absent) {
+                   EventSink& events, const ObjectCache& cache, KnownAbsent& rb_absent,
+                   EmittedPhases& emitted) {
   // Whole-pass latency histogram: the in-daemon half of the BASELINE
   // metric surface, scrapeable at /metrics and read back by bench.py.
   struct PassTimer {
@@ -305,7 +344,11 @@ bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::s
   // round-trip per pass. Absent from cache = deleted (the watch DELETED
   // event removed it); owner refs GC the children.
   Json ub;
-  if (!cache.get(name, &ub)) return false;
+  if (!cache.get(name, &ub)) {
+    emitted.erase(name);  // CR deleted: drop the per-CR emission record
+    rb_absent.erase(name);
+    return false;
+  }
 
   log_info("reconciling", {{"name", name}});
   std::vector<Json> children = desired_children(ub, cfg.core);
@@ -435,6 +478,9 @@ bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::s
     } catch (const KubeError& e) {
       log_warn("slice status removal failed", {{"name", name}, {"error", e.what()}});
     }
+    // The slice is gone; a re-added spec.tpu must re-emit its phase
+    // history from scratch (symmetric with the CR-deletion paths).
+    emitted.erase(name);
   } else if (has_tpu) {
     Json observed;  // null unless the JobSet exists
     if (have_applied_jobset) {
@@ -474,9 +520,15 @@ bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::s
       // Surface the phase transition as a core/v1 Event so `kubectl
       // describe ub` shows slice history. Queued to the async sink:
       // best-effort telemetry stays off the reconcile critical path.
-      Json event = slice_event(ub, ub.get("status").get("slice").get_string("phase"),
-                               desired_slice, now_rfc3339());
+      // old_phase comes from this process's own emission record (exact);
+      // the informer-cached status is only the cold-start fallback.
+      const std::string uid = ub.get("metadata").get_string("uid");
+      std::string old_phase;
+      if (!emitted.get(name, uid, &old_phase))
+        old_phase = ub.get("status").get("slice").get_string("phase");
+      Json event = slice_event(ub, old_phase, desired_slice, now_rfc3339());
       if (event.is_object()) events.enqueue(std::move(event));
+      emitted.set(name, uid, desired_slice.get_string("phase"));
     }
   }
   Metrics::instance().inc("reconciles_total");
@@ -541,6 +593,7 @@ int main() {
   EventSink events(client);
   ObjectCache cache;
   KnownAbsent rb_absent;
+  EmittedPhases emitted_phases;
 
   // Reconcile workers.
   std::vector<std::thread> workers;
@@ -560,7 +613,8 @@ int main() {
           continue;
         }
         try {
-          bool exists = reconcile_one(client, cfg, name, events, cache, rb_absent);
+          bool exists = reconcile_one(client, cfg, name, events, cache, rb_absent,
+                                      emitted_phases);
           queue.done(name);
           if (exists) queue.add(name, cfg.requeue_secs * 1000);  // controller.rs:154
         } catch (const std::exception& e) {
@@ -675,6 +729,9 @@ int main() {
             cache.remove(name);
             queue.remove(name);  // GC handles children; stop requeueing
             rb_absent.erase(name);  // don't grow unbounded across CR churn
+            // A recreated CR must re-emit its phase history; a stale
+            // record would swallow its transitions forever.
+            emitted_phases.erase(name);
             return;
           }
           cache.put(obj);

@@ -377,6 +377,9 @@ def test_1f1b_with_fsdp_matches_sequential(mesh_cfg):
     (MeshConfig(pipe=2, data=2, seq=2), "flash", None),
     (MeshConfig(pipe=2, seq=2, tensor=2), "dense", None),  # pp x sp x tp
     (MeshConfig(pipe=2, data=2, seq=2), "dense", 1),       # MQA in the ring
+    # DENSE model on a seq x expert mesh: expert is just more batch
+    # parallelism here (the MoE rejection applies only to MoE models)
+    (MeshConfig(pipe=2, seq=2, expert=2), "dense", None),
 ])
 def test_pipeline_with_seq_parallelism_matches_sequential(mesh_cfg, attention,
                                                           num_kv_heads):
@@ -394,7 +397,7 @@ def test_pipeline_with_seq_parallelism_matches_sequential(mesh_cfg, attention,
     cfg = TrainConfig(model=model, mesh=mesh_cfg, attention=attention,
                       attention_block=8)
     params, stacked = stacked_state(model, jax.random.PRNGKey(0))
-    dsz = mesh_cfg.dcn * mesh_cfg.data * mesh_cfg.fsdp
+    dsz = mesh_cfg.dcn * mesh_cfg.data * mesh_cfg.fsdp * mesh_cfg.expert
     tokens = jax.random.randint(jax.random.PRNGKey(1), (4 * dsz, model.max_seq_len),
                                 0, model.vocab_size)
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
@@ -466,8 +469,10 @@ def test_pipelined_checkpoint_resume_matches(tmp_path):
 
 
 def test_pipeline_rejects_bad_configs():
-    # seq x expert in one pipeline: per-row routing would see only a
-    # sequence shard — rejected rather than subtly divergent.
+    # seq x MoE in one pipeline: per-row routing would see only a
+    # sequence shard — rejected rather than subtly divergent. (A dense
+    # model on the same mesh passes; expert is then just batch
+    # parallelism — test_pipeline_with_seq_parallelism covers it.)
     mesh = build_mesh(MeshConfig(pipe=2, seq=2, expert=2))
     cfg = TrainConfig(
         model=ModelConfig(**{**MODEL.__dict__, "num_experts": 2, "max_seq_len": 17}),
@@ -545,6 +550,8 @@ def test_1f1b_uses_less_activation_memory_than_gpipe():
 @pytest.mark.parametrize("mesh_cfg", [
     MeshConfig(pipe=2, data=2, expert=2),    # pp x dp x ep
     MeshConfig(pipe=2, expert=2, tensor=2),  # pp x ep x tp
+    MeshConfig(pipe=2, fsdp=2, expert=2),    # pp x fsdp x ep (ZeRO-3 gathers
+                                             # of the expert stacks in-stage)
     MeshConfig(pipe=2, data=4),              # MoE blocks, expert axis = 1
 ])
 def test_pipeline_with_moe_matches_sequential(mesh_cfg):
@@ -623,3 +630,58 @@ def test_pipeline_moe_aux_matches_per_shard_oracle():
                 for m in range(M_mb) for r in range(m, M_mb * dsz, M_mb)]
     want = nll + model.moe_aux_coef * float(np.mean(aux_vals))
     assert got == pytest.approx(want, rel=2e-5)
+
+
+def test_pipeline_moe_aux_grads_match_oracle():
+    """Gradients THROUGH the aux path (aux_coef > 0): the pipelined loss
+    and the same microbatched estimator written as one differentiable
+    expression — nll(full batch) + coef * mean over (microbatch, shard)
+    of the per-group aux — must agree on every gradient, router
+    included. Catches a wrong transpose through the psum(pipe) /
+    pmean(data) normalization or the bubble-tick masking that a
+    value-only check (above) cannot see."""
+    from tpu_bootstrap.workload.model import _attention, _rms_norm
+    from tpu_bootstrap.workload.moe import moe_mlp
+    from tpu_bootstrap.workload.pipeline import _head_nll
+
+    model = ModelConfig(vocab_size=64, num_layers=2, num_heads=2, head_dim=8,
+                        embed_dim=32, mlp_dim=64, max_seq_len=16, num_experts=4,
+                        expert_top_k=2, expert_capacity_factor=4.0,
+                        moe_aux_coef=0.1)
+    mesh_cfg = MeshConfig(pipe=2, data=2, expert=2)
+    mesh = build_mesh(mesh_cfg)
+    cfg = TrainConfig(model=model, mesh=mesh_cfg)
+    params, stacked = stacked_state(model, jax.random.PRNGKey(0))
+    M_mb, dsz = 2, 4
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (M_mb * dsz, model.max_seq_len),
+                                0, model.vocab_size)
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    loss = make_pipeline_loss(cfg, mesh, num_microbatches=M_mb)
+    g_pipe = jax.grad(lambda p: loss(p, inputs, targets))(stacked)
+
+    def oracle(p):
+        def run_blocks(x):
+            aux_total = 0.0
+            for blk in p["blocks"]:
+                x = x + _attention(blk, x, model)
+                out, aux = moe_mlp(blk, _rms_norm(x, blk["mlp_norm"]), model)
+                x = x + out
+                aux_total = aux_total + aux
+            return x, aux_total / len(p["blocks"])
+
+        x_full = p["embed"][inputs]
+        y_full, _ = run_blocks(x_full)
+        nll = _head_nll(y_full, p["final_norm"], p["embed"], targets)
+        # microbatch m = rows {i*M + m}; per-shard groups are single rows
+        aux_vals = [run_blocks(x_full[r:r + 1])[1]
+                    for m in range(M_mb) for r in range(m, M_mb * dsz, M_mb)]
+        return nll + model.moe_aux_coef * jnp.mean(jnp.stack(aux_vals))
+
+    g_want = jax.grad(oracle)(params)
+    g_want_stacked = stack_block_params(g_want["blocks"])
+    for name in ("wq", "wo", "router", "w_up", "w_down", "attn_norm", "mlp_norm"):
+        np.testing.assert_allclose(np.asarray(g_pipe["blocks"][name]),
+                                   np.asarray(g_want_stacked[name]),
+                                   rtol=5e-4, atol=1e-5, err_msg=name)
+    np.testing.assert_allclose(np.asarray(g_pipe["embed"]),
+                               np.asarray(g_want["embed"]), rtol=5e-4, atol=1e-5)

@@ -119,18 +119,12 @@ def moe_mlp(block, h, cfg):
     dense MLP: norm, then project).
     Returns (out (B, S, M), aux_loss scalar f32).
     """
-    dtype = cfg.compute_dtype
-    dispatch, combine, aux = _route(block, h, cfg)
-
-    # Expert FFN on the dense (E, B, C, M) batch. The E axis is sharded
-    # over the expert mesh axis (weights pin it), B over the data axes:
-    # GSPMD materializes the all-to-all at this boundary.
-    expert_in = jnp.einsum("bsec,bsm->ebcm", dispatch.astype(dtype), h)
-    hidden = _expert_linear(expert_in, block["w_up"], dtype)
-    hidden = jax.nn.gelu(hidden)
-    expert_out = _expert_linear(hidden, block["w_down"], dtype)
-    out = jnp.einsum("bsec,ebcm->bsm", combine.astype(dtype), expert_out)
-    return out, aux
+    # Same body as moe_mlp_manual at n_expert=1 (no collective, no axis
+    # name, so it is valid under plain GSPMD jit): the expert FFN runs on
+    # the dense (E, B, C, M) batch whose E axis the weights pin to the
+    # expert mesh axis while B stays on the data axes — GSPMD
+    # materializes the all-to-all pair at that boundary on its own.
+    return moe_mlp_manual(block, h, cfg)
 
 
 def moe_mlp_manual(block, h, cfg, axis_name: str = "expert", n_expert: int = 1):
