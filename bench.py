@@ -434,6 +434,35 @@ try:
         "train_seq%d_mfu_pct" % LSEQ: round(
             100 * (6 * ln * ltoks + lattn) / (lms / 1e3) / PEAK_BF16, 2),
     })
+    emit()
+
+    # Same configuration with the chunked cross-entropy head
+    # (workload/xent.py): the (B, S, V) logits — 2 GB of f32 at this
+    # shape — never materialize, so the step sheds its largest tensor and
+    # the HBM traffic that came with it. The dense run's state (params +
+    # Adam moments, ~1.6 GB f32) is dead now — drop it before the second
+    # init so peak HBM holds one train state, not two.
+    del lparams, lopt, lstep
+    ccfg = TrainConfig(
+        model=ModelConfig(vocab_size=32768, num_layers=8, num_heads=16, head_dim=64,
+                          embed_dim=1024, mlp_dim=4096, max_seq_len=LSEQ,
+                          compute_dtype=jnp.bfloat16, vocab_chunk=4096),
+        mesh=MeshConfig(), attention="flash", remat=True,
+    )
+    cparams, copt, cp_sh = init_train_state(ccfg, lmesh, jax.random.PRNGKey(0))
+    cstep = make_train_step(ccfg, lmesh, cp_sh)
+    cparams, copt, cl = cstep(cparams, copt, ltokens); float(cl)
+    t0 = time.time()
+    for _ in range(5):
+        cparams, copt, cl = cstep(cparams, copt, ltokens)
+    float(cl)
+    cms = (time.time() - t0) / 5 * 1e3
+    out.update({
+        "train_seq%d_chunked_xent_step_ms" % LSEQ: round(cms, 3),
+        "train_seq%d_chunked_xent_mfu_pct" % LSEQ: round(
+            100 * (6 * ln * ltoks + lattn) / (cms / 1e3) / PEAK_BF16, 2),
+        "chunked_xent_speedup_seq%d" % LSEQ: round(lms / cms, 3),
+    })
 except Exception as e:  # noqa: BLE001
     out["longctx_bench_error"] = f"{type(e).__name__}: {e}"[:400]
 emit()
@@ -521,15 +550,16 @@ def _attach_cached_workload(err_result: dict) -> dict:
     return err_result
 
 
-def workload_bench(timeout_secs: int = 780):
+def workload_bench(timeout_secs: int = 900):
     """Run the TPU workload micro-bench in a subprocess, first and
     isolated (VERDICT r1 item 1): explicit JAX_PLATFORMS passthrough and
     a hard timeout. Fast failures (crash, no JSON) get one retry; a
     timeout with ZERO output — hung backend init, i.e. a dead tunnel —
-    does NOT retry (it would hang just as long again). 780s cap: a fully
+    does NOT retry (it would hang just as long again). 900s cap: a fully
     cold run (15+ Mosaic compiles through the tunnel) measured ~600s
     through the decode section alone, which cost one run its seq-8192
-    long-context metric. The subprocess
+    long-context metric — and the chunked-xent section adds two more
+    seq-8192 compiles. The subprocess
     emits its accumulated results after every milestone, so even a
     timeout or crash returns whatever was measured up to that point. On
     total failure returns the error string instead of raising — the

@@ -10,14 +10,14 @@ OUT="$REPO/.tpu_workload_probe.json"
 LOG="$REPO/.tpu_workload_probe.log"
 while true; do
   echo "$(date -u +%FT%TZ) attempt start" >> "$LOG"
-  RESULT=$(timeout 1600 python - <<'EOF' 2>>"$LOG"
+  RESULT=$(timeout 1900 python - <<'EOF' 2>>"$LOG"
 import sys
 sys.path.insert(0, "/root/repo")
 import bench
 import json
 # One attempt per loop iteration (workload_bench itself retries once, so
-# the outer 1600s bound must cover 2 x timeout_secs).
-r = bench.workload_bench(timeout_secs=700)
+# the outer 1900s bound must cover 2 x timeout_secs).
+r = bench.workload_bench(timeout_secs=900)
 print(json.dumps(r))
 EOF
 )

@@ -135,6 +135,17 @@ def test_sharded_decode_matches_single_device(params):
     want_q = generate(params, prompt, CFG, 5, kv_quant=True)
     got_q = generate(sharded, prompt, CFG, 5, kv_quant=True)
     np.testing.assert_array_equal(np.asarray(got_q), np.asarray(want_q))
+    # Kernel-ELIGIBLE cache length (prompt 4 + 28 = 32): generate's
+    # kv_kernel AUTO default turns the Pallas kernel ON for the
+    # single-device params and OFF for the multi-device layout (GSPMD
+    # cannot partition a pallas_call — decode._multi_device seam), and
+    # the two paths must produce identical greedy tokens.
+    from tpu_bootstrap.workload.decode import _multi_device
+
+    assert _multi_device(sharded) and not _multi_device(params)
+    want_k = generate(params, prompt, CFG, 28, kv_quant=True)
+    got_k = generate(sharded, prompt, CFG, 28, kv_quant=True)
+    np.testing.assert_array_equal(np.asarray(got_k), np.asarray(want_k))
 
 
 def test_int8_kv_cache_matches_fp_cache(params):

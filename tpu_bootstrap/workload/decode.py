@@ -6,14 +6,17 @@ TPU-first design:
   head_dim) buffer per block, written with `lax.dynamic_update_slice`;
   the decode loop is a `lax.scan` over a fixed step count. One trace,
   one compile, no shape churn.
-* Decode is HBM-bandwidth-bound (every step streams the whole cache),
-  so the per-step attention is a plain masked einsum — at query length
-  1 there is no score matrix to avoid, and XLA fuses the mask/softmax
-  into the two small matmuls. The flash kernel stays a training-path
-  tool. The bandwidth levers stack: GQA shrinks the cache by the
-  query/KV group factor, int8 weight-only quantization halves the
-  weight stream, and the int8 KV cache (init_cache quantized=True /
-  generate kv_quant=True) halves the cache stream.
+* Decode is HBM-bandwidth-bound (every step streams the whole cache).
+  The float-cache per-step attention is a plain masked einsum — at
+  query length 1 there is no score matrix to avoid, and XLA fuses the
+  mask/softmax into the two small matmuls. The int8 cache instead goes
+  through a dedicated Pallas kernel (workload/decode_attention.py) that
+  dequantizes tiles in VMEM on the way into the MXU, making the 1-byte
+  cache read structural rather than an XLA fusion outcome. The
+  bandwidth levers stack: GQA shrinks the cache by the query/KV group
+  factor, int8 weight-only quantization halves the weight stream, and
+  the int8 KV cache (init_cache quantized=True / generate
+  kv_quant=True) halves the cache stream again.
 * Sharding falls out of the same rules as training: batch over the data
   axes, heads over `tensor`, cache sharded like activations — run
   `generate` under `jit` with sharded params and GSPMD partitions the
@@ -40,7 +43,7 @@ from jax import lax
 
 import math
 
-from tpu_bootstrap.workload import quant
+from tpu_bootstrap.workload import decode_attention, quant
 from tpu_bootstrap.workload.model import (
     ModelConfig,
     Params,
@@ -132,9 +135,16 @@ def _attend(q: jax.Array, cache_k: jax.Array, cache_v: jax.Array,
 
 
 def _block_step(block: Params, x: jax.Array, cache: dict, positions: jax.Array,
-                valid: jax.Array, cfg: ModelConfig):
+                valid: jax.Array, cfg: ModelConfig, kv_kernel: bool = True):
     """One transformer block over x (B, S, E) with its KV written into the
-    cache at `positions` and attention over the whole cache."""
+    cache at `positions` and attention over the whole cache.
+
+    kv_kernel=False keeps the int8-cache attention on the einsum path —
+    the choice for SHARDED decode: GSPMD has no partitioning rule for
+    pallas_call, so under a multi-device mesh the kernel's operands would
+    be all-gathered and the kernel run fully replicated (correct tokens,
+    but the sharding win gone), while the einsum path partitions
+    normally."""
     dtype = cfg.compute_dtype
     h = _rms_norm(x, block["attn_norm"])
     wqkv = block.get("wqkv")
@@ -162,7 +172,19 @@ def _block_step(block: Params, x: jax.Array, cache: dict, positions: jax.Array,
             "v": lax.dynamic_update_slice(cache["v"], vq, (0, start, 0, 0)),
             "v_scale": lax.dynamic_update_slice(cache["v_scale"], vs, (0, start, 0)),
         }
-        # Dequant fuses into the attention einsums' operand reads; the
+        if (kv_kernel and q.shape[1] == 1
+                and decode_attention.supports(cache["k"].shape[1])):
+            # Single-query decode step: the Pallas kernel streams the
+            # int8 cache directly (dequant in VMEM, online softmax) —
+            # the 1-byte cache read is structural, not an XLA fusion
+            # outcome. valid is (1, L) here; the kernel wants the row.
+            out = decode_attention.decode_attention_int8(
+                q[:, 0], cache["k"], cache["k_scale"],
+                cache["v"], cache["v_scale"], valid[0])
+            x = x + _linear(out[:, None], block["wo"], 2, dtype)
+            return _mlp_tail(block, x, cfg), cache
+        # Prefill (multi-query) or an un-tileable cache length: dequant
+        # fuses into the attention einsums' operand reads; the
         # materialized-in-HBM tensors stay int8.
         cache_k = _dequantize_kv(cache["k"], cache["k_scale"], dtype)
         cache_v = _dequantize_kv(cache["v"], cache["v_scale"], dtype)
@@ -174,13 +196,17 @@ def _block_step(block: Params, x: jax.Array, cache: dict, positions: jax.Array,
         cache_k, cache_v = cache["k"], cache["v"]
     out = _attend(q, cache_k, cache_v, valid, cfg)
     x = x + _linear(out, block["wo"], 2, dtype)
+    return _mlp_tail(block, x, cfg), cache
+
+
+def _mlp_tail(block: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """The FFN half of a block (dense or MoE) — shared by the einsum and
+    kernel attention paths of _block_step."""
     if cfg.num_experts > 0:
         h2 = _rms_norm(x, block["mlp_norm"])
         moe_out, _ = moe_mlp(block, h2, cfg)
-        x = x + moe_out
-    else:
-        x = x + _mlp(block, x, cfg, linear=_linear)
-    return x, cache
+        return x + moe_out
+    return x + _mlp(block, x, cfg, linear=_linear)
 
 
 def _logits(params: Params, x: jax.Array) -> jax.Array:
@@ -195,7 +221,8 @@ def _logits(params: Params, x: jax.Array) -> jax.Array:
     return jnp.einsum("bse,ve->bsv", x.astype(jnp.float32), params["embed"])
 
 
-def prefill(params: Params, tokens: jax.Array, caches: list, cfg: ModelConfig):
+def prefill(params: Params, tokens: jax.Array, caches: list, cfg: ModelConfig,
+            kv_kernel: bool = True):
     """Run the prompt (B, S) through the model, filling cache slots
     [0, S). Returns (logits for the LAST prompt position (B, vocab),
     updated caches)."""
@@ -207,13 +234,13 @@ def prefill(params: Params, tokens: jax.Array, caches: list, cfg: ModelConfig):
     x = params["embed"].astype(cfg.compute_dtype)[tokens]
     new_caches = []
     for block, cache in zip(params["blocks"], caches):
-        x, cache = _block_step(block, x, cache, positions, valid, cfg)
+        x, cache = _block_step(block, x, cache, positions, valid, cfg, kv_kernel)
         new_caches.append(cache)
     return _logits(params, x[:, -1:])[:, 0], new_caches
 
 
 def decode_step(params: Params, token: jax.Array, pos: jax.Array, caches: list,
-                cfg: ModelConfig):
+                cfg: ModelConfig, kv_kernel: bool = True):
     """One token (B,) at position `pos` (traced scalar). Returns
     (next-token logits (B, vocab), updated caches)."""
     max_len = caches[0]["k"].shape[1]
@@ -222,7 +249,7 @@ def decode_step(params: Params, token: jax.Array, pos: jax.Array, caches: list,
     x = params["embed"].astype(cfg.compute_dtype)[token[:, None]]
     new_caches = []
     for block, cache in zip(params["blocks"], caches):
-        x, cache = _block_step(block, x, cache, positions, valid, cfg)
+        x, cache = _block_step(block, x, cache, positions, valid, cfg, kv_kernel)
         new_caches.append(cache)
     return _logits(params, x)[:, 0], new_caches
 
@@ -249,11 +276,21 @@ def _filter_logits(logits: jax.Array, top_k: int, top_p: float) -> jax.Array:
     return logits
 
 
-@partial(jax.jit, static_argnames=("cfg", "steps", "temperature", "top_k", "top_p",
-                                   "kv_quant"))
+def _multi_device(params: Params) -> bool:
+    """True when any param leaf is laid out across more than one device —
+    decidable only OUTSIDE jit (tracers carry no sharding), which is why
+    generate keeps its auto-detect in a thin unjitted wrapper."""
+    for leaf in jax.tree.leaves(params):
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None and len(sharding.device_set) > 1:
+            return True
+    return False
+
+
 def generate(params: Params, prompt: jax.Array, cfg: ModelConfig, steps: int,
              temperature: float = 0.0, key: jax.Array | None = None,
-             top_k: int = 0, top_p: float = 1.0, kv_quant: bool = False):
+             top_k: int = 0, top_p: float = 1.0, kv_quant: bool = False,
+             kv_kernel: bool | None = None):
     """Greedy (temperature == 0) or sampled generation, with optional
     top-k and/or nucleus (top-p) filtering of the sampled distribution.
 
@@ -261,15 +298,37 @@ def generate(params: Params, prompt: jax.Array, cfg: ModelConfig, steps: int,
     cache is sized S + steps; the whole thing — prefill plus a
     `lax.scan` of decode steps — is one jit (one compile per
     (shape, steps) pair). kv_quant=True decodes from an int8 KV cache
-    (see init_cache) — half the cache bandwidth per step.
+    (see init_cache) — half the cache bandwidth per step, streamed by
+    the Pallas decode-attention kernel when the cache length tiles.
+
+    kv_kernel defaults to AUTO: on for single-device params, OFF when
+    the params are laid out across a multi-device mesh — GSPMD cannot
+    partition a pallas_call (it would all-gather the cache and run the
+    kernel replicated), while the einsum path partitions normally.
+    Pass True/False to override either way.
     """
+    if kv_kernel is None:
+        kv_kernel = not _multi_device(params)
+    # Statics must go by keyword: jax.jit's static_argnames does not
+    # match positionally-passed arguments.
+    return _generate(params, prompt, cfg=cfg, steps=steps,
+                     temperature=temperature, key=key, top_k=top_k,
+                     top_p=top_p, kv_quant=kv_quant, kv_kernel=kv_kernel)
+
+
+@partial(jax.jit, static_argnames=("cfg", "steps", "temperature", "top_k", "top_p",
+                                   "kv_quant", "kv_kernel"))
+def _generate(params: Params, prompt: jax.Array, cfg: ModelConfig, steps: int,
+              temperature: float = 0.0, key: jax.Array | None = None,
+              top_k: int = 0, top_p: float = 1.0, kv_quant: bool = False,
+              kv_kernel: bool = True):
     if steps < 1:
         raise ValueError(f"steps must be >= 1, got {steps}")
     if not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     b, s = prompt.shape
     caches = init_cache(cfg, b, s + steps, quantized=kv_quant)
-    logits, caches = prefill(params, prompt, caches, cfg)
+    logits, caches = prefill(params, prompt, caches, cfg, kv_kernel)
     if key is None:
         key = jax.random.PRNGKey(0)
 
@@ -287,7 +346,7 @@ def generate(params: Params, prompt: jax.Array, cfg: ModelConfig, steps: int,
     def step(carry, i):
         token, caches, key = carry
         key, sub = jax.random.split(key)
-        logits, caches = decode_step(params, token, s + i, caches, cfg)
+        logits, caches = decode_step(params, token, s + i, caches, cfg, kv_kernel)
         nxt = pick(logits, sub)
         return (nxt, caches, key), token
 

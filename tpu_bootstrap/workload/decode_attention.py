@@ -1,0 +1,162 @@
+"""Pallas decode-attention kernel over the int8 KV cache — the
+single-query attention of a decode step, streamed at 1 byte/element.
+
+Why a kernel: a decode step's attention reads the ENTIRE cache to score
+one query, so past short contexts it is the step's dominant HBM read
+(at seq 8k the cache outweighs even the int8 weights). The einsum path
+dequantizes the int8 cache into bf16 arrays first (decode._dequantize_kv)
+and then trusts XLA to fuse that convert-and-scale into the two score
+einsums; whether the fusion actually lands is compiler-version-dependent,
+and when it does not, the step streams the cache THREE times (int8 read,
+bf16 write, bf16 read). This kernel makes the 1-byte stream structural:
+the int8 tile is dequantized in VMEM registers on its way into the MXU,
+and the only HBM traffic is the int8 values + one f32 scale per cached
+vector.
+
+Layout/grid design (mirrors flash_attention.py's streamed formulation):
+* Grid (batch x kv_heads, L tiles); the L axis is the innermost
+  "arbitrary" (sequential) axis so Mosaic double-buffers cache tiles
+  HBM->VMEM while the MXU works on the previous tile.
+* The cache keeps its native (B, L, Hk, D) layout — no transpose copies;
+  the BlockSpec index map picks the (b, hk) plane per grid row.
+* GQA is native: the query's (group, D) rows for one KV head ride
+  together, so each cache tile is read ONCE at the true KV head count.
+* Online softmax state (m, l, acc) in VMEM scratch across L tiles —
+  numerically identical (up to f32 rounding) to the masked softmax the
+  einsum path computes.
+* The validity mask arrives as an additive (0 / -1e30) bias row — a
+  runtime input, not a static python value, because the cache length a
+  step may see grows every step under `lax.scan`.
+
+Reference parity note: the reference (bacchus-gpu-controller) has no
+compute path (SURVEY.md §2); this module extends the serving half of the
+JAX workload its JobSets launch.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -1e30
+
+
+def _interpret_default() -> bool:
+    try:
+        return jax.default_backend() not in ("tpu", "axon")
+    except Exception:  # noqa: BLE001
+        return True
+
+
+def _kernel(q_ref, k_ref, ks_ref, v_ref, vs_ref, bias_ref, o_ref,
+            m_scr, l_scr, acc_scr, *, sm_scale):
+    j = pl.program_id(1)
+    num_l = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[:].astype(jnp.float32) * sm_scale  # (g_pad, D)
+    # Dequant in VMEM: the int8 tile never exists in HBM at 2 bytes.
+    k = k_ref[:].astype(jnp.float32) * ks_ref[:]  # (bl, D) * (bl, 1)
+    v = v_ref[:].astype(jnp.float32) * vs_ref[:]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # (g_pad, bl)
+    s = s + bias_ref[0:1, :]  # invalid cache slots carry -1e30
+
+    m = m_scr[:]
+    m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new)
+    m_scr[:] = m_new
+    l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(j == num_l - 1)
+    def _finalize():
+        o_ref[:] = (acc_scr[:] / l_scr[:]).astype(o_ref.dtype)
+
+
+def _pick_block(length: int) -> int | None:
+    """Largest int8-tileable L block that divides the cache length (the
+    cache is NOT padded — padding would copy the whole cache in HBM)."""
+    for bl in (512, 256, 128, 64, 32):
+        if length % bl == 0:
+            return bl
+    return None
+
+
+def supports(length: int) -> bool:
+    return _pick_block(length) is not None
+
+
+def decode_attention_int8(q: jax.Array, kq: jax.Array, ks: jax.Array,
+                          vq: jax.Array, vs: jax.Array, valid: jax.Array,
+                          *, interpret: bool | None = None) -> jax.Array:
+    """Single-position attention over the quantized cache.
+
+    q: (B, H, D) — the one decode-step query, any float dtype.
+    kq/vq: (B, L, Hk, D) int8; ks/vs: (B, L, Hk) f32 per-vector scales
+    (decode.init_cache quantized=True layout, H % Hk == 0).
+    valid: (L,) bool — which cache slots the query may see.
+    Returns (B, H, D) in q.dtype.
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    b, h, d = q.shape
+    _, length, kv_heads, _ = kq.shape
+    group = h // kv_heads
+    bl = _pick_block(length)
+    if bl is None:
+        raise ValueError(
+            f"cache length {length} has no 32-multiple block divisor; "
+            "gate direct calls on supports(length) — decode._block_step "
+            "does, falling back to its einsum path")
+
+    g_pad = max(8, -(-group // 8) * 8)
+    q4 = q.reshape(b, kv_heads, group, d)
+    if g_pad != group:
+        q4 = jnp.pad(q4, ((0, 0), (0, 0), (0, g_pad - group), (0, 0)))
+    q3 = q4.reshape(b * kv_heads, g_pad, d)
+
+    bias = jnp.where(valid, 0.0, _NEG).astype(jnp.float32)
+    bias8 = jnp.broadcast_to(bias, (8, length))  # (8, L): sublane-tileable
+    ks4 = ks.astype(jnp.float32)[..., None]  # (B, L, Hk, 1)
+    vs4 = vs.astype(jnp.float32)[..., None]
+
+    hk = kv_heads
+    cache_idx = lambda r, j: (r // hk, j, r % hk, 0)  # noqa: E731
+    out = pl.pallas_call(
+        functools.partial(_kernel, sm_scale=d ** -0.5),
+        grid=(b * kv_heads, length // bl),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        in_specs=[
+            pl.BlockSpec((None, g_pad, d), lambda r, j: (r, 0, 0)),
+            pl.BlockSpec((None, bl, None, d), cache_idx),
+            pl.BlockSpec((None, bl, None, 1), cache_idx),
+            pl.BlockSpec((None, bl, None, d), cache_idx),
+            pl.BlockSpec((None, bl, None, 1), cache_idx),
+            pl.BlockSpec((8, bl), lambda r, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((None, g_pad, d), lambda r, j: (r, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * kv_heads, g_pad, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((g_pad, 1), jnp.float32),
+            pltpu.VMEM((g_pad, 1), jnp.float32),
+            pltpu.VMEM((g_pad, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, kq, ks4, vq, vs4, bias8)
+    return out.reshape(b, kv_heads, g_pad, d)[:, :, :group].reshape(b, h, d)
+
+
+__all__ = ["decode_attention_int8", "supports"]

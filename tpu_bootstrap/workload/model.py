@@ -53,6 +53,13 @@ class ModelConfig:
     expert_top_k: int = 2
     expert_capacity_factor: float = 2.0
     moe_aux_coef: float = 0.01
+    # Chunked cross-entropy head (workload/xent.py): > 0 streams the loss
+    # over vocab chunks of this size instead of materializing the
+    # (batch, seq, vocab) logits — the largest tensor of the train step at
+    # LM vocab sizes. 0 keeps the dense head. Must divide vocab_size.
+    # Honored by loss_from_inputs AND both pipeline schedules' loss heads
+    # (pipeline._head_nll); forward/generate still produce real logits.
+    vocab_chunk: int = 0
 
     @property
     def qkv_dim(self) -> int:
@@ -206,13 +213,15 @@ def _mlp(block: Params, x: jax.Array, cfg: ModelConfig, linear=_default_linear) 
     return linear(h, block["w_down"], 1, dtype)
 
 
-def forward_with_aux(params: Params, tokens: jax.Array, cfg: ModelConfig,
-                     attn_fn=None) -> tuple[jax.Array, jax.Array]:
-    """tokens (batch, seq) int32 -> (logits (batch, seq, vocab), aux).
+def hidden_with_aux(params: Params, tokens: jax.Array, cfg: ModelConfig,
+                    attn_fn=None) -> tuple[jax.Array, jax.Array]:
+    """tokens (batch, seq) int32 -> (final-normed hidden states
+    (batch, seq, embed), aux) — the whole model up to (not including) the
+    tied-embedding head. Split out so the chunked-xent loss path can
+    consume the hidden states without logits ever materializing.
 
     ``aux`` is the mean MoE load-balancing loss over blocks (0.0 for the
-    dense model) — kept separate from the logits so the dense-path API
-    (``forward``) stays unchanged."""
+    dense model)."""
     dtype = cfg.compute_dtype
     x = params["embed"].astype(dtype)[tokens]
     aux = jnp.zeros((), jnp.float32)
@@ -225,10 +234,30 @@ def forward_with_aux(params: Params, tokens: jax.Array, cfg: ModelConfig,
             aux = aux + aux_b / len(params["blocks"])
         else:
             x = x + _mlp(block, x, cfg)
-    x = _rms_norm(x, params["final_norm"])
-    # logits in float32 for a numerically stable softmax/xent
-    logits = jnp.einsum("bse,ve->bsv", x.astype(jnp.float32), params["embed"])
-    return logits, aux
+    return _rms_norm(x, params["final_norm"]), aux
+
+
+def head_logits(x: jax.Array, embed: jax.Array) -> jax.Array:
+    """The tied-embedding head matmul: x (..., S, E) against embed
+    (V, E) -> f32 logits. ONE definition of the recipe — operands in x's
+    (compute) dtype, f32 accumulation — shared by the dense head here,
+    the pipeline loss head (pipeline._head_nll), and the chunked-xent
+    head (xent._chunk_logits), whose to-f32-round-off parity guarantees
+    all assume the identical recipe. Logits land in float32 for a
+    numerically stable softmax/xent, but the MATMUL runs in the compute
+    dtype: a true-f32 head matmul is emulated on the MXU as multiple
+    bf16 passes, and at LM vocab sizes the head is ~a quarter of the
+    model's FLOPs — bf16-operands/f32-accumulate runs it at native MXU
+    rate, and f32 operands are bit-identical to a plain f32 matmul."""
+    return jnp.einsum("bse,ve->bsv", x, embed.astype(x.dtype),
+                      preferred_element_type=jnp.float32)
+
+
+def forward_with_aux(params: Params, tokens: jax.Array, cfg: ModelConfig,
+                     attn_fn=None) -> tuple[jax.Array, jax.Array]:
+    """tokens (batch, seq) int32 -> (logits (batch, seq, vocab), aux)."""
+    x, aux = hidden_with_aux(params, tokens, cfg, attn_fn)
+    return head_logits(x, params["embed"]), aux
 
 
 def forward(params: Params, tokens: jax.Array, cfg: ModelConfig, attn_fn=None) -> jax.Array:
@@ -244,11 +273,21 @@ def loss_from_inputs(params: Params, inputs: jax.Array, targets: jax.Array,
     Split out from loss_fn so the train step can shift tokens itself and
     pin shardings on the shifted int32 arrays (sequence parallelism needs
     inputs/targets sharded over the seq axis; the unshifted tokens are one
-    element too long to tile)."""
-    logits, aux = forward_with_aux(params, inputs, cfg, attn_fn)
-    logprobs = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
-    loss = jnp.mean(nll)
+    element too long to tile).
+
+    cfg.vocab_chunk > 0 streams the head over vocab chunks
+    (workload/xent.py) — same value and gradients to f32 round-off, never
+    materializing the (batch, seq, vocab) logits."""
+    if cfg.vocab_chunk > 0:
+        from tpu_bootstrap.workload.xent import chunked_mean_xent
+
+        x, aux = hidden_with_aux(params, inputs, cfg, attn_fn)
+        loss = chunked_mean_xent(x, params["embed"], targets, cfg.vocab_chunk)
+    else:
+        logits, aux = forward_with_aux(params, inputs, cfg, attn_fn)
+        logprobs = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
+        loss = jnp.mean(nll)
     if cfg.num_experts > 0:
         loss = loss + cfg.moe_aux_coef * aux
     return loss

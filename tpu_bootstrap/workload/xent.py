@@ -1,0 +1,147 @@
+"""Chunked (flash-style) cross-entropy head — the LM loss without ever
+materializing the (batch, seq, vocab) logits tensor.
+
+Why: at LM vocab sizes the logits are the largest tensor in the whole
+train step — (B, S, V) float32 is ~1 GB at the single-chip bench shape
+and ~4 GB at seq 8192 — and the naive head writes them, reads them for
+log_softmax, and keeps them (or rematerializes the matmul) for the
+backward. All of that is HBM traffic and live memory for a tensor whose
+only consumers are a reduction (logsumexp) and a gather (the target
+logit).
+
+TPU-first design: a `lax.scan` over vocab chunks. The forward computes
+each chunk's logits on the MXU (compute-dtype operands, f32
+accumulation — the same recipe as the dense head), folds them into a
+running online logsumexp (the flash-attention rescaling trick, exact in
+f32), gathers the target logit when it falls in the chunk, and DROPS the
+chunk. Live memory is one (B, S, chunk) block instead of (B, S, V);
+residuals for the backward are O(B*S): the hidden states, the lse, and
+the targets. The backward re-runs the chunk matmul (one extra head
+matmul of FLOPs — cheap on the MXU) and forms d_hidden and d_embed
+chunk-by-chunk; the full softmax never exists in HBM.
+
+The chunk loop is a sequential `lax.scan` (static trip count, XLA
+pipelines the matmuls); chunk size trades live memory against per-chunk
+matmul efficiency — anything >= 2048 keeps the MXU saturated.
+
+Numerics: identical accumulation dtype (f32) as the dense head;
+logsumexp-with-rescaling equals log(sum(exp)) exactly up to f32
+rounding, so value AND gradients match the dense path to float32
+round-off (tested in tests/test_xent.py).
+
+Reference parity note: the reference (bacchus-gpu-controller) has no
+compute path (SURVEY.md §2); this module extends the training half of
+the JAX workload its JobSets launch.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _chunks(embed: jax.Array, chunk: int):
+    """(V, E) -> (V/chunk, chunk, E) plus the chunk start offsets."""
+    v = embed.shape[0]
+    if chunk < 1 or v % chunk != 0:
+        raise ValueError(
+            f"vocab_chunk ({chunk}) must be a positive divisor of the "
+            f"vocab size ({v})")
+    n = v // chunk
+    return embed.reshape(n, chunk, embed.shape[1]), jnp.arange(n) * chunk
+
+
+def _chunk_logits(x: jax.Array, emb_c: jax.Array) -> jax.Array:
+    """(B, S, E) @ (C, E)^T -> (B, S, C) f32, through the ONE shared
+    head-matmul recipe (model.head_logits) so the chunked head's parity
+    with the dense head cannot drift."""
+    from tpu_bootstrap.workload.model import head_logits
+
+    return head_logits(x, emb_c)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def chunked_nll(x: jax.Array, embed: jax.Array, targets: jax.Array,
+                chunk: int) -> jax.Array:
+    """Per-position negative log-likelihood of ``targets`` under the
+    tied-embedding head, streamed over vocab chunks.
+
+    x: (B, S, E) final-normed hidden states (compute dtype).
+    embed: (V, E) float master embedding (V % chunk == 0).
+    targets: (B, S) int32.
+    Returns nll (B, S) float32 == logsumexp(logits) - logits[target],
+    bit-comparable to the dense head's log_softmax gather up to f32
+    rounding.
+    """
+    nll, _ = _fwd(x, embed, targets, chunk)
+    return nll
+
+
+def _fwd(x, embed, targets, chunk):
+    emb, offsets = _chunks(embed, chunk)
+    b, s, _ = x.shape
+    neg = jnp.full((b, s), -jnp.inf, jnp.float32)
+
+    def body(carry, xs):
+        m, acc, tgt = carry
+        emb_c, off = xs
+        logits = _chunk_logits(x, emb_c)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        # Rescale the running sum onto the new max (exp(-inf - m) == 0 on
+        # the first chunk: the acc starts empty).
+        acc = acc * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[..., None]), axis=-1)
+        idx = jnp.clip(targets - off, 0, chunk - 1)
+        val = jnp.take_along_axis(logits, idx[..., None], axis=-1)[..., 0]
+        in_chunk = (targets >= off) & (targets < off + chunk)
+        tgt = jnp.where(in_chunk, val, tgt)
+        return (m_new, acc, tgt), None
+
+    (m, acc, tgt), _ = lax.scan(
+        body, (neg, jnp.zeros((b, s), jnp.float32), neg), (emb, offsets))
+    lse = m + jnp.log(acc)
+    return lse - tgt, (x, embed, targets, lse)
+
+
+def _bwd(chunk, res, g):
+    """g: (B, S) cotangent of the nll. dlogits = g * (softmax - onehot),
+    formed and consumed one chunk at a time."""
+    x, embed, targets, lse = res
+    emb, offsets = _chunks(embed, chunk)
+
+    def body(dx, xs):
+        emb_c, off = xs
+        logits = _chunk_logits(x, emb_c)
+        probs = jnp.exp(logits - lse[..., None])
+        onehot = (targets[..., None] == (off + jnp.arange(chunk))).astype(
+            jnp.float32)
+        dlogits = g[..., None] * (probs - onehot)  # (B, S, C) f32
+        dx = dx + jnp.einsum("bsv,ve->bse", dlogits.astype(x.dtype),
+                             emb_c.astype(x.dtype),
+                             preferred_element_type=jnp.float32)
+        demb_c = jnp.einsum("bsv,bse->ve", dlogits.astype(x.dtype), x,
+                            preferred_element_type=jnp.float32)
+        return dx, demb_c
+
+    dx, demb = lax.scan(
+        body, jnp.zeros(x.shape[:2] + (x.shape[-1],), jnp.float32),
+        (emb, offsets))
+    return (dx.astype(x.dtype), demb.reshape(embed.shape).astype(embed.dtype),
+            None)
+
+
+chunked_nll.defvjp(_fwd, _bwd)
+
+
+def chunked_mean_xent(x: jax.Array, embed: jax.Array, targets: jax.Array,
+                      chunk: int) -> jax.Array:
+    """Mean token cross-entropy over all positions — the drop-in
+    replacement for log_softmax + take_along_axis + mean in
+    model.loss_from_inputs."""
+    return jnp.mean(chunked_nll(x, embed, targets, chunk))
+
+
+__all__ = ["chunked_nll", "chunked_mean_xent"]
