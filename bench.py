@@ -463,8 +463,54 @@ try:
             100 * (6 * ln * ltoks + lattn) / (cms / 1e3) / PEAK_BF16, 2),
         "chunked_xent_speedup_seq%d" % LSEQ: round(lms / cms, 3),
     })
+    del cparams, copt, cstep  # free the train state before the decode section
 except Exception as e:  # noqa: BLE001
     out["longctx_bench_error"] = f"{type(e).__name__}: {e}"[:400]
+emit()
+
+# Long-context DECODE: per-step cost against a fixed 4096-slot cache —
+# the regime where the cache, not the weights, is the step's dominant
+# HBM read (bf16 cache ~1 GB at batch 8 vs 268 MB of weights). Compares
+# the bf16 einsum baseline against the full int8 serving stack: int8
+# weights + int8 KV cache streamed by the Pallas decode-attention
+# kernel. Uses prefill + a fixed-length scan of decode_steps directly
+# (generate sizes its cache to prompt+steps, which would change L
+# between measurements).
+try:
+    from tpu_bootstrap.workload.decode import decode_step, init_cache, prefill
+
+    DL = 4096
+    dlb = 8
+
+    def longctx_step_ms(params, quantized):
+        caches = init_cache(dcfg, dlb, DL, quantized=quantized)
+        _, caches = prefill(params, dprompt, caches, dcfg)
+
+        @jax.jit
+        def run(tok, caches):
+            def body(carry, i):
+                tok, caches = carry
+                logits, caches = decode_step(params, tok, 64 + i, caches, dcfg)
+                return (jnp.argmax(logits, -1).astype(tok.dtype), caches), ()
+            (tok, caches), _ = lax.scan(body, (tok, caches), jnp.arange(64))
+            return tok
+
+        tok0 = dprompt[:, -1]
+        int(run(tok0, caches)[0])  # compile + warm
+        t0 = time.time()
+        int(run(tok0, caches)[0])
+        return (time.time() - t0) / 64 * 1e3
+
+    base_ms = longctx_step_ms(dparams, quantized=False)
+    q_ms = longctx_step_ms(qparams, quantized=True)
+    out.update({
+        "decode_L%d_step_ms" % DL: round(base_ms, 3),
+        "decode_L%d_tokens_per_sec" % DL: round(dlb / (base_ms / 1e3), 1),
+        "decode_L%d_int8kv_kernel_step_ms" % DL: round(q_ms, 3),
+        "decode_L%d_int8kv_kernel_speedup" % DL: round(base_ms / q_ms, 3),
+    })
+except Exception as e:  # noqa: BLE001
+    out["decode_longctx_bench_error"] = f"{type(e).__name__}: {e}"[:400]
 emit()
 """
 

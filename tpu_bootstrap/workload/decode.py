@@ -276,15 +276,20 @@ def _filter_logits(logits: jax.Array, top_k: int, top_p: float) -> jax.Array:
     return logits
 
 
-def _multi_device(params: Params) -> bool:
-    """True when any param leaf is laid out across more than one device —
-    decidable only OUTSIDE jit (tracers carry no sharding), which is why
-    generate keeps its auto-detect in a thin unjitted wrapper."""
+def _multi_device(params: Params) -> bool | None:
+    """True when any param leaf is laid out across more than one device,
+    False when all leaves are concrete single-device arrays, None when
+    the layout is UNKNOWABLE (a tracer leaf — generate called inside an
+    outer jit, where arrays carry no committed sharding)."""
+    unknown = False
     for leaf in jax.tree.leaves(params):
+        if isinstance(leaf, jax.core.Tracer):
+            unknown = True
+            continue
         sharding = getattr(leaf, "sharding", None)
         if sharding is not None and len(sharding.device_set) > 1:
             return True
-    return False
+    return None if unknown else False
 
 
 def generate(params: Params, prompt: jax.Array, cfg: ModelConfig, steps: int,
@@ -304,11 +309,14 @@ def generate(params: Params, prompt: jax.Array, cfg: ModelConfig, steps: int,
     kv_kernel defaults to AUTO: on for single-device params, OFF when
     the params are laid out across a multi-device mesh — GSPMD cannot
     partition a pallas_call (it would all-gather the cache and run the
-    kernel replicated), while the einsum path partitions normally.
-    Pass True/False to override either way.
+    kernel replicated), while the einsum path partitions normally. AUTO
+    also resolves to OFF when the layout is unknowable (generate called
+    inside an outer jit: tracer params carry no sharding) — the safe
+    default; single-device serving wrapped in an outer jit should pass
+    kv_kernel=True explicitly. Pass True/False to override either way.
     """
     if kv_kernel is None:
-        kv_kernel = not _multi_device(params)
+        kv_kernel = _multi_device(params) is False
     # Statics must go by keyword: jax.jit's static_argnames does not
     # match positionally-passed arguments.
     return _generate(params, prompt, cfg=cfg, steps=steps,

@@ -42,14 +42,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# One definition of backend detection (incl. the axon tunneled-PJRT
+# case) — a backend added to one kernel's allowlist but not another's
+# would silently run that kernel in interpret mode on real hardware.
+from tpu_bootstrap.workload.flash_attention import _interpret_default
+
 _NEG = -1e30
-
-
-def _interpret_default() -> bool:
-    try:
-        return jax.default_backend() not in ("tpu", "axon")
-    except Exception:  # noqa: BLE001
-        return True
 
 
 def _kernel(q_ref, k_ref, ks_ref, v_ref, vs_ref, bias_ref, o_ref,

@@ -1,0 +1,207 @@
+"""Speculative decoding — a draft model proposes, the target verifies,
+greedy output is EXACTLY the target model's own.
+
+Why it fits TPU serving: autoregressive decode is HBM-bandwidth-bound —
+each step streams all target weights to emit ONE token. Speculation
+turns that stream into gamma+1 tokens of work: a small draft model runs
+gamma cheap steps, then the target scores all gamma+1 candidate
+positions in a single forward (one weight stream, MXU-batched over the
+candidate chunk). Accepted prefix lengths of 2-4 are typical for a
+well-matched draft, cutting target weight traffic per token by the same
+factor.
+
+TPU-first shape discipline:
+* Every loop iteration does the SAME static-shape work — gamma draft
+  steps (a `lax.scan`) and one (gamma+1)-token target verify chunk —
+  inside a `lax.while_loop` that runs until `steps` tokens are
+  committed. No data-dependent shapes anywhere; acceptance only moves
+  indices.
+* Acceptance is LOCKSTEP across the batch: the iteration commits
+  c = min over rows of (accepted + 1) tokens, so cache positions stay
+  identical across rows (one dynamic_update_slice start, one causal
+  mask). Rows that would have accepted more simply re-verify those
+  tokens next round — throughput cost only, never correctness: each
+  row's committed tokens are ITS OWN target argmaxes, so the output is
+  bit-identical to `decode.generate`'s greedy path for every row (the
+  equivalence the tests pin, draft quality irrelevant).
+* Speculated-but-rejected cache entries are left in place: the causal
+  masks (`valid = column <= position`) already exclude everything past
+  the committed frontier, and the next feed overwrites them — no
+  rollback copies of the cache.
+
+Exactness fine print under kv_quant: the TARGET runs here only through
+multi-query chunks (prefill, the gamma+1 verify), which always take the
+einsum attention path — so the bit-for-bit guarantee is against
+`generate(..., kv_kernel=False)`. Plain `generate` may route its
+single-query steps through the Pallas decode-attention kernel, whose
+online softmax rounds differently at f32 round-off; a near-tie argmax
+could in principle flip between the two implementations. (The draft's
+own steps may use the kernel freely — draft numerics never affect
+committed tokens.)
+
+Greedy only (temperature 0): sampled speculative decoding needs the
+rejection-resampling scheme to keep the target distribution; the greedy
+case is where the exactness guarantee is checkable bit-for-bit, and is
+the serving default here.
+
+Reference parity note: the reference (bacchus-gpu-controller) has no
+compute path (SURVEY.md §2); this module extends the serving half of
+the JAX workload its JobSets launch.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_bootstrap.workload.decode import (
+    _logits,
+    _multi_device,
+    decode_step,
+    init_cache,
+    prefill,
+)
+from tpu_bootstrap.workload.model import ModelConfig, Params
+
+
+def _verify_chunk(params: Params, tokens: jax.Array, pos, caches: list,
+                  cfg: ModelConfig, kv_kernel: bool):
+    """Run a (B, C) chunk of candidate tokens through the target at
+    positions pos..pos+C-1 (traced start), returning logits for EVERY
+    chunk position — the multi-query analogue of decode_step."""
+    b, c = tokens.shape
+    max_len = caches[0]["k"].shape[1]
+    positions = pos + jnp.arange(c)
+    # Chunk row i may see cache columns 0..pos+i.
+    valid = jnp.arange(max_len)[None, :] <= positions[:, None]
+    x = params["embed"].astype(cfg.compute_dtype)[tokens]
+    from tpu_bootstrap.workload.decode import _block_step
+
+    new_caches = []
+    for block, cache in zip(params["blocks"], caches):
+        x, cache = _block_step(block, x, cache, positions, valid, cfg, kv_kernel)
+        new_caches.append(cache)
+    return _logits(params, x), new_caches  # (B, C, vocab)
+
+
+@partial(jax.jit, static_argnames=("target_cfg", "draft_cfg", "steps", "gamma",
+                                   "kv_quant", "kv_kernel"))
+def _speculative(target_params, draft_params, prompt, target_cfg, draft_cfg,
+                 steps, gamma, kv_quant, kv_kernel):
+    b, s = prompt.shape
+    cap = s + steps + gamma + 1  # slack: the last iteration may overshoot
+    tcaches = init_cache(target_cfg, b, cap, quantized=kv_quant)
+    dcaches = init_cache(draft_cfg, b, cap, quantized=kv_quant)
+    tlogits, tcaches = prefill(target_params, prompt, tcaches, target_cfg, kv_kernel)
+    _, dcaches = prefill(draft_params, prompt, dcaches, draft_cfg, kv_kernel)
+
+    dt = prompt.dtype
+    first = jnp.argmax(tlogits, axis=-1).astype(dt)  # exact: target's own
+    out = jnp.zeros((b, steps + gamma + 1), dt)
+    out = out.at[:, 0].set(first)
+
+    # State: tokens committed so far (n_out), the next cache slot to fill
+    # (pos — the position of `last`, the newest committed-but-unprocessed
+    # token), both identical across rows by lockstep construction.
+    def cond(state):
+        return state[0] < steps
+
+    def body(state):
+        n_out, pos, last, out, tcaches, dcaches, n_iter = state
+
+        def draft_one(carry, i):
+            tok, caches = carry
+            logits, caches = decode_step(draft_params, tok, pos + i, caches,
+                                         draft_cfg, kv_kernel)
+            nxt = jnp.argmax(logits, axis=-1).astype(dt)
+            return (nxt, caches), nxt
+
+        # gamma+1 draft steps for gamma proposals: the extra step feeds
+        # the LAST proposal through the draft so its KV lands in slot
+        # pos+gamma. Without it, a full-acceptance round (commit ==
+        # gamma+1) would leave that slot zero forever — inside every
+        # later validity mask — and each such round would add another
+        # zero-KV hole the draft attends to, collapsing acceptance. The
+        # extra step's own proposal is discarded; on partial acceptance
+        # its cache write is stale-beyond-frontier like any rejected
+        # slot (masked, later overwritten).
+        (_, dcaches2), drafts = lax.scan(draft_one, (last, dcaches),
+                                         jnp.arange(gamma + 1))
+        drafts = drafts.swapaxes(0, 1)[:, :gamma]  # (B, gamma)
+
+        chunk = jnp.concatenate([last[:, None], drafts], axis=1)  # (B, gamma+1)
+        vlogits, tcaches2 = _verify_chunk(target_params, chunk, pos, tcaches,
+                                          target_cfg, kv_kernel)
+        greedy = jnp.argmax(vlogits, axis=-1).astype(dt)  # (B, gamma+1)
+        # greedy[:, i] is the target's next token after chunk[:, i];
+        # draft token drafts[:, i] == chunk[:, i+1] is accepted iff it
+        # matches greedy[:, i]. Count the matching prefix per row, then
+        # commit lockstep at the batch minimum.
+        match = drafts == greedy[:, :-1]  # (B, gamma)
+        accepted = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+        commit = jnp.min(accepted) + 1  # 1..gamma+1 committed tokens
+
+        # Write all gamma+1 candidate commits at n_out; only the first
+        # `commit` are real — the next iteration's write (at n_out +
+        # commit) overwrites the tail. Rows beyond their own acceptance
+        # still hold THEIR target argmaxes (exactness preserved).
+        out = lax.dynamic_update_slice(out, greedy, (0, n_out))
+        last2 = jnp.take_along_axis(greedy, jnp.full((b, 1), commit - 1), axis=1)[:, 0]
+        return (n_out + commit, pos + commit, last2, out, tcaches2, dcaches2,
+                n_iter + 1)
+
+    n_out, _, _, out, _, _, n_iter = lax.while_loop(
+        cond, body,
+        (jnp.int32(1), jnp.int32(s), first, out, tcaches, dcaches, jnp.int32(0)))
+    # Mean committed tokens per verify round (1..gamma+1) — the
+    # acceptance telemetry serving wants; the first token is free
+    # (prefill), hence steps - 1.
+    stats = {"verify_rounds": n_iter,
+             "mean_committed": (steps - 1) / jnp.maximum(n_iter, 1)}
+    return out[:, :steps], stats
+
+
+def speculative_generate(target_params: Params, draft_params: Params,
+                         prompt: jax.Array, target_cfg: ModelConfig,
+                         draft_cfg: ModelConfig, steps: int, gamma: int = 4,
+                         kv_quant: bool = False,
+                         kv_kernel: bool | None = None,
+                         with_stats: bool = False):
+    """Greedy generation of (B, steps) continuations, bit-identical to
+    `decode.generate(target_params, ...)`'s greedy output for every row,
+    at up to (gamma+1)x fewer target weight streams per token.
+
+    gamma: draft tokens proposed per verify chunk. kv_quant/kv_kernel as
+    in decode.generate (kv_kernel AUTO-disables on multi-device params).
+    A cheap high-acceptance draft needs no second model: the target's
+    own int8 copy (quant.quantize_params) rarely flips an argmax, so
+    self-speculation accelerates the bf16 target with its quantized
+    shadow — and exactness holds regardless.
+
+    with_stats=True additionally returns {"verify_rounds",
+    "mean_committed"} — committed tokens per verify round is the
+    acceptance telemetry (gamma+1 = every proposal accepted).
+    """
+    if steps < 1:
+        raise ValueError(f"steps must be >= 1, got {steps}")
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    if target_cfg.vocab_size != draft_cfg.vocab_size:
+        raise ValueError(
+            f"target and draft must share a vocab: {target_cfg.vocab_size} "
+            f"vs {draft_cfg.vocab_size}")
+    if kv_kernel is None:
+        # Kernel only when BOTH layouts are known single-device (None =
+        # unknowable under an outer jit -> safe off, as in generate).
+        kv_kernel = (_multi_device(target_params) is False
+                     and _multi_device(draft_params) is False)
+    out, stats = _speculative(target_params, draft_params, prompt, target_cfg,
+                              draft_cfg, steps=steps, gamma=gamma,
+                              kv_quant=kv_quant, kv_kernel=kv_kernel)
+    return (out, stats) if with_stats else out
+
+
+__all__ = ["speculative_generate"]
