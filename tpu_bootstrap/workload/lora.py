@@ -1,0 +1,168 @@
+"""LoRA fine-tuning — low-rank adapters over a frozen base model.
+
+Why it fits the slice workload: fine-tuning a shared base on a quota'd
+TPU slice is the classic tenant job this controller provisions. LoRA
+reparameterizes each targeted projection as w + (alpha/r) * A @ B with
+A (in, r), B (r, out), r << min(in, out): the optimizer sees only the
+adapters (~1% of the params), so Adam moments shrink by the same factor
+— the HBM that frees is exactly what lets a bigger base model fit one
+slice — and the frozen base can stay in bf16.
+
+TPU-first design:
+* Merge-on-the-fly: the train step materializes each targeted
+  projection's effective weight as one fused rank-r matmul + add —
+  two tiny MXU ops XLA fuses into the existing projection, no model
+  surgery. The forward is the SAME model code (model.loss_from_inputs)
+  on an effective-params pytree, so every attention core (dense, flash)
+  and every GSPMD sharding axis the train step supports works under
+  LoRA unchanged.
+* Gradients flow only to the adapters: jax.grad differentiates the
+  loss w.r.t. the lora pytree; the base enters as a closed-over
+  constant. No stop_gradient bookkeeping, no optimizer masking — the
+  optimizer never sees base leaves at all.
+* B is zero-initialized (the standard recipe): the adapted model
+  starts exactly equal to the base, so step 0 loss is the base loss —
+  a testable invariant.
+* Serving: merge_lora folds the adapters into the base once,
+  producing plain params for decode.generate / quantize_params —
+  adapters cost nothing at inference.
+
+Pipeline meshes (stacked block layout) are rejected at construction:
+adapters would need the stacked layout and in-schedule gathers; the
+GSPMD axes (dcn/data/fsdp/expert/seq/tensor) all compose.
+
+Reference parity note: the reference (bacchus-gpu-controller) has no
+compute path (SURVEY.md §2); this module extends the training half of
+the JAX workload its JobSets launch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tpu_bootstrap.workload.model import ModelConfig, Params, loss_from_inputs
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    # Which block projections get adapters. Attention q/v is the classic
+    # minimal set; any of wq/wk/wv/wo/w_up/w_down works.
+    targets: tuple = ("wq", "wv")
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+
+def init_lora(params: Params, lcfg: LoraConfig, key: jax.Array) -> Params:
+    """Adapter pytree mirroring params["blocks"]: per block, per target,
+    {"a": (in, r) normal-init, "b": (r, out) ZERO-init} in f32 (adapters
+    train in full precision; they are tiny). Weights with a structured
+    shape (e.g. wq (embed, heads, head_dim)) adapt in 2-D matmul layout
+    (contraction dims flattened, like quant._q2d)."""
+    if lcfg.rank < 1:
+        raise ValueError(f"rank must be >= 1, got {lcfg.rank}")
+    blocks = []
+    keys = jax.random.split(key, max(len(params["blocks"]), 1))
+    for block, bkey in zip(params["blocks"], keys):
+        adapters = {}
+        tkeys = jax.random.split(bkey, len(lcfg.targets))
+        for name, tkey in zip(lcfg.targets, tkeys):
+            if name not in block:
+                raise ValueError(
+                    f"LoRA target {name!r} not in block (have "
+                    f"{sorted(k for k in block if not k.endswith('norm'))})")
+            if "router" in block and name in ("w_up", "w_down"):
+                raise ValueError(
+                    "LoRA on MoE expert stacks is not supported (per-expert "
+                    "adapters would need the (E, K, N) layout); target the "
+                    "attention projections instead")
+            w = block[name]
+            k_in = w.shape[0] if name != "wo" else w.shape[0] * w.shape[1]
+            n_out = w.size // k_in
+            adapters[name] = {
+                "a": jax.random.normal(tkey, (k_in, lcfg.rank), jnp.float32)
+                / jnp.sqrt(jnp.asarray(k_in, jnp.float32)),
+                "b": jnp.zeros((lcfg.rank, n_out), jnp.float32),
+            }
+        blocks.append(adapters)
+    return {"blocks": blocks}
+
+
+def _delta(adapter: dict, w: jax.Array, scale: float) -> jax.Array:
+    """(alpha/r) * A @ B, reshaped to w's logical shape and dtype."""
+    d = (adapter["a"] @ adapter["b"]) * scale
+    return d.reshape(w.shape).astype(w.dtype)
+
+
+def apply_lora(params: Params, lora: Params, lcfg: LoraConfig) -> Params:
+    """Effective params: base + adapter deltas on the targeted leaves.
+    Pure function of both pytrees — under jit the rank-r matmuls fuse
+    into the surrounding projections; nothing else is copied."""
+    blocks = []
+    for block, adapters in zip(params["blocks"], lora["blocks"]):
+        eff = dict(block)
+        for name, adapter in adapters.items():
+            eff[name] = block[name] + _delta(adapter, block[name], lcfg.scale)
+        blocks.append(eff)
+    return {**params, "blocks": blocks}
+
+
+def merge_lora(params: Params, lora: Params, lcfg: LoraConfig) -> Params:
+    """Fold the adapters in permanently (serving: plain params for
+    decode.generate / quant.quantize_params, zero inference cost).
+    Outside jit, apply_lora already returns concrete merged arrays;
+    this alias exists as the serving-intent entry point."""
+    return apply_lora(params, lora, lcfg)
+
+
+def make_lora_train_step(cfg, mesh, base_params: Params, lcfg: LoraConfig,
+                         attn_fn=None):
+    """Returns (jitted step(lora, opt_state, tokens) -> (lora, opt_state,
+    loss), optimizer). The BASE is closed over frozen — the optimizer
+    state exists only for the adapters. cfg is a train.TrainConfig; the
+    mesh must not have a pipe axis (stacked layouts are rejected)."""
+    from tpu_bootstrap.workload.sharding import (batch_shardings,
+                                                 degenerate_mesh, replicated)
+    from tpu_bootstrap.workload.train import make_optimizer
+
+    if mesh.shape.get("pipe", 1) > 1:
+        raise ValueError(
+            "LoRA does not compose with pipeline meshes (adapters would "
+            "need the stacked per-stage layout); use the GSPMD axes "
+            "(data/fsdp/expert/seq/tensor)")
+    opt = make_optimizer(cfg)
+
+    def loss(lora, inputs, targets):
+        eff = apply_lora(base_params, lora, lcfg)
+        return loss_from_inputs(eff, inputs, targets, cfg.model, attn_fn=attn_fn)
+
+    if cfg.remat:
+        loss = jax.checkpoint(loss)
+
+    def step(lora, opt_state, tokens):
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        loss_value, grads = jax.value_and_grad(loss)(lora, inputs, targets)
+        updates, opt_state = opt.update(grads, opt_state, lora)
+        lora = optax.apply_updates(lora, updates)
+        return lora, opt_state, loss_value
+
+    if degenerate_mesh(mesh):
+        return jax.jit(step, donate_argnums=(0, 1)), opt
+    # Adapters are tiny: replicate them; the batch shards as in training.
+    return jax.jit(
+        step,
+        in_shardings=(replicated(mesh), None, batch_shardings(mesh)),
+        out_shardings=(replicated(mesh), None, replicated(mesh)),
+        donate_argnums=(0, 1),
+    ), opt
+
+
+__all__ = ["LoraConfig", "apply_lora", "init_lora", "make_lora_train_step",
+           "merge_lora"]

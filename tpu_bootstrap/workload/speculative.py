@@ -58,6 +58,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from tpu_bootstrap.workload.decode import (
+    _block_step,
     _logits,
     _multi_device,
     decode_step,
@@ -78,8 +79,6 @@ def _verify_chunk(params: Params, tokens: jax.Array, pos, caches: list,
     # Chunk row i may see cache columns 0..pos+i.
     valid = jnp.arange(max_len)[None, :] <= positions[:, None]
     x = params["embed"].astype(cfg.compute_dtype)[tokens]
-    from tpu_bootstrap.workload.decode import _block_step
-
     new_caches = []
     for block, cache in zip(params["blocks"], caches):
         x, cache = _block_step(block, x, cache, positions, valid, cfg, kv_kernel)
@@ -157,10 +156,12 @@ def _speculative(target_params, draft_params, prompt, target_cfg, draft_cfg,
         cond, body,
         (jnp.int32(1), jnp.int32(s), first, out, tcaches, dcaches, jnp.int32(0)))
     # Mean committed tokens per verify round (1..gamma+1) — the
-    # acceptance telemetry serving wants; the first token is free
-    # (prefill), hence steps - 1.
+    # acceptance telemetry serving wants. Numerator is the ACTUAL commit
+    # count (n_out - 1; the first token is free from prefill), including
+    # the final round's overshoot — (steps - 1) would under-read full
+    # acceptance as ~gamma+0.6 and a ceiling check could never fire.
     stats = {"verify_rounds": n_iter,
-             "mean_committed": (steps - 1) / jnp.maximum(n_iter, 1)}
+             "mean_committed": (n_out - 1) / jnp.maximum(n_iter, 1)}
     return out[:, :steps], stats
 
 
