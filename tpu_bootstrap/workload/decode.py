@@ -44,6 +44,7 @@ from jax import lax
 import math
 
 from tpu_bootstrap.workload import decode_attention, quant
+from tpu_bootstrap.workload.flash_attention import flash_attention
 from tpu_bootstrap.workload.model import (
     ModelConfig,
     Params,
@@ -135,7 +136,8 @@ def _attend(q: jax.Array, cache_k: jax.Array, cache_v: jax.Array,
 
 
 def _block_step(block: Params, x: jax.Array, cache: dict, positions: jax.Array,
-                valid: jax.Array, cfg: ModelConfig, kv_kernel: bool = True):
+                valid: jax.Array, cfg: ModelConfig, kv_kernel: bool = True,
+                prefill_flash: bool = False):
     """One transformer block over x (B, S, E) with its KV written into the
     cache at `positions` and attention over the whole cache.
 
@@ -144,7 +146,16 @@ def _block_step(block: Params, x: jax.Array, cache: dict, positions: jax.Array,
     pallas_call, so under a multi-device mesh the kernel's operands would
     be all-gathered and the kernel run fully replicated (correct tokens,
     but the sharding win gone), while the einsum path partitions
-    normally."""
+    normally.
+
+    prefill_flash=True routes MULTI-query attention through the flash
+    kernel on the block's own (q, k, v) — valid ONLY for a fresh prefill
+    (positions starting at 0, attention purely causal over the chunk
+    itself); callers that attend to earlier cache (speculative verify)
+    must leave it off. The einsum prefill materializes (S, L) score
+    rows; flash is what makes LONG prompts servable. On a quantized
+    cache the flash path attends at full precision (the int8 rounding
+    only enters later decode steps via the stored cache)."""
     dtype = cfg.compute_dtype
     h = _rms_norm(x, block["attn_norm"])
     wqkv = block.get("wqkv")
@@ -194,7 +205,14 @@ def _block_step(block: Params, x: jax.Array, cache: dict, positions: jax.Array,
             "v": lax.dynamic_update_slice(cache["v"], v, (0, start, 0, 0)),
         }
         cache_k, cache_v = cache["k"], cache["v"]
-    out = _attend(q, cache_k, cache_v, valid, cfg)
+    if prefill_flash and q.shape[1] > 1:
+        # Fresh prefill: attention over the chunk IS causal
+        # self-attention on the local (q, k, v) — O(S) memory via the
+        # flash kernel, never reading the (padded) cache buffer. The
+        # unused dequantized cache_k/v above are dead code XLA removes.
+        out = flash_attention(q, k, v, causal=True)
+    else:
+        out = _attend(q, cache_k, cache_v, valid, cfg)
     x = x + _linear(out, block["wo"], 2, dtype)
     return _mlp_tail(block, x, cfg), cache
 
@@ -222,10 +240,12 @@ def _logits(params: Params, x: jax.Array) -> jax.Array:
 
 
 def prefill(params: Params, tokens: jax.Array, caches: list, cfg: ModelConfig,
-            kv_kernel: bool = True):
+            kv_kernel: bool = True, flash: bool = False):
     """Run the prompt (B, S) through the model, filling cache slots
     [0, S). Returns (logits for the LAST prompt position (B, vocab),
-    updated caches)."""
+    updated caches). flash=True runs the prompt's causal self-attention
+    through the flash kernel — O(S) memory instead of the einsum's
+    (S, cache_len) score rows; the long-prompt path."""
     b, s = tokens.shape
     max_len = caches[0]["k"].shape[1]
     positions = jnp.arange(s)
@@ -234,7 +254,8 @@ def prefill(params: Params, tokens: jax.Array, caches: list, cfg: ModelConfig,
     x = params["embed"].astype(cfg.compute_dtype)[tokens]
     new_caches = []
     for block, cache in zip(params["blocks"], caches):
-        x, cache = _block_step(block, x, cache, positions, valid, cfg, kv_kernel)
+        x, cache = _block_step(block, x, cache, positions, valid, cfg, kv_kernel,
+                               prefill_flash=flash)
         new_caches.append(cache)
     return _logits(params, x[:, -1:])[:, 0], new_caches
 
@@ -295,7 +316,7 @@ def _multi_device(params: Params) -> bool | None:
 def generate(params: Params, prompt: jax.Array, cfg: ModelConfig, steps: int,
              temperature: float = 0.0, key: jax.Array | None = None,
              top_k: int = 0, top_p: float = 1.0, kv_quant: bool = False,
-             kv_kernel: bool | None = None):
+             kv_kernel: bool | None = None, prefill_flash: bool = False):
     """Greedy (temperature == 0) or sampled generation, with optional
     top-k and/or nucleus (top-p) filtering of the sampled distribution.
 
@@ -314,6 +335,11 @@ def generate(params: Params, prompt: jax.Array, cfg: ModelConfig, steps: int,
     inside an outer jit: tracer params carry no sharding) — the safe
     default; single-device serving wrapped in an outer jit should pass
     kv_kernel=True explicitly. Pass True/False to override either way.
+
+    prefill_flash=True (opt-in; same GSPMD caveat as kv_kernel) runs the
+    prompt through the flash kernel in O(prompt) memory — the einsum
+    prefill materializes (prompt, cache) score rows and caps servable
+    prompt lengths.
     """
     if kv_kernel is None:
         kv_kernel = _multi_device(params) is False
@@ -321,22 +347,24 @@ def generate(params: Params, prompt: jax.Array, cfg: ModelConfig, steps: int,
     # match positionally-passed arguments.
     return _generate(params, prompt, cfg=cfg, steps=steps,
                      temperature=temperature, key=key, top_k=top_k,
-                     top_p=top_p, kv_quant=kv_quant, kv_kernel=kv_kernel)
+                     top_p=top_p, kv_quant=kv_quant, kv_kernel=kv_kernel,
+                     prefill_flash=prefill_flash)
 
 
 @partial(jax.jit, static_argnames=("cfg", "steps", "temperature", "top_k", "top_p",
-                                   "kv_quant", "kv_kernel"))
+                                   "kv_quant", "kv_kernel", "prefill_flash"))
 def _generate(params: Params, prompt: jax.Array, cfg: ModelConfig, steps: int,
               temperature: float = 0.0, key: jax.Array | None = None,
               top_k: int = 0, top_p: float = 1.0, kv_quant: bool = False,
-              kv_kernel: bool = True):
+              kv_kernel: bool = True, prefill_flash: bool = False):
     if steps < 1:
         raise ValueError(f"steps must be >= 1, got {steps}")
     if not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     b, s = prompt.shape
     caches = init_cache(cfg, b, s + steps, quantized=kv_quant)
-    logits, caches = prefill(params, prompt, caches, cfg, kv_kernel)
+    logits, caches = prefill(params, prompt, caches, cfg, kv_kernel,
+                             flash=prefill_flash)
     if key is None:
         key = jax.random.PRNGKey(0)
 
