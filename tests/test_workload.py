@@ -156,10 +156,51 @@ def test_parse_mesh_env():
         parse_mesh_env("data=2", 8)  # size 2 != 8 devices
     with pytest.raises(ValueError, match="unknown"):
         parse_mesh_env("rows=8", 8)
-    with pytest.raises(ValueError, match="axis=extent"):
+    with pytest.raises(ValueError, match="key=value"):
         parse_mesh_env("data", 8)
     with pytest.raises(ValueError, match=">= 1"):
         parse_mesh_env("pipe=-2,data=-4", 8)  # sign-cancel must not pass
+
+
+def test_parse_model_env():
+    """WORKLOAD_MODEL — the CR-to-workload MODEL knob (spec.tpu.env ->
+    JobSet env -> worker_main): field=value terms onto ModelConfig,
+    dtype/None handling, loud failures for typos and invalid configs."""
+    import jax.numpy as jnp
+    import pytest
+
+    from tpu_bootstrap.workload.train import parse_model_env
+
+    cfg = parse_model_env(
+        "embed_dim=1024, num_layers=8, vocab_size=32768, vocab_chunk=4096,"
+        "compute_dtype=bfloat16, num_kv_heads=4, expert_capacity_factor=1.5")
+    assert (cfg.embed_dim, cfg.num_layers, cfg.vocab_size) == (1024, 8, 32768)
+    assert cfg.vocab_chunk == 4096 and cfg.compute_dtype == jnp.bfloat16
+    assert cfg.kv_heads == 4 and cfg.expert_capacity_factor == 1.5
+    assert parse_model_env("num_kv_heads=none").num_kv_heads is None
+    assert parse_model_env("") == ModelConfig()
+    with pytest.raises(ValueError, match="unknown"):
+        parse_model_env("layers=8")
+    with pytest.raises(ValueError, match="key=value"):
+        parse_model_env("embed_dim")
+    with pytest.raises(ValueError, match="twice"):
+        parse_model_env("embed_dim=8,embed_dim=16")
+    with pytest.raises(ValueError, match="compute_dtype"):
+        parse_model_env("compute_dtype=fp8")
+    with pytest.raises(ValueError, match="divide"):
+        parse_model_env("num_heads=4,num_kv_heads=3")
+    with pytest.raises(ValueError, match="vocab_chunk"):
+        parse_model_env("vocab_size=100,vocab_chunk=33")
+    # degenerate numerics fail loudly, not train silently
+    with pytest.raises(ValueError, match=">= 1"):
+        parse_model_env("num_layers=0")
+    with pytest.raises(ValueError, match=">= 0"):
+        parse_model_env("vocab_chunk=-4")
+    with pytest.raises(ValueError, match="> 0"):
+        parse_model_env("expert_capacity_factor=0")
+    assert parse_model_env("expert_capacity_factor=0.5"
+                           ).expert_capacity_factor == 0.5
+    assert parse_model_env("num_experts=0").num_experts == 0
 
 
 def test_train_loop_progress_logging(capsys):

@@ -194,24 +194,30 @@ def _block_step(block: Params, x: jax.Array, cache: dict, positions: jax.Array,
                 cache["v"], cache["v_scale"], valid[0])
             x = x + _linear(out[:, None], block["wo"], 2, dtype)
             return _mlp_tail(block, x, cfg), cache
-        # Prefill (multi-query) or an un-tileable cache length: dequant
-        # fuses into the attention einsums' operand reads; the
-        # materialized-in-HBM tensors stay int8.
-        cache_k = _dequantize_kv(cache["k"], cache["k_scale"], dtype)
-        cache_v = _dequantize_kv(cache["v"], cache["v_scale"], dtype)
+        quantized = True
     else:
         cache = {
             "k": lax.dynamic_update_slice(cache["k"], k, (0, start, 0, 0)),
             "v": lax.dynamic_update_slice(cache["v"], v, (0, start, 0, 0)),
         }
-        cache_k, cache_v = cache["k"], cache["v"]
+        quantized = False
     if prefill_flash and q.shape[1] > 1:
         # Fresh prefill: attention over the chunk IS causal
         # self-attention on the local (q, k, v) — O(S) memory via the
-        # flash kernel, never reading the (padded) cache buffer. The
-        # unused dequantized cache_k/v above are dead code XLA removes.
+        # flash kernel, never reading the (padded) cache buffer (and on
+        # a quantized cache, never materializing its fp dequant — which
+        # eager callers of the public prefill would otherwise pay for
+        # real).
         out = flash_attention(q, k, v, causal=True)
     else:
+        if quantized:
+            # Prefill (multi-query) or an un-tileable cache length:
+            # dequant fuses into the attention einsums' operand reads;
+            # the materialized-in-HBM tensors stay int8.
+            cache_k = _dequantize_kv(cache["k"], cache["k_scale"], dtype)
+            cache_v = _dequantize_kv(cache["v"], cache["v_scale"], dtype)
+        else:
+            cache_k, cache_v = cache["k"], cache["v"]
         out = _attend(q, cache_k, cache_v, valid, cfg)
     x = x + _linear(out, block["wo"], 2, dtype)
     return _mlp_tail(block, x, cfg), cache

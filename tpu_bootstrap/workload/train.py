@@ -448,6 +448,86 @@ def bootstrap_from_env(environ=None) -> dict | None:
     }
 
 
+def _parse_env_terms(value: str, valid: set, env_name: str):
+    """Shared grammar of the WORKLOAD_MODEL / WORKLOAD_MESH env knobs:
+    comma-separated key=value terms, whitespace-tolerant, unknown and
+    duplicate keys rejected loudly. Yields (key, raw value) pairs; the
+    per-field type dispatch stays with each caller."""
+    seen = set()
+    for term in value.split(","):
+        term = term.strip()
+        if not term:
+            continue
+        if "=" not in term:
+            raise ValueError(f"{env_name} term {term!r} is not key=value")
+        k, v = term.split("=", 1)
+        k = k.strip()
+        if k not in valid:
+            raise ValueError(
+                f"{env_name} field {k!r} unknown (valid: {sorted(valid)})")
+        if k in seen:
+            # Last-wins would let a typo silently configure the wrong
+            # model/layout.
+            raise ValueError(f"{env_name} field {k} specified twice")
+        seen.add(k)
+        yield k, v.strip()
+
+
+def parse_model_env(value: str) -> ModelConfig:
+    """WORKLOAD_MODEL: "embed_dim=1024,num_layers=8,vocab_size=32768" —
+    key=value pairs onto ModelConfig fields (unset fields keep their
+    defaults; empty string = all defaults). The CR's spec.tpu.env
+    carries this through the JobSet like WORKLOAD_MESH, so the
+    operator-facing resource selects the MODEL a slice trains, not just
+    its parallelism layout. Validated here so a typo fails the worker
+    loudly at startup. compute_dtype accepts bfloat16/float32/float16
+    by name; num_kv_heads accepts "none" for MHA."""
+    if not value.strip():
+        return ModelConfig()
+    valid = {f.name: f for f in dataclasses.fields(ModelConfig)}
+    dtypes = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
+              "float16": jnp.float16}
+    # Fields where 0 is a meaningful "off"/dense setting; every other
+    # numeric field must be >= 1 (a truncated edit like "num_layers=0"
+    # must not silently train a degenerate model — same rationale as
+    # parse_mesh_env's extent check).
+    zero_ok = {"num_experts", "vocab_chunk", "moe_aux_coef"}
+    fields: dict = {}
+    for k, v in _parse_env_terms(value, set(valid), "WORKLOAD_MODEL"):
+        if k == "compute_dtype":
+            if v not in dtypes:
+                raise ValueError(
+                    f"WORKLOAD_MODEL compute_dtype {v!r} unknown "
+                    f"(valid: {sorted(dtypes)})")
+            fields[k] = dtypes[v]
+            continue
+        if k == "num_kv_heads" and v.lower() == "none":
+            fields[k] = None
+            continue
+        if valid[k].type in ("float", float):
+            num = float(v)
+            # Fractional values are fine (capacity_factor 0.5 is a real
+            # setting); negatives never are, zero only for the off-able.
+            if num < 0 or (num == 0 and k not in zero_ok):
+                raise ValueError(
+                    f"WORKLOAD_MODEL {k} must be "
+                    f"{'>= 0' if k in zero_ok else '> 0'}, got {v}")
+        else:
+            num = int(v)
+            if num < (0 if k in zero_ok else 1):
+                raise ValueError(
+                    f"WORKLOAD_MODEL {k} must be >= "
+                    f"{0 if k in zero_ok else 1}, got {v}")
+        fields[k] = num
+    cfg = ModelConfig(**fields)
+    cfg.kv_heads  # noqa: B018 — divisibility check fails loudly here
+    if cfg.vocab_chunk > 0 and cfg.vocab_size % cfg.vocab_chunk != 0:
+        raise ValueError(
+            f"WORKLOAD_MODEL vocab_chunk ({cfg.vocab_chunk}) must divide "
+            f"vocab_size ({cfg.vocab_size})")
+    return cfg
+
+
 def parse_mesh_env(value: str, n_devices: int) -> MeshConfig:
     """WORKLOAD_MESH: "pipe=2,data=4" (unnamed axes default to 1) or the
     empty string for the for_device_count default. The CR's spec.tpu.env
@@ -459,20 +539,7 @@ def parse_mesh_env(value: str, n_devices: int) -> MeshConfig:
         return MeshConfig.for_device_count(n_devices)
     fields = {}
     valid = {f.name for f in dataclasses.fields(MeshConfig)}
-    for term in value.split(","):
-        term = term.strip()
-        if not term:
-            continue
-        if "=" not in term:
-            raise ValueError(f"WORKLOAD_MESH term {term!r} is not axis=extent")
-        k, v = term.split("=", 1)
-        k = k.strip()
-        if k not in valid:
-            raise ValueError(
-                f"WORKLOAD_MESH axis {k!r} unknown (valid: {sorted(valid)})")
-        if k in fields:
-            # Last-wins would let a typo silently train the wrong layout.
-            raise ValueError(f"WORKLOAD_MESH axis {k} specified twice")
+    for k, v in _parse_env_terms(value, valid, "WORKLOAD_MESH"):
         extent = int(v)
         if extent < 1:
             # A negative pair can sign-cancel through the size check and
@@ -497,8 +564,10 @@ def worker_main() -> None:
     mesh then spans every chip on the slice. Config via env (settable per
     CR through spec.tpu.env): WORKLOAD_STEPS, WORKLOAD_SAVE_EVERY,
     WORKLOAD_CHECKPOINT_DIR (shared storage — resume-on-restart),
-    WORKLOAD_SEED, WORKLOAD_MESH ("pipe=2,data=4" — the slice's
-    parallelism layout), WORKLOAD_ATTENTION (dense|flash),
+    WORKLOAD_SEED, WORKLOAD_MODEL
+    ("embed_dim=1024,num_layers=8,vocab_size=32768,vocab_chunk=4096" —
+    the MODEL the slice trains), WORKLOAD_MESH ("pipe=2,data=4" — the
+    slice's parallelism layout), WORKLOAD_ATTENTION (dense|flash),
     WORKLOAD_SCHEDULE (gpipe|1f1b), WORKLOAD_MICROBATCHES,
     WORKLOAD_LOG_EVERY (progress-line cadence, default 10, 0 = off).
     """
@@ -533,6 +602,7 @@ def worker_main() -> None:
     # (TrainConfig's documented total_steps == 0 mode).
     total_env = os.environ.get("WORKLOAD_TOTAL_STEPS")
     cfg = TrainConfig(
+        model=parse_model_env(os.environ.get("WORKLOAD_MODEL", "")),
         mesh=parse_mesh_env(os.environ.get("WORKLOAD_MESH", ""), len(jax.devices())),
         data=data,
         warmup_steps=int(os.environ.get("WORKLOAD_WARMUP_STEPS", "0")),
