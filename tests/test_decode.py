@@ -175,6 +175,41 @@ def test_flash_prefill_matches_einsum(params, kv_quant):
             np.asarray(generate(params, prompt, CFG, 6, kv_kernel=False)))
 
 
+@pytest.mark.parametrize("kv_quant", [False, True])
+def test_ragged_prompts_match_per_row_generation(params, kv_quant):
+    """The ragged-batch contract: LEFT-padded prompts with
+    prompt_lengths produce, for every row, exactly the tokens that row
+    would produce generated ALONE at its true length (same greedy path,
+    pads invisible to attention, rotary counted from the first real
+    token)."""
+    lengths = [3, 7, 5]
+    S = max(lengths)
+    rows = [jax.random.randint(jax.random.PRNGKey(40 + i), (1, n), 0,
+                               CFG.vocab_size)
+            for i, n in enumerate(lengths)]
+    padded = jnp.stack([
+        jnp.pad(r[0], (S - n, 0)) for r, n in zip(rows, lengths)])
+    got = generate(params, padded, CFG, 6, kv_quant=kv_quant,
+                   prompt_lengths=jnp.array(lengths))
+    for i, (r, n) in enumerate(zip(rows, lengths)):
+        want = generate(params, r, CFG, 6, kv_quant=kv_quant,
+                        kv_kernel=False)
+        np.testing.assert_array_equal(np.asarray(got[i]), np.asarray(want[0]),
+                                      err_msg=f"row {i} (len {n})")
+
+
+def test_ragged_rejects_flash_prefill_and_bad_lengths(params):
+    prompt = jnp.zeros((2, 4), jnp.int32)
+    with pytest.raises(ValueError, match="prompt_lengths"):
+        generate(params, prompt, CFG, 2, prefill_flash=True,
+                 prompt_lengths=jnp.array([2, 4]))
+    # A length-0 row must fail loudly, not silently generate from a pad.
+    with pytest.raises(ValueError, match=r"\[1, 4\]"):
+        generate(params, prompt, CFG, 2, prompt_lengths=jnp.array([0, 4]))
+    with pytest.raises(ValueError, match=r"\[1, 4\]"):
+        generate(params, prompt, CFG, 2, prompt_lengths=jnp.array([2, 5]))
+
+
 def test_int8_kv_cache_matches_fp_cache(params):
     """The int8 KV cache is a bandwidth optimization, not a semantics
     change: per-step logits must track the fp-cache logits to quant

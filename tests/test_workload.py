@@ -198,6 +198,10 @@ def test_parse_model_env():
         parse_model_env("vocab_chunk=-4")
     with pytest.raises(ValueError, match="> 0"):
         parse_model_env("expert_capacity_factor=0")
+    with pytest.raises(ValueError, match="finite"):
+        parse_model_env("expert_capacity_factor=nan")
+    with pytest.raises(ValueError, match="finite"):
+        parse_model_env("moe_aux_coef=inf")
     assert parse_model_env("expert_capacity_factor=0.5"
                            ).expert_capacity_factor == 0.5
     assert parse_model_env("num_experts=0").num_experts == 0

@@ -118,9 +118,10 @@ def _project_kv(block: Params, h: jax.Array, positions: jax.Array, cfg: ModelCon
 def _attend(q: jax.Array, cache_k: jax.Array, cache_v: jax.Array,
             valid: jax.Array, cfg: ModelConfig) -> jax.Array:
     """q: (B, S, H, D) against the (B, L, Hk, D) cache, masked to `valid`
-    columns (valid: (S, L) bool — which cache slots each query row may
-    see). GQA folds q into (Hk, group) so the cache is read once at its
-    small head count — no materialized repeat."""
+    columns — (S, L) bool shared across the batch, or (B, S, L) when
+    rows see different slots (ragged left-padded prompts). GQA folds q
+    into (Hk, group) so the cache is read once at its small head count —
+    no materialized repeat."""
     dtype = cfg.compute_dtype
     b, s, heads, d = q.shape
     kv_heads = cache_k.shape[2]
@@ -129,7 +130,8 @@ def _attend(q: jax.Array, cache_k: jax.Array, cache_v: jax.Array,
     scale = jnp.asarray(cfg.head_dim, jnp.float32) ** -0.5
     scores = jnp.einsum("bskgd,blkd->bkgsl", qg.astype(jnp.float32),
                         cache_k.astype(jnp.float32)) * scale
-    scores = jnp.where(valid[None, None, None], scores, -1e30)
+    mask = valid[:, None, None] if valid.ndim == 3 else valid[None, None, None]
+    scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
     out = jnp.einsum("bkgsl,blkd->bskgd", probs, cache_v.astype(dtype))
     return out.reshape(b, s, heads, d)
@@ -137,7 +139,7 @@ def _attend(q: jax.Array, cache_k: jax.Array, cache_v: jax.Array,
 
 def _block_step(block: Params, x: jax.Array, cache: dict, positions: jax.Array,
                 valid: jax.Array, cfg: ModelConfig, kv_kernel: bool = True,
-                prefill_flash: bool = False):
+                prefill_flash: bool = False, slot=None):
     """One transformer block over x (B, S, E) with its KV written into the
     cache at `positions` and attention over the whole cache.
 
@@ -155,7 +157,12 @@ def _block_step(block: Params, x: jax.Array, cache: dict, positions: jax.Array,
     must leave it off. The einsum prefill materializes (S, L) score
     rows; flash is what makes LONG prompts servable. On a quantized
     cache the flash path attends at full precision (the int8 rounding
-    only enters later decode steps via the stored cache)."""
+    only enters later decode steps via the stored cache).
+
+    positions: (S,) shared across the batch, or (B, S) per-row ROTARY
+    phases (ragged left-padded prompts — cache slots stay uniform, only
+    the rotary offsets differ). With per-row positions the caller must
+    pass `slot` (the uniform cache slot the chunk starts at)."""
     dtype = cfg.compute_dtype
     h = _rms_norm(x, block["attn_norm"])
     wqkv = block.get("wqkv")
@@ -173,7 +180,7 @@ def _block_step(block: Params, x: jax.Array, cache: dict, positions: jax.Array,
         q = _linear(h, block["wq"], 1, dtype)
         q = _rotary(q, positions)
         k, v = _project_kv(block, h, positions, cfg)
-    start = positions[0]
+    start = positions[0] if slot is None else slot
     if "k_scale" in cache:
         kq, ks = _quantize_kv(k)
         vq, vs = _quantize_kv(v)
@@ -183,7 +190,7 @@ def _block_step(block: Params, x: jax.Array, cache: dict, positions: jax.Array,
             "v": lax.dynamic_update_slice(cache["v"], vq, (0, start, 0, 0)),
             "v_scale": lax.dynamic_update_slice(cache["v_scale"], vs, (0, start, 0)),
         }
-        if (kv_kernel and q.shape[1] == 1
+        if (kv_kernel and q.shape[1] == 1 and valid.ndim == 2
                 and decode_attention.supports(cache["k"].shape[1])):
             # Single-query decode step: the Pallas kernel streams the
             # int8 cache directly (dequant in VMEM, online softmax) —
@@ -246,37 +253,74 @@ def _logits(params: Params, x: jax.Array) -> jax.Array:
 
 
 def prefill(params: Params, tokens: jax.Array, caches: list, cfg: ModelConfig,
-            kv_kernel: bool = True, flash: bool = False):
+            kv_kernel: bool = True, flash: bool = False,
+            lengths: jax.Array | None = None):
     """Run the prompt (B, S) through the model, filling cache slots
     [0, S). Returns (logits for the LAST prompt position (B, vocab),
     updated caches). flash=True runs the prompt's causal self-attention
     through the flash kernel — O(S) memory instead of the einsum's
-    (S, cache_len) score rows; the long-prompt path."""
+    (S, cache_len) score rows; the long-prompt path.
+
+    lengths: (B,) int32 true prompt lengths for a RAGGED batch whose
+    prompts are LEFT-padded to S (real tokens right-aligned, so the
+    last column — the one whose logits pick the next token — is real
+    for every row). Pad columns are excluded from every attention mask
+    and rotary phases count from each row's first real token; the pad
+    slots' cache content is garbage that no mask ever admits.
+    Incompatible with flash (the kernel's causal mask has no per-row
+    pad exclusion)."""
     b, s = tokens.shape
     max_len = caches[0]["k"].shape[1]
-    positions = jnp.arange(s)
-    # Query row i may see cache columns 0..i (its own prefix).
-    valid = jnp.arange(max_len)[None, :] <= positions[:, None]
+    if lengths is None:
+        positions = jnp.arange(s)
+        # Query row i may see cache columns 0..i (its own prefix).
+        valid = jnp.arange(max_len)[None, :] <= positions[:, None]
+        slot = None
+    else:
+        if flash:
+            raise ValueError(
+                "ragged prompts (lengths) do not compose with the flash "
+                "prefill — its causal mask cannot exclude per-row pads")
+        pad = (s - lengths).astype(jnp.int32)  # (B,)
+        positions = jnp.maximum(jnp.arange(s)[None, :] - pad[:, None], 0)
+        cols = jnp.arange(max_len)
+        # (B, S, L): col c visible to row j iff real (c >= pad_b) and
+        # causal (c <= j).
+        valid = (cols[None, None, :] >= pad[:, None, None]) & (
+            cols[None, None, :] <= jnp.arange(s)[None, :, None])
+        slot = 0
     x = params["embed"].astype(cfg.compute_dtype)[tokens]
     new_caches = []
     for block, cache in zip(params["blocks"], caches):
         x, cache = _block_step(block, x, cache, positions, valid, cfg, kv_kernel,
-                               prefill_flash=flash)
+                               prefill_flash=flash, slot=slot)
         new_caches.append(cache)
     return _logits(params, x[:, -1:])[:, 0], new_caches
 
 
 def decode_step(params: Params, token: jax.Array, pos: jax.Array, caches: list,
-                cfg: ModelConfig, kv_kernel: bool = True):
-    """One token (B,) at position `pos` (traced scalar). Returns
-    (next-token logits (B, vocab), updated caches)."""
+                cfg: ModelConfig, kv_kernel: bool = True,
+                pad: jax.Array | None = None):
+    """One token (B,) at cache slot `pos` (traced scalar). Returns
+    (next-token logits (B, vocab), updated caches). pad: (B,) per-row
+    left-pad widths for ragged batches — pad columns stay masked and
+    rotary phases run at pos - pad per row."""
     max_len = caches[0]["k"].shape[1]
-    positions = pos[None] if pos.ndim == 0 else pos
-    valid = (jnp.arange(max_len) <= positions[0])[None, :]
+    if pad is None:
+        positions = pos[None] if pos.ndim == 0 else pos
+        valid = (jnp.arange(max_len) <= positions[0])[None, :]
+        slot = None
+    else:
+        slot = pos
+        positions = (pos - pad)[:, None]  # (B, 1) rotary phases
+        cols = jnp.arange(max_len)
+        valid = ((cols[None, :] <= pos) & (cols[None, :] >= pad[:, None])
+                 )[:, None, :]  # (B, 1, L)
     x = params["embed"].astype(cfg.compute_dtype)[token[:, None]]
     new_caches = []
     for block, cache in zip(params["blocks"], caches):
-        x, cache = _block_step(block, x, cache, positions, valid, cfg, kv_kernel)
+        x, cache = _block_step(block, x, cache, positions, valid, cfg, kv_kernel,
+                               slot=slot)
         new_caches.append(cache)
     return _logits(params, x)[:, 0], new_caches
 
@@ -322,7 +366,8 @@ def _multi_device(params: Params) -> bool | None:
 def generate(params: Params, prompt: jax.Array, cfg: ModelConfig, steps: int,
              temperature: float = 0.0, key: jax.Array | None = None,
              top_k: int = 0, top_p: float = 1.0, kv_quant: bool = False,
-             kv_kernel: bool | None = None, prefill_flash: bool = False):
+             kv_kernel: bool | None = None, prefill_flash: bool = False,
+             prompt_lengths: jax.Array | None = None):
     """Greedy (temperature == 0) or sampled generation, with optional
     top-k and/or nucleus (top-p) filtering of the sampled distribution.
 
@@ -346,15 +391,40 @@ def generate(params: Params, prompt: jax.Array, cfg: ModelConfig, steps: int,
     prompt through the flash kernel in O(prompt) memory — the einsum
     prefill materializes (prompt, cache) score rows and caps servable
     prompt lengths.
+
+    prompt_lengths: (B,) int32 true lengths for a RAGGED batch whose
+    prompts arrive LEFT-padded to the shared (B, S) shape — rows behave
+    exactly as if each were generated alone at its true length (the
+    parity the tests pin). Ragged batches take the einsum attention
+    path (per-row masks; incompatible with prefill_flash, and the
+    decode kernel's shared-row bias is skipped).
     """
-    if kv_kernel is None:
+    if prompt_lengths is not None:
+        if prefill_flash:
+            raise ValueError(
+                "prompt_lengths does not compose with prefill_flash (the "
+                "flash causal mask cannot exclude per-row pads)")
+        if not isinstance(prompt_lengths, jax.core.Tracer):
+            # Concrete lengths (the normal un-jitted call): reject
+            # out-of-range values loudly — a clamped length-0 row would
+            # silently generate from a pad token as if it were a real
+            # prompt. (Traced lengths fall back to the clamp below.)
+            lo = int(jnp.min(jnp.asarray(prompt_lengths)))
+            hi = int(jnp.max(jnp.asarray(prompt_lengths)))
+            if lo < 1 or hi > prompt.shape[1]:
+                raise ValueError(
+                    f"prompt_lengths must be in [1, {prompt.shape[1]}] "
+                    f"(the padded prompt width); got [{lo}, {hi}]")
+        kv_kernel = False  # per-row masks: einsum path
+    elif kv_kernel is None:
         kv_kernel = _multi_device(params) is False
     # Statics must go by keyword: jax.jit's static_argnames does not
     # match positionally-passed arguments.
     return _generate(params, prompt, cfg=cfg, steps=steps,
                      temperature=temperature, key=key, top_k=top_k,
                      top_p=top_p, kv_quant=kv_quant, kv_kernel=kv_kernel,
-                     prefill_flash=prefill_flash)
+                     prefill_flash=prefill_flash,
+                     prompt_lengths=prompt_lengths)
 
 
 @partial(jax.jit, static_argnames=("cfg", "steps", "temperature", "top_k", "top_p",
@@ -362,15 +432,22 @@ def generate(params: Params, prompt: jax.Array, cfg: ModelConfig, steps: int,
 def _generate(params: Params, prompt: jax.Array, cfg: ModelConfig, steps: int,
               temperature: float = 0.0, key: jax.Array | None = None,
               top_k: int = 0, top_p: float = 1.0, kv_quant: bool = False,
-              kv_kernel: bool = True, prefill_flash: bool = False):
+              kv_kernel: bool = True, prefill_flash: bool = False,
+              prompt_lengths: jax.Array | None = None):
     if steps < 1:
         raise ValueError(f"steps must be >= 1, got {steps}")
     if not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     b, s = prompt.shape
     caches = init_cache(cfg, b, s + steps, quantized=kv_quant)
+    pad = None
+    lengths = None
+    if prompt_lengths is not None:
+        # Clamp defensively: a length of 0 or > S has no meaning here.
+        lengths = jnp.clip(prompt_lengths, 1, s).astype(jnp.int32)
+        pad = s - lengths
     logits, caches = prefill(params, prompt, caches, cfg, kv_kernel,
-                             flash=prefill_flash)
+                             flash=prefill_flash, lengths=lengths)
     if key is None:
         key = jax.random.PRNGKey(0)
 
@@ -388,7 +465,8 @@ def _generate(params: Params, prompt: jax.Array, cfg: ModelConfig, steps: int,
     def step(carry, i):
         token, caches, key = carry
         key, sub = jax.random.split(key)
-        logits, caches = decode_step(params, token, s + i, caches, cfg, kv_kernel)
+        logits, caches = decode_step(params, token, s + i, caches, cfg, kv_kernel,
+                                     pad=pad)
         nxt = pick(logits, sub)
         return (nxt, caches, key), token
 

@@ -10,6 +10,7 @@ and ``tensor``). No hand-written pmap/collectives anywhere.
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Any
 
 import jax
@@ -507,10 +508,12 @@ def parse_model_env(value: str) -> ModelConfig:
         if valid[k].type in ("float", float):
             num = float(v)
             # Fractional values are fine (capacity_factor 0.5 is a real
-            # setting); negatives never are, zero only for the off-able.
-            if num < 0 or (num == 0 and k not in zero_ok):
+            # setting); negatives, nan, and inf never are, zero only for
+            # the off-able.
+            if (not math.isfinite(num) or num < 0
+                    or (num == 0 and k not in zero_ok)):
                 raise ValueError(
-                    f"WORKLOAD_MODEL {k} must be "
+                    f"WORKLOAD_MODEL {k} must be a finite value "
                     f"{'>= 0' if k in zero_ok else '> 0'}, got {v}")
         else:
             num = int(v)
