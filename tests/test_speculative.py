@@ -99,6 +99,71 @@ def test_self_speculation_int8_draft_accepts(models):
     assert float(rand_stats["mean_committed"]) < float(stats["mean_committed"])
 
 
+def test_sampled_matches_target_distribution():
+    """The rejection scheme's whole point: sampled speculative tokens
+    follow EXACTLY the target's sampling distribution, draft quality
+    only affecting throughput. Checked on the second generated token
+    (the first to pass through accept/reject): its exact marginal
+    sum_t1 p(t1) p(t2|t1) is enumerable at vocab 16, and the empirical
+    marginal over many seeded keys must match within sampling noise.
+    Deterministic: fixed key set."""
+    V, T = 16, 0.8
+    tcfg = ModelConfig(vocab_size=V, num_layers=1, num_heads=2, head_dim=4,
+                       embed_dim=8, mlp_dim=16, max_seq_len=32)
+    dcfg = ModelConfig(vocab_size=V, num_layers=1, num_heads=1, head_dim=4,
+                       embed_dim=4, mlp_dim=8, max_seq_len=32)
+    target = init_params(tcfg, jax.random.PRNGKey(0))
+    draft = init_params(dcfg, jax.random.PRNGKey(1))
+    prompt = jnp.array([[3, 1, 4]], jnp.int32)
+
+    # Exact marginal of token 2: p(t1) from the prompt forward, then
+    # p(t2 | prompt + t1) for every t1 in one batched forward.
+    from tpu_bootstrap.workload.model import forward
+
+    p1 = jax.nn.softmax(forward(target, prompt, tcfg)[0, -1] / T)
+    ext = jnp.concatenate(
+        [jnp.tile(prompt, (V, 1)), jnp.arange(V)[:, None]], axis=1)
+    p2_given = jax.nn.softmax(forward(target, ext, tcfg)[:, -1] / T, axis=-1)
+    want = np.asarray(p1 @ p2_given)  # (V,)
+
+    B, calls = 8, 64  # 512 samples
+    counts = np.zeros(V)
+    bprompt = jnp.tile(prompt, (B, 1))
+    for i in range(calls):
+        toks = speculative_generate(
+            target, draft, bprompt, tcfg, dcfg, steps=2, gamma=2,
+            temperature=T, key=jax.random.PRNGKey(100 + i))
+        for t in np.asarray(toks[:, 1]):
+            counts[t] += 1
+    got = counts / counts.sum()
+    # 512 samples over 16 categories: per-category sigma <= 0.022, so an
+    # L1 within 0.25 separates a correct sampler from e.g. greedy
+    # (L1 ~ 1.2 here) or draft-distribution leakage.
+    assert np.abs(got - want).sum() < 0.25, (
+        f"L1 {np.abs(got - want).sum():.3f}\n got {np.round(got, 3)}\n"
+        f"want {np.round(want, 3)}")
+    # Determinism: same key, same tokens.
+    a = speculative_generate(target, draft, bprompt, tcfg, dcfg, steps=4,
+                             gamma=2, temperature=T, key=jax.random.PRNGKey(5))
+    b = speculative_generate(target, draft, bprompt, tcfg, dcfg, steps=4,
+                             gamma=2, temperature=T, key=jax.random.PRNGKey(5))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sampled_draft_is_target_accepts_everything():
+    """draft == target at temperature > 0: acceptance probability is
+    min(1, p/p) = 1 every draw, so every round commits gamma+1 tokens."""
+    tcfg = ModelConfig(vocab_size=32, num_layers=1, num_heads=2, head_dim=4,
+                       embed_dim=8, mlp_dim=16, max_seq_len=64)
+    target = init_params(tcfg, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 5), 0, 32)
+    _, stats = speculative_generate(target, target, prompt, tcfg, tcfg,
+                                    steps=21, gamma=4, temperature=1.0,
+                                    key=jax.random.PRNGKey(3),
+                                    with_stats=True)
+    assert float(stats["mean_committed"]) == pytest.approx(5.0)
+
+
 def test_rejects_bad_configs(models):
     target, draft, prompt = models
     with pytest.raises(ValueError, match="steps"):

@@ -1,5 +1,7 @@
 """Speculative decoding — a draft model proposes, the target verifies,
-greedy output is EXACTLY the target model's own.
+and the output is EXACTLY the target model's own: bit-identical tokens
+in greedy mode, the exact target sampling distribution at
+temperature > 0 (rejection scheme).
 
 Why it fits TPU serving: autoregressive decode is HBM-bandwidth-bound —
 each step streams all target weights to emit ONE token. Speculation
@@ -39,10 +41,12 @@ could in principle flip between the two implementations. (The draft's
 own steps may use the kernel freely — draft numerics never affect
 committed tokens.)
 
-Greedy only (temperature 0): sampled speculative decoding needs the
-rejection-resampling scheme to keep the target distribution; the greedy
-case is where the exactness guarantee is checkable bit-for-bit, and is
-the serving default here.
+Two modes, one implementation (`temperature` is static, so each mode is
+its own compiled program): temperature 0 — greedy, bit-for-bit equal to
+the target's own greedy path, the checkable-by-equality default; and
+temperature > 0 — the Leviathan et al. rejection scheme, where every
+committed token is distributed exactly as target-only sampling (pinned
+by an exact-marginal test), the draft affecting only throughput.
 
 Reference parity note: the reference (bacchus-gpu-controller) has no
 compute path (SURVEY.md §2); this module extends the serving half of
@@ -87,9 +91,25 @@ def _verify_chunk(params: Params, tokens: jax.Array, pos, caches: list,
 
 
 @partial(jax.jit, static_argnames=("target_cfg", "draft_cfg", "steps", "gamma",
-                                   "kv_quant", "kv_kernel"))
-def _speculative(target_params, draft_params, prompt, target_cfg, draft_cfg,
-                 steps, gamma, kv_quant, kv_kernel):
+                                   "temperature", "kv_quant", "kv_kernel"))
+def _speculative(target_params, draft_params, prompt, key, target_cfg,
+                 draft_cfg, steps, gamma, temperature, kv_quant, kv_kernel):
+    """One implementation for both decoding modes; ``temperature`` is a
+    STATIC argument, so the greedy (== 0) and sampled (> 0) variants are
+    separate compiled programs sharing all scaffolding — cache handling,
+    the draft-cache-hole scan, lockstep commit, telemetry.
+
+    Sampled mode is the Leviathan et al. rejection scheme: the draft
+    PROPOSES from q = softmax(draft logits / T), the target accepts d
+    with probability min(1, p(d)/q(d)) and on the first rejection
+    resamples from norm(max(p - q, 0)) — each committed token is
+    distributed EXACTLY as target-only sampling at temperature T. The
+    lockstep commit (batch min) preserves that per row: committed
+    accepted tokens are already exact, the resample token is committed
+    only by the rows that rejected at exactly the commit frontier, and
+    rows that would have accepted further simply re-draft from fresh
+    randomness next round (memoryless, so still exact)."""
+    sampled = temperature > 0
     b, s = prompt.shape
     cap = s + steps + gamma + 1  # slack: the last iteration may overshoot
     tcaches = init_cache(target_cfg, b, cap, quantized=kv_quant)
@@ -98,25 +118,37 @@ def _speculative(target_params, draft_params, prompt, target_cfg, draft_cfg,
     _, dcaches = prefill(draft_params, prompt, dcaches, draft_cfg, kv_kernel)
 
     dt = prompt.dtype
-    first = jnp.argmax(tlogits, axis=-1).astype(dt)  # exact: target's own
+    if sampled:
+        key, sub = jax.random.split(key)
+        first = jax.random.categorical(sub, tlogits / temperature,
+                                       axis=-1).astype(dt)
+    else:
+        first = jnp.argmax(tlogits, axis=-1).astype(dt)  # exact: target's own
     out = jnp.zeros((b, steps + gamma + 1), dt)
     out = out.at[:, 0].set(first)
 
     # State: tokens committed so far (n_out), the next cache slot to fill
     # (pos — the position of `last`, the newest committed-but-unprocessed
-    # token), both identical across rows by lockstep construction.
+    # token), both identical across rows by lockstep construction. The
+    # key rides the carry; greedy mode never consumes it.
     def cond(state):
         return state[0] < steps
 
     def body(state):
-        n_out, pos, last, out, tcaches, dcaches, n_iter = state
+        n_out, pos, last, out, tcaches, dcaches, key, n_iter = state
+        key, draft_key, accept_key, resample_key = jax.random.split(key, 4)
 
         def draft_one(carry, i):
             tok, caches = carry
             logits, caches = decode_step(draft_params, tok, pos + i, caches,
                                          draft_cfg, kv_kernel)
+            if sampled:
+                logq = jax.nn.log_softmax(logits / temperature, axis=-1)
+                nxt = jax.random.categorical(
+                    jax.random.fold_in(draft_key, i), logq, axis=-1).astype(dt)
+                return (nxt, caches), (nxt, logq)
             nxt = jnp.argmax(logits, axis=-1).astype(dt)
-            return (nxt, caches), nxt
+            return (nxt, caches), (nxt, ())
 
         # gamma+1 draft steps for gamma proposals: the extra step feeds
         # the LAST proposal through the draft so its KV lands in slot
@@ -127,34 +159,71 @@ def _speculative(target_params, draft_params, prompt, target_cfg, draft_cfg,
         # extra step's own proposal is discarded; on partial acceptance
         # its cache write is stale-beyond-frontier like any rejected
         # slot (masked, later overwritten).
-        (_, dcaches2), drafts = lax.scan(draft_one, (last, dcaches),
-                                         jnp.arange(gamma + 1))
+        (_, dcaches2), (drafts, logq) = lax.scan(
+            draft_one, (last, dcaches), jnp.arange(gamma + 1))
         drafts = drafts.swapaxes(0, 1)[:, :gamma]  # (B, gamma)
 
         chunk = jnp.concatenate([last[:, None], drafts], axis=1)  # (B, gamma+1)
         vlogits, tcaches2 = _verify_chunk(target_params, chunk, pos, tcaches,
                                           target_cfg, kv_kernel)
-        greedy = jnp.argmax(vlogits, axis=-1).astype(dt)  # (B, gamma+1)
-        # greedy[:, i] is the target's next token after chunk[:, i];
-        # draft token drafts[:, i] == chunk[:, i+1] is accepted iff it
-        # matches greedy[:, i]. Count the matching prefix per row, then
-        # commit lockstep at the batch minimum.
-        match = drafts == greedy[:, :-1]  # (B, gamma)
-        accepted = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
-        commit = jnp.min(accepted) + 1  # 1..gamma+1 committed tokens
 
-        # Write all gamma+1 candidate commits at n_out; only the first
-        # `commit` are real — the next iteration's write (at n_out +
-        # commit) overwrites the tail. Rows beyond their own acceptance
-        # still hold THEIR target argmaxes (exactness preserved).
-        out = lax.dynamic_update_slice(out, greedy, (0, n_out))
-        last2 = jnp.take_along_axis(greedy, jnp.full((b, 1), commit - 1), axis=1)[:, 0]
+        if sampled:
+            logq = logq.swapaxes(0, 1)[:, :gamma]  # (B, gamma, V)
+            logp = jax.nn.log_softmax(vlogits / temperature, axis=-1)
+            # Accept draft i (1-based) iff u < p(d_i)/q(d_i), log-space.
+            d_idx = drafts[..., None]
+            p_at = jnp.take_along_axis(logp[:, :gamma], d_idx, axis=-1)[..., 0]
+            q_at = jnp.take_along_axis(logq, d_idx, axis=-1)[..., 0]
+            u = jax.random.uniform(accept_key, (b, gamma))
+            accept = jnp.log(u) < (p_at - q_at)
+            a = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)
+
+            # Resample at each row's rejection frontier j = a_r from
+            # norm(max(p_j - q_j, 0)); a_r == gamma (all accepted) takes
+            # the bonus sample from p_gamma directly. In exact arithmetic
+            # a rejection guarantees residual mass, but two near-equal
+            # f32 softmaxes (int8 self-draft!) can round it to zero
+            # everywhere — fall back to p_row rather than let an all
+            # -inf categorical silently emit token 0.
+            p_row = jnp.take_along_axis(logp, a[:, None, None], axis=1)[:, 0]
+            q_row = jnp.take_along_axis(
+                logq, jnp.minimum(a, gamma - 1)[:, None, None], axis=1)[:, 0]
+            residual = jnp.maximum(jnp.exp(p_row) - jnp.exp(q_row), 0.0)
+            has_mass = jnp.sum(residual, axis=-1, keepdims=True) > 0
+            use_p = (a[:, None] >= gamma) | ~has_mass
+            dist = jnp.where(use_p, jnp.exp(p_row), residual)
+            logdist = jnp.where(dist > 0, jnp.log(dist), -jnp.inf)
+            resample = jax.random.categorical(
+                resample_key, logdist, axis=-1).astype(dt)
+
+            # Commit matrix: column i is draft i+1 while i < a_r, the
+            # resample at i == a_r, (never-committed) filler beyond.
+            cols = jnp.arange(gamma + 1)[None, :]
+            padded = jnp.concatenate([drafts, resample[:, None]], axis=1)
+            committed = jnp.where(cols < a[:, None], padded,
+                                  resample[:, None]).astype(dt)
+        else:
+            greedy = jnp.argmax(vlogits, axis=-1).astype(dt)  # (B, gamma+1)
+            # greedy[:, i] is the target's next token after chunk[:, i];
+            # draft i+1 is accepted iff it matches. Committed tokens are
+            # each row's OWN target argmaxes — bit-exact regardless of
+            # the draft.
+            match = drafts == greedy[:, :-1]
+            a = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+            committed = greedy
+
+        commit = jnp.min(a) + 1  # 1..gamma+1 committed tokens, lockstep
+        # Write all gamma+1 candidates at n_out; only the first `commit`
+        # are real — the next iteration's write overwrites the tail.
+        out = lax.dynamic_update_slice(out, committed, (0, n_out))
+        last2 = jnp.take_along_axis(
+            committed, jnp.full((b, 1), commit - 1), axis=1)[:, 0]
         return (n_out + commit, pos + commit, last2, out, tcaches2, dcaches2,
-                n_iter + 1)
+                key, n_iter + 1)
 
-    n_out, _, _, out, _, _, n_iter = lax.while_loop(
-        cond, body,
-        (jnp.int32(1), jnp.int32(s), first, out, tcaches, dcaches, jnp.int32(0)))
+    n_out, _, _, out, _, _, _, n_iter = lax.while_loop(
+        cond, body, (jnp.int32(1), jnp.int32(s), first, out, tcaches, dcaches,
+                     key, jnp.int32(0)))
     # Mean committed tokens per verify round (1..gamma+1) — the
     # acceptance telemetry serving wants. Numerator is the ACTUAL commit
     # count (n_out - 1; the first token is free from prefill), including
@@ -170,10 +239,18 @@ def speculative_generate(target_params: Params, draft_params: Params,
                          draft_cfg: ModelConfig, steps: int, gamma: int = 4,
                          kv_quant: bool = False,
                          kv_kernel: bool | None = None,
-                         with_stats: bool = False):
+                         with_stats: bool = False,
+                         temperature: float = 0.0,
+                         key: jax.Array | None = None):
     """Greedy generation of (B, steps) continuations, bit-identical to
     `decode.generate(target_params, ...)`'s greedy output for every row,
     at up to (gamma+1)x fewer target weight streams per token.
+
+    temperature > 0 switches to SAMPLED speculative decoding (rejection
+    scheme, `key` seeds it): every committed token is distributed
+    exactly as target-only sampling at that temperature — the draft
+    changes throughput, never the distribution (pinned by an
+    exact-marginal test).
 
     gamma: draft tokens proposed per verify chunk. kv_quant/kv_kernel as
     in decode.generate (kv_kernel AUTO-disables on multi-device params).
@@ -194,14 +271,18 @@ def speculative_generate(target_params: Params, draft_params: Params,
         raise ValueError(
             f"target and draft must share a vocab: {target_cfg.vocab_size} "
             f"vs {draft_cfg.vocab_size}")
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
     if kv_kernel is None:
         # Kernel only when BOTH layouts are known single-device (None =
         # unknowable under an outer jit -> safe off, as in generate).
         kv_kernel = (_multi_device(target_params) is False
                      and _multi_device(draft_params) is False)
-    out, stats = _speculative(target_params, draft_params, prompt, target_cfg,
-                              draft_cfg, steps=steps, gamma=gamma,
-                              kv_quant=kv_quant, kv_kernel=kv_kernel)
+    out, stats = _speculative(
+        target_params, draft_params, prompt,
+        jax.random.PRNGKey(0) if key is None else key, target_cfg,
+        draft_cfg, steps=steps, gamma=gamma, temperature=float(temperature),
+        kv_quant=kv_quant, kv_kernel=kv_kernel)
     return (out, stats) if with_stats else out
 
 
