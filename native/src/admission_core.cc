@@ -250,6 +250,19 @@ Json mutate(const Json& request, const Json& config) {
     int64_t slices = tpu.get_int("slices", 1);
     if (slices < 1) return deny(request, "spec.tpu.slices must be >= 1");
 
+    // TTL floor: a TTL shorter than the controller's observation window
+    // races the JobSet controller's GC — the terminal phase would never
+    // be recorded, the one-shot gate never closes, and the workload
+    // re-runs forever. 60s comfortably covers watch delivery + a
+    // reconcile pass (steady-state resync is 30s).
+    int64_t ttl = tpu.get_int("ttl_seconds_after_finished", -1);
+    if (tpu.get("ttl_seconds_after_finished").is_number() && ttl < 60) {
+      return deny(request,
+                  "spec.tpu.ttl_seconds_after_finished must be >= 60 (a "
+                  "shorter TTL races the controller's observation of the "
+                  "finished slice)");
+    }
+
     int64_t max_chips = config.get_int("max_chips_per_user", 0);
     if (!username.is_admin && max_chips > 0 && geom.chips * slices > max_chips) {
       return deny(request, "requested " + std::to_string(slices) + " slice(s) totalling " +

@@ -214,6 +214,20 @@ Json tpu_schema() {
                                           {"nullable", true},
                                           {"format", "int64"},
                                           {"type", "integer"}})},
+           {"ttl_seconds_after_finished",
+            Json::object({{"description",
+                           "JobSet ttlSecondsAfterFinished: a finished "
+                           "(Succeeded/Failed) slice is garbage-collected "
+                           "after this many seconds, releasing its quota'd "
+                           "chips without operator action. Absent = keep. "
+                           "Floor 60: a shorter TTL races the controller's "
+                           "observation of the finished slice (the terminal "
+                           "phase would never be recorded and the slice "
+                           "would re-run forever)."},
+                          {"nullable", true},
+                          {"format", "int64"},
+                          {"type", "integer"},
+                          {"minimum", 60}})},
            {"env", Json::object({{"description",
                                   "Extra environment for slice workers — the workload "
                                   "config surface (WORKLOAD_MESH, WORKLOAD_SCHEDULE, "
@@ -277,6 +291,13 @@ Json status_schema() {
                           "Pending | Provisioning | Running | Succeeded | Failed | Absent.")},
                      {"chips", int_schema("Chips granted.")},
                      {"hosts", int_schema("Hosts granted.")},
+                     {"slices", int_schema("ICI slices granted (multislice).")},
+                     {"observed_generation",
+                      int_schema("spec generation this observation belongs "
+                                 "to (the observedGeneration idiom): scopes "
+                                 "terminal-phase stickiness and the TTL "
+                                 "one-shot gate to the spec that produced "
+                                 "the outcome.")},
                      {"jobset", nullable_string_schema("Name of the materialized JobSet.")},
                      {"conditions",
                       Json::object({

@@ -165,6 +165,33 @@ Json build_jobset(const Json& ub, const Json& config) {
   });
 
   int64_t max_restarts = tpu.get_int("max_restarts", 0);
+  // Completed-slice GC: pass the CR's TTL straight through to JobSet's
+  // own ttlSecondsAfterFinished — a finished (Succeeded/Failed) slice
+  // and its pods are deleted by the JobSet controller after the TTL,
+  // releasing the quota'd chips without operator action. Absent = keep
+  // forever (the JobSet default).
+  int64_t ttl = tpu.get_int("ttl_seconds_after_finished", -1);
+
+  Json spec = Json::object({
+      // Headless-service wiring: JobSet creates a headless
+      // Service named after the subdomain and publishes
+      // not-ready addresses, giving every worker a stable DNS
+      // name for rendezvous before readiness.
+      {"network", Json::object({
+                      {"enableDNSHostnames", true},
+                      {"subdomain", name},
+                  })},
+      {"failurePolicy", Json::object({{"maxRestarts", max_restarts}})},
+      // One replica per slice: the exclusive-topology
+      // annotation places each child job on its own
+      // ICI-connected pool; slices talk over DCN.
+      {"replicatedJobs", Json::array({Json::object({
+           {"name", "workers"},
+           {"replicas", slices},
+           {"template", job_template},
+       })})},
+  });
+  if (ttl >= 0) spec.set("ttlSecondsAfterFinished", ttl);
 
   return Json::object({
       {"apiVersion", "jobset.x-k8s.io/v1alpha2"},
@@ -179,25 +206,7 @@ Json build_jobset(const Json& ub, const Json& config) {
                                              "cloud.google.com/gke-nodepool"}}));
          return m;
        }()},
-      {"spec", Json::object({
-                   // Headless-service wiring: JobSet creates a headless
-                   // Service named after the subdomain and publishes
-                   // not-ready addresses, giving every worker a stable DNS
-                   // name for rendezvous before readiness.
-                   {"network", Json::object({
-                                   {"enableDNSHostnames", true},
-                                   {"subdomain", name},
-                               })},
-                   {"failurePolicy", Json::object({{"maxRestarts", max_restarts}})},
-                   // One replica per slice: the exclusive-topology
-                   // annotation places each child job on its own
-                   // ICI-connected pool; slices talk over DCN.
-                   {"replicatedJobs", Json::array({Json::object({
-                        {"name", "workers"},
-                        {"replicas", slices},
-                        {"template", job_template},
-                    })})},
-               })},
+      {"spec", spec},
   });
 }
 
@@ -272,7 +281,28 @@ std::vector<Json> desired_children(const Json& ub, const Json& config) {
   // 5. JobSet — iff spec.tpu AND sheet-synchronized. Same interlock as the
   // RoleBinding: chips are only granted after sheet approval lands quota.
   if (spec.get("tpu").is_object() && synchronized) {
-    children.push_back(build_jobset(ub, config));
+    // TTL'd slices are one-shot: once the slice reached a terminal
+    // phase FOR THIS SPEC, stop emitting the JobSet — after the JobSet
+    // controller GC-deletes it, the next resync's server-side apply
+    // would otherwise recreate it and re-run the finished workload in
+    // an endless run -> TTL-GC -> recreate cycle. The gate is scoped to
+    // the spec via the observedGeneration idiom: editing spec (e.g. a
+    // fixed image after a Failed run) bumps metadata.generation past
+    // the recorded status.slice.observed_generation and reopens it —
+    // without that, a Failed TTL'd slice would be locked out forever.
+    // Without a TTL the JobSet object persists, so re-applying it is an
+    // idempotent no-op and terminal CRs keep their record visible.
+    const bool one_shot =
+        spec.get("tpu").get_int("ttl_seconds_after_finished", -1) >= 0;
+    const Json& slice = ub.get("status").get("slice");
+    const std::string phase = slice.get_string("phase");
+    const int64_t gen = ub.get("metadata").get_int("generation", 0);
+    const int64_t seen = slice.get_int("observed_generation", 0);
+    const bool same_spec = gen == 0 || seen == 0 || gen == seen;
+    if (!(one_shot && same_spec &&
+          (phase == "Succeeded" || phase == "Failed"))) {
+      children.push_back(build_jobset(ub, config));
+    }
   }
 
   return children;
@@ -341,8 +371,28 @@ Json slice_status(const Json& ub, const Json& observed_jobset) {
         }
       }
     }
+  } else {
+    // Terminal phases are STICKY when the JobSet is gone: a
+    // ttl_seconds_after_finished GC must not regress the record to
+    // Pending — that would erase the slice's outcome from kubectl and
+    // re-open desired_children's one-shot gate (recreating the GC'd
+    // JobSet forever). Stickiness is scoped to the spec that produced
+    // the outcome: a generation bump (spec edit) releases it so the
+    // edited slice reprovisions.
+    const Json& prev_slice = ub.get("status").get("slice");
+    const std::string prev = prev_slice.get_string("phase");
+    const int64_t gen = ub.get("metadata").get_int("generation", 0);
+    const int64_t seen = prev_slice.get_int("observed_generation", 0);
+    if ((prev == "Succeeded" || prev == "Failed") &&
+        (gen == 0 || seen == 0 || gen == seen)) {
+      phase = prev;
+    }
   }
   st.set("phase", phase);
+  // Record which spec generation this observation belongs to (the
+  // observedGeneration idiom); 0 = unknown (no generation in metadata).
+  const int64_t cur_gen = ub.get("metadata").get_int("generation", 0);
+  if (cur_gen > 0) st.set("observed_generation", cur_gen);
 
   // Slice-provisioning conditions (SURVEY.md §7: "add slice-provisioning
   // conditions"). Pure function of observed state — no timestamps, so the

@@ -248,6 +248,28 @@ def test_tpu_invalid_topology_denied(lib):
     assert "3D" in resp["status"]["message"]
 
 
+def test_tpu_ttl_floor_denied(lib):
+    """Sub-minute TTLs race the controller's observation of the finished
+    slice (the terminal phase would never be recorded and the slice
+    would re-run forever) — denied synchronously with the reason."""
+    for bad in (0, 59, -5):
+        resp = lib.mutate(
+            req(spec={"tpu": {"accelerator": "tpu-v5-lite-podslice",
+                              "topology": "2x2",
+                              "ttl_seconds_after_finished": bad}}),
+            lib.default_admission_config(),
+        )
+        assert resp["allowed"] is False, bad
+        assert ">= 60" in resp["status"]["message"]
+    resp = lib.mutate(
+        req(spec={"tpu": {"accelerator": "tpu-v5-lite-podslice",
+                          "topology": "2x2",
+                          "ttl_seconds_after_finished": 600}}),
+        lib.default_admission_config(),
+    )
+    assert resp["allowed"] is True
+
+
 def test_tpu_multihost_v5p_geometry(lib):
     request = req(spec={"tpu": {"accelerator": "tpu-v5p-slice", "topology": "4x4x4"}})
     resp = lib.mutate(request, lib.default_admission_config())

@@ -46,7 +46,11 @@ def test_crd_spec_fields(lib):
         "hosts",
         "chips_per_host",
         "max_restarts",
+        "ttl_seconds_after_finished",
     }
+    ttl = tpu["properties"]["ttl_seconds_after_finished"]
+    assert ttl["minimum"] == 60  # sub-minute TTLs race the controller's
+    # observation of the finished slice; the schema floors them out
     accels = tpu["properties"]["accelerator"]["enum"]
     assert "tpu-v5-lite-podslice" in accels
     assert "tpu-v5p-slice" in accels

@@ -233,6 +233,21 @@ def test_jobset_image_command_and_restarts(lib):
     assert c["command"] == ["python", "train.py"]
     assert c["args"] == ["--steps", "100"]
     assert js["spec"]["failurePolicy"]["maxRestarts"] == 3
+    # No TTL in the spec: the JobSet keeps its default (live forever).
+    assert "ttlSecondsAfterFinished" not in js["spec"]
+
+
+def test_jobset_ttl_passthrough(lib):
+    """spec.tpu.ttl_seconds_after_finished rides into JobSet's own
+    ttlSecondsAfterFinished — completed slices garbage-collect
+    themselves, releasing the quota'd chips. (Values < 60 are rejected
+    upstream by the CRD schema minimum and the admission webhook.)"""
+    js = lib.build_jobset(
+        ub(spec={"tpu": tpu_spec(ttl_seconds_after_finished=3600)}))
+    assert js["spec"]["ttlSecondsAfterFinished"] == 3600
+    js60 = lib.build_jobset(
+        ub(spec={"tpu": tpu_spec(ttl_seconds_after_finished=60)}))
+    assert js60["spec"]["ttlSecondsAfterFinished"] == 60
 
 
 def test_jobset_default_image_from_config(lib):
@@ -311,6 +326,71 @@ def test_slice_status_phases(lib):
 
     js["status"] = {"conditions": [{"type": "Failed", "status": "True"}]}
     assert lib.slice_status(cr, js)["phase"] == "Failed"
+
+    # Terminal phases are STICKY once the JobSet is gone (TTL GC): the
+    # record must not regress to Pending — that would erase the outcome
+    # and re-open the one-shot gate below.
+    done = ub(spec={"tpu": tpu_spec(chips=4, hosts=1)},
+              status={"slice": {"phase": "Succeeded"}})
+    assert lib.slice_status(done, None)["phase"] == "Succeeded"
+    failed = ub(spec={"tpu": tpu_spec(chips=4, hosts=1)},
+                status={"slice": {"phase": "Failed"}})
+    assert lib.slice_status(failed, None)["phase"] == "Failed"
+    # Non-terminal history regresses normally (a deleted mid-run JobSet
+    # means reprovisioning).
+    running = ub(spec={"tpu": tpu_spec(chips=4, hosts=1)},
+                 status={"slice": {"phase": "Running"}})
+    assert lib.slice_status(running, None)["phase"] == "Pending"
+
+
+def test_ttl_slice_is_one_shot(lib):
+    """With a TTL, a terminal slice's JobSet is NOT re-emitted: after
+    the JobSet controller GC-deletes it, the next server-side apply
+    would otherwise recreate it and re-run the workload forever.
+    Without a TTL the JobSet stays in the desired set (idempotent
+    re-apply of a live object)."""
+    def children_kinds(spec_tpu, phase):
+        cr = ub(spec={"tpu": spec_tpu},
+                status={"synchronized_with_sheet": True,
+                        "slice": {"phase": phase}})
+        return [c["kind"] for c in lib.desired_children(cr)]
+
+    ttl = tpu_spec(ttl_seconds_after_finished=600)
+    assert "JobSet" in children_kinds(ttl, "Running")
+    assert "JobSet" not in children_kinds(ttl, "Succeeded")
+    assert "JobSet" not in children_kinds(ttl, "Failed")
+    # No TTL: terminal slices keep their JobSet record.
+    assert "JobSet" in children_kinds(tpu_spec(), "Succeeded")
+
+    # The gate is scoped to the spec that produced the outcome
+    # (observedGeneration idiom): a spec edit bumps metadata.generation
+    # past status.slice.observed_generation and reopens it — a Failed
+    # TTL'd slice is re-runnable by fixing the spec, not locked out.
+    def children_gen(gen, seen):
+        cr = ub(spec={"tpu": ttl},
+                status={"synchronized_with_sheet": True,
+                        "slice": {"phase": "Failed",
+                                  "observed_generation": seen}})
+        cr["metadata"]["generation"] = gen
+        return [c["kind"] for c in lib.desired_children(cr)]
+
+    assert "JobSet" not in children_gen(gen=2, seen=2)  # same spec: closed
+    assert "JobSet" in children_gen(gen=3, seen=2)      # edited: reopened
+
+
+def test_slice_status_stickiness_scoped_to_generation(lib):
+    """Terminal-phase stickiness releases on a spec edit: generation
+    past the recorded observed_generation means the outcome belongs to
+    an OLD spec, so the phase regresses to Pending and the slice
+    reprovisions; the fresh observation records the new generation."""
+    cr = ub(spec={"tpu": tpu_spec(chips=4, hosts=1)},
+            status={"slice": {"phase": "Failed", "observed_generation": 2}})
+    cr["metadata"]["generation"] = 2
+    st = lib.slice_status(cr, None)
+    assert st["phase"] == "Failed" and st["observed_generation"] == 2
+    cr["metadata"]["generation"] = 3  # spec edited
+    st = lib.slice_status(cr, None)
+    assert st["phase"] == "Pending" and st["observed_generation"] == 3
 
 
 def test_slice_event_on_phase_transition(lib):

@@ -174,6 +174,11 @@ def test_rejects_bad_configs(models):
     with pytest.raises(ValueError, match="vocab"):
         speculative_generate(target, init_params(odd_vocab, jax.random.PRNGKey(3)),
                              prompt, TARGET, odd_vocab, 4)
+    # Sampling without a key would silently return the same
+    # continuation for every request — rejected.
+    with pytest.raises(ValueError, match="PRNG key"):
+        speculative_generate(target, draft, prompt, TARGET, DRAFT, 4,
+                             temperature=0.7)
 
 
 def test_sharded_target_matches_single_device(models):

@@ -204,10 +204,18 @@ class Store:
                 meta["creationTimestamp"] = existing["metadata"]["creationTimestamp"]
                 if preserve_status and "status" in existing and "status" not in obj:
                     obj["status"] = existing["status"]
+                # metadata.generation: real apiservers bump it only when
+                # SPEC changes (status/metadata-only writes keep it) —
+                # the observedGeneration idiom controllers key off.
+                prev_gen = existing["metadata"].get("generation", 1)
+                meta["generation"] = (
+                    prev_gen + 1
+                    if obj.get("spec") != existing.get("spec") else prev_gen)
                 etype = "MODIFIED"
             else:
                 meta.setdefault("uid", str(uuid.uuid4()))
                 meta["creationTimestamp"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+                meta["generation"] = 1
                 etype = "ADDED"
             meta["resourceVersion"] = str(self.next_rv())
             coll[name] = obj

@@ -273,6 +273,11 @@ def speculative_generate(target_params: Params, draft_params: Params,
             f"vs {draft_cfg.vocab_size}")
     if temperature < 0:
         raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if temperature > 0 and key is None:
+        # A silent fixed seed would make every "sampled" serving request
+        # return the identical continuation; greedy mode alone needs no
+        # randomness.
+        raise ValueError("temperature > 0 requires an explicit PRNG key")
     if kv_kernel is None:
         # Kernel only when BOTH layouts are known single-device (None =
         # unknowable under an outer jit -> safe off, as in generate).
