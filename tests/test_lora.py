@@ -118,6 +118,61 @@ def test_sharded_matches_single_device(base, tokens):
     np.testing.assert_allclose(sharded, single, rtol=1e-5)
 
 
+def test_qlora_int8_frozen_base(base, tokens):
+    """QLoRA-style fine-tuning: the FROZEN base rides HBM as int8
+    (~half the bytes of a bf16 base), adapters train in f32 on top.
+    Targeted leaves dequantize into the adapter add, untargeted
+    quantized projections dequantize transiently, and training
+    moves the loss while the zero-init model tracks the (quantized)
+    base's own loss."""
+    from tpu_bootstrap.workload.quant import quantize_params
+
+    qbase = quantize_params(base, head=False)
+    cfg = TrainConfig(model=MODEL, learning_rate=1e-2)
+    step, opt = make_lora_train_step(cfg, build_mesh(MeshConfig()), qbase, LORA)
+    lora = init_lora(qbase, LORA, jax.random.PRNGKey(2))
+
+    # Zero-init: the adapted model IS the dequantized base — its loss
+    # tracks the float base within int8 rounding.
+    eff0 = apply_lora(qbase, lora, LORA)
+    assert float(loss_fn(eff0, tokens, MODEL)) == pytest.approx(
+        float(loss_fn(base, tokens, MODEL)), rel=0.05)
+
+    opt_state = opt.init(lora)
+    first = None
+    for _ in range(10):
+        lora, opt_state, loss = step(lora, opt_state, tokens)
+        first = first if first is not None else float(loss)
+    assert float(loss) < first
+
+    # The resident-memory claim: int8 base blocks stream/store at
+    # roughly half the bytes of a bf16 base (int8 values + small f32
+    # per-channel scales vs 2-byte weights). The decode-only fused
+    # "wqkv" copies are STRIPPED from the closed-over base by
+    # make_lora_train_step itself (a pruned-but-referenced constant
+    # would still hold HBM), so measure exactly what the step closes
+    # over. (At this toy scale the per-channel scales are a visible
+    # fraction; at real widths the ratio approaches 0.5.)
+    def nbytes(blocks):
+        return sum(x.size * x.dtype.itemsize
+                   for x in jax.tree.leaves(blocks))
+
+    resident = [{k: v for k, v in b.items() if k != "wqkv"}
+                for b in qbase["blocks"]]
+    bf16_blocks = jax.tree.map(lambda x: x.astype(jnp.bfloat16),
+                               base["blocks"])
+    assert nbytes(resident) < 0.75 * nbytes(bf16_blocks)
+
+    # Merged serving params are plain float arrays (the stale fused
+    # wqkv cache is dropped); generate runs on them directly.
+    from tpu_bootstrap.workload.decode import generate
+
+    merged = merge_lora(qbase, lora, LORA)
+    assert all("wqkv" not in b for b in merged["blocks"])
+    out = generate(merged, tokens[:2, :4], MODEL, 4)
+    assert out.shape == (2, 4)
+
+
 def test_lora_checkpoint_resume(base, tokens, tmp_path):
     """The generic orbax module checkpoints LoRA state unchanged: resume
     from step 2 replays steps 3-4 bit-for-bit (adapter-sized files — the
@@ -158,8 +213,12 @@ def test_lora_checkpoint_resume(base, tokens, tmp_path):
 def test_rejects_bad_configs(base):
     with pytest.raises(ValueError, match="rank"):
         init_lora(base, LoraConfig(rank=0), jax.random.PRNGKey(0))
-    with pytest.raises(ValueError, match="not in block"):
+    with pytest.raises(ValueError, match="adaptable"):
         init_lora(base, LoraConfig(targets=("nope",)), jax.random.PRNGKey(0))
+    # A real block key that is not an adaptable projection (an adapter
+    # on it would silently never enter the forward) is rejected too.
+    with pytest.raises(ValueError, match="adaptable"):
+        init_lora(base, LoraConfig(targets=("attn_norm",)), jax.random.PRNGKey(0))
     moe_model = ModelConfig(**{**MODEL.__dict__, "num_experts": 2})
     moe_params = init_params(moe_model, jax.random.PRNGKey(0))
     with pytest.raises(ValueError, match="expert"):

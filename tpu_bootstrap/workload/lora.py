@@ -39,6 +39,7 @@ the JAX workload its JobSets launch.
 from __future__ import annotations
 
 import dataclasses
+import math
 
 import jax
 import jax.numpy as jnp
@@ -74,6 +75,14 @@ def init_lora(params: Params, lcfg: LoraConfig, key: jax.Array) -> Params:
         adapters = {}
         tkeys = jax.random.split(bkey, len(lcfg.targets))
         for name, tkey in zip(lcfg.targets, tkeys):
+            if name not in _FORWARD_LEAVES:
+                # apply_lora only folds adapters into the projection
+                # leaves the model forward reads; an adapter on any
+                # other key would silently never enter the forward
+                # (zero gradients, loss never moves).
+                raise ValueError(
+                    f"LoRA target {name!r} is not an adaptable projection "
+                    f"(valid: {list(_FORWARD_LEAVES)})")
             if name not in block:
                 raise ValueError(
                     f"LoRA target {name!r} not in block (have "
@@ -84,8 +93,10 @@ def init_lora(params: Params, lcfg: LoraConfig, key: jax.Array) -> Params:
                     "adapters would need the (E, K, N) layout); target the "
                     "attention projections instead")
             w = block[name]
+            # w.shape is the LOGICAL shape for both plain arrays and
+            # int8 QuantizedWeight bases (quant.QuantizedWeight.shape).
             k_in = w.shape[0] if name != "wo" else w.shape[0] * w.shape[1]
-            n_out = w.size // k_in
+            n_out = math.prod(w.shape) // k_in
             adapters[name] = {
                 "a": jax.random.normal(tkey, (k_in, lcfg.rank), jnp.float32)
                 / jnp.sqrt(jnp.asarray(k_in, jnp.float32)),
@@ -95,21 +106,51 @@ def init_lora(params: Params, lcfg: LoraConfig, key: jax.Array) -> Params:
     return {"blocks": blocks}
 
 
-def _delta(adapter: dict, w: jax.Array, scale: float) -> jax.Array:
-    """(alpha/r) * A @ B, reshaped to w's logical shape and dtype."""
+def _effective(adapter: dict, w, scale: float):
+    """base + (alpha/r) * A @ B in the base's logical shape. The base
+    may be an int8 QuantizedWeight (quant.quantize_block — the
+    QLoRA-style recipe: the FROZEN base rides HBM at 1 byte/element,
+    halving fine-tune residency vs bf16; it is dequantized transiently
+    on the way into each step's projections, never stored in float)."""
+    from tpu_bootstrap.workload import quant
+
+    if quant.is_quantized(w):
+        shape, dtype = w.shape, adapter["a"].dtype
+        base = quant.dequantize_weight(w).reshape(shape)
+    else:
+        shape, dtype = w.shape, w.dtype
+        base = w
     d = (adapter["a"] @ adapter["b"]) * scale
-    return d.reshape(w.shape).astype(w.dtype)
+    return (base + d.reshape(shape).astype(base.dtype)).astype(dtype)
+
+
+_FORWARD_LEAVES = ("wq", "wk", "wv", "wo", "w_up", "w_down")
 
 
 def apply_lora(params: Params, lora: Params, lcfg: LoraConfig) -> Params:
     """Effective params: base + adapter deltas on the targeted leaves.
     Pure function of both pytrees — under jit the rank-r matmuls fuse
-    into the surrounding projections; nothing else is copied."""
+    into the surrounding projections; nothing else is copied.
+
+    Quantized (int8) bases (quant.quantize_params) are supported:
+    targeted leaves dequantize into the adapter add, UNtargeted
+    quantized projections dequantize plain (the model's training
+    forward reads arrays), and the block's fused "wqkv" — a derived
+    cache of the BASE q/k/v that would serve stale logits next to
+    adapted weights — is dropped; re-derive it via quantize_params
+    after merge_lora for serving."""
+    from tpu_bootstrap.workload import quant
+
     blocks = []
     for block, adapters in zip(params["blocks"], lora["blocks"]):
         eff = dict(block)
-        for name, adapter in adapters.items():
-            eff[name] = block[name] + _delta(adapter, block[name], lcfg.scale)
+        eff.pop("wqkv", None)
+        for name in _FORWARD_LEAVES:
+            if name in adapters:
+                eff[name] = _effective(adapters[name], block[name], lcfg.scale)
+            elif name in block and quant.is_quantized(block[name]):
+                w = block[name]
+                eff[name] = quant.dequantize_weight(w).reshape(w.shape)
         blocks.append(eff)
     return {**params, "blocks": blocks}
 
@@ -137,6 +178,17 @@ def make_lora_train_step(cfg, mesh, base_params: Params, lcfg: LoraConfig,
             "LoRA does not compose with pipeline meshes (adapters would "
             "need the stacked per-stage layout); use the GSPMD axes "
             "(data/fsdp/expert/seq/tensor)")
+    # Drop the decode-only fused-QKV copies from the CLOSED-OVER base so
+    # the compiled step never embeds them — XLA pruning an unused
+    # constant does not free the caller's source buffers, so without
+    # this an int8 (QLoRA) base would keep a full duplicate q+k+v per
+    # block resident and the ~0.5x-of-bf16 residency claim would be
+    # overstated. (Callers who keep their own qbase reference still pay
+    # for it; drop it or quantize fresh for fine-tuning.)
+    if any("wqkv" in b for b in base_params["blocks"]):
+        base_params = {**base_params,
+                       "blocks": [{k: v for k, v in b.items() if k != "wqkv"}
+                                  for b in base_params["blocks"]]}
     opt = make_optimizer(cfg)
 
     def loss(lora, inputs, targets):
