@@ -127,7 +127,10 @@ def test_qlora_int8_frozen_base(base, tokens):
     base's own loss."""
     from tpu_bootstrap.workload.quant import quantize_params
 
-    qbase = quantize_params(base, head=False)
+    # head=True (the default): make_lora_train_step must strip the int8
+    # lm_head duplicate from its closure along with the wqkv copies —
+    # the training forward ties the head to params["embed"].
+    qbase = quantize_params(base)
     cfg = TrainConfig(model=MODEL, learning_rate=1e-2)
     step, opt = make_lora_train_step(cfg, build_mesh(MeshConfig()), qbase, LORA)
     lora = init_lora(qbase, LORA, jax.random.PRNGKey(2))

@@ -124,6 +124,24 @@ def test_apply_preserves_server_written_status(store):
     assert got["status"] == {"observed": 1}
 
 
+def test_generation_bumps_on_spec_change_only(store):
+    """metadata.generation follows real apiserver semantics: created at
+    1, bumped by spec changes, untouched by status/metadata-only writes
+    — the observedGeneration idiom the TTL one-shot gate keys off."""
+    _, got = store.server_side_apply(KEY, "cm", obj(replicas=1), "ctl", False)
+    assert got["metadata"]["generation"] == 1
+    _, got = store.server_side_apply(KEY, "cm", obj(replicas=2), "ctl", False)
+    assert got["metadata"]["generation"] == 2
+    # Same spec re-applied: no bump.
+    _, got = store.server_side_apply(KEY, "cm", obj(replicas=2), "ctl", False)
+    assert got["metadata"]["generation"] == 2
+    # Status write through upsert preserves spec -> no bump.
+    live = dict(store.collection(KEY)["cm"])
+    live["status"] = {"observed": 2}
+    got = store.upsert(KEY, "cm", live, preserve_status=False)
+    assert got["metadata"]["generation"] == 2
+
+
 # ---- end-to-end over HTTP: the daemons' actual wire path -------------------
 
 

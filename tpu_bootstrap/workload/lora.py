@@ -83,10 +83,6 @@ def init_lora(params: Params, lcfg: LoraConfig, key: jax.Array) -> Params:
                 raise ValueError(
                     f"LoRA target {name!r} is not an adaptable projection "
                     f"(valid: {list(_FORWARD_LEAVES)})")
-            if name not in block:
-                raise ValueError(
-                    f"LoRA target {name!r} not in block (have "
-                    f"{sorted(k for k in block if not k.endswith('norm'))})")
             if "router" in block and name in ("w_up", "w_down"):
                 raise ValueError(
                     "LoRA on MoE expert stacks is not supported (per-expert "
@@ -178,17 +174,22 @@ def make_lora_train_step(cfg, mesh, base_params: Params, lcfg: LoraConfig,
             "LoRA does not compose with pipeline meshes (adapters would "
             "need the stacked per-stage layout); use the GSPMD axes "
             "(data/fsdp/expert/seq/tensor)")
-    # Drop the decode-only fused-QKV copies from the CLOSED-OVER base so
-    # the compiled step never embeds them — XLA pruning an unused
-    # constant does not free the caller's source buffers, so without
-    # this an int8 (QLoRA) base would keep a full duplicate q+k+v per
-    # block resident and the ~0.5x-of-bf16 residency claim would be
+    # Drop the decode-only leaves from the CLOSED-OVER base — the fused
+    # per-block "wqkv" copies AND the top-level int8 "lm_head" (the
+    # training forward ties the head to params["embed"]; the quantized
+    # head copy is a full vocab x embed duplicate). XLA pruning an
+    # unused constant does not free the caller's source buffers, so
+    # without this an int8 (QLoRA) base would keep those duplicates
+    # resident and the ~0.5x-of-bf16 residency claim would be
     # overstated. (Callers who keep their own qbase reference still pay
     # for it; drop it or quantize fresh for fine-tuning.)
-    if any("wqkv" in b for b in base_params["blocks"]):
-        base_params = {**base_params,
-                       "blocks": [{k: v for k, v in b.items() if k != "wqkv"}
-                                  for b in base_params["blocks"]]}
+    if "lm_head" in base_params or any("wqkv" in b
+                                       for b in base_params["blocks"]):
+        base_params = {
+            **{k: v for k, v in base_params.items() if k != "lm_head"},
+            "blocks": [{k: v for k, v in b.items() if k != "wqkv"}
+                       for b in base_params["blocks"]],
+        }
     opt = make_optimizer(cfg)
 
     def loss(lora, inputs, targets):

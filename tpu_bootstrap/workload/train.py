@@ -571,7 +571,9 @@ def worker_main() -> None:
     ("embed_dim=1024,num_layers=8,vocab_size=32768,vocab_chunk=4096" —
     the MODEL the slice trains), WORKLOAD_MESH ("pipe=2,data=4" — the
     slice's parallelism layout), WORKLOAD_ATTENTION (dense|flash),
-    WORKLOAD_SCHEDULE (gpipe|1f1b), WORKLOAD_MICROBATCHES,
+    WORKLOAD_ATTENTION_BLOCK (flash tile size, default 512),
+    WORKLOAD_REMAT (1|true — rematerialize the loss: the long-context
+    lever), WORKLOAD_SCHEDULE (gpipe|1f1b), WORKLOAD_MICROBATCHES,
     WORKLOAD_LOG_EVERY (progress-line cadence, default 10, 0 = off).
     """
     import os
@@ -612,6 +614,11 @@ def worker_main() -> None:
         total_steps=steps if total_env is None else int(total_env),
         grad_clip_norm=float(os.environ.get("WORKLOAD_GRAD_CLIP", "1.0")),
         attention=os.environ.get("WORKLOAD_ATTENTION", "dense"),
+        attention_block=int(os.environ.get("WORKLOAD_ATTENTION_BLOCK", "512")),
+        # Long-context models need rematerialization — the WORKLOAD_MODEL
+        # knob makes big max_seq_len reachable from the CR, so the remat
+        # lever must be too. "1"/"true" (case-insensitive) enable.
+        remat=os.environ.get("WORKLOAD_REMAT", "").lower() in ("1", "true"),
         pipeline_schedule=os.environ.get("WORKLOAD_SCHEDULE", "gpipe"),
         num_microbatches=int(os.environ.get("WORKLOAD_MICROBATCHES", "0")),
     )
