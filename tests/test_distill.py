@@ -1,0 +1,152 @@
+"""Draft distillation (workload/distill.py): the KL objective falls,
+and the distilled student raises speculative acceptance end-to-end —
+the metric the module exists to move."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_bootstrap.workload.distill import distill_loss, make_distill_step
+from tpu_bootstrap.workload.model import ModelConfig, init_params
+from tpu_bootstrap.workload.sharding import MeshConfig, batch_shardings, build_mesh
+
+TEACHER = ModelConfig(vocab_size=32, num_layers=2, num_heads=4, head_dim=8,
+                      embed_dim=32, mlp_dim=64, max_seq_len=48)
+STUDENT = ModelConfig(vocab_size=32, num_layers=1, num_heads=2, head_dim=4,
+                      embed_dim=16, mlp_dim=32, max_seq_len=48)
+
+
+@pytest.fixture(scope="module")
+def teacher():
+    # A random init is near-uniform over the vocab — nothing to distill
+    # (the soft loss would sit at the teacher-entropy floor). Scaling
+    # the tied embedding x30 sharpens the conditionals into
+    # input-dependent, PEAKED distributions (mean max-prob ~0.8),
+    # giving the student real signal — the toy stand-in for a trained
+    # teacher. (x3 measured max-prob 0.06: still uniform.)
+    params = init_params(TEACHER, jax.random.PRNGKey(0))
+    return {**params, "embed": params["embed"] * 30.0}
+
+
+def _batch(i):
+    return jax.random.randint(jax.random.PRNGKey(100 + i), (8, 24), 0, 32)
+
+
+def test_distill_loss_falls_and_student_tracks_teacher(teacher):
+    mesh = build_mesh(MeshConfig())
+    step, opt = make_distill_step(STUDENT, teacher, TEACHER, mesh,
+                                  learning_rate=3e-3, temperature=2.0)
+    student = init_params(STUDENT, jax.random.PRNGKey(1))
+    opt_state = opt.init(student)
+    first = None
+    for i in range(60):
+        student, opt_state, loss = step(student, opt_state, _batch(i % 4))
+        first = first if first is not None else float(loss)
+    assert float(loss) < first - 0.5, (first, float(loss))
+    # The loss at T=1 upper-bounds teacher entropy; tracking means the
+    # gap (the actual KL) shrank — spot-check on held-out tokens.
+    held = _batch(999)
+    kl_end = float(distill_loss(student, teacher, held, STUDENT, TEACHER))
+    kl_start = float(distill_loss(init_params(STUDENT, jax.random.PRNGKey(1)),
+                                  teacher, held, STUDENT, TEACHER))
+    assert kl_end < kl_start
+
+
+def test_distilled_draft_raises_speculative_acceptance(teacher):
+    """The end-to-end payoff: a distilled draft commits meaningfully
+    more tokens per verify round than its random init (whose proposals
+    almost never match a 32-way argmax)."""
+    from tpu_bootstrap.workload.speculative import speculative_generate
+
+    mesh = build_mesh(MeshConfig())
+    # T < 1 sharpens the soft targets toward the teacher's argmax — the
+    # right setting when the goal is DRAFT acceptance (top-1 agreement)
+    # rather than calibrated distributions. Measured here: T=0.7 for
+    # 300 steps reaches full acceptance (5.0 committed/round at
+    # gamma=4) where the random init sits at ~1.0.
+    step, opt = make_distill_step(STUDENT, teacher, TEACHER, mesh,
+                                  learning_rate=5e-3, temperature=0.7)
+    random_student = init_params(STUDENT, jax.random.PRNGKey(1))
+    prompt = jax.random.randint(jax.random.PRNGKey(7), (2, 6), 0, 32)
+
+    def acceptance(draft):
+        out, stats = speculative_generate(teacher, draft, prompt, TEACHER,
+                                          STUDENT, steps=30, gamma=4,
+                                          with_stats=True)
+        # Exactness holds for ANY draft; acceptance is what moves.
+        from tpu_bootstrap.workload.decode import generate
+
+        np.testing.assert_array_equal(
+            np.asarray(out), np.asarray(generate(teacher, prompt, TEACHER, 30)))
+        return float(stats["mean_committed"])
+
+    # Measure the random init BEFORE training: the step donates its
+    # input buffers, so the first training call consumes them.
+    before = acceptance(random_student)
+    student, opt_state = random_student, opt.init(random_student)
+    for i in range(300):
+        student, opt_state, _ = step(student, opt_state, _batch(i % 8))
+    after = acceptance(student)
+    # Conservative bar (measured ~1.0 -> 5.0): distillation must move
+    # the serving metric, not just the training loss.
+    assert after > before + 0.5, (before, after)
+
+
+def test_hard_label_mix(teacher):
+    """hard_weight mixes the ordinary next-token cross-entropy (at T=1,
+    on the data labels) into the soft loss, additively and linearly —
+    pinned against composing the two pieces directly."""
+    from tpu_bootstrap.workload.model import loss_fn
+
+    student = init_params(STUDENT, jax.random.PRNGKey(1))
+    tokens = _batch(0)
+    soft = float(distill_loss(student, teacher, tokens, STUDENT, TEACHER,
+                              temperature=2.0))
+    mixed = float(distill_loss(student, teacher, tokens, STUDENT, TEACHER,
+                               temperature=2.0, hard_weight=0.3))
+    hard = float(loss_fn(student, tokens, STUDENT))
+    assert mixed == pytest.approx(soft + 0.3 * hard, rel=1e-5)
+    # The mixed objective also trains.
+    mesh = build_mesh(MeshConfig())
+    step, opt = make_distill_step(STUDENT, teacher, TEACHER, mesh,
+                                  learning_rate=3e-3, temperature=2.0,
+                                  hard_weight=0.3)
+    opt_state = opt.init(student)
+    first = None
+    for i in range(30):
+        student, opt_state, loss = step(student, opt_state, _batch(i % 4))
+        first = first if first is not None else float(loss)
+    assert float(loss) < first
+
+
+def test_rejects_bad_configs(teacher):
+    mesh = build_mesh(MeshConfig())
+    odd = ModelConfig(**{**STUDENT.__dict__, "vocab_size": 16})
+    with pytest.raises(ValueError, match="vocab"):
+        make_distill_step(odd, teacher, TEACHER, mesh)
+    with pytest.raises(ValueError, match="temperature"):
+        make_distill_step(STUDENT, teacher, TEACHER, mesh, temperature=0)
+
+
+def test_sharded_matches_single_device(teacher):
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+
+    def run(mesh_cfg):
+        mesh = build_mesh(mesh_cfg)
+        step, opt = make_distill_step(STUDENT, teacher, TEACHER, mesh,
+                                      learning_rate=3e-3)
+        student = init_params(STUDENT, jax.random.PRNGKey(1))
+        opt_state = opt.init(student)
+        losses = []
+        for i in range(3):
+            toks = _batch(i)
+            if mesh_cfg.size > 1:
+                toks = jax.device_put(toks, batch_shardings(mesh))
+            student, opt_state, loss = step(student, opt_state, toks)
+            losses.append(float(loss))
+        return losses
+
+    np.testing.assert_allclose(run(MeshConfig(data=2, fsdp=2, tensor=2)),
+                               run(MeshConfig()), rtol=2e-5)
