@@ -205,6 +205,24 @@ float(jnp.sum(jnp.ones((128, 128), jnp.bfloat16) @ jnp.ones((128, 128), jnp.bflo
 out["chip_alive"] = True
 emit()
 
+# Early Mosaic smoke for the decode-attention kernel (tiny shapes, fast
+# compile): its first-ever hardware compile happens here rather than
+# deep inside the int8-KV decode section, so a Mosaic rejection shows
+# up as one labeled boolean instead of a lost section.
+try:
+    from tpu_bootstrap.workload.decode_attention import decode_attention_int8
+
+    _q = jnp.ones((1, 4, 64), jnp.bfloat16)
+    _kq = jnp.ones((1, 32, 2, 64), jnp.int8)
+    _ks = jnp.ones((1, 32, 2), jnp.float32)
+    float(jnp.sum(decode_attention_int8(
+        _q, _kq, _ks, _kq, _ks, jnp.arange(32) < 20).astype(jnp.float32)))
+    out["decode_kernel_mosaic_ok"] = True
+except Exception as e:  # noqa: BLE001
+    out["decode_kernel_mosaic_ok"] = False
+    out["decode_kernel_mosaic_error"] = f"{type(e).__name__}: {e}"[:300]
+emit()
+
 PEAK_BF16 = 197e12  # v5e chip peak, bf16
 
 try:

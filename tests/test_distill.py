@@ -127,6 +127,16 @@ def test_rejects_bad_configs(teacher):
         make_distill_step(odd, teacher, TEACHER, mesh)
     with pytest.raises(ValueError, match="temperature"):
         make_distill_step(STUDENT, teacher, TEACHER, mesh, temperature=0)
+    # distill_loss is public API: the same guard must hold when called
+    # directly (temperature=0 would silently produce inf/NaN).
+    with pytest.raises(ValueError, match="temperature"):
+        distill_loss(init_params(STUDENT, jax.random.PRNGKey(1)), teacher,
+                     _batch(0), STUDENT, TEACHER, temperature=0)
+    # An MoE student would train with zero load-balancing aux (router
+    # collapse) — rejected; draft students are dense by design.
+    moe = ModelConfig(**{**STUDENT.__dict__, "num_experts": 2})
+    with pytest.raises(ValueError, match="MoE"):
+        make_distill_step(moe, teacher, TEACHER, mesh)
 
 
 def test_sharded_matches_single_device(teacher):

@@ -46,6 +46,8 @@ def distill_loss(student_params: Params, teacher_params: Params,
     KL(p_T || p_S) up to the teacher-entropy constant, so its gradients
     ARE the KL gradients), scaled by T^2; plus `hard_weight` times the
     ordinary next-token cross-entropy on the data labels."""
+    if temperature <= 0:
+        raise ValueError(f"temperature must be > 0, got {temperature}")
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
     t_logits = jax.lax.stop_gradient(
         forward(teacher_params, inputs, teacher_cfg))
@@ -75,7 +77,27 @@ def make_distill_step(student_cfg: ModelConfig, teacher_params: Params,
             f"{student_cfg.vocab_size} vs {teacher_cfg.vocab_size}")
     if temperature <= 0:
         raise ValueError(f"temperature must be > 0, got {temperature}")
+    if student_cfg.num_experts > 0:
+        # distill_loss trains through raw logits and would silently drop
+        # the MoE load-balancing aux (router collapse); draft students
+        # are dense by design — an MoE teacher is fine (frozen, its aux
+        # is a training regularizer).
+        raise ValueError(
+            "MoE students are not supported (the distillation loss "
+            "carries no load-balancing aux); use a dense student_cfg")
     opt = optax.adamw(learning_rate, weight_decay=weight_decay)
+
+    if not degenerate_mesh(mesh):
+        # The TEACHER — much larger than the student, the premise of
+        # draft distillation — is laid out onto the mesh BEFORE the
+        # closure captures it: an uncommitted closure constant would be
+        # replicated per device, defeating fsdp exactly where
+        # distillation needs it.
+        from tpu_bootstrap.workload.sharding import param_shardings
+
+        teacher_params = jax.tree.map(
+            jax.device_put, teacher_params,
+            param_shardings(mesh, teacher_params))
 
     def loss(student, tokens):
         return distill_loss(student, teacher_params, tokens, student_cfg,
@@ -90,8 +112,7 @@ def make_distill_step(student_cfg: ModelConfig, teacher_params: Params,
     if degenerate_mesh(mesh):
         return jax.jit(step, donate_argnums=(0, 1)), opt
     # The student is tiny next to the teacher: replicate it, shard the
-    # batch — GSPMD shards the teacher forward through the closure's
-    # committed shardings.
+    # batch; the teacher was committed to its param shardings above.
     return jax.jit(
         step,
         in_shardings=(replicated(mesh), None, batch_shardings(mesh)),
