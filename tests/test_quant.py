@@ -155,3 +155,94 @@ def test_lm_head_quantization():
     want = _logits(params, x)
     assert got.shape == want.shape
     assert float(jnp.max(jnp.abs(got - want))) < 0.35
+
+
+# ---- int4 (nibble-packed, group-wise scales) -------------------------------
+
+
+def test_int4_pack_roundtrip_error_bound():
+    """dequantize(quantize4(w)) stays within the group-wise int4 step
+    (absmax/7 per (group, channel) half-step)."""
+    from tpu_bootstrap.workload.quant import dequantize_weight4, quantize_weight4
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (128, 96), jnp.float32)
+    qw = quantize_weight4(w, group=32)
+    back = dequantize_weight4(qw)
+    step = np.asarray(
+        jnp.repeat(jnp.max(jnp.abs(w.reshape(4, 32, 96)), axis=1), 32, axis=0)
+    ) / 7.0
+    assert np.all(np.abs(np.asarray(back) - np.asarray(w)) <= step / 2 + 1e-6)
+    # Packing really is half a byte per element (+ scales).
+    assert qw.q.shape == (64, 96) and qw.q.dtype == jnp.uint8
+    assert qw.s.shape == (4, 96)
+
+
+def test_int4_kernel_matches_dequant_oracle():
+    """int4_matmul == x @ dequantize_weight4 up to the kernel's bf16
+    operand rounding (the same contract as the int8 kernel)."""
+    from tpu_bootstrap.workload.quant import (dequantize_weight4, int4_matmul,
+                                              quantize_weight4)
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (10, 128), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(2), (128, 200), jnp.float32)
+    qw = quantize_weight4(w, group=64)
+    got = int4_matmul(x, qw)
+    want = jnp.dot(x.astype(jnp.bfloat16),
+                   dequantize_weight4(qw).astype(jnp.bfloat16),
+                   preferred_element_type=jnp.float32).astype(x.dtype)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_int4_rejections():
+    from tpu_bootstrap.workload.quant import (int4_matmul, quantize_block4,
+                                              quantize_weight4)
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (66, 8), jnp.float32)
+    with pytest.raises(ValueError, match="divisible"):
+        quantize_weight4(w, group=64)  # 66 % 64 != 0
+    ok = quantize_weight4(jax.random.normal(jax.random.PRNGKey(0), (64, 8)),
+                          group=32)
+    with pytest.raises(ValueError, match="contraction"):
+        int4_matmul(jnp.ones((2, 32)), ok)
+    moe_block = {"router": None}
+    with pytest.raises(ValueError, match="MoE"):
+        quantize_block4(moe_block)
+
+
+def test_int4_model_level_semantics_and_quality():
+    """Model-level contract, in two halves. Semantics: the kernel path's
+    prefill logits match the SAME int4 values run as plain dequantized
+    arrays through the float matmul — within the kernel's bf16-operand
+    rounding — so the kernel introduces no semantics beyond the
+    quantization itself. Quality: int4 at group 32 still tracks the
+    float model's logits closely on the toy config."""
+    from tpu_bootstrap.workload.decode import generate, init_cache, prefill
+    from tpu_bootstrap.workload.quant import (dequantize_weight4,
+                                              quantize_params4)
+
+    cfg = ModelConfig(vocab_size=64, num_layers=2, num_heads=4, head_dim=16,
+                      embed_dim=64, mlp_dim=128, max_seq_len=32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    q4 = quantize_params4(params, group=32, head=False)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 6), 0, 64)
+
+    out = generate(q4, prompt, cfg, 6)
+    assert out.shape == (2, 6)
+
+    # Semantics: same int4 VALUES as plain arrays (float matmul path).
+    deq = {**params, "blocks": [
+        {k: (dequantize_weight4(v).reshape(v.shape)
+             if hasattr(v, "group") else v)
+         for k, v in b.items()} for b in q4["blocks"]]}
+    lq, _ = prefill(q4, prompt, init_cache(cfg, 2, 12), cfg)
+    ld, _ = prefill(deq, prompt, init_cache(cfg, 2, 12), cfg)
+    np.testing.assert_allclose(np.asarray(lq), np.asarray(ld),
+                               rtol=3e-2, atol=3e-2)
+
+    # Quality: int4 logits correlate strongly with the float model's.
+    lf, _ = prefill(params, prompt, init_cache(cfg, 2, 12), cfg)
+    corr = np.corrcoef(np.asarray(lq).ravel(), np.asarray(lf).ravel())[0, 1]
+    assert corr > 0.98, corr
+    # head=True (default) stores the finer int8 head copy alongside.
+    assert "lm_head" in quantize_params4(params, group=32)

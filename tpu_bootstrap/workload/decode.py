@@ -63,7 +63,7 @@ def _linear(x: jax.Array, w, contract_rank: int, dtype) -> jax.Array:
     k = math.prod(w.shape[:contract_rank])
     x2 = x.reshape(-1, k).astype(dtype)
     if quant.is_quantized(w):
-        y = quant.int8_matmul(x2, w)
+        y = quant.quantized_matmul(x2, w)
     else:
         y = x2 @ w.astype(dtype).reshape(k, -1)
     return y.reshape(*x.shape[: x.ndim - contract_rank], *w.shape[contract_rank:])

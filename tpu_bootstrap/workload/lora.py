@@ -112,7 +112,7 @@ def _effective(adapter: dict, w, scale: float):
 
     if quant.is_quantized(w):
         shape, dtype = w.shape, adapter["a"].dtype
-        base = quant.dequantize_weight(w).reshape(shape)
+        base = quant.dequantize_any(w).reshape(shape)
     else:
         shape, dtype = w.shape, w.dtype
         base = w
@@ -146,7 +146,7 @@ def apply_lora(params: Params, lora: Params, lcfg: LoraConfig) -> Params:
                 eff[name] = _effective(adapters[name], block[name], lcfg.scale)
             elif name in block and quant.is_quantized(block[name]):
                 w = block[name]
-                eff[name] = quant.dequantize_weight(w).reshape(w.shape)
+                eff[name] = quant.dequantize_any(w).reshape(w.shape)
         blocks.append(eff)
     return {**params, "blocks": blocks}
 

@@ -37,6 +37,7 @@ the JAX workload its JobSets launch.
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -181,6 +182,135 @@ def int8_expert_matmul(x: jax.Array, qw: QuantizedWeight, *, block_n: int = 512,
     return out[:, :t, :n]
 
 
+@dataclasses.dataclass
+class Quantized4Weight:
+    """int4 values nibble-packed two-per-byte along the contraction
+    axis, with GROUP-wise scales (per (K-group, output channel) — int4's
+    dynamic range is too coarse for whole-column scales). ``shape`` is
+    the original logical shape, static pytree metadata."""
+
+    q: jax.Array  # uint8 (K/2, N): low nibble = even k, high = odd k
+    s: jax.Array  # f32 (K/group, N)
+    group: int    # static K-group size
+    shape: tuple  # original logical shape, static
+
+
+jax.tree_util.register_dataclass(
+    Quantized4Weight, data_fields=["q", "s"], meta_fields=["group", "shape"])
+
+
+def quantize_weight4(w: jax.Array, group: int = 64) -> Quantized4Weight:
+    """w: (K, N) float -> nibble-packed int4 with symmetric per-(group,
+    channel) scales. K must be even and divisible by `group`."""
+    k, n = w.shape
+    if k % 2 != 0 or group % 2 != 0 or k % group != 0:
+        raise ValueError(
+            f"int4 packing needs K ({k}) even and divisible by an even "
+            f"group ({group})")
+    wf = w.astype(jnp.float32).reshape(k // group, group, n)
+    absmax = jnp.max(jnp.abs(wf), axis=1, keepdims=True)  # (K/g, 1, N)
+    scale = jnp.where(absmax > 0, absmax / 7.0, 1.0)
+    q = jnp.clip(jnp.round(wf / scale), -7, 7).astype(jnp.int32).reshape(k, n)
+    u = (q + 8).astype(jnp.uint8)  # nibbles in [1, 15]
+    packed = (u[0::2] | (u[1::2] << 4)).astype(jnp.uint8)  # (K/2, N)
+    return Quantized4Weight(q=packed, s=scale[:, 0], group=group,
+                            shape=tuple(w.shape))
+
+
+def dequantize_weight4(qw: Quantized4Weight) -> jax.Array:
+    """(K, N) f32 reconstruction — the oracle the kernel is tested
+    against and the fallback for consumers that need a plain array."""
+    lo = (qw.q & 0xF).astype(jnp.int32) - 8
+    hi = (qw.q >> 4).astype(jnp.int32) - 8
+    k2, n = qw.q.shape
+    w = jnp.stack([lo, hi], axis=1).reshape(2 * k2, n).astype(jnp.float32)
+    return (w.reshape(-1, qw.group, n) * qw.s[:, None, :]).reshape(2 * k2, n)
+
+
+def _matmul4_kernel(x_ref, q_ref, s_ref, o_ref, *, group):
+    # Unpack nibbles in VMEM: the weight never exists in HBM at more
+    # than half a byte per element. Even k rides the low nibble.
+    q = q_ref[:]
+    lo = (q & 0xF).astype(jnp.int8) - 8
+    hi = (q >> 4).astype(jnp.int8) - 8
+    k2, bn = q.shape
+    w = jnp.stack([lo, hi], axis=1).reshape(2 * k2, bn).astype(jnp.float32)
+    w = (w.reshape(-1, group, bn) * s_ref[:][:, None, :]).reshape(2 * k2, bn)
+    acc = jax.lax.dot_general(
+        x_ref[:].astype(jnp.bfloat16), w.astype(jnp.bfloat16),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[:] = acc.astype(o_ref.dtype)
+
+
+def int4_matmul(x: jax.Array, qw: Quantized4Weight, *, block_n: int = 512,
+                interpret: bool | None = None) -> jax.Array:
+    """x (T, K) @ dequant(qw) (K, N) -> (T, N) in x.dtype, streaming the
+    weight at 0.5 bytes/element + the small group scales."""
+    if interpret is None:
+        interpret = _interpret_default()
+    t, k2 = x.shape[0], qw.q.shape[0]
+    k = 2 * k2
+    if x.shape[1] != k:
+        raise ValueError(f"contraction mismatch: x has K={x.shape[1]}, "
+                         f"weight has K={k}")
+    n = qw.q.shape[1]
+    t_pad = -(-t // 8) * 8
+    bn = min(block_n, -(-n // 128) * 128)
+    n_pad = -(-n // bn) * bn
+    xp = jnp.pad(x, ((0, t_pad - t), (0, 0))) if t_pad != t else x
+    q, s = qw.q, qw.s
+    if n_pad != n:
+        q = jnp.pad(q, ((0, 0), (0, n_pad - n)))
+        s = jnp.pad(s, ((0, 0), (0, n_pad - n)))
+
+    out = pl.pallas_call(
+        functools.partial(_matmul4_kernel, group=qw.group),
+        grid=(n_pad // bn,),
+        in_specs=[
+            pl.BlockSpec((t_pad, k), lambda j: (0, 0)),
+            pl.BlockSpec((k2, bn), lambda j: (0, j)),
+            pl.BlockSpec((k // qw.group, bn), lambda j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((t_pad, bn), lambda j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((t_pad, n_pad), x.dtype),
+        compiler_params=pltpu.CompilerParams(dimension_semantics=("parallel",)),
+        interpret=interpret,
+    )(xp, q, s)
+    return out[:t, :n]
+
+
+def quantize_block4(block: dict, group: int = 64) -> dict:
+    """int4 counterpart of quantize_block for DENSE blocks (MoE expert
+    stacks are rejected — per-expert int4 grouping is unimplemented).
+    No fused QKV: int4 is the extreme-bandwidth option and keeps the
+    minimal surface."""
+    if "router" in block:
+        raise ValueError("int4 quantization does not support MoE blocks")
+    out = dict(block)
+    for name, contract_rank in (("wq", 1), ("wk", 1), ("wv", 1), ("wo", 2),
+                                ("w_up", 1), ("w_down", 1)):
+        out[name] = _q2d(block[name], contract_rank,
+                         quantize=functools.partial(quantize_weight4,
+                                                    group=group))
+    return out
+
+
+def quantize_params4(params: dict, *, group: int = 64,
+                     head: bool = True) -> dict:
+    """Params pytree -> dense block projections int4-quantized (the
+    decode._linear seam detects Quantized4Weight like QuantizedWeight).
+    head=True stores the logits head as the INT8 copy (``lm_head``, as
+    in quantize_params) — int4's coarseness costs the most exactly
+    where the softmax decides, so the head keeps the finer format."""
+    out = {**params, "blocks": [quantize_block4(b, group)
+                                for b in params["blocks"]]}
+    if head:
+        out["lm_head"] = quantize_weight(params["embed"].T)
+    return out
+
+
 def reference_int8_matmul(x: jax.Array, qw: QuantizedWeight) -> jax.Array:
     """Oracle mirroring the kernel's arithmetic order (bf16 operands,
     f32 accumulation, per-channel scale applied after the matmul) —
@@ -193,13 +323,16 @@ def reference_int8_matmul(x: jax.Array, qw: QuantizedWeight) -> jax.Array:
     return (acc * qw.s).astype(x.dtype)
 
 
-def _q2d(w, contract_rank):
+def _q2d(w, contract_rank, quantize=None):
     """Flatten a projection to 2-D matmul layout (contraction axes first)
-    and quantize; the original logical shape rides in the wrapper."""
+    and quantize; the original logical shape rides in the wrapper. The
+    ONE definition of the flattening convention — `quantize` selects the
+    format (default int8 per-channel; int4 passes quantize_weight4) so
+    the int8/int4 layouts cannot diverge."""
     k = 1
     for d in w.shape[:contract_rank]:
         k *= d
-    qw = quantize_weight(w.reshape(k, -1))
+    qw = (quantize or quantize_weight)(w.reshape(k, -1))
     return dataclasses.replace(qw, shape=tuple(w.shape))
 
 
@@ -254,18 +387,43 @@ def quantize_params(params: dict, *, head: bool = True) -> dict:
 
 
 def is_quantized(w) -> bool:
-    return isinstance(w, QuantizedWeight)
+    return isinstance(w, (QuantizedWeight, Quantized4Weight))
+
+
+def quantized_matmul(x2: jax.Array, w) -> jax.Array:
+    """Route a 2-D activation through whichever quantized kernel matches
+    the weight — the single dispatch the decode._linear seam calls."""
+    if isinstance(w, Quantized4Weight):
+        return int4_matmul(x2, w)
+    return int8_matmul(x2, w)
+
+
+def dequantize_any(w) -> jax.Array:
+    """(K, N) f32 reconstruction for either quantized format — the
+    dispatch consumers that need a plain array (lora's QLoRA base)
+    call."""
+    if isinstance(w, Quantized4Weight):
+        return dequantize_weight4(w)
+    return dequantize_weight(w)
 
 
 __all__ = [
+    "Quantized4Weight",
     "QuantizedWeight",
     "dequantize_weight",
+    "dequantize_any",
+    "dequantize_weight4",
+    "int4_matmul",
     "int8_expert_matmul",
     "int8_matmul",
     "quantize_expert_weight",
     "is_quantized",
     "quantize_block",
+    "quantize_block4",
     "quantize_params",
+    "quantize_params4",
     "quantize_weight",
+    "quantize_weight4",
+    "quantized_matmul",
     "reference_int8_matmul",
 ]
