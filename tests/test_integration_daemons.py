@@ -758,6 +758,56 @@ def test_revocation_tears_down_access_and_slice(fake, tmp_path):
             assert code == 0, err
 
 
+def test_ttl_one_shot_through_daemon(fake):
+    """The TTL recreate-loop fix, end to end through the controller
+    binary: a TTL'd slice that completes and is GC-deleted (as JobSet's
+    ttlSecondsAfterFinished would) must NOT be recreated by later
+    resyncs, the terminal phase must stick — and a spec edit
+    (generation bump) must reopen the gate and reprovision."""
+    spec = full_spec()
+    spec["tpu"]["ttl_seconds_after_finished"] = 600
+    fake.create_ub("alice", spec=spec, status=dict(SYNCED))
+    port = free_port()
+    d = Daemon("tpubc-controller",
+               controller_env(fake, port, conf_requeue_secs=1), port).wait_healthy()
+    try:
+        js = wait_for(lambda: fake.get(KEY_JS("alice"), "alice-slice"),
+                      desc="jobset")
+        assert js["spec"]["ttlSecondsAfterFinished"] == 600
+
+        # The slice finishes: the JobSet controller would set Completed.
+        done = dict(js)
+        done["status"] = {"conditions": [{"type": "Completed", "status": "True"}]}
+        fake.store.upsert(KEY_JS("alice"), "alice-slice", done,
+                          preserve_status=False)
+        wait_for(
+            lambda: (fake.get(fake.KEY_UB, "alice") or {}).get("status", {})
+            .get("slice", {}).get("phase") == "Succeeded",
+            desc="phase Succeeded",
+        )
+
+        # TTL GC deletes the finished JobSet.
+        fake.store.delete(KEY_JS("alice"), "alice-slice")
+        # Several 1s resyncs later: NOT recreated, phase still terminal.
+        time.sleep(3)
+        assert fake.get(KEY_JS("alice"), "alice-slice") is None
+        ub = fake.get(fake.KEY_UB, "alice")
+        assert ub["status"]["slice"]["phase"] == "Succeeded"
+
+        # Operator edits the spec (new run): generation bumps past the
+        # recorded observed_generation and the slice reprovisions.
+        ub2 = dict(ub)
+        ub2["spec"] = dict(ub2["spec"])
+        ub2["spec"]["tpu"] = {**ub2["spec"]["tpu"],
+                              "env": {"WORKLOAD_STEPS": "7"}}
+        fake.store.upsert(fake.KEY_UB, "alice", ub2)
+        wait_for(lambda: fake.get(KEY_JS("alice"), "alice-slice"),
+                 desc="jobset reprovisioned after spec edit")
+    finally:
+        code, err = d.stop()
+        assert code == 0, err
+
+
 def test_synchronizer_leader_election(fake, tmp_path):
     """With CONF_LEADER_ELECT=1 and two replicas, only the lease holder
     syncs — the standby serves /health but writes nothing until it wins."""

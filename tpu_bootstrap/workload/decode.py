@@ -57,9 +57,9 @@ from tpu_bootstrap.workload.model import (
 
 def _linear(x: jax.Array, w, contract_rank: int, dtype) -> jax.Array:
     """Projection of x's trailing dims against w's leading dims, for
-    float weights or int8-quantized ones (workload/quant.py) — the one
-    seam through which weight-only quantization reaches every block
-    projection."""
+    float weights or quantized ones (int8/int4, workload/quant.py) —
+    the one seam through which weight-only quantization reaches every
+    block projection."""
     k = math.prod(w.shape[:contract_rank])
     x2 = x.reshape(-1, k).astype(dtype)
     if quant.is_quantized(w):

@@ -104,10 +104,10 @@ def init_lora(params: Params, lcfg: LoraConfig, key: jax.Array) -> Params:
 
 def _effective(adapter: dict, w, scale: float):
     """base + (alpha/r) * A @ B in the base's logical shape. The base
-    may be an int8 QuantizedWeight (quant.quantize_block — the
-    QLoRA-style recipe: the FROZEN base rides HBM at 1 byte/element,
-    halving fine-tune residency vs bf16; it is dequantized transiently
-    on the way into each step's projections, never stored in float)."""
+    may be quantized — int8 QuantizedWeight or int4 Quantized4Weight
+    (the QLoRA-style recipe: the FROZEN base rides HBM at 1 or 0.5
+    bytes/element; it is dequantized transiently on the way into each
+    step's projections, never stored in float)."""
     from tpu_bootstrap.workload import quant
 
     if quant.is_quantized(w):
@@ -128,7 +128,8 @@ def apply_lora(params: Params, lora: Params, lcfg: LoraConfig) -> Params:
     Pure function of both pytrees — under jit the rank-r matmuls fuse
     into the surrounding projections; nothing else is copied.
 
-    Quantized (int8) bases (quant.quantize_params) are supported:
+    Quantized bases (int8 quantize_params / int4 quantize_params4) are
+    supported:
     targeted leaves dequantize into the adapter add, UNtargeted
     quantized projections dequantize plain (the model's training
     forward reads arrays), and the block's fused "wqkv" — a derived

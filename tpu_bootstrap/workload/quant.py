@@ -80,6 +80,17 @@ def dequantize_weight(qw: QuantizedWeight) -> jax.Array:
     return qw.q.astype(jnp.float32) * qw.s
 
 
+def _tile_pads(t: int, n: int, block_n: int):
+    """The ONE tile-alignment convention for every quantized matmul:
+    T pads to the f32 sublane (8), N to a lane-aligned block that
+    divides the padded extent. int8, expert, and int4 kernels all align
+    through here so the convention cannot diverge."""
+    t_pad = -(-t // 8) * 8
+    bn = min(block_n, -(-n // 128) * 128)
+    n_pad = -(-n // bn) * bn
+    return t_pad, bn, n_pad
+
+
 def _matmul_kernel(x_ref, q_ref, s_ref, o_ref):
     # Dequant fused into the matmul: int8 -> bf16 happens in VMEM, the
     # MXU accumulates f32, per-channel scales apply on the way out.
@@ -106,9 +117,7 @@ def int8_matmul(x: jax.Array, qw: QuantizedWeight, *, block_n: int = 512,
     if k != kq:
         raise ValueError(f"contraction mismatch: x has K={k}, weight has K={kq}")
 
-    t_pad = -(-t // 8) * 8
-    bn = min(block_n, -(-n // 128) * 128)
-    n_pad = -(-n // bn) * bn
+    t_pad, bn, n_pad = _tile_pads(t, n, block_n)
     xp = jnp.pad(x, ((0, t_pad - t), (0, 0))) if t_pad != t else x
     q = qw.q
     s = qw.s
@@ -156,9 +165,7 @@ def int8_expert_matmul(x: jax.Array, qw: QuantizedWeight, *, block_n: int = 512,
     if (e, k) != (eq, kq):
         raise ValueError(f"expert/contraction mismatch: x {x.shape}, weight {qw.q.shape}")
 
-    t_pad = -(-t // 8) * 8
-    bn = min(block_n, -(-n // 128) * 128)
-    n_pad = -(-n // bn) * bn
+    t_pad, bn, n_pad = _tile_pads(t, n, block_n)
     xp = jnp.pad(x, ((0, 0), (0, t_pad - t), (0, 0))) if t_pad != t else x
     q, s = qw.q, qw.s
     if n_pad != n:
@@ -256,9 +263,7 @@ def int4_matmul(x: jax.Array, qw: Quantized4Weight, *, block_n: int = 512,
         raise ValueError(f"contraction mismatch: x has K={x.shape[1]}, "
                          f"weight has K={k}")
     n = qw.q.shape[1]
-    t_pad = -(-t // 8) * 8
-    bn = min(block_n, -(-n // 128) * 128)
-    n_pad = -(-n // bn) * bn
+    t_pad, bn, n_pad = _tile_pads(t, n, block_n)
     xp = jnp.pad(x, ((0, t_pad - t), (0, 0))) if t_pad != t else x
     q, s = qw.q, qw.s
     if n_pad != n:
