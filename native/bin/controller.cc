@@ -351,6 +351,7 @@ bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::s
   }
 
   log_info("reconciling", {{"name", name}});
+  const std::string ns = target_namespace(ub);
   std::vector<Json> children = desired_children(ub, cfg.core);
   Json applied_jobset;  // the apply response doubles as the observation
   bool have_applied_jobset = false;
@@ -366,13 +367,23 @@ bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::s
   // {RoleBinding, JobSet}. Worst case cost is 3 API round-trips instead
   // of the reference's 4-5 sequential ones (controller.rs:81-149), and
   // within each wave the applies overlap on pooled connections.
+  // Kind of the wave member whose apply threw (the immutable-rejection
+  // fallback below must only ever act on the JOBSET's own failure — a
+  // RoleBinding denied by a policy webhook in the same wave must not get
+  // a live workload deleted).
+  std::string wave_failed_kind;
   auto apply_wave = [&](const std::vector<const Json*>& wave) {
     if (wave.size() == 1) {  // no point paying a thread spawn for one call
-      Json resp = client.apply(*wave[0], kFieldManager, /*force=*/true);
-      Metrics::instance().inc("applies_total");
-      if (wave[0]->get("kind").as_string() == "JobSet") {
-        applied_jobset = std::move(resp);
-        have_applied_jobset = true;
+      try {
+        Json resp = client.apply(*wave[0], kFieldManager, /*force=*/true);
+        Metrics::instance().inc("applies_total");
+        if (wave[0]->get("kind").as_string() == "JobSet") {
+          applied_jobset = std::move(resp);
+          have_applied_jobset = true;
+        }
+      } catch (...) {
+        wave_failed_kind = wave[0]->get("kind").as_string();
+        throw;
       }
       return;
     }
@@ -395,18 +406,54 @@ bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::s
     for (size_t i = 1; i < wave.size(); ++i) appliers.emplace_back(apply_one, i);
     apply_one(0);  // the calling thread takes a share instead of idling
     for (auto& t : appliers) t.join();
-    for (auto& err : errors) {
-      if (err) std::rethrow_exception(err);  // first failure -> error requeue
+    for (size_t i = 0; i < wave.size(); ++i) {
+      if (errors[i]) {  // first failure -> error requeue
+        wave_failed_kind = wave[i]->get("kind").as_string();
+        std::rethrow_exception(errors[i]);
+      }
     }
   };
 
+  // Best-effort JobSet deletion shared by the recreate paths and the
+  // revocation prune: absent is success (the point is that it be gone).
+  auto remove_jobset = [&](const std::string& js_name) {
+    try {
+      client.remove("jobset.x-k8s.io/v1alpha2", "JobSet", ns, js_name);
+      return true;
+    } catch (const KubeError& e) {
+      if (e.status != 404) throw;
+      return false;
+    }
+  };
+  // The JobSet name the controller's own record points at (falls back to
+  // the deterministic name for status written before the record existed).
+  const std::string recorded_jobset = [&] {
+    const std::string js = ub.get("status").get("slice").get_string("jobset");
+    return js.empty() ? ns + "-slice" : js;
+  }();
+
   std::vector<const Json*> wave1, wave2;
   bool applying_rolebinding = false;
+  bool recreating_jobset = false;
   for (const Json& child : children) {
     const std::string kind = child.get("kind").as_string();
     if (kind == "Namespace") {
       client.apply(child, kFieldManager, /*force=*/true);
       Metrics::instance().inc("applies_total");
+    } else if (kind == "JobSet" && jobset_spec_changed(ub, child)) {
+      // The recorded JobSet was built from a different spec. JobSet pod
+      // templates are immutable, so applying the new spec over it would
+      // be rejected — and SSA force-apply would overwrite the generation
+      // stamp, attributing the OLD run's outcome to the NEW spec (which
+      // for a finished TTL'd slice closes the one-shot gate permanently).
+      // Delete it and skip the apply; the next pass (triggered by the
+      // JobSet watch's DELETED event) recreates it with fresh stamps.
+      if (remove_jobset(recorded_jobset)) {
+        Metrics::instance().inc("jobset_recreates_total");
+        log_info("deleted jobset (spec changed; recreating)",
+                 {{"name", name}, {"jobset", recorded_jobset}});
+      }
+      recreating_jobset = true;
     } else if (kind == "RoleBinding" || kind == "JobSet") {
       if (kind == "RoleBinding") applying_rolebinding = true;
       wave2.push_back(&child);
@@ -418,7 +465,35 @@ bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::s
   // apply is attempted it may exist server-side even if this pass throws.
   if (applying_rolebinding) rb_absent.erase(name);
   if (!wave1.empty()) apply_wave(wave1);
-  if (!wave2.empty()) apply_wave(wave2);
+  try {
+    if (!wave2.empty()) apply_wave(wave2);
+  } catch (const KubeError& e) {
+    // Safety net for the unrecorded case jobset_spec_changed cannot see
+    // (status.slice.spec_hash absent — written by a pre-hash build —
+    // while the stored JobSet predates the current spec): the apiserver
+    // rejects the immutable-field update (422 Invalid from its own
+    // validation, or 400 from JobSet's validating webhook — both carry
+    // "immutable" in the message). Delete the JobSet so the next pass
+    // recreates it, then surface the error for the usual requeue.
+    // Deliberately narrow: only the JOBSET's own failure (a RoleBinding
+    // denied by a policy webhook in the same wave must not get a live
+    // workload deleted), never 403 (RBAC problems likewise), and only
+    // messages naming immutability (a generic webhook denial would deny
+    // the recreate too — deleting first would kill the workload with no
+    // way back).
+    const std::string msg = e.what();
+    const bool immutable_rejection =
+        (e.status == 422 || e.status == 400) &&
+        msg.find("immutable") != std::string::npos;
+    if (immutable_rejection && wave_failed_kind == "JobSet") {
+      if (remove_jobset(recorded_jobset)) {
+        Metrics::instance().inc("jobset_recreates_total");
+        log_info("deleted jobset (immutable-field rejection; recreating)",
+                 {{"name", name}, {"jobset", recorded_jobset}});
+      }
+    }
+    throw;
+  }
 
   // Revocation teardown: the sheet gate closing (synchronizer revocation,
   // or an admin clearing the status) must take back what it granted —
@@ -430,7 +505,6 @@ bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::s
   // controller's own record that a slice was provisioned.
   const bool synchronized = ub.get("status").get_bool("synchronized_with_sheet", false);
   const bool has_tpu = ub.get("spec").get("tpu").is_object();
-  const std::string ns = target_namespace(ub);
   bool pruned_jobset = false;
   if (!synchronized && ub.get("spec").get("rolebinding").is_object() &&
       !rb_absent.contains(name)) {
@@ -454,13 +528,10 @@ bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::s
       (!cached_phase.empty() && cached_phase != "Pending" && cached_phase != "Absent");
   if ((!has_tpu || !synchronized) && slice_may_exist) {
     const std::string js_name = cached_jobset.empty() ? ns + "-slice" : cached_jobset;
-    try {
-      client.remove("jobset.x-k8s.io/v1alpha2", "JobSet", ns, js_name);
+    if (remove_jobset(js_name)) {
       Metrics::instance().inc("prunes_total");
       log_info("pruned jobset (revoked or tpu spec removed)",
                {{"name", name}, {"jobset", js_name}});
-    } catch (const KubeError& e) {
-      if (e.status != 404) throw;
     }
     pruned_jobset = true;
   }
@@ -487,10 +558,10 @@ bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::s
       // The SSA response is the server's current stored object (status
       // included) — a free observation, no extra GET.
       observed = std::move(applied_jobset);
-    } else if (!pruned_jobset) {
+    } else if (!pruned_jobset && !recreating_jobset) {
       // No JobSet child this pass (sheet gate closed at emit time): one
       // may still exist from an earlier approval — unless we just
-      // deleted it above.
+      // deleted it above (revocation prune or spec-change recreate).
       try {
         observed = client.get("jobset.x-k8s.io/v1alpha2", "JobSet", ns, ns + "-slice");
       } catch (const KubeError& e) {
@@ -513,8 +584,15 @@ bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::s
         client.merge_status(kApiVersion, kKind, "", name,
                             Json::object({{"slice", desired_slice}}));
       } catch (const KubeError& e) {
-        // Status update races with the synchronizer are benign; next pass
-        // converges.
+        // The delete-then-recreate handshake gates the NEXT pass's apply
+        // on this write clearing status.slice.spec_hash: swallowing its
+        // failure would livelock the slice (re-delete a 404, skip the
+        // apply, repeat) with no error surfaced and — since nothing
+        // changed server-side — no watch event to trigger a retry before
+        // the periodic resync. Rethrow so the error requeue retries.
+        if (recreating_jobset) throw;
+        // Otherwise: status update races with the synchronizer are
+        // benign; next pass converges.
         log_warn("slice status update failed", {{"name", name}, {"error", e.what()}});
       }
       // Surface the phase transition as a core/v1 Event so `kubectl

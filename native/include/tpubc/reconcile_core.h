@@ -44,6 +44,33 @@ std::vector<Json> desired_children(const Json& ub, const Json& config);
 // tooling. Throws JsonError if spec.tpu is absent/invalid.
 Json build_jobset(const Json& ub, const Json& config);
 
+// Labels stamped on emitted JobSets (build_jobset):
+//   generation — the CR metadata.generation the JobSet was built from;
+//                slice_status reads it back so observed outcomes are
+//                attributed to the spec that produced them (evidence, not
+//                assumption).
+//   spec-hash  — sha256 prefix of the JobSet spec's workload-shaping
+//                fields (network + replicatedJobs: the immutable pod
+//                template and gang shape); the controller compares it
+//                against status.slice.spec_hash to decide
+//                delete-then-recreate (JobSet pod templates are immutable,
+//                so applying a changed spec over an existing JobSet would
+//                be rejected — and relabeling a finished TTL'd run with
+//                the new generation would misattribute its outcome).
+//                Edits that leave the hash alone — unrelated CR fields
+//                (role/quota) and mutable JobSet knobs (TTL,
+//                failurePolicy) — apply in place without killing a
+//                running slice.
+inline constexpr const char* kGenerationLabel = "tpu.bacchus.io/generation";
+inline constexpr const char* kSpecHashLabel = "tpu.bacchus.io/spec-hash";
+
+// True when status.slice.spec_hash records a JobSet whose spec differs from
+// the desired one: the controller must DELETE the recorded JobSet before
+// applying (and skip the apply until the next pass). False when there is no
+// record (fresh CR, or status written before the hash existed — apply-over
+// self-heals by adding the labels, a metadata-only change).
+bool jobset_spec_changed(const Json& ub, const Json& desired_jobset);
+
 // Desired status.slice block given the CR and the observed JobSet (or null).
 Json slice_status(const Json& ub, const Json& observed_jobset);
 

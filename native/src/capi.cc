@@ -133,6 +133,14 @@ char* tpubc_slice_status(const char* ub, const char* jobset) {
   });
 }
 
+char* tpubc_jobset_spec_changed(const char* ub, const char* desired_jobset) {
+  return guarded([&] {
+    return tpubc::Json(tpubc::jobset_spec_changed(tpubc::Json::parse(ub),
+                                                  tpubc::Json::parse(desired_jobset)))
+        .dump();
+  });
+}
+
 char* tpubc_slice_event(const char* ub, const char* old_phase, const char* new_slice,
                         const char* timestamp) {
   return guarded([&] {

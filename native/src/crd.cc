@@ -299,6 +299,13 @@ Json status_schema() {
                                  "one-shot gate to the spec that produced "
                                  "the outcome.")},
                      {"jobset", nullable_string_schema("Name of the materialized JobSet.")},
+                     {"spec_hash",
+                      nullable_string_schema(
+                          "spec-hash label of the observed JobSet: which "
+                          "JobSet spec this observation belongs to. The "
+                          "controller compares it against the desired "
+                          "JobSet's hash to decide delete-then-recreate "
+                          "(JobSet pod templates are immutable).")},
                      {"conditions",
                       Json::object({
                           {"description", "Slice-provisioning conditions "

@@ -204,10 +204,48 @@ Json build_jobset(const Json& ub, const Json& config) {
          // single node pool, the TPU analogue of NCCL clique placement.
          m.set("annotations", Json::object({{"alpha.jobset.sigs.k8s.io/exclusive-topology",
                                              "cloud.google.com/gke-nodepool"}}));
+         // Stamp the CR spec generation that produced this JobSet.
+         // slice_status reads it back so status.slice.observed_generation
+         // records which spec an observed outcome belongs to — without the
+         // stamp, a spec edit landing while the previous (finished, TTL'd)
+         // JobSet still exists would record the OLD run's terminal phase
+         // against the NEW generation and permanently close the one-shot
+         // gate in desired_children. The spec-hash stamp is what keeps
+         // the generation stamp honest under SSA: when the JobSet spec
+         // actually changed, the controller deletes-then-recreates
+         // (jobset_spec_changed) instead of force-applying the new
+         // generation label onto the old run; when only unrelated CR
+         // fields changed (role/quota — generation bumps, hash does not)
+         // the apply is a metadata-only relabel, which is correct — the
+         // finished workload IS the current spec.tpu's outcome.
+         // Hash basis: ONLY the workload-shaping fields (network wiring +
+         // replicatedJobs, which holds the immutable pod template and
+         // gang shape). Mutable knobs — ttlSecondsAfterFinished,
+         // failurePolicy — stay out: editing only them must apply in
+         // place, not delete a LIVE workload. If a field assumed mutable
+         // here turns out immutable on some JobSet version, the 422
+         // fallback in the controller still recovers by delete+requeue.
+         const Json hash_basis =
+             Json::object({{"network", spec.get("network")},
+                           {"replicatedJobs", spec.get("replicatedJobs")}});
+         Json labels = Json::object(
+             {{kSpecHashLabel, sha256_hex(hash_basis.dump()).substr(0, 16)}});
+         const int64_t gen = ub.get("metadata").get_int("generation", 0);
+         if (gen > 0) labels.set(kGenerationLabel, std::to_string(gen));
+         m.set("labels", std::move(labels));
          return m;
        }()},
       {"spec", spec},
   });
+}
+
+bool jobset_spec_changed(const Json& ub, const Json& desired_jobset) {
+  const std::string recorded =
+      ub.get("status").get("slice").get_string("spec_hash");
+  if (recorded.empty()) return false;  // no record: apply-over self-heals
+  const std::string want =
+      desired_jobset.get("metadata").get("labels").get_string(kSpecHashLabel);
+  return !want.empty() && want != recorded;
 }
 
 std::vector<Json> desired_children(const Json& ub, const Json& config) {
@@ -298,7 +336,13 @@ std::vector<Json> desired_children(const Json& ub, const Json& config) {
     const std::string phase = slice.get_string("phase");
     const int64_t gen = ub.get("metadata").get_int("generation", 0);
     const int64_t seen = slice.get_int("observed_generation", 0);
-    const bool same_spec = gen == 0 || seen == 0 || gen == seen;
+    // Strict when the apiserver reports a generation: seen==0 means "no
+    // evidence of which spec the recorded outcome belongs to" (status
+    // written before the generation stamp existed), so the gate stays
+    // OPEN — a legacy terminal TTL'd CR re-runs once post-upgrade and
+    // then records a proper observed_generation, rather than staying
+    // locked out of spec edits forever (see MIGRATION.md).
+    const bool same_spec = gen == 0 || (seen > 0 && gen == seen);
     if (!(one_shot && same_spec &&
           (phase == "Succeeded" || phase == "Failed"))) {
       children.push_back(build_jobset(ub, config));
@@ -383,16 +427,39 @@ Json slice_status(const Json& ub, const Json& observed_jobset) {
     const std::string prev = prev_slice.get_string("phase");
     const int64_t gen = ub.get("metadata").get_int("generation", 0);
     const int64_t seen = prev_slice.get_int("observed_generation", 0);
+    // Same strictness as the one-shot gate above: stickiness requires
+    // evidence (seen > 0) that the terminal outcome belongs to THIS spec.
     if ((prev == "Succeeded" || prev == "Failed") &&
-        (gen == 0 || seen == 0 || gen == seen)) {
+        (gen == 0 || (seen > 0 && gen == seen))) {
       phase = prev;
     }
   }
   st.set("phase", phase);
   // Record which spec generation this observation belongs to (the
-  // observedGeneration idiom); 0 = unknown (no generation in metadata).
-  const int64_t cur_gen = ub.get("metadata").get_int("generation", 0);
-  if (cur_gen > 0) st.set("observed_generation", cur_gen);
+  // observedGeneration idiom). Derived from EVIDENCE, not assumed: the
+  // observed JobSet carries the generation that produced it (stamped in
+  // build_jobset), so when a spec edit races the TTL window — the old
+  // finished JobSet still exists while metadata.generation has already
+  // advanced — the old outcome is recorded against the OLD generation and
+  // the one-shot gate stays open for the edited spec. When the JobSet is
+  // gone (TTL GC) or predates the stamp, keep the previously recorded
+  // value rather than advancing it. 0 / absent = no evidence yet.
+  int64_t obs_gen =
+      ub.get("status").get("slice").get_int("observed_generation", 0);
+  if (observed_jobset.is_object()) {
+    const Json& js_labels = observed_jobset.get("metadata").get("labels");
+    const std::string stamp = js_labels.get_string(kGenerationLabel);
+    if (!stamp.empty()) {
+      const int64_t js_gen = std::strtoll(stamp.c_str(), nullptr, 10);
+      if (js_gen > 0) obs_gen = js_gen;
+    }
+    // Record which JobSet spec this observation belongs to — the
+    // controller's delete-then-recreate decision (jobset_spec_changed)
+    // compares it against the desired hash without an extra GET.
+    const std::string h = js_labels.get_string(kSpecHashLabel);
+    if (!h.empty()) st.set("spec_hash", h);
+  }
+  if (obs_gen > 0) st.set("observed_generation", obs_gen);
 
   // Slice-provisioning conditions (SURVEY.md §7: "add slice-provisioning
   // conditions"). Pure function of observed state — no timestamps, so the

@@ -808,6 +808,117 @@ def test_ttl_one_shot_through_daemon(fake):
         assert code == 0, err
 
 
+def test_spec_edit_during_ttl_window_through_daemon(fake):
+    """The round-3 advisor race, end to end: a spec.tpu edit lands while
+    the previous (finished, TTL'd) JobSet still exists. The controller
+    must NOT force-apply the new generation stamp onto the old completed
+    JobSet (that would attribute the old run's outcome to the new spec
+    and close the one-shot gate permanently) — it deletes the old JobSet
+    (spec-hash mismatch) and recreates it from the edited spec."""
+    spec = full_spec()
+    spec["tpu"]["ttl_seconds_after_finished"] = 600
+    fake.create_ub("alice", spec=spec, status=dict(SYNCED))
+    port = free_port()
+    d = Daemon("tpubc-controller",
+               controller_env(fake, port, conf_requeue_secs=1), port).wait_healthy()
+    try:
+        js = wait_for(lambda: fake.get(KEY_JS("alice"), "alice-slice"),
+                      desc="jobset")
+        old_hash = js["metadata"]["labels"]["tpu.bacchus.io/spec-hash"]
+
+        # The slice finishes; the edit races the TTL window: the finished
+        # JobSet is still stored when the spec changes.
+        done = dict(js)
+        done["status"] = {"conditions": [{"type": "Completed", "status": "True"}]}
+        fake.store.upsert(KEY_JS("alice"), "alice-slice", done,
+                          preserve_status=False)
+        wait_for(
+            lambda: (fake.get(fake.KEY_UB, "alice") or {}).get("status", {})
+            .get("slice", {}).get("phase") == "Succeeded",
+            desc="phase Succeeded",
+        )
+        ub = fake.get(fake.KEY_UB, "alice")
+        ub2 = dict(ub)
+        ub2["spec"] = dict(ub2["spec"])
+        ub2["spec"]["tpu"] = {**ub2["spec"]["tpu"],
+                              "env": {"WORKLOAD_STEPS": "7"}}
+        fake.store.upsert(fake.KEY_UB, "alice", ub2)
+
+        # The controller deletes the stale JobSet and recreates it from
+        # the edited spec: new hash, new env, no Completed condition.
+        def fresh_jobset():
+            j = fake.get(KEY_JS("alice"), "alice-slice")
+            if not j:
+                return None
+            h = j["metadata"].get("labels", {}).get("tpu.bacchus.io/spec-hash")
+            return j if h and h != old_hash else None
+
+        fresh = wait_for(fresh_jobset, desc="jobset recreated from edited spec")
+        env = fresh["spec"]["replicatedJobs"][0]["template"]["spec"]["template"][
+            "spec"]["containers"][0]["env"]
+        assert {"name": "WORKLOAD_STEPS", "value": "7"} in env
+        assert not fresh.get("status", {}).get("conditions")
+        # The rerun is attributed to the edited CR generation once observed.
+        edited_gen = fake.get(fake.KEY_UB, "alice")["metadata"]["generation"]
+        wait_for(
+            lambda: (fake.get(fake.KEY_UB, "alice") or {}).get("status", {})
+            .get("slice", {}).get("observed_generation") == edited_gen,
+            desc="observed_generation advances to the edited spec",
+        )
+    finally:
+        code, err = d.stop()
+        assert code == 0, err
+
+
+def test_legacy_jobset_immutable_rejection_recovers(fake):
+    """The pre-spec-hash upgrade case jobset_spec_changed cannot see:
+    status.slice has no spec_hash record while the stored JobSet (from an
+    older build, no labels) predates the current spec. The fake apiserver
+    enforces JobSet immutability like the real validating webhook, so the
+    controller's apply is rejected 422 'field is immutable' — the fallback
+    must delete the stale JobSet and recreate it on the next pass instead
+    of wedging in an apply-reject-requeue livelock."""
+    spec = full_spec()
+    spec["tpu"]["env"] = {"WORKLOAD_STEPS": "9"}
+    # Legacy status: slice recorded, but no spec_hash (pre-hash build).
+    fake.create_ub("alice", spec=spec,
+                   status={**SYNCED,
+                           "slice": {"phase": "Running",
+                                     "jobset": "alice-slice"}})
+    # Pre-populate a legacy JobSet: same name, no stamp labels, and a pod
+    # template the current spec does not produce (different env).
+    from tpu_bootstrap import nativelib
+    lib = nativelib.NativeLib()
+    stale_cr = fake.get(fake.KEY_UB, "alice")
+    stale_cr = {**stale_cr,
+                "spec": {**stale_cr["spec"],
+                         "tpu": {**stale_cr["spec"]["tpu"],
+                                 "env": {"WORKLOAD_STEPS": "1"}}}}
+    legacy = lib.build_jobset(stale_cr)
+    legacy["metadata"].pop("labels", None)
+    fake.store.upsert(KEY_JS("alice"), "alice-slice", legacy)
+
+    port = free_port()
+    d = Daemon("tpubc-controller",
+               controller_env(fake, port, conf_requeue_secs=1), port).wait_healthy()
+    try:
+        def recreated():
+            j = fake.get(KEY_JS("alice"), "alice-slice")
+            if not j:
+                return None
+            labels = j["metadata"].get("labels", {})
+            return j if "tpu.bacchus.io/spec-hash" in labels else None
+
+        fresh = wait_for(recreated, timeout=15,
+                         desc="stale legacy jobset deleted and recreated")
+        env = fresh["spec"]["replicatedJobs"][0]["template"]["spec"][
+            "template"]["spec"]["containers"][0]["env"]
+        assert {"name": "WORKLOAD_STEPS", "value": "9"} in env
+    finally:
+        code, err = d.stop()
+        assert code == 0, err
+
+
 def test_synchronizer_leader_election(fake, tmp_path):
     """With CONF_LEADER_ELECT=1 and two replicas, only the lease holder
     syncs — the standby serves /health but writes nothing until it wins."""

@@ -118,6 +118,45 @@ def test_sharded_matches_single_device(base, tokens):
     np.testing.assert_allclose(sharded, single, rtol=1e-5)
 
 
+def test_qlora_sharded_base_committed(base, tokens):
+    """QLoRA on a non-degenerate mesh: the frozen int8 base is committed
+    to its mesh shardings BEFORE the closure captures it (an uncommitted
+    closure constant replicates per device, defeating fsdp residency —
+    advisor finding, round 3), and the sharded step's loss matches the
+    single-device step's."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    from tpu_bootstrap.workload import quant
+    from tpu_bootstrap.workload.sharding import param_shardings
+
+    qbase = quant.quantize_params(base)
+    # param_shardings understands quantized leaves: same dataclass type,
+    # packed data sharded over fsdp, scales replicated.
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, tensor=2))
+    sh = param_shardings(mesh, qbase)
+    wq_sh = sh["blocks"][0]["wq"]
+    assert quant.is_quantized(wq_sh)
+    assert "fsdp" in str(wq_sh.q.spec)
+
+    cfg = TrainConfig(model=MODEL, learning_rate=1e-2)
+
+    def run(mesh_cfg):
+        m = build_mesh(mesh_cfg)
+        step, opt = make_lora_train_step(cfg, m, qbase, LORA)
+        lora = init_lora(qbase, LORA, jax.random.PRNGKey(2))
+        opt_state = opt.init(lora)
+        toks = tokens if mesh_cfg.size == 1 else jax.device_put(
+            tokens, batch_shardings(m))
+        losses = []
+        for _ in range(3):
+            lora, opt_state, loss = step(lora, opt_state, toks)
+            losses.append(float(loss))
+        return losses
+
+    np.testing.assert_allclose(run(MeshConfig(data=2, fsdp=2, tensor=2)),
+                               run(MeshConfig()), rtol=1e-5)
+
+
 def test_qlora_int8_frozen_base(base, tokens):
     """QLoRA-style fine-tuning: the FROZEN base rides HBM as int8
     (~half the bytes of a bf16 base), adapters train in f32 on top.

@@ -382,15 +382,127 @@ def test_slice_status_stickiness_scoped_to_generation(lib):
     """Terminal-phase stickiness releases on a spec edit: generation
     past the recorded observed_generation means the outcome belongs to
     an OLD spec, so the phase regresses to Pending and the slice
-    reprovisions; the fresh observation records the new generation."""
+    reprovisions. observed_generation is EVIDENCE, not assumption: with
+    no JobSet observed it keeps the previously recorded value — it only
+    advances when a JobSet stamped with the new generation shows up."""
     cr = ub(spec={"tpu": tpu_spec(chips=4, hosts=1)},
-            status={"slice": {"phase": "Failed", "observed_generation": 2}})
+            status={"synchronized_with_sheet": True,
+                    "slice": {"phase": "Failed", "observed_generation": 2}})
     cr["metadata"]["generation"] = 2
     st = lib.slice_status(cr, None)
     assert st["phase"] == "Failed" and st["observed_generation"] == 2
     cr["metadata"]["generation"] = 3  # spec edited
     st = lib.slice_status(cr, None)
-    assert st["phase"] == "Pending" and st["observed_generation"] == 3
+    assert st["phase"] == "Pending" and st["observed_generation"] == 2
+    # The reprovisioned JobSet carries the generation stamp; observing it
+    # is what advances observed_generation.
+    js = lib.desired_children(cr)
+    jobset = next(c for c in js if c["kind"] == "JobSet")
+    assert jobset["metadata"]["labels"]["tpu.bacchus.io/generation"] == "3"
+    st = lib.slice_status(cr, jobset)
+    assert st["observed_generation"] == 3
+
+
+def test_slice_status_edit_during_ttl_window(lib):
+    """A spec edit landing while the previous (finished, TTL'd) JobSet
+    still exists must NOT record the old run's outcome against the new
+    generation — that would close the one-shot gate permanently and the
+    edited spec would never run (advisor finding, round 3). The observed
+    JobSet's generation stamp keeps the record honest and the gate open."""
+    ttl = tpu_spec(chips=4, hosts=1)
+    ttl["ttl_seconds_after_finished"] = 60
+    cr = ub(spec={"tpu": ttl},
+            status={"synchronized_with_sheet": True,
+                    "slice": {"phase": "Running", "observed_generation": 1}})
+    cr["metadata"]["generation"] = 1
+    old_jobset = next(c for c in lib.desired_children(cr)
+                      if c["kind"] == "JobSet")
+    assert old_jobset["metadata"]["labels"]["tpu.bacchus.io/generation"] == "1"
+    old_jobset["status"] = {"conditions": [{"type": "Completed",
+                                            "status": "True"}]}
+
+    cr["metadata"]["generation"] = 2  # edit races the TTL window
+    st = lib.slice_status(cr, old_jobset)
+    # Old outcome recorded against the OLD generation it belongs to.
+    assert st["phase"] == "Succeeded" and st["observed_generation"] == 1
+    cr["status"]["slice"] = st
+    # Gate stays open for the edited spec: the JobSet is re-emitted.
+    kinds = [c["kind"] for c in lib.desired_children(cr)]
+    assert "JobSet" in kinds
+
+
+def test_jobset_spec_hash_stamp(lib):
+    """Emitted JobSets carry a spec-hash label: same spec.tpu -> same
+    hash regardless of unrelated CR fields (role edits relabel in place,
+    never kill a running slice); changed spec.tpu -> different hash, so
+    the controller deletes-then-recreates (pod templates are immutable)."""
+    cr = ub(spec={"tpu": tpu_spec(chips=4, hosts=1)},
+            status={"synchronized_with_sheet": True})
+    cr["metadata"]["generation"] = 1
+    js1 = lib.build_jobset(cr)
+    h1 = js1["metadata"]["labels"]["tpu.bacchus.io/spec-hash"]
+    assert len(h1) == 16
+
+    # Unrelated CR change (generation bump via role edit): hash stable.
+    cr["metadata"]["generation"] = 2
+    cr["spec"]["role"] = {"rules": []}
+    assert (lib.build_jobset(cr)["metadata"]["labels"]
+            ["tpu.bacchus.io/spec-hash"] == h1)
+
+    # Mutable JobSet knobs (TTL, failurePolicy) stay OUT of the hash:
+    # editing only them applies in place — recreating would kill a live
+    # workload over a field the apiserver accepts in-place.
+    cr["spec"]["tpu"]["ttl_seconds_after_finished"] = 3600
+    cr["spec"]["tpu"]["max_restarts"] = 2
+    assert (lib.build_jobset(cr)["metadata"]["labels"]
+            ["tpu.bacchus.io/spec-hash"] == h1)
+    del cr["spec"]["tpu"]["ttl_seconds_after_finished"]
+    del cr["spec"]["tpu"]["max_restarts"]
+
+    # spec.tpu change: hash moves.
+    cr["spec"]["tpu"]["env"] = {"WORKLOAD_STEPS": "5"}
+    js2 = lib.build_jobset(cr)
+    assert js2["metadata"]["labels"]["tpu.bacchus.io/spec-hash"] != h1
+
+    # jobset_spec_changed: fires only when the recorded hash differs.
+    cr["status"]["slice"] = {"spec_hash": h1, "jobset": "alice-slice"}
+    assert lib.jobset_spec_changed(cr, js2) is True
+    assert lib.jobset_spec_changed(cr, js1) is False
+    cr["status"]["slice"] = {}  # no record (legacy): apply-over self-heals
+    assert lib.jobset_spec_changed(cr, js2) is False
+
+
+def test_slice_status_records_spec_hash(lib):
+    """slice_status copies the observed JobSet's spec-hash label into
+    status.slice.spec_hash (the controller's recreate decision reads it
+    back without an extra GET); absent JobSet leaves no hash."""
+    cr = ub(spec={"tpu": tpu_spec(chips=4, hosts=1)},
+            status={"synchronized_with_sheet": True})
+    cr["metadata"]["generation"] = 1
+    js = lib.build_jobset(cr)
+    h = js["metadata"]["labels"]["tpu.bacchus.io/spec-hash"]
+    st = lib.slice_status(cr, js)
+    assert st["spec_hash"] == h
+    assert "spec_hash" not in lib.slice_status(cr, None)
+
+
+def test_one_shot_gate_legacy_status_reopens(lib):
+    """observed_generation == 0 (status written before the generation
+    stamp existed) is 'no evidence', not 'same spec': the gate stays
+    open so a legacy terminal TTL'd CR re-runs once post-upgrade instead
+    of being locked out of spec edits forever (MIGRATION.md)."""
+    ttl = tpu_spec(chips=4, hosts=1)
+    ttl["ttl_seconds_after_finished"] = 60
+    cr = ub(spec={"tpu": ttl},
+            status={"synchronized_with_sheet": True,
+                    "slice": {"phase": "Succeeded",
+                              "observed_generation": 0}})
+    cr["metadata"]["generation"] = 2
+    kinds = [c["kind"] for c in lib.desired_children(cr)]
+    assert "JobSet" in kinds
+    # Stickiness likewise requires evidence.
+    st = lib.slice_status(cr, None)
+    assert st["phase"] == "Pending"
 
 
 def test_slice_event_on_phase_transition(lib):

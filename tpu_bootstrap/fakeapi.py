@@ -297,6 +297,31 @@ class Store:
             for p in applied_paths:
                 set_path(new_obj, p, copy.deepcopy(get_path(body, p)))
 
+            # JobSet immutability (what the real JobSet validating webhook
+            # enforces): spec.replicatedJobs — the pod template and gang
+            # shape — cannot change on an existing object. Checked BEFORE
+            # the ownership bookkeeping below, as a real apiserver rejects
+            # in admission before persisting anything: a rejected apply
+            # must not rewrite managed-field ownership. Surfacing this
+            # keeps the fake honest about the one write the controller
+            # must never attempt (it deletes-then-recreates instead), and
+            # exercises the controller's immutable-rejection fallback for
+            # legacy JobSets that predate the spec-hash record.
+            if existing is not None and new_obj.get("kind") == "JobSet":
+                old_rj = existing.get("spec", {}).get("replicatedJobs")
+                new_rj = new_obj.get("spec", {}).get("replicatedJobs")
+                if old_rj is not None and new_rj != old_rj:
+                    return 422, {
+                        "kind": "Status",
+                        "apiVersion": "v1",
+                        "status": "Failure",
+                        "message": f'JobSet.jobset.x-k8s.io "{name}" is '
+                                   "invalid: spec.replicatedJobs: Invalid "
+                                   "value: field is immutable",
+                        "reason": "Invalid",
+                        "code": 422,
+                    }
+
             # Ownership: this manager owns what it applied; forced
             # conflicts transfer those paths away from previous owners.
             owners[manager] = set(applied_paths)

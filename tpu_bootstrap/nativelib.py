@@ -135,6 +135,9 @@ class NativeLib:
     def slice_status(self, ub: Any, jobset: Any) -> dict:
         return self._call_json("tpubc_slice_status", ub, jobset)
 
+    def jobset_spec_changed(self, ub: Any, desired_jobset: Any) -> bool:
+        return self._call_json("tpubc_jobset_spec_changed", ub, desired_jobset)
+
     def slice_event(
         self, ub: Any, old_phase: str, new_slice: Any, timestamp: str
     ) -> dict | None:

@@ -167,7 +167,8 @@ def make_lora_train_step(cfg, mesh, base_params: Params, lcfg: LoraConfig,
     state exists only for the adapters. cfg is a train.TrainConfig; the
     mesh must not have a pipe axis (stacked layouts are rejected)."""
     from tpu_bootstrap.workload.sharding import (batch_shardings,
-                                                 degenerate_mesh, replicated)
+                                                 degenerate_mesh,
+                                                 param_shardings, replicated)
     from tpu_bootstrap.workload.train import make_optimizer
 
     if mesh.shape.get("pipe", 1) > 1:
@@ -192,6 +193,16 @@ def make_lora_train_step(cfg, mesh, base_params: Params, lcfg: LoraConfig,
                        for b in base_params["blocks"]],
         }
     opt = make_optimizer(cfg)
+
+    if not degenerate_mesh(mesh):
+        # Commit the frozen BASE to its mesh shardings before the closure
+        # captures it (same reason make_distill_step device_puts its
+        # teacher): an uncommitted closure constant is replicated per
+        # device, which for a large (QLoRA) base defeats fsdp exactly
+        # where HBM residency matters. The adapters stay replicated — they
+        # are tiny and train as explicit jit arguments below.
+        base_params = jax.tree.map(jax.device_put, base_params,
+                                   param_shardings(mesh, base_params))
 
     def loss(lora, inputs, targets):
         eff = apply_lora(base_params, lora, lcfg)

@@ -35,6 +35,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_bootstrap.workload import quant
 from tpu_bootstrap.workload.model import ModelConfig, Params
 
 
@@ -163,6 +164,32 @@ def param_shardings(mesh: Mesh, params: Params):
             return {k: walk(v, f"{path}/{k}") for k, v in tree.items()}
         if isinstance(tree, list):
             return [walk(v, path) for v in tree]
+        if quant.is_quantized(tree):
+            # Quantized leaves are pytree dataclasses: shard the packed
+            # int data's contraction dim over fsdp (ZeRO-3 residency — the
+            # reason a QLoRA base gets committed here at all) and the
+            # expert dim over expert for stacked (E, K, N) weights.
+            # Scales follow their own shape: int8's per-column (N,) really
+            # is tiny and replicates, but int4's per-group (K/group, N)
+            # f32 scales are K*N/16 BYTES — at fsdp=8 a replicated copy
+            # would match the per-device packed-weight bytes and halve the
+            # residency win — so their group dim shards over fsdp like q's
+            # packed contraction dim; expert scales (E, 1, N) shard over
+            # expert. Returning the same dataclass type keeps the treedef
+            # identical so jax.tree.map(device_put, params, shardings)
+            # descends into the q/s fields without unflattening tricks.
+            qspec = (P("expert", "fsdp", None) if tree.q.ndim == 3
+                     else P("fsdp", None))
+            if tree.s.ndim == 3:      # int8 expert stack: (E, 1, N)
+                sspec = P("expert", None, None)
+            elif tree.s.ndim == 2:    # int4 group scales: (K/group, N)
+                sspec = P("fsdp", None)
+            else:                     # int8 per-column: (N,)
+                sspec = P(None)
+            return dataclasses.replace(
+                tree,
+                q=NamedSharding(mesh, fit(qspec, tree.q.shape)),
+                s=NamedSharding(mesh, fit(sspec, tree.s.shape)))
         if stacked and path.startswith("/blocks"):
             spec = P("pipe", *spec_for(path, tree.ndim - 1))
         else:
