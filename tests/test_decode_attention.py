@@ -59,10 +59,13 @@ def test_kernel_respects_validity_mask(pos):
 
 
 def test_supports_block_divisors():
-    assert supports(256) and supports(96) and supports(32)
-    assert not supports(48) and not supports(17)
+    # 128-multiples tile; any 8-multiple up to the VMEM ceiling runs as a
+    # single tile (block == full axis satisfies Mosaic for any size).
+    assert supports(256) and supports(96) and supports(32) and supports(48)
+    assert supports(4096) and supports(512)
+    assert not supports(17) and not supports(520)
     q, kq, kscale, vq, vscale = _case(4, 4)
-    with pytest.raises(ValueError, match="block divisor"):
+    with pytest.raises(ValueError, match="single tile"):
         decode_attention_int8(q, kq[:, :17], kscale[:, :17],
                               vq[:, :17], vscale[:, :17], jnp.ones(17, bool))
 

@@ -14,13 +14,20 @@ and the only HBM traffic is the int8 values + one f32 scale per cached
 vector.
 
 Layout/grid design (mirrors flash_attention.py's streamed formulation):
-* Grid (batch x kv_heads, L tiles); the L axis is the innermost
-  "arbitrary" (sequential) axis so Mosaic double-buffers cache tiles
-  HBM->VMEM while the MXU works on the previous tile.
-* The cache keeps its native (B, L, Hk, D) layout — no transpose copies;
-  the BlockSpec index map picks the (b, hk) plane per grid row.
-* GQA is native: the query's (group, D) rows for one KV head ride
-  together, so each cache tile is read ONCE at the true KV head count.
+* Grid (batch, L tiles); the L axis is the innermost "arbitrary"
+  (sequential) axis so Mosaic double-buffers cache tiles HBM->VMEM while
+  the MXU works on the previous tile.
+* The cache keeps its native (B, L, Hk, D) layout — no transpose copies.
+  Each tile carries ALL kv heads — (bl, Hk, D), whose last two dims are
+  the full array dims, the shape Mosaic's (8, 128) tiling accepts for
+  ANY Hk. (The obvious alternative — grid (B x Hk, L tiles) with a
+  squeezed Hk dim in the BlockSpec — puts a 1-extent block dim
+  second-to-minor, which Mosaic rejects for Hk not divisible by 8;
+  interpret-mode tests cannot catch that, and round 3's kernel shipped
+  with exactly that latent rejection. Verified on hardware this round.)
+* GQA is native: the kernel unrolls a static loop over the Hk heads of
+  the tile, each head's (group, D) query rows scoring its own (bl, D)
+  plane — the cache is still read ONCE at the true KV head count.
 * Online softmax state (m, l, acc) in VMEM scratch across L tiles —
   numerically identical (up to f32 rounding) to the masked softmax the
   einsum path computes.
@@ -54,6 +61,7 @@ def _kernel(q_ref, k_ref, ks_ref, v_ref, vs_ref, bias_ref, o_ref,
             m_scr, l_scr, acc_scr, *, sm_scale):
     j = pl.program_id(1)
     num_l = pl.num_programs(1)
+    hk, g_pad = q_ref.shape[0], q_ref.shape[1]
 
     @pl.when(j == 0)
     def _init():
@@ -61,34 +69,51 @@ def _kernel(q_ref, k_ref, ks_ref, v_ref, vs_ref, bias_ref, o_ref,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[:].astype(jnp.float32) * sm_scale  # (g_pad, D)
-    # Dequant in VMEM: the int8 tile never exists in HBM at 2 bytes.
-    k = k_ref[:].astype(jnp.float32) * ks_ref[:]  # (bl, D) * (bl, 1)
-    v = v_ref[:].astype(jnp.float32) * vs_ref[:]
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)  # (g_pad, bl)
-    s = s + bias_ref[0:1, :]  # invalid cache slots carry -1e30
+    bias = bias_ref[0:1, :]  # invalid cache slots carry -1e30
+    # Static unroll over the kv heads sharing this cache tile: each
+    # head's scratch lives in its own g_pad-row band (sublane-aligned —
+    # g_pad is a multiple of 8).
+    for i in range(hk):
+        q = q_ref[i].astype(jnp.float32) * sm_scale  # (g_pad, D)
+        # Dequant in VMEM: the int8 tile never exists in HBM at 2 bytes.
+        k = k_ref[:, i, :].astype(jnp.float32) * ks_ref[:, i, :]  # (bl,D)*(bl,1)
+        v = v_ref[:, i, :].astype(jnp.float32) * vs_ref[:, i, :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # (g_pad, bl)
+        s = s + bias
 
-    m = m_scr[:]
-    m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
-    alpha = jnp.exp(m - m_new)
-    p = jnp.exp(s - m_new)
-    m_scr[:] = m_new
-    l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
-    acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        band = slice(i * g_pad, (i + 1) * g_pad)
+        m = m_scr[band]
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        m_scr[band] = m_new
+        l_scr[band] = l_scr[band] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[band] = acc_scr[band] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
     @pl.when(j == num_l - 1)
     def _finalize():
-        o_ref[:] = (acc_scr[:] / l_scr[:]).astype(o_ref.dtype)
+        o_ref[:] = (acc_scr[:] / l_scr[:]).reshape(o_ref.shape).astype(o_ref.dtype)
+
+
+# Single-tile VMEM ceiling: a (L, Hk, D) int8 tile (x2 for k+v, x2 for
+# double buffering) must fit comfortably in the ~16 MB of VMEM.
+_MAX_SINGLE_TILE = 512
 
 
 def _pick_block(length: int) -> int | None:
-    """Largest int8-tileable L block that divides the cache length (the
-    cache is NOT padded — padding would copy the whole cache in HBM)."""
-    for bl in (512, 256, 128, 64, 32):
-        if length % bl == 0:
+    """L block that divides the cache length (the cache is NOT padded —
+    padding would copy the whole cache in HBM). Multi-tile blocks must be
+    128-multiples: the bias row's (8, bl) block puts bl on the lane axis,
+    where Mosaic wants 128-divisibility — unless the block IS the whole
+    axis, which is why any 8-multiple length up to the VMEM ceiling works
+    as a single tile."""
+    for bl in (512, 256, 128):
+        if length % bl == 0 and length > bl:
             return bl
+    if length % 8 == 0 and length <= _MAX_SINGLE_TILE:
+        return length
     return None
 
 
@@ -115,15 +140,15 @@ def decode_attention_int8(q: jax.Array, kq: jax.Array, ks: jax.Array,
     bl = _pick_block(length)
     if bl is None:
         raise ValueError(
-            f"cache length {length} has no 32-multiple block divisor; "
-            "gate direct calls on supports(length) — decode._block_step "
-            "does, falling back to its einsum path")
+            f"cache length {length} is neither a 128-multiple nor a small "
+            f"(<= {_MAX_SINGLE_TILE}) 8-multiple single tile; gate direct "
+            "calls on supports(length) — decode._block_step does, falling "
+            "back to its einsum path")
 
     g_pad = max(8, -(-group // 8) * 8)
     q4 = q.reshape(b, kv_heads, group, d)
     if g_pad != group:
         q4 = jnp.pad(q4, ((0, 0), (0, 0), (0, g_pad - group), (0, 0)))
-    q3 = q4.reshape(b * kv_heads, g_pad, d)
 
     bias = jnp.where(valid, 0.0, _NEG).astype(jnp.float32)
     bias8 = jnp.broadcast_to(bias, (8, length))  # (8, L): sublane-tileable
@@ -131,30 +156,30 @@ def decode_attention_int8(q: jax.Array, kq: jax.Array, ks: jax.Array,
     vs4 = vs.astype(jnp.float32)[..., None]
 
     hk = kv_heads
-    cache_idx = lambda r, j: (r // hk, j, r % hk, 0)  # noqa: E731
+    cache_idx = lambda r, j: (r, j, 0, 0)  # noqa: E731
     out = pl.pallas_call(
         functools.partial(_kernel, sm_scale=d ** -0.5),
-        grid=(b * kv_heads, length // bl),
+        grid=(b, length // bl),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         in_specs=[
-            pl.BlockSpec((None, g_pad, d), lambda r, j: (r, 0, 0)),
-            pl.BlockSpec((None, bl, None, d), cache_idx),
-            pl.BlockSpec((None, bl, None, 1), cache_idx),
-            pl.BlockSpec((None, bl, None, d), cache_idx),
-            pl.BlockSpec((None, bl, None, 1), cache_idx),
+            pl.BlockSpec((None, hk, g_pad, d), lambda r, j: (r, 0, 0, 0)),
+            pl.BlockSpec((None, bl, hk, d), cache_idx),
+            pl.BlockSpec((None, bl, hk, 1), cache_idx),
+            pl.BlockSpec((None, bl, hk, d), cache_idx),
+            pl.BlockSpec((None, bl, hk, 1), cache_idx),
             pl.BlockSpec((8, bl), lambda r, j: (0, j)),
         ],
-        out_specs=pl.BlockSpec((None, g_pad, d), lambda r, j: (r, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * kv_heads, g_pad, d), q.dtype),
+        out_specs=pl.BlockSpec((None, hk, g_pad, d), lambda r, j: (r, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, kv_heads, g_pad, d), q.dtype),
         scratch_shapes=[
-            pltpu.VMEM((g_pad, 1), jnp.float32),
-            pltpu.VMEM((g_pad, 1), jnp.float32),
-            pltpu.VMEM((g_pad, d), jnp.float32),
+            pltpu.VMEM((hk * g_pad, 1), jnp.float32),
+            pltpu.VMEM((hk * g_pad, 1), jnp.float32),
+            pltpu.VMEM((hk * g_pad, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q3, kq, ks4, vq, vs4, bias8)
-    return out.reshape(b, kv_heads, g_pad, d)[:, :, :group].reshape(b, h, d)
+    )(q4, kq, ks4, vq, vs4, bias8)
+    return out[:, :, :group].reshape(b, h, d)
 
 
 __all__ = ["decode_attention_int8", "supports"]
