@@ -372,6 +372,140 @@ def test_1f1b_with_fsdp_matches_sequential(mesh_cfg):
                                np.asarray(g_seq["final_norm"]), rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.parametrize("mesh_cfg,num_kv_heads", [
+    (MeshConfig(pipe=2, data=2, seq=2), None),
+    (MeshConfig(pipe=2, seq=2, tensor=2), None),  # pp x sp x tp
+    (MeshConfig(pipe=2, fsdp=2, seq=2), None),    # pp x sp x fsdp (both pairs)
+    # MQA under pp x sp x tp: the expand-then-slice GQA fallback feeds
+    # the gathered-KV core (GPipe's ring rejects this shape; 1F1B takes
+    # it).
+    (MeshConfig(pipe=2, seq=2, tensor=2), 1),
+])
+def test_1f1b_with_seq_parallelism_matches_sequential(mesh_cfg, num_kv_heads):
+    """pp x sp under the MANUAL 1F1B backward: gathered-KV attention —
+    K/V all-gathered over seq through the custom pair (all_gather fwd,
+    psum_scatter bwd; the ppermute ring cannot run inside the
+    schedule's stage-divergent conds — its rendezvous is global), with
+    the causal mask on global positions, and replicated-leaf grads
+    finishing with a pmean over seq. Loss and every gradient must match
+    the sequential model."""
+    import dataclasses
+
+    from tpu_bootstrap.workload.pipeline import make_pipeline_1f1b_grad
+
+    model = dataclasses.replace(MODEL, max_seq_len=17,  # shifts to 16
+                                num_kv_heads=num_kv_heads)
+    mesh = build_mesh(mesh_cfg)
+    cfg = TrainConfig(model=model, mesh=mesh_cfg, attention="dense",
+                      attention_block=8)
+    params, stacked = stacked_state(model, jax.random.PRNGKey(0))
+    dsz = mesh_cfg.data * mesh_cfg.fsdp
+    tokens = jax.random.randint(jax.random.PRNGKey(1),
+                                (4 * dsz, model.max_seq_len), 0, model.vocab_size)
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+
+    want_loss, g_seq = jax.value_and_grad(lambda p: loss_fn(p, tokens, model))(params)
+    grad_fn = make_pipeline_1f1b_grad(cfg, mesh, num_microbatches=4)
+    loss, grads, _ = jax.jit(grad_fn)(stacked, inputs, targets)
+    assert float(loss) == pytest.approx(float(want_loss), rel=1e-5)
+
+    g_seq_stacked = stack_block_params(g_seq["blocks"])
+    gtol = 1e-4
+    for name in ("wq", "wk", "wv", "wo", "w_up", "w_down", "attn_norm", "mlp_norm"):
+        np.testing.assert_allclose(np.asarray(grads["blocks"][name]),
+                                   np.asarray(g_seq_stacked[name]),
+                                   rtol=gtol, atol=1e-5, err_msg=name)
+    np.testing.assert_allclose(np.asarray(grads["embed"]), np.asarray(g_seq["embed"]),
+                               rtol=gtol, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads["final_norm"]),
+                               np.asarray(g_seq["final_norm"]), rtol=gtol, atol=1e-5)
+
+
+@pytest.mark.parametrize("mesh_cfg", [
+    MeshConfig(pipe=2, data=2, expert=2),    # pp x dp x ep
+    MeshConfig(pipe=2, expert=2, tensor=2),  # pp x ep x tp
+    MeshConfig(pipe=2, fsdp=2, expert=2),    # pp x fsdp x ep
+    MeshConfig(pipe=2, data=4),              # MoE blocks, expert axis = 1
+])
+def test_1f1b_with_moe_matches_sequential(mesh_cfg):
+    """pp x ep under the MANUAL 1F1B backward: moe_mlp_manual's GShard
+    all-to-alls differentiate in-body (their transpose is the inverse
+    all-to-all — a data permutation, exact per-device), and the
+    expert-sharded stacks' grads scale by 1/n_ep instead of joining the
+    expert pmean. With a capacity factor high enough to avoid drops and
+    aux_coef=0, loss and every gradient — router and expert stacks
+    included — must match the sequential model."""
+    from tpu_bootstrap.workload.pipeline import make_pipeline_1f1b_grad
+
+    model = ModelConfig(vocab_size=64, num_layers=4, num_heads=2, head_dim=8,
+                        embed_dim=32, mlp_dim=64, max_seq_len=16, num_experts=4,
+                        expert_top_k=2, expert_capacity_factor=4.0,
+                        moe_aux_coef=0.0)
+    mesh = build_mesh(mesh_cfg)
+    cfg = TrainConfig(model=model, mesh=mesh_cfg)
+    params, stacked = stacked_state(model, jax.random.PRNGKey(0))
+    dsz = mesh_cfg.dcn * mesh_cfg.data * mesh_cfg.fsdp * mesh_cfg.expert
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2 * dsz, model.max_seq_len),
+                                0, model.vocab_size)
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+
+    want_loss, g_seq = jax.value_and_grad(lambda p: loss_fn(p, tokens, model))(params)
+    grad_fn = make_pipeline_1f1b_grad(cfg, mesh, num_microbatches=2)
+    loss, grads, _ = jax.jit(grad_fn)(stacked, inputs, targets)
+    assert float(loss) == pytest.approx(float(want_loss), rel=1e-5)
+
+    g_seq_stacked = stack_block_params(g_seq["blocks"])
+    for name in ("wq", "wo", "router", "w_up", "w_down", "attn_norm", "mlp_norm"):
+        np.testing.assert_allclose(np.asarray(grads["blocks"][name]),
+                                   np.asarray(g_seq_stacked[name]),
+                                   rtol=2e-4, atol=1e-5, err_msg=name)
+    np.testing.assert_allclose(np.asarray(grads["embed"]), np.asarray(g_seq["embed"]),
+                               rtol=2e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("mesh_cfg", [
+    MeshConfig(pipe=2, data=2, expert=2),
+    # tensor exercises the 1/tp aux seed: every tensor member computes
+    # the identical aux, and the router's tensor-replicated grads are
+    # psummed — without the scale the aux path would double-count.
+    MeshConfig(pipe=2, expert=2, tensor=2),
+])
+def test_1f1b_moe_aux_matches_gpipe(mesh_cfg):
+    """With aux_coef > 0 the two schedules compute the SAME microbatched
+    aux estimator — loss and gradients through the aux path (router
+    included) must agree between 1F1B's manually-seeded aux and GPipe's
+    AD-derived one."""
+    from tpu_bootstrap.workload.pipeline import (
+        make_pipeline_1f1b_grad,
+        make_pipeline_loss,
+    )
+
+    model = ModelConfig(vocab_size=64, num_layers=4, num_heads=2, head_dim=8,
+                        embed_dim=32, mlp_dim=64, max_seq_len=16, num_experts=4,
+                        expert_top_k=2, expert_capacity_factor=4.0,
+                        moe_aux_coef=0.1)
+    mesh = build_mesh(mesh_cfg)
+    cfg = TrainConfig(model=model, mesh=mesh_cfg)
+    params, stacked = stacked_state(model, jax.random.PRNGKey(0))
+    dsz = mesh_cfg.dcn * mesh_cfg.data * mesh_cfg.fsdp * mesh_cfg.expert
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2 * dsz, model.max_seq_len),
+                                0, model.vocab_size)
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+
+    gp_loss = make_pipeline_loss(cfg, mesh, num_microbatches=2)
+    want_loss, g_gp = jax.value_and_grad(
+        lambda p: gp_loss(p, inputs, targets))(stacked)
+    grad_fn = make_pipeline_1f1b_grad(cfg, mesh, num_microbatches=2)
+    loss, grads, _ = jax.jit(grad_fn)(stacked, inputs, targets)
+    assert float(loss) == pytest.approx(float(want_loss), rel=1e-5)
+    for name in ("router", "w_up", "w_down", "wq", "attn_norm", "mlp_norm"):
+        np.testing.assert_allclose(np.asarray(grads["blocks"][name]),
+                                   np.asarray(g_gp["blocks"][name]),
+                                   rtol=2e-4, atol=1e-5, err_msg=name)
+    np.testing.assert_allclose(np.asarray(grads["embed"]),
+                               np.asarray(g_gp["embed"]), rtol=2e-4, atol=1e-5)
+
+
 @pytest.mark.parametrize("mesh_cfg,attention,num_kv_heads", [
     (MeshConfig(pipe=2, data=2, seq=2), "dense", None),
     (MeshConfig(pipe=2, data=2, seq=2), "flash", None),
@@ -434,14 +568,32 @@ def test_pipeline_seq_requires_divisible_length():
         loss(stacked, tokens[:, :-1], tokens[:, 1:])
 
 
-def test_1f1b_rejects_seq_and_unknown_schedules():
-    """1F1B covers dcn/data/fsdp/tensor; seq (ring attention's own
-    shard_map) is rejected loudly, as are unknown schedule names."""
+def test_1f1b_rejects_bad_seq_and_unknown_schedules():
+    """1F1B now covers the full axis family, but still rejects loudly:
+    a sequence length that does not tile, flash's ring core under seq,
+    and unknown schedule names. (MQA/GQA under pp x sp x tp is NOT
+    rejected — the gathered-KV core takes the expand-then-slice
+    fallback; see the parity test above.)"""
     from tpu_bootstrap.workload.pipeline import make_pipeline_1f1b_grad
 
-    cfg = TrainConfig(model=MODEL, mesh=MeshConfig(pipe=2, data=2, seq=2))
-    with pytest.raises(ValueError, match="seq"):
-        make_pipeline_1f1b_grad(cfg, build_mesh(cfg.mesh), num_microbatches=2)
+    import dataclasses
+
+    # flash's seq core is the ppermute ring — structurally impossible
+    # inside the schedule's stage-divergent conds; rejected with the
+    # alternative named.
+    fl = TrainConfig(model=dataclasses.replace(MODEL, max_seq_len=17),
+                     mesh=MeshConfig(pipe=2, data=2, seq=2), attention="flash")
+    with pytest.raises(ValueError, match="flash"):
+        make_pipeline_1f1b_grad(fl, build_mesh(fl.mesh), num_microbatches=2)
+    undiv = TrainConfig(model=MODEL,  # max_seq_len 16 shifts to 15
+                        mesh=MeshConfig(pipe=2, data=2, seq=2))
+    grad_fn = make_pipeline_1f1b_grad(undiv, build_mesh(undiv.mesh),
+                                      num_microbatches=2)
+    params, stacked = stacked_state(MODEL, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, MODEL.max_seq_len),
+                                0, MODEL.vocab_size)
+    with pytest.raises(ValueError, match="divisible by the seq"):
+        grad_fn(stacked, tokens[:, :-1], tokens[:, 1:])
     bad = TrainConfig(model=MODEL, mesh=MeshConfig(pipe=2, data=4),
                       pipeline_schedule="zigzag")
     mesh = build_mesh(bad.mesh)
@@ -508,14 +660,15 @@ def test_pipeline_rejects_bad_configs():
                                     0, MODEL.vocab_size)
     with pytest.raises(ValueError, match="divide"):
         bad_loss(odd_stacked, odd_tokens[:, :-1], odd_tokens[:, 1:])
-    # MoE is GPipe-only: the 1F1B manual backward rejects it at
-    # construction, not at first trace.
+    # seq x MoE under 1F1B: the same per-row-routing semantics hole as
+    # GPipe's, rejected at construction, not at first trace.
     from tpu_bootstrap.workload.pipeline import make_pipeline_1f1b_grad
 
     moe = TrainConfig(
-        model=ModelConfig(**{**MODEL.__dict__, "num_experts": 2}),
-        mesh=MeshConfig(pipe=2, data=4), num_microbatches=2)
-    with pytest.raises(ValueError, match="MoE"):
+        model=ModelConfig(**{**MODEL.__dict__, "num_experts": 2,
+                             "max_seq_len": 17}),
+        mesh=MeshConfig(pipe=2, seq=2, expert=2), num_microbatches=2)
+    with pytest.raises(ValueError, match="routing"):
         make_pipeline_1f1b_grad(moe, build_mesh(moe.mesh), num_microbatches=2)
 
 
