@@ -1,0 +1,74 @@
+"""Mesh factorization at BASELINE config #5 scale (v5p 4x4x4, 64 chips).
+
+The driver's dryrun runs at n=8; mesh-factorization and microbatch-
+divisibility bugs live at larger counts (VERDICT r3 weak #6). Two layers
+of proof here:
+
+* pure pins on ``MeshConfig.for_device_count`` — the factorization is a
+  contract (tensor rides intra-host ICI, fsdp across-host ICI, data the
+  rest), so changes must be deliberate;
+* subprocess runs of ``dryrun_multichip`` at 16/32/64 virtual CPU
+  devices — a fresh interpreter per count because XLA's host-platform
+  device count freezes once the backend initializes (the suite's own
+  process is pinned to 8 by conftest). 64 exercises the composed
+  pp x dp x fsdp x tp "v5p-4x4x4 carve" pass end to end: real shardings,
+  one real train step, loss finite.
+"""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tpu_bootstrap.workload.sharding import MeshConfig
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_for_device_count_factorizations_pinned():
+    # (n) -> (data, fsdp, tensor); pipe/seq/expert/dcn never defaulted.
+    pins = {
+        1: (1, 1, 1),
+        2: (1, 1, 2),
+        4: (1, 1, 4),
+        8: (1, 2, 4),     # v5e 2x4: tp fills the 4-chip host, fsdp spans hosts
+        16: (1, 4, 4),    # v5e-16: tp=4 intra-host, fsdp across hosts
+        32: (1, 8, 4),
+        64: (2, 8, 4),    # v5p 4x4x4: 16 hosts x 4 chips
+        128: (4, 8, 4),
+        6: (3, 1, 2),     # non-power-of-2: pow2 factors only
+        3: (3, 1, 1),
+    }
+    for n, (data, fsdp, tensor) in pins.items():
+        cfg = MeshConfig.for_device_count(n)
+        assert cfg == MeshConfig(data=data, fsdp=fsdp, tensor=tensor), (n, cfg)
+        assert cfg.size == n
+
+
+def _run_dryrun(n: int, labels: list[str]) -> str:
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    code = (
+        "import __graft_entry__ as g; "
+        f"g.dryrun_multichip({n}, only_labels={labels!r})"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], cwd=str(REPO), env=env,
+        capture_output=True, text=True, timeout=900,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    return proc.stdout
+
+
+@pytest.mark.parametrize("n,labels", [
+    (16, ["dp/fsdp/tp", "pp/fsdp/tp 1f1b schedule+flash"]),
+    (32, ["pp/dp/fsdp/tp v5p-4x4x4 carve"]),
+    (64, ["dp/fsdp/tp", "pp/dp/fsdp/tp v5p-4x4x4 carve"]),
+])
+def test_dryrun_scales_beyond_eight(n, labels):
+    out = _run_dryrun(n, labels)
+    for label in labels:
+        assert f"{label} over {n} devices" in out, out
+    assert out.count("dryrun_multichip ok") == len(labels), out

@@ -56,14 +56,28 @@ class MeshConfig:
 
     @staticmethod
     def for_device_count(n: int) -> "MeshConfig":
-        """A sensible default factorization: tensor gets up to 2, fsdp up
-        to 2, the rest goes to data — mirroring how a v5p 4x4x4 slice would
-        be carved (tp within host, fsdp across hosts, dp across slices).
-        Sequence parallelism is opt-in (long-context runs set seq
-        explicitly), so the default leaves seq=1."""
-        tensor = 2 if n % 2 == 0 else 1
+        """A sensible default factorization, mirroring how a v5p slice is
+        physically carved: ``tensor`` up to 4 (the chips-per-host count on
+        v5p/v5e — Megatron's per-layer all-reduces ride intra-host ICI),
+        then ``fsdp`` up to 8 (across-host ICI: per-layer all-gather /
+        reduce-scatter), the rest to ``data`` (one gradient all-reduce per
+        step — the axis that tolerates the slowest links). A v5p 4x4x4
+        64-chip slice (16 hosts x 4 chips) therefore carves as
+        tensor=4 / fsdp=8 / data=2. Only power-of-2 factors are taken —
+        odd counts fall through to pure data parallelism. Pipeline is
+        never defaulted (pipe>1 changes the parameter layout to
+        per-stage stacks, so it must be an explicit choice), and sequence
+        parallelism is opt-in (long-context runs set seq explicitly)."""
+
+        def pow2(m: int, cap: int) -> int:
+            f = 1
+            while f < cap and m % (f * 2) == 0:
+                f *= 2
+            return f
+
+        tensor = pow2(n, 4)
         rest = n // tensor
-        fsdp = 2 if rest % 2 == 0 else 1
+        fsdp = pow2(rest, 8)
         data = rest // fsdp
         return MeshConfig(data=data, fsdp=fsdp, tensor=tensor)
 

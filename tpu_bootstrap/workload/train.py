@@ -187,6 +187,22 @@ def make_train_step(cfg: TrainConfig, mesh, p_shardings):
     else:
         attn = None
 
+    # The shard_map attention paths (flash, ring) shard the heads axis
+    # over `tensor` — num_heads must actually divide, and the failure
+    # should name the knob, not surface as a shard_map divisibility
+    # error at first trace. (The GSPMD dense path instead just drops the
+    # sharding via param_shardings' fit(), so it takes any head count.)
+    # Matters since for_device_count takes tensor up to 4: a num_heads=2
+    # model on a default 8-device mesh lands here.
+    if attn is not None and cfg.model.num_heads % mesh.shape["tensor"] != 0:
+        raise ValueError(
+            f"attention={cfg.attention!r} with sequence/flash shard_map "
+            f"shards the heads axis over the tensor mesh axis: num_heads "
+            f"({cfg.model.num_heads}) must be divisible by tensor "
+            f"({mesh.shape['tensor']}). Pick a mesh (WORKLOAD_MESH / "
+            f"TrainConfig.mesh) whose tensor extent divides num_heads, or "
+            f"use attention='dense'.")
+
     # GQA + tensor parallelism: the shard_map attention paths shard the
     # heads axis over `tensor`, which requires kv_heads % tensor == 0.
     # When it doesn't hold (e.g. MQA on a tensor>1 mesh), expand KV to the
