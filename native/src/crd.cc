@@ -278,7 +278,16 @@ Json status_schema() {
             Json::object({{"description",
                            "Set true by the synchronizer once an authorized sheet row has been "
                            "applied; gates RoleBinding and JobSet creation."},
-                          {"type", "boolean"}})},
+                          {"type", "boolean"},
+                          // Defaulted, NOT required (diverges from the
+                          // reference's required bool deliberately): this
+                          // build's status has TWO writers — the controller
+                          // merge-patches status.slice.phase before the
+                          // synchronizer ever syncs a new CR, and a
+                          // required sibling would 422 that first write
+                          // against a real apiserver (caught by the fake
+                          // apiserver's write-path schema validation).
+                          {"default", false}})},
            {"slice",
             Json::object({
                 {"description", "Observed state of the TPU slice JobSet."},
@@ -327,7 +336,6 @@ Json status_schema() {
                  })},
             })},
        })},
-      {"required", Json::array({Json("synchronized_with_sheet")})},
   });
 }
 
