@@ -205,9 +205,93 @@ def test_int4_rejections():
                           group=32)
     with pytest.raises(ValueError, match="contraction"):
         int4_matmul(jnp.ones((2, 32)), ok)
-    moe_block = {"router": None}
-    with pytest.raises(ValueError, match="MoE"):
-        quantize_block4(moe_block)
+    with pytest.raises(ValueError, match="head"):
+        from tpu_bootstrap.workload.quant import quantize_params4
+
+        params = init_params(ModelConfig(vocab_size=64, num_layers=1,
+                                         num_heads=2, head_dim=8,
+                                         embed_dim=16, mlp_dim=32,
+                                         max_seq_len=8),
+                             jax.random.PRNGKey(0))
+        quantize_params4(params, group=16, head="int2")
+
+
+def test_int4_expert_stacks():
+    """int4 MoE (VERDICT r3 item 8): the (E, K, N) expert stacks stream
+    at 0.5 bytes/element through int4_expert_matmul with per-(expert,
+    group, channel) scales. Kernel vs dequant oracle, then the full MoE
+    model through quantize_params4."""
+    from tpu_bootstrap.workload.decode import init_cache, prefill
+    from tpu_bootstrap.workload.quant import (dequantize_weight4,
+                                              int4_expert_matmul,
+                                              quantize_expert_weight4,
+                                              quantize_params4)
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (4, 64, 96), jnp.float32)
+    qw = quantize_expert_weight4(w, group=32)
+    assert qw.q.shape == (4, 32, 96) and qw.s.shape == (4, 2, 96)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 5, 64), jnp.float32)
+    got = int4_expert_matmul(x, qw)
+    want = jnp.einsum("etk,ekn->etn", x.astype(jnp.bfloat16),
+                      dequantize_weight4(qw).astype(jnp.bfloat16),
+                      preferred_element_type=jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-2, atol=2e-2)
+
+    cfg = ModelConfig(vocab_size=64, num_layers=2, num_heads=4, head_dim=16,
+                      embed_dim=64, mlp_dim=128, max_seq_len=32,
+                      num_experts=4, expert_top_k=2,
+                      expert_capacity_factor=4.0)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    q4 = quantize_params4(params, group=32, head=False)
+    # router stays float, stacks are packed int4
+    assert not hasattr(q4["blocks"][0]["router"], "group")
+    assert q4["blocks"][0]["w_up"].q.dtype == jnp.uint8
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 6), 0, 64)
+    lq, _ = prefill(q4, prompt, init_cache(cfg, 2, 12), cfg)
+    lf, _ = prefill(params, prompt, init_cache(cfg, 2, 12), cfg)
+    corr = np.corrcoef(np.asarray(lq).ravel(), np.asarray(lf).ravel())[0, 1]
+    # Looser than the dense int4 bound (0.98): routing is DISCRETE, so
+    # int4 noise near a routing boundary flips whole token-rows to a
+    # different expert on the random-init toy (measured ~0.956 here; the
+    # kernel-vs-oracle assertion above already pins the arithmetic).
+    assert corr > 0.93, corr
+
+
+def test_int4_head_option_and_quality_ladder():
+    """The logits head is where int4's coarseness bites (the softmax
+    decides there), so quantize_params4 defaults to the finer int8 head
+    copy and offers head='int4' as the measured full-int4 floor. Pin the
+    quality ladder on mean next-token xent against the float model:
+    int8 <= int4+int8head <= int4+int4head, all within a loose bound —
+    the bench reports the same ladder at checkpoint size on chip."""
+    from tpu_bootstrap.workload.decode import init_cache, prefill
+    from tpu_bootstrap.workload.quant import quantize_params, quantize_params4
+
+    cfg = ModelConfig(vocab_size=128, num_layers=3, num_heads=4, head_dim=16,
+                      embed_dim=64, mlp_dim=256, max_seq_len=40)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 24), 0, 128)
+
+    def mean_xent(p):
+        logits, _ = prefill(p, tokens[:, :-1], init_cache(cfg, 4, 24), cfg,
+                            all_logits=True)
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        return -float(jnp.mean(jnp.take_along_axis(
+            lp, tokens[:, 1:, None], axis=-1)))
+
+    base = mean_xent(params)
+    d_int8 = abs(mean_xent(quantize_params(params)) - base)
+    d_int4 = abs(mean_xent(quantize_params4(params, group=32)) - base)
+    d_int4h = abs(mean_xent(quantize_params4(params, group=32,
+                                             head="int4")) - base)
+    # int4's group scales keep it close; the int4 head adds the largest
+    # step of the ladder. Bounds are loose (random weights) — the point
+    # is the ORDER and that nothing explodes.
+    assert d_int8 < 0.05, d_int8
+    assert d_int4 < 0.15, d_int4
+    assert d_int4h < 0.4, d_int4h
+    assert d_int8 <= d_int4 + 0.02
 
 
 def test_int4_model_level_semantics_and_quality():

@@ -254,12 +254,15 @@ def _logits(params: Params, x: jax.Array) -> jax.Array:
 
 def prefill(params: Params, tokens: jax.Array, caches: list, cfg: ModelConfig,
             kv_kernel: bool = True, flash: bool = False,
-            lengths: jax.Array | None = None):
+            lengths: jax.Array | None = None, all_logits: bool = False):
     """Run the prompt (B, S) through the model, filling cache slots
     [0, S). Returns (logits for the LAST prompt position (B, vocab),
-    updated caches). flash=True runs the prompt's causal self-attention
-    through the flash kernel — O(S) memory instead of the einsum's
-    (S, cache_len) score rows; the long-prompt path.
+    updated caches). all_logits=True returns (B, S, vocab) instead —
+    the scoring surface (teacher-forced logprobs of a given completion,
+    and the quantization-quality eval's probe). flash=True runs the
+    prompt's causal self-attention through the flash kernel — O(S)
+    memory instead of the einsum's (S, cache_len) score rows; the
+    long-prompt path.
 
     lengths: (B,) int32 true prompt lengths for a RAGGED batch whose
     prompts are LEFT-padded to S (real tokens right-aligned, so the
@@ -295,6 +298,8 @@ def prefill(params: Params, tokens: jax.Array, caches: list, cfg: ModelConfig,
         x, cache = _block_step(block, x, cache, positions, valid, cfg, kv_kernel,
                                prefill_flash=flash, slot=slot)
         new_caches.append(cache)
+    if all_logits:
+        return _logits(params, x), new_caches
     return _logits(params, x[:, -1:])[:, 0], new_caches
 
 

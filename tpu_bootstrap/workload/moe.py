@@ -65,7 +65,7 @@ def _expert_linear(x, w, dtype):
 
     if quant.is_quantized(w):
         e, b, c, k = x.shape
-        y = quant.int8_expert_matmul(x.reshape(e, b * c, k).astype(dtype), w)
+        y = quant.quantized_expert_matmul(x.reshape(e, b * c, k).astype(dtype), w)
         return y.reshape(e, b, c, -1)
     return jnp.einsum("ebck,ekn->ebcn", x, w.astype(dtype))
 

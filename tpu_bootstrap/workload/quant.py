@@ -225,13 +225,16 @@ def quantize_weight4(w: jax.Array, group: int = 64) -> Quantized4Weight:
 
 
 def dequantize_weight4(qw: Quantized4Weight) -> jax.Array:
-    """(K, N) f32 reconstruction — the oracle the kernel is tested
-    against and the fallback for consumers that need a plain array."""
+    """f32 reconstruction — the oracle the kernels are tested against
+    and the fallback for consumers that need a plain array. Handles both
+    the dense (K/2, N) and the expert-stacked (E, K/2, N) layouts."""
     lo = (qw.q & 0xF).astype(jnp.int32) - 8
     hi = (qw.q >> 4).astype(jnp.int32) - 8
-    k2, n = qw.q.shape
-    w = jnp.stack([lo, hi], axis=1).reshape(2 * k2, n).astype(jnp.float32)
-    return (w.reshape(-1, qw.group, n) * qw.s[:, None, :]).reshape(2 * k2, n)
+    k2, n = qw.q.shape[-2:]
+    lead = qw.q.shape[:-2]
+    w = jnp.stack([lo, hi], axis=-2).reshape(*lead, 2 * k2, n).astype(jnp.float32)
+    w = w.reshape(*lead, -1, qw.group, n) * qw.s[..., :, None, :]
+    return w.reshape(*lead, 2 * k2, n)
 
 
 def _matmul4_kernel(x_ref, q_ref, s_ref, o_ref, *, group):
@@ -286,32 +289,104 @@ def int4_matmul(x: jax.Array, qw: Quantized4Weight, *, block_n: int = 512,
     return out[:t, :n]
 
 
+def quantize_expert_weight4(w: jax.Array, group: int = 64) -> Quantized4Weight:
+    """Expert stack (E, K, N) float -> nibble-packed int4 with
+    per-(expert, K-group, output channel) scales — the same group-wise
+    scaling as the dense int4 format, one more leading axis."""
+    e, k, n = w.shape
+    if k % 2 != 0 or group % 2 != 0 or k % group != 0:
+        raise ValueError(
+            f"int4 packing needs K ({k}) even and divisible by an even "
+            f"group ({group})")
+    wf = w.astype(jnp.float32).reshape(e, k // group, group, n)
+    absmax = jnp.max(jnp.abs(wf), axis=2, keepdims=True)  # (E, K/g, 1, N)
+    scale = jnp.where(absmax > 0, absmax / 7.0, 1.0)
+    q = jnp.clip(jnp.round(wf / scale), -7, 7).astype(jnp.int32).reshape(e, k, n)
+    u = (q + 8).astype(jnp.uint8)
+    packed = (u[:, 0::2] | (u[:, 1::2] << 4)).astype(jnp.uint8)  # (E, K/2, N)
+    return Quantized4Weight(q=packed, s=scale[:, :, 0], group=group,
+                            shape=tuple(w.shape))
+
+
+def int4_expert_matmul(x: jax.Array, qw: Quantized4Weight, *,
+                       block_n: int = 512,
+                       interpret: bool | None = None) -> jax.Array:
+    """Per-expert batched matmul: x (E, T, K) @ dequant(qw) (E, K, N) ->
+    (E, T, N) in x.dtype, streaming the stacks at 0.5 bytes/element.
+    Grid (E, N tiles); the leading None block dims squeeze away, so the
+    kernel body is the same unpack-in-VMEM matmul as int4_matmul's."""
+    if interpret is None:
+        interpret = _interpret_default()
+    e, t, k = x.shape
+    eq, k2, n = qw.q.shape
+    if (e, k) != (eq, 2 * k2):
+        raise ValueError(f"expert/contraction mismatch: x {x.shape}, "
+                         f"weight {qw.q.shape} (K = 2x{k2})")
+    t_pad, bn, n_pad = _tile_pads(t, n, block_n)
+    xp = jnp.pad(x, ((0, 0), (0, t_pad - t), (0, 0))) if t_pad != t else x
+    q, s = qw.q, qw.s
+    if n_pad != n:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, n_pad - n)))
+        s = jnp.pad(s, ((0, 0), (0, 0), (0, n_pad - n)))
+
+    out = pl.pallas_call(
+        functools.partial(_matmul4_kernel, group=qw.group),
+        grid=(e, n_pad // bn),
+        in_specs=[
+            pl.BlockSpec((None, t_pad, k), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, k2, bn), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((None, k // qw.group, bn), lambda i, j: (i, 0, j)),
+        ],
+        out_specs=pl.BlockSpec((None, t_pad, bn), lambda i, j: (i, 0, j)),
+        out_shape=jax.ShapeDtypeStruct((e, t_pad, n_pad), x.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
+        interpret=interpret,
+    )(xp, q, s)
+    return out[:, :t, :n]
+
+
 def quantize_block4(block: dict, group: int = 64) -> dict:
-    """int4 counterpart of quantize_block for DENSE blocks (MoE expert
-    stacks are rejected — per-expert int4 grouping is unimplemented).
-    No fused QKV: int4 is the extreme-bandwidth option and keeps the
-    minimal surface."""
-    if "router" in block:
-        raise ValueError("int4 quantization does not support MoE blocks")
+    """int4 counterpart of quantize_block. MoE blocks quantize their
+    attention projections and (E, K, N) expert stacks with per-(expert,
+    group, channel) scales; the router (tiny, routing-critical) stays
+    float, as in int8. No fused QKV: int4 is the extreme-bandwidth
+    option and keeps the minimal surface."""
+    q4 = functools.partial(quantize_weight4, group=group)
     out = dict(block)
+    if "router" in block:
+        for name in ("wq", "wk", "wv"):
+            out[name] = _q2d(block[name], 1, quantize=q4)
+        out["wo"] = _q2d(block["wo"], 2, quantize=q4)
+        out["w_up"] = quantize_expert_weight4(block["w_up"], group)
+        out["w_down"] = quantize_expert_weight4(block["w_down"], group)
+        return out
     for name, contract_rank in (("wq", 1), ("wk", 1), ("wv", 1), ("wo", 2),
                                 ("w_up", 1), ("w_down", 1)):
-        out[name] = _q2d(block[name], contract_rank,
-                         quantize=functools.partial(quantize_weight4,
-                                                    group=group))
+        out[name] = _q2d(block[name], contract_rank, quantize=q4)
     return out
 
 
 def quantize_params4(params: dict, *, group: int = 64,
-                     head: bool = True) -> dict:
-    """Params pytree -> dense block projections int4-quantized (the
+                     head: str | bool = "int8") -> dict:
+    """Params pytree -> block projections int4-quantized (the
     decode._linear seam detects Quantized4Weight like QuantizedWeight).
-    head=True stores the logits head as the INT8 copy (``lm_head``, as
-    in quantize_params) — int4's coarseness costs the most exactly
-    where the softmax decides, so the head keeps the finer format."""
+
+    head picks the logits-head format: "int8" (default) stores the head
+    as the finer int8 copy — int4's coarseness costs the most exactly
+    where the softmax decides — while "int4" streams the head at 0.5
+    bytes/element too (the full-int4 bandwidth floor; measure the
+    quality delta before shipping it), and False leaves the float
+    embedding as the head."""
+    if head not in ("int8", "int4", True, False):
+        # Validate BEFORE quantizing every block — an argument typo must
+        # not pay the full model's packing work first.
+        raise ValueError(f"head must be 'int8', 'int4', or False, got {head!r}")
     out = {**params, "blocks": [quantize_block4(b, group)
                                 for b in params["blocks"]]}
-    if head:
+    if head == "int4":
+        out["lm_head"] = quantize_weight4(params["embed"].T, group=group)
+    elif head == "int8" or head is True:
         out["lm_head"] = quantize_weight(params["embed"].T)
     return out
 
@@ -403,6 +478,14 @@ def quantized_matmul(x2: jax.Array, w) -> jax.Array:
     return int8_matmul(x2, w)
 
 
+def quantized_expert_matmul(x3: jax.Array, w) -> jax.Array:
+    """Expert-stack counterpart of quantized_matmul — the dispatch the
+    MoE FFN seam (moe._expert_linear) calls."""
+    if isinstance(w, Quantized4Weight):
+        return int4_expert_matmul(x3, w)
+    return int8_expert_matmul(x3, w)
+
+
 def dequantize_any(w) -> jax.Array:
     """(K, N) f32 reconstruction for either quantized format — the
     dispatch consumers that need a plain array (lora's QLoRA base)
@@ -414,6 +497,9 @@ def dequantize_any(w) -> jax.Array:
 
 __all__ = [
     "Quantized4Weight",
+    "int4_expert_matmul",
+    "quantize_expert_weight4",
+    "quantized_expert_matmul",
     "QuantizedWeight",
     "dequantize_weight",
     "dequantize_any",
