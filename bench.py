@@ -373,11 +373,30 @@ try:
             samples.append(max((t2 - t1) / (d2 - d1), 1e-9))
         return sorted(samples)[len(samples) // 2]
 
+    # Roofline accounting (VERDICT r3 item 6): a decode step streams every
+    # weight byte once (the KV cache is negligible at this section's
+    # L <= 256); bytes/token localizes the gap between the measured int8
+    # speedup and its 2x weight-bandwidth ceiling.
+    PEAK_HBM = 819e9  # v5e HBM bandwidth, bytes/s
+
+    def param_bytes(params):
+        return sum(x.nbytes for x in jax.tree.leaves(params))
+
+    def roofline(prefix, params, step_s):
+        bytes_step = param_bytes(params)
+        out.update({
+            f"{prefix}_bytes_per_token": round(bytes_step / dbatch),
+            f"{prefix}_achieved_gbps": round(bytes_step / step_s / 1e9, 1),
+            f"{prefix}_hbm_roofline_frac": round(
+                bytes_step / step_s / PEAK_HBM, 3),
+        })
+
     step_s = decode_step_s(dparams)
     out.update({
         "decode_tokens_per_sec": round(dbatch / step_s, 1),
         "decode_step_ms": round(step_s * 1e3, 3),
     })
+    roofline("decode", dparams, step_s)
     emit()
 
     # Same measurement with int8 weight-only quantized blocks (the
@@ -389,6 +408,48 @@ try:
     out.update({
         "decode_int8_tokens_per_sec": round(dbatch / qstep_s, 1),
         "decode_int8_speedup": round(step_s / qstep_s, 3),
+    })
+    roofline("decode_int8", qparams, qstep_s)
+    emit()
+
+    # int4 weight-only (VERDICT r3 item 8): 0.5 bytes/element through
+    # the group-scaled nibble-packed kernel; head stays int8 (the
+    # softmax decides there). Plus the quality ladder at CHECKPOINT size
+    # — mean next-token xent delta vs the f32 master on the same batch
+    # (random-init weights: this measures the FORMAT's noise at scale,
+    # not task degradation; no real checkpoints exist in this sandbox).
+    from tpu_bootstrap.workload.quant import quantize_params4, quantize_weight4
+
+    qparams4 = quantize_params4(dmaster)
+    q4step_s = decode_step_s(qparams4)
+    out.update({
+        "decode_int4_tokens_per_sec": round(dbatch / q4step_s, 1),
+        "decode_int4_speedup": round(step_s / q4step_s, 3),
+    })
+    roofline("decode_int4", qparams4, q4step_s)
+    emit()
+
+    from tpu_bootstrap.workload.decode import init_cache as _ic, prefill as _pf
+
+    def mean_xent(params):
+        toks = jax.random.randint(jax.random.PRNGKey(9), (dbatch, 65), 0,
+                                  dcfg.vocab_size)
+        logits, _ = _pf(params, toks[:, :-1], _ic(dcfg, dbatch, 64), dcfg,
+                        all_logits=True)
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        return -float(jnp.mean(jnp.take_along_axis(lp, toks[:, 1:, None], -1)))
+
+    xb = mean_xent(dmaster)
+    out.update({
+        "quant_xent_f32": round(xb, 4),
+        "quant_xent_delta_int8": round(abs(mean_xent(qparams) - xb), 4),
+        "quant_xent_delta_int4": round(abs(mean_xent(qparams4) - xb), 4),
+        # int4 head: reuse the already-quantized blocks, swap only the
+        # head copy (re-quantizing every block would re-pay the whole
+        # device transfer inside the timeout-sensitive decode section).
+        "quant_xent_delta_int4_head4": round(abs(mean_xent(
+            {**qparams4, "lm_head": quantize_weight4(dmaster["embed"].T)})
+            - xb), 4),
     })
     emit()
 
@@ -416,74 +477,42 @@ except Exception as e:  # noqa: BLE001
     out["decode_bench_error"] = f"{type(e).__name__}: {e}"[:400]
 emit()
 
-# Long-context training on one chip: the same 134M model at seq 8192
-# with the flash kernel and rematerialization — a configuration the
-# dense path cannot touch (the seq^2 score tensors would blow HBM).
-# The grid-streamed kernel formulation is what makes this compile: the
-# earlier whole-slab kernels crashed the tunnel's remote compile helper
-# when fused into full train graphs past ~6k seq. 16k seq at batch 1
-# works too (25.7% MFU measured); 8192 is the benched point.
+# Speculative decoding (VERDICT r3 item 5): committed-tokens/s for int8
+# SELF-speculation — the target's own int8 copy drafts gamma tokens, the
+# bf16 target verifies the chunk in one weight stream. The only reason
+# speculative.py exists is wall-clock speedup; this measures it against
+# the plain bf16 generate above (decode_tokens_per_sec). Acceptance
+# telemetry rides along: with random-init weights the int8 shadow's
+# argmax agreement is the worst case a real checkpoint would beat, so
+# mean_committed contextualizes whatever speedup appears.
 try:
-    LSEQ = 8192
-    lcfg = TrainConfig(
-        model=ModelConfig(vocab_size=32768, num_layers=8, num_heads=16, head_dim=64,
-                          embed_dim=1024, mlp_dim=4096, max_seq_len=LSEQ,
-                          compute_dtype=jnp.bfloat16),
-        mesh=MeshConfig(), attention="flash", remat=True,
-    )
-    lmesh = build_mesh(lcfg.mesh, jax.devices()[:1])
-    lparams, lopt, lp_sh = init_train_state(lcfg, lmesh, jax.random.PRNGKey(0))
-    lstep = make_train_step(lcfg, lmesh, lp_sh)
-    lbatch = 2
-    ltokens = jax.random.randint(jax.random.PRNGKey(1), (lbatch, LSEQ), 0, 32768)
-    lparams, lopt, ll = lstep(lparams, lopt, ltokens); float(ll)
-    t0 = time.time()
-    for _ in range(5):
-        lparams, lopt, ll = lstep(lparams, lopt, ltokens)
-    float(ll)
-    lms = (time.time() - t0) / 5 * 1e3
-    ln = sum(x.size for x in jax.tree.leaves(lparams))
-    lm = lcfg.model
-    ltoks = lbatch * (LSEQ - 1)
-    lattn = 12 * lbatch * lm.num_layers * lm.num_heads * (LSEQ - 1) ** 2 * lm.head_dim
-    out.update({
-        "train_seq%d_step_ms" % LSEQ: round(lms, 3),
-        "train_seq%d_tokens_per_sec" % LSEQ: round(ltoks / (lms / 1e3), 1),
-        "train_seq%d_mfu_pct" % LSEQ: round(
-            100 * (6 * ln * ltoks + lattn) / (lms / 1e3) / PEAK_BF16, 2),
-    })
-    emit()
+    from tpu_bootstrap.workload.speculative import speculative_generate
 
-    # Same configuration with the chunked cross-entropy head
-    # (workload/xent.py): the (B, S, V) logits — 2 GB of f32 at this
-    # shape — never materialize, so the step sheds its largest tensor and
-    # the HBM traffic that came with it. The dense run's state (params +
-    # Adam moments, ~1.6 GB f32) is dead now — drop it before the second
-    # init so peak HBM holds one train state, not two.
-    del lparams, lopt, lstep
-    ccfg = TrainConfig(
-        model=ModelConfig(vocab_size=32768, num_layers=8, num_heads=16, head_dim=64,
-                          embed_dim=1024, mlp_dim=4096, max_seq_len=LSEQ,
-                          compute_dtype=jnp.bfloat16, vocab_chunk=4096),
-        mesh=MeshConfig(), attention="flash", remat=True,
-    )
-    cparams, copt, cp_sh = init_train_state(ccfg, lmesh, jax.random.PRNGKey(0))
-    cstep = make_train_step(ccfg, lmesh, cp_sh)
-    cparams, copt, cl = cstep(cparams, copt, ltokens); float(cl)
-    t0 = time.time()
-    for _ in range(5):
-        cparams, copt, cl = cstep(cparams, copt, ltokens)
-    float(cl)
-    cms = (time.time() - t0) / 5 * 1e3
+    def timed_spec(steps, gamma):
+        t0 = time.time()
+        toks, stats = speculative_generate(
+            dparams, qparams, dprompt, dcfg, dcfg, steps, gamma=gamma,
+            with_stats=True)
+        int(toks[0, -1])
+        return time.time() - t0, stats
+
+    g = 4
+    timed_spec(d1, g)  # compile + warm both chunk shapes
+    timed_spec(d2, g)
+    samples = []
+    for _ in range(3):
+        t1, _s = timed_spec(d1, g)
+        t2, stats = timed_spec(d2, g)
+        samples.append(max((t2 - t1) / (d2 - d1), 1e-9))
+    sstep_s = sorted(samples)[len(samples) // 2]
     out.update({
-        "train_seq%d_chunked_xent_step_ms" % LSEQ: round(cms, 3),
-        "train_seq%d_chunked_xent_mfu_pct" % LSEQ: round(
-            100 * (6 * ln * ltoks + lattn) / (cms / 1e3) / PEAK_BF16, 2),
-        "chunked_xent_speedup_seq%d" % LSEQ: round(lms / cms, 3),
+        "speculative_tokens_per_sec": round(dbatch / sstep_s, 1),
+        "speculative_speedup": round(step_s / sstep_s, 3),
+        "speculative_gamma": g,
+        "speculative_mean_committed": round(float(stats["mean_committed"]), 2),
     })
-    del cparams, copt, cstep  # free the train state before the decode section
 except Exception as e:  # noqa: BLE001
-    out["longctx_bench_error"] = f"{type(e).__name__}: {e}"[:400]
+    out["speculative_bench_error"] = f"{type(e).__name__}: {e}"[:400]
 emit()
 
 # Long-context DECODE: per-step cost against a fixed 4096-slot cache —
@@ -530,6 +559,102 @@ try:
 except Exception as e:  # noqa: BLE001
     out["decode_longctx_bench_error"] = f"{type(e).__name__}: {e}"[:400]
 emit()
+
+# Long-context training on one chip: the same 134M model at seq 8192
+# with the flash kernel and rematerialization — a configuration the
+# dense path cannot touch (the seq^2 score tensors would blow HBM).
+# The grid-streamed kernel formulation is what makes this compile: the
+# earlier whole-slab kernels crashed the tunnel's remote compile helper
+# when fused into full train graphs past ~6k seq. 16k seq at batch 1
+# works too (25.7% MFU measured); 8192 is the benched point.
+try:
+    LSEQ = 8192
+    lcfg = TrainConfig(
+        model=ModelConfig(vocab_size=32768, num_layers=8, num_heads=16, head_dim=64,
+                          embed_dim=1024, mlp_dim=4096, max_seq_len=LSEQ,
+                          compute_dtype=jnp.bfloat16),
+        mesh=MeshConfig(), attention="flash", remat=True,
+    )
+    lmesh = build_mesh(lcfg.mesh, jax.devices()[:1])
+    lparams, lopt, lp_sh = init_train_state(lcfg, lmesh, jax.random.PRNGKey(0))
+    lbatch = 2
+    ltokens = jax.random.randint(jax.random.PRNGKey(1), (lbatch, LSEQ), 0, 32768)
+    # AOT-compile ONCE and reuse the executable for both the timing loop
+    # and the memory accounting — a second lower().compile() at seq 8192
+    # through the tunnel would eat minutes of the timeout budget.
+    lstep = make_train_step(lcfg, lmesh, lp_sh).lower(
+        lparams, lopt, ltokens).compile()
+    try:
+        lmem = lstep.memory_analysis()
+    except Exception:  # noqa: BLE001
+        lmem = None
+    lparams, lopt, ll = lstep(lparams, lopt, ltokens); float(ll)
+    t0 = time.time()
+    for _ in range(5):
+        lparams, lopt, ll = lstep(lparams, lopt, ltokens)
+    float(ll)
+    lms = (time.time() - t0) / 5 * 1e3
+    ln = sum(x.size for x in jax.tree.leaves(lparams))
+    lm = lcfg.model
+    ltoks = lbatch * (LSEQ - 1)
+    lattn = 12 * lbatch * lm.num_layers * lm.num_heads * (LSEQ - 1) ** 2 * lm.head_dim
+    out.update({
+        "train_seq%d_step_ms" % LSEQ: round(lms, 3),
+        "train_seq%d_tokens_per_sec" % LSEQ: round(ltoks / (lms / 1e3), 1),
+        "train_seq%d_mfu_pct" % LSEQ: round(
+            100 * (6 * ln * ltoks + lattn) / (lms / 1e3) / PEAK_BF16, 2),
+    })
+    emit()
+
+    # Same configuration with the chunked cross-entropy head
+    # (workload/xent.py): the (B, S, V) logits — 2 GB of f32 at this
+    # shape — never materialize, so the step sheds its largest tensor and
+    # the HBM traffic that came with it. The dense run's state (params +
+    # Adam moments, ~1.6 GB f32) is dead now — drop it before the second
+    # init so peak HBM holds one train state, not two.
+    del lparams, lopt, lstep
+    ccfg = TrainConfig(
+        model=ModelConfig(vocab_size=32768, num_layers=8, num_heads=16, head_dim=64,
+                          embed_dim=1024, mlp_dim=4096, max_seq_len=LSEQ,
+                          compute_dtype=jnp.bfloat16, vocab_chunk=4096),
+        mesh=MeshConfig(), attention="flash", remat=True,
+    )
+    cparams, copt, cp_sh = init_train_state(ccfg, lmesh, jax.random.PRNGKey(0))
+    cstep = make_train_step(ccfg, lmesh, cp_sh).lower(
+        cparams, copt, ltokens).compile()  # one compile: timing + memory
+    cparams, copt, cl = cstep(cparams, copt, ltokens); float(cl)
+    t0 = time.time()
+    for _ in range(5):
+        cparams, copt, cl = cstep(cparams, copt, ltokens)
+    float(cl)
+    cms = (time.time() - t0) / 5 * 1e3
+    out.update({
+        "train_seq%d_chunked_xent_step_ms" % LSEQ: round(cms, 3),
+        "train_seq%d_chunked_xent_mfu_pct" % LSEQ: round(
+            100 * (6 * ln * ltoks + lattn) / (cms / 1e3) / PEAK_BF16, 2),
+        # Step-time parity is EXPECTED at this shape: attention FLOPs
+        # (~1.7e13) dwarf the head's (~3e12) at seq 8192, so the head is
+        # <15% of the step. The chunked head's real win is MEMORY — the
+        # (B, S, V) f32 logits (2.1 GB here) never materialize — which
+        # the compiler's own temp accounting shows below; it buys batch
+        # (or seq) headroom, not step time.
+        "chunked_xent_speedup_seq%d" % LSEQ: round(lms / cms, 3),
+    })
+    try:
+        cmem = cstep.memory_analysis()
+        out.update({
+            "chunked_xent_temp_mb": round(cmem.temp_size_in_bytes / 1e6, 1),
+            "dense_xent_temp_mb": round(lmem.temp_size_in_bytes / 1e6, 1),
+            "chunked_xent_temp_reduction": round(
+                lmem.temp_size_in_bytes / max(cmem.temp_size_in_bytes, 1), 2),
+        })
+    except Exception:  # noqa: BLE001
+        pass  # memory_analysis availability varies by backend
+    del cparams, copt, cstep  # drop the train state before interpreter exit
+except Exception as e:  # noqa: BLE001
+    out["longctx_bench_error"] = f"{type(e).__name__}: {e}"[:400]
+emit()
+
 """
 
 
@@ -583,14 +708,36 @@ def _git_fingerprint() -> str:
 
 
 def _cache_workload(parsed: dict) -> None:
-    if parsed.get("chip_alive") and "workload_bench_error" not in parsed:
+    """Cache chip-measured numbers for rounds when the tunnel is down.
+    Partial runs (timeout after some sections) cache too, MERGED over the
+    previous cache's results: keys a truncated run never reached keep
+    their older measurement rather than vanishing — each key is the
+    freshest value ever measured, and the fingerprint records the tree
+    of the LATEST contribution."""
+    if not parsed.get("chip_alive"):
+        return
+    fresh = {k: v for k, v in parsed.items()
+             if k != "workload_bench_error" and not k.endswith("_error")}
+    head = _git_fingerprint()
+    try:
         try:
-            WORKLOAD_CACHE.write_text(json.dumps(
-                {"measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-                 "commit": _git_fingerprint(),
-                 "results": parsed}))
-        except OSError:
-            pass
+            cache = json.loads(WORKLOAD_CACHE.read_text())
+            old = cache.get("results", {})
+            # Per-key provenance: keys carried over keep the fingerprint
+            # of the run that actually measured them (legacy caches
+            # without the map get the cache-level commit for all keys).
+            key_commits = cache.get("key_commits") or {
+                k: cache.get("commit", "unknown") for k in old}
+        except (OSError, ValueError):
+            old, key_commits = {}, {}
+        key_commits.update({k: head for k in fresh})
+        WORKLOAD_CACHE.write_text(json.dumps(
+            {"measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+             "commit": head,
+             "key_commits": key_commits,
+             "results": {**old, **fresh}}))
+    except OSError:
+        pass
 
 
 def _attach_cached_workload(err_result: dict) -> dict:
@@ -600,35 +747,46 @@ def _attach_cached_workload(err_result: dict) -> dict:
         return err_result
     commit = cache.get("commit", "unknown")
     head = _git_fingerprint()
+    key_commits = cache.get("key_commits") or {
+        k: commit for k in cache.get("results", {})}
     err_result["workload_cached_note"] = (
         "chip unavailable at bench time; cached_* keys were measured at "
         f"commit {commit} ({cache.get('measured_at', '?')})")
-    if commit != head:
-        # The honest label: these numbers are from a DIFFERENT build.
+    # Per-key honesty: a MERGED cache can hold keys measured at several
+    # commits (partial runs contribute only the sections they reached),
+    # so staleness is judged per key, not from the cache-level stamp.
+    stale = sorted(k for k, c in key_commits.items() if c != head)
+    if stale:
         err_result["workload_cache_stale"] = True
+        err_result["workload_cache_stale_keys"] = stale[:20]
         err_result["workload_cached_note"] += (
-            f" — STALE: current tree is {head}; kernels changed since the "
-            "cache was measured may be unproven on the chip")
+            f" — STALE: current tree is {head}; {len(stale)} cached keys "
+            "were measured on a different build and may be unproven on "
+            "the chip")
     for k, v in cache.get("results", {}).items():
         err_result[f"cached_{k}"] = v
     return err_result
 
 
-def workload_bench(timeout_secs: int = 900):
+def workload_bench(timeout_secs: int | None = None):
     """Run the TPU workload micro-bench in a subprocess, first and
     isolated (VERDICT r1 item 1): explicit JAX_PLATFORMS passthrough and
     a hard timeout. Fast failures (crash, no JSON) get one retry; a
     timeout with ZERO output — hung backend init, i.e. a dead tunnel —
-    does NOT retry (it would hang just as long again). 900s cap: a fully
-    cold run (15+ Mosaic compiles through the tunnel) measured ~600s
-    through the decode section alone, which cost one run its seq-8192
-    long-context metric — and the chunked-xent section adds two more
-    seq-8192 compiles. The subprocess
-    emits its accumulated results after every milestone, so even a
-    timeout or crash returns whatever was measured up to that point. On
-    total failure returns the error string instead of raising — the
-    control-plane metric is the primary and must never be lost to a
-    workload hiccup."""
+    does NOT retry (it would hang just as long again). The 1400s default
+    cap (TPUBC_WORKLOAD_TIMEOUT overrides): a fully cold run through the
+    tunnel measured ~900s through the speculative section (20+ Mosaic
+    compiles), and the round-3 900s cap cost that run its long-context
+    sections; sections are ordered never-measured-first so a timeout
+    loses the already-proven tail, whose numbers ride the merged cache.
+    The subprocess emits its accumulated results after every milestone,
+    so even a timeout or crash returns whatever was measured up to that
+    point — and those partials are cached (merged) too. On total failure
+    returns the error string instead of raising — the control-plane
+    metric is the primary and must never be lost to a workload
+    hiccup."""
+    if timeout_secs is None:
+        timeout_secs = int(os.environ.get("TPUBC_WORKLOAD_TIMEOUT", "1400"))
     err = ""
     for _attempt in range(2):
         stdout = ""
@@ -653,6 +811,7 @@ def workload_bench(timeout_secs: int = 900):
                 parsed = _last_json_line(stdout)
                 tail = proc.stderr.decode(errors="replace")[-400:]
                 if parsed is not None:
+                    _cache_workload(parsed)
                     parsed.setdefault("workload_bench_error",
                                       f"exited {proc.returncode}: {tail}")
                     return parsed
@@ -661,6 +820,7 @@ def workload_bench(timeout_secs: int = 900):
             stdout = (e.stdout or b"").decode(errors="replace")
             parsed = _last_json_line(stdout)
             if parsed is not None:
+                _cache_workload(parsed)
                 parsed.setdefault(
                     "workload_bench_error",
                     f"timed out after {timeout_secs}s with partial results")
@@ -774,6 +934,133 @@ def admission_bench(n: int = 2000, threads: int = 4):
             proc.kill()
 
 
+def webhook_path_bench(k: int = 30):
+    """p50 CR-apply -> JobSet-created through the DEPLOYED write path
+    (VERDICT r3 items 2/3, in-environment form): the real admission
+    daemon registered as a MutatingWebhookConfiguration in the fake
+    apiserver's write path over caBundle-verified TLS with
+    failurePolicy=Fail, CRD schema validation after the patch, then the
+    controller's reconcile. Each sample is the full onboarding
+    lifecycle: impersonated CREATE (webhook mutate + validate +
+    persist) -> sheet-gate status write -> JobSet visible."""
+    import base64
+    import ssl
+    import tempfile
+    import urllib.error
+
+    tmp = Path(tempfile.mkdtemp())
+    cert, keyf = tmp / "adm.crt", tmp / "adm.key"
+    try:
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(keyf), "-out", str(cert), "-days", "1",
+             "-subj", "/CN=bench-admission"],
+            check=True, capture_output=True)
+    except Exception as e:  # noqa: BLE001
+        return {"webhook_path_bench_error": f"openssl: {e}"[:200]}
+
+    fake = FakeKube().start()
+    aport, cport = free_port(), free_port()
+    adm = subprocess.Popen(
+        [str(REPO / "native" / "build" / "tpubc-admission")],
+        env={**os.environ, "CONF_LISTEN_ADDR": "127.0.0.1",
+             "CONF_LISTEN_PORT": str(aport), "CONF_CERT_PATH": str(cert),
+             "CONF_KEY_PATH": str(keyf),
+             "CONF_AUTHORIZED_GROUP_NAMES": "tpu,admin", "TPUBC_LOG": "error"},
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+    ctrl = subprocess.Popen(
+        [str(REPO / "native" / "build" / "tpubc-controller")],
+        env={**os.environ, "CONF_KUBE_API_URL": fake.url,
+             "CONF_LISTEN_ADDR": "127.0.0.1", "CONF_LISTEN_PORT": str(cport),
+             "TPUBC_LOG": "error"},
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+    try:
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        deadline = time.time() + 15
+        while True:
+            try:
+                urllib.request.urlopen(f"https://127.0.0.1:{aport}/health",
+                                       timeout=1, context=ctx)
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise TimeoutError("admission TLS health timeout")
+                time.sleep(0.05)
+        wait_health(cport, ctrl)
+
+        def post(path, body, headers=None):
+            req = urllib.request.Request(
+                fake.url + path, data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json", **(headers or {})},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=15) as r:
+                return json.loads(r.read())
+
+        post("/apis/admissionregistration.k8s.io/v1/mutatingwebhookconfigurations", {
+            "apiVersion": "admissionregistration.k8s.io/v1",
+            "kind": "MutatingWebhookConfiguration",
+            "metadata": {"name": "tpubc-bench"},
+            "webhooks": [{
+                "name": "mutate.tpu.bacchus.io",
+                "clientConfig": {
+                    "url": f"https://127.0.0.1:{aport}/mutate",
+                    "caBundle": base64.b64encode(cert.read_bytes()).decode(),
+                },
+                "rules": [{"apiGroups": ["tpu.bacchus.io"],
+                           "apiVersions": ["v1"],
+                           "resources": ["userbootstraps"],
+                           "operations": ["CREATE", "UPDATE", "DELETE"]}],
+                "failurePolicy": "Fail", "timeoutSeconds": 10,
+            }],
+        })
+
+        latencies = []
+        for i in range(k):
+            name = f"wh{i:03d}"
+            t0 = time.time()
+            post("/apis/tpu.bacchus.io/v1/userbootstraps",
+                 {"apiVersion": "tpu.bacchus.io/v1", "kind": "UserBootstrap",
+                  "metadata": {"name": name},
+                  "spec": {"tpu": {"accelerator": "tpu-v5-lite-podslice",
+                                   "topology": "2x2"}}},
+                 headers={"Impersonate-User": f"oidc:{name}",
+                          "Impersonate-Group": "tpu"})
+            req = urllib.request.Request(
+                fake.url + f"/apis/tpu.bacchus.io/v1/userbootstraps/{name}/status",
+                data=json.dumps({"status": {"synchronized_with_sheet": True}}).encode(),
+                headers={"Content-Type": "application/merge-patch+json"},
+                method="PATCH")
+            urllib.request.urlopen(req, timeout=15)
+            while True:
+                with fake.store.lock:
+                    if fake.store.objects.get(KEY_JS(name), {}).get(f"{name}-slice"):
+                        break
+                if time.time() - t0 > 30:
+                    raise TimeoutError(f"{name} never produced a JobSet")
+                time.sleep(0.002)
+            latencies.append((time.time() - t0) * 1000)
+        latencies.sort()
+        return {
+            "webhook_path_p50_apply_to_jobset_ms": round(
+                latencies[len(latencies) // 2], 2),
+            "webhook_path_p90_apply_to_jobset_ms": round(
+                latencies[int(len(latencies) * 0.9)], 2),
+            "webhook_path_samples": k,
+        }
+    except Exception as e:  # noqa: BLE001
+        return {"webhook_path_bench_error": f"{type(e).__name__}: {e}"[:300]}
+    finally:
+        for proc in (adm, ctrl):
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        fake.stop()
+
+
 def main():
     nativelib.build_native()
 
@@ -829,6 +1116,7 @@ def main():
         "burst2000_p50_ms": round(scale_p50, 2),
     }
     result.update(admission_bench())
+    result.update(webhook_path_bench())
     result.update(workload)
     print(json.dumps(result))
 

@@ -23,27 +23,59 @@ def test_cache_round_trip(tmp_path, monkeypatch):
 
 
 def test_cache_from_other_commit_is_flagged_stale(tmp_path, monkeypatch):
-    """A cache written at a different commit must not be relabeled as
-    'this build' — round 2 shipped cached numbers that silently predated
-    four kernel commits; the fingerprint makes that visible."""
+    """Staleness is judged PER KEY: a merged cache holds keys measured at
+    several commits (partial runs contribute only the sections they
+    reached), and only the keys from other builds flag — round 2 shipped
+    cached numbers that silently predated four kernel commits, and a
+    cache-level stamp alone would relabel merged old keys as 'this
+    build'."""
     monkeypatch.setattr(bench, "WORKLOAD_CACHE", tmp_path / "cache.json")
-    bench._cache_workload({"chip_alive": True, "train_mfu_pct": 50.0})
+    bench._cache_workload({"chip_alive": True, "train_mfu_pct": 50.0,
+                           "decode_int8_speedup": 1.6})
     cache = json.loads((tmp_path / "cache.json").read_text())
     assert cache["commit"] == bench._git_fingerprint()
+    assert set(cache["key_commits"]) == {"chip_alive", "train_mfu_pct",
+                                         "decode_int8_speedup"}
+    # Simulate one key surviving from an older build's run.
+    cache["key_commits"]["train_mfu_pct"] = "0000000"
+    (tmp_path / "cache.json").write_text(json.dumps(cache))
+    out = bench._attach_cached_workload({"workload_bench_error": "tunnel down"})
+    assert out["workload_cache_stale"] is True
+    assert out["workload_cache_stale_keys"] == ["train_mfu_pct"]
+    assert "STALE" in out["workload_cached_note"]
+
+    # Legacy cache without the per-key map: the cache-level commit covers
+    # every key.
+    del cache["key_commits"]
     cache["commit"] = "0000000"
     (tmp_path / "cache.json").write_text(json.dumps(cache))
     out = bench._attach_cached_workload({"workload_bench_error": "tunnel down"})
     assert out["workload_cache_stale"] is True
-    assert "STALE" in out["workload_cached_note"]
+    assert len(out["workload_cache_stale_keys"]) == 3
     assert "0000000" in out["workload_cached_note"]
 
 
-def test_cache_skips_failed_runs(tmp_path, monkeypatch):
+def test_cache_merges_partial_runs(tmp_path, monkeypatch):
+    """chip_alive=False never caches; a truncated on-chip run (timeout
+    after some sections) caches what it DID measure, merged over the
+    previous cache — keys the truncated run never reached keep their
+    older measurement, error strings never enter the cache (the r3
+    lesson: a 900s timeout must not cost the cache its tail keys)."""
     monkeypatch.setattr(bench, "WORKLOAD_CACHE", tmp_path / "cache.json")
-    bench._cache_workload({"workload_bench_error": "x", "chip_alive": True})
-    bench._cache_workload({"chip_alive": False})
+    bench._cache_workload({"chip_alive": False, "train_mfu_pct": 1.0})
     assert not (tmp_path / "cache.json").exists()
+    bench._cache_workload({"chip_alive": True, "train_mfu_pct": 50.0,
+                           "decode_int8_speedup": 1.2})
+    bench._cache_workload({"chip_alive": True, "decode_int8_speedup": 1.6,
+                           "workload_bench_error": "timed out",
+                           "decode_bench_error": "boom"})
+    r = json.loads((tmp_path / "cache.json").read_text())["results"]
+    assert r["train_mfu_pct"] == 50.0          # unreached key survives
+    assert r["decode_int8_speedup"] == 1.6     # fresher key wins
+    assert "workload_bench_error" not in r
+    assert "decode_bench_error" not in r
     # no cache -> the error result passes through untouched
+    monkeypatch.setattr(bench, "WORKLOAD_CACHE", tmp_path / "none.json")
     err = {"workload_bench_error": "y"}
     assert bench._attach_cached_workload(dict(err)) == err
 
