@@ -74,6 +74,12 @@ def test_cache_merges_partial_runs(tmp_path, monkeypatch):
     assert r["decode_int8_speedup"] == 1.6     # fresher key wins
     assert "workload_bench_error" not in r
     assert "decode_bench_error" not in r
+    # A COMPLETE clean run REPLACES the cache: renamed/removed metrics
+    # must not haunt the staleness flag forever.
+    bench._cache_workload({"chip_alive": True, "train_mfu_pct": 51.0})
+    cache = json.loads((tmp_path / "cache.json").read_text())
+    assert cache["results"] == {"chip_alive": True, "train_mfu_pct": 51.0}
+    assert set(cache["key_commits"]) == {"chip_alive", "train_mfu_pct"}
     # no cache -> the error result passes through untouched
     monkeypatch.setattr(bench, "WORKLOAD_CACHE", tmp_path / "none.json")
     err = {"workload_bench_error": "y"}

@@ -372,16 +372,22 @@ def test_1f1b_with_fsdp_matches_sequential(mesh_cfg):
                                np.asarray(g_seq["final_norm"]), rtol=1e-4, atol=1e-6)
 
 
-@pytest.mark.parametrize("mesh_cfg,num_kv_heads", [
-    (MeshConfig(pipe=2, data=2, seq=2), None),
-    (MeshConfig(pipe=2, seq=2, tensor=2), None),  # pp x sp x tp
-    (MeshConfig(pipe=2, fsdp=2, seq=2), None),    # pp x sp x fsdp (both pairs)
+@pytest.mark.parametrize("mesh_cfg,num_kv_heads,attention", [
+    (MeshConfig(pipe=2, data=2, seq=2), None, "dense"),
+    # 'flash' rides the same gathered-KV scanned-fold core (the Pallas
+    # kernel's static causal gating can't take a traced q offset; the
+    # folds already bound score memory per chunk) — accepted, identical
+    # numerics.
+    (MeshConfig(pipe=2, data=2, seq=2), None, "flash"),
+    (MeshConfig(pipe=2, seq=2, tensor=2), None, "dense"),  # pp x sp x tp
+    (MeshConfig(pipe=2, fsdp=2, seq=2), None, "dense"),    # pp x sp x fsdp
     # MQA under pp x sp x tp: the expand-then-slice GQA fallback feeds
     # the gathered-KV core (GPipe's ring rejects this shape; 1F1B takes
     # it).
-    (MeshConfig(pipe=2, seq=2, tensor=2), 1),
+    (MeshConfig(pipe=2, seq=2, tensor=2), 1, "dense"),
 ])
-def test_1f1b_with_seq_parallelism_matches_sequential(mesh_cfg, num_kv_heads):
+def test_1f1b_with_seq_parallelism_matches_sequential(mesh_cfg, num_kv_heads,
+                                                      attention):
     """pp x sp under the MANUAL 1F1B backward: gathered-KV attention —
     K/V all-gathered over seq through the custom pair (all_gather fwd,
     psum_scatter bwd; the ppermute ring cannot run inside the
@@ -396,7 +402,7 @@ def test_1f1b_with_seq_parallelism_matches_sequential(mesh_cfg, num_kv_heads):
     model = dataclasses.replace(MODEL, max_seq_len=17,  # shifts to 16
                                 num_kv_heads=num_kv_heads)
     mesh = build_mesh(mesh_cfg)
-    cfg = TrainConfig(model=model, mesh=mesh_cfg, attention="dense",
+    cfg = TrainConfig(model=model, mesh=mesh_cfg, attention=attention,
                       attention_block=8)
     params, stacked = stacked_state(model, jax.random.PRNGKey(0))
     dsz = mesh_cfg.data * mesh_cfg.fsdp
@@ -570,21 +576,12 @@ def test_pipeline_seq_requires_divisible_length():
 
 def test_1f1b_rejects_bad_seq_and_unknown_schedules():
     """1F1B now covers the full axis family, but still rejects loudly:
-    a sequence length that does not tile, flash's ring core under seq,
-    and unknown schedule names. (MQA/GQA under pp x sp x tp is NOT
-    rejected — the gathered-KV core takes the expand-then-slice
-    fallback; see the parity test above.)"""
+    a sequence length that does not tile and unknown schedule names.
+    (MQA/GQA under pp x sp x tp and attention='flash' are NOT rejected —
+    the gathered-KV core takes the GQA fallback and already has flash's
+    O-behavior via its scanned folds; see the parity tests above.)"""
     from tpu_bootstrap.workload.pipeline import make_pipeline_1f1b_grad
 
-    import dataclasses
-
-    # flash's seq core is the ppermute ring — structurally impossible
-    # inside the schedule's stage-divergent conds; rejected with the
-    # alternative named.
-    fl = TrainConfig(model=dataclasses.replace(MODEL, max_seq_len=17),
-                     mesh=MeshConfig(pipe=2, data=2, seq=2), attention="flash")
-    with pytest.raises(ValueError, match="flash"):
-        make_pipeline_1f1b_grad(fl, build_mesh(fl.mesh), num_microbatches=2)
     undiv = TrainConfig(model=MODEL,  # max_seq_len 16 shifts to 15
                         mesh=MeshConfig(pipe=2, data=2, seq=2))
     grad_fn = make_pipeline_1f1b_grad(undiv, build_mesh(undiv.mesh),
