@@ -8,16 +8,22 @@ set -u
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 OUT="$REPO/.tpu_workload_probe.json"
 LOG="$REPO/.tpu_workload_probe.log"
+WB_CAP="${TPUBC_WORKLOAD_TIMEOUT:-1400}"
+# Outer bound derives from the same knob the inner cap reads: two
+# attempts (workload_bench retries once) plus slack — a hardcoded
+# bound would SIGTERM python mid-attempt under a larger override,
+# losing the partial results and orphaning the chip-holding child.
+OUTER=$((2 * WB_CAP + 300))
 while true; do
   echo "$(date -u +%FT%TZ) attempt start" >> "$LOG"
-  RESULT=$(timeout 1900 python - <<'EOF' 2>>"$LOG"
+  RESULT=$(timeout "$OUTER" python - <<'EOF' 2>>"$LOG"
 import sys
 sys.path.insert(0, "/root/repo")
 import bench
 import json
 # One attempt per loop iteration (workload_bench itself retries once, so
-# the outer 1900s bound must cover 2 x timeout_secs).
-r = bench.workload_bench(timeout_secs=900)
+# the outer 3100s bound must cover 2 x the 1400s default.
+r = bench.workload_bench()  # default cap (TPUBC_WORKLOAD_TIMEOUT, 1400s)
 print(json.dumps(r))
 EOF
 )

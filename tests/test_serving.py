@@ -1,0 +1,89 @@
+"""Continuous batching (workload/serving.py): exactness against solo
+generation, slot recycling's utilization win, and eos early exit."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_bootstrap.workload.model import ModelConfig, init_params
+from tpu_bootstrap.workload.decode import generate
+from tpu_bootstrap.workload.serving import (
+    Request,
+    serve,
+    static_schedule_slot_steps,
+)
+
+CFG = ModelConfig(vocab_size=64, num_layers=2, num_heads=4, head_dim=16,
+                  embed_dim=64, mlp_dim=128, max_seq_len=128)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+def solo(params, prompt, steps):
+    out = generate(params, jnp.asarray([prompt], jnp.int32), CFG, steps)
+    return np.asarray(out)[0].tolist()
+
+
+def test_serve_matches_solo_generation(params):
+    """Every request's tokens equal its solo greedy generate() output —
+    rows admitted at different times, with different prompt lengths and
+    budgets, through a 2-slot pool (history replay + the ragged batch
+    path's per-row exactness)."""
+    rng = np.random.default_rng(0)
+    requests = [
+        Request(rid=i, tokens=rng.integers(0, 64, size=n).tolist(), max_new=m)
+        for i, (n, m) in enumerate([(3, 5), (7, 1), (2, 9), (5, 3), (4, 6)])
+    ]
+    stats = {}
+    got = serve(params, CFG, requests, batch_size=2, stats=stats)
+    assert set(got) == {r.rid for r in requests}
+    for r in requests:
+        assert got[r.rid] == solo(params, r.tokens, r.max_new), r.rid
+    assert stats["rounds"] >= 1
+    assert stats["active_slot_steps"] <= stats["slot_steps"]
+
+
+def test_slot_recycling_beats_static_batching(params):
+    """The utilization claim, asserted analytically from the recorded
+    schedule: one long request plus a stream of short ones through a
+    2-slot pool executes fewer slot-steps than the static
+    wait-for-the-wave schedule (the short rows cycle through the free
+    slot while the long row streams)."""
+    rng = np.random.default_rng(1)
+    requests = [Request(rid=0, tokens=rng.integers(0, 64, 4).tolist(),
+                        max_new=16)]
+    requests += [Request(rid=i, tokens=rng.integers(0, 64, 3).tolist(),
+                         max_new=1) for i in range(1, 9)]
+    stats = {}
+    got = serve(params, CFG, requests, batch_size=2, stats=stats)
+    assert len(got) == 9
+    static = static_schedule_slot_steps(requests, batch_size=2)
+    assert stats["slot_steps"] < static, (stats, static)
+    # and the outputs are still exact
+    assert got[0] == solo(params, requests[0].tokens, 16)
+    assert got[3] == solo(params, requests[3].tokens, 1)
+
+
+def test_eos_finishes_rows_early(params):
+    """eos_id retires a row at its first emission (inclusive), freeing
+    the slot for queued work; output truncates exactly there."""
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(0, 64, 4).tolist()
+    full = solo(params, prompt, 12)
+    eos = full[2]  # the third greedy token, whatever it is
+    requests = [Request(rid=0, tokens=prompt, max_new=12)]
+    got = serve(params, CFG, requests, batch_size=1, eos_id=eos)
+    assert got[0] == full[:full.index(eos) + 1]
+
+
+def test_serve_rejects_bad_requests(params):
+    with pytest.raises(ValueError, match="max_new"):
+        serve(params, CFG, [Request(0, [1], 0)], 1)
+    with pytest.raises(ValueError, match="empty"):
+        serve(params, CFG, [Request(0, [], 3)], 1)
+    with pytest.raises(ValueError, match="batch_size"):
+        serve(params, CFG, [Request(0, [1], 1)], 0)
