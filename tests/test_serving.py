@@ -87,3 +87,51 @@ def test_serve_rejects_bad_requests(params):
         serve(params, CFG, [Request(0, [], 3)], 1)
     with pytest.raises(ValueError, match="batch_size"):
         serve(params, CFG, [Request(0, [1], 1)], 0)
+    with pytest.raises(ValueError, match="duplicate"):
+        serve(params, CFG, [Request(0, [1], 1), Request(0, [2], 1)], 1)
+
+
+def test_worker_serve_mode(tmp_path):
+    """WORKLOAD_MODE=serve through the real JobSet entry point
+    (python -m tpu_bootstrap.workload.train): the CR's spec.tpu.env can
+    launch a serving slice. Trains two steps first so the serve run
+    restores a real checkpoint."""
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    from tpu_bootstrap.workload.sharding import MeshConfig
+    from tpu_bootstrap.workload.train import TrainConfig, train_loop
+
+    model = "vocab_size=64,num_layers=2,num_heads=2,head_dim=8," \
+            "embed_dim=16,mlp_dim=32,max_seq_len=64"
+    ckpt = tmp_path / "ckpt"
+    from tpu_bootstrap.workload.train import parse_model_env
+
+    # Train with the WORKER-SHAPED optimizer (clip chain + cosine
+    # schedule — a structurally different optax tree from serve's
+    # defaults): the serve restore must be structure-agnostic, taking
+    # params only from the raw composite.
+    train_loop(TrainConfig(model=parse_model_env(model), mesh=MeshConfig(),
+                           grad_clip_norm=1.0, total_steps=2),
+               2, checkpoint_dir=str(ckpt), save_every=1)
+
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "WORKLOAD_MODE": "serve",
+        "WORKLOAD_MODEL": model,
+        "WORKLOAD_CHECKPOINT_DIR": str(ckpt),
+        "WORKLOAD_QUANT": "int8",
+        "WORKLOAD_REQUESTS": "6",
+        "WORKLOAD_SERVE_BATCH": "2",
+    })
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_bootstrap.workload.train"],
+        env=env, capture_output=True, text=True, timeout=600,
+        cwd=str(Path(__file__).resolve().parent.parent))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "restored checkpoint step" in proc.stdout
+    assert "serve done: 6 requests" in proc.stdout
+    assert "slot utilization" in proc.stdout

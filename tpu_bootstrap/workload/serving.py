@@ -157,6 +157,79 @@ def serve(params: Params, cfg: ModelConfig, requests: list,
     return done
 
 
+def serve_demo_from_env() -> None:
+    """``WORKLOAD_MODE=serve`` JobSet entry (dispatched by
+    train.worker_main): build the model the CR's WORKLOAD_MODEL names,
+    restore the latest checkpoint from WORKLOAD_CHECKPOINT_DIR when one
+    exists (params only — the optimizer state is dead weight for
+    serving), optionally quantize (WORKLOAD_QUANT=int8|int4, int8 KV
+    via WORKLOAD_KV_QUANT=1), then drive WORKLOAD_REQUESTS synthetic
+    requests of mixed prompt/budget sizes through the continuous
+    batcher (WORKLOAD_SERVE_BATCH slots) and print tokens/s plus slot
+    utilization — the slice-serving counterpart of the training
+    demo, reachable from a CR through spec.tpu.env."""
+    import os
+    import time
+
+    import jax
+
+    from tpu_bootstrap.workload import quant
+    from tpu_bootstrap.workload.model import init_params
+    from tpu_bootstrap.workload.train import parse_model_env
+
+    cfg = parse_model_env(os.environ.get("WORKLOAD_MODEL", ""))
+    seed = int(os.environ.get("WORKLOAD_SEED", "0"))
+    params = init_params(cfg, jax.random.PRNGKey(seed))
+    ckpt = os.environ.get("WORKLOAD_CHECKPOINT_DIR")
+    if ckpt:
+        from tpu_bootstrap.workload import checkpoint as ck
+
+        mgr = ck.make_manager(ckpt)
+        step = ck.latest_step(mgr)
+        if step is not None:
+            # Restore WITHOUT a structure target: the saved composite
+            # holds {params, opt_state}, and the optimizer state's optax
+            # tree depends on the TRAINING run's config (clip chain,
+            # schedule count) that serving has no way to reconstruct.
+            # A raw restore hands back nested plain containers; params
+            # is an array dict needing no structure, and the optimizer
+            # state is dead weight here anyway.
+            import jax.numpy as jnp
+
+            out = mgr.restore(step)
+            params = jax.tree.map(jnp.asarray, out[ck.STATE_KEY]["params"])
+            print(f"serve: restored checkpoint step {step} from {ckpt}")
+
+    q = os.environ.get("WORKLOAD_QUANT", "")
+    if q == "int8":
+        params = quant.quantize_params(params)
+    elif q == "int4":
+        params = quant.quantize_params4(params)
+    elif q:
+        raise ValueError(f"WORKLOAD_QUANT must be int8|int4, got {q!r}")
+    kv_quant = os.environ.get("WORKLOAD_KV_QUANT", "").lower() in ("1", "true")
+
+    n = int(os.environ.get("WORKLOAD_REQUESTS", "32"))
+    batch = int(os.environ.get("WORKLOAD_SERVE_BATCH", "8"))
+    rng = np.random.default_rng(seed)
+    requests = [
+        Request(rid=i,
+                tokens=rng.integers(1, cfg.vocab_size,
+                                    int(rng.integers(4, 17))).tolist(),
+                max_new=int(rng.integers(1, 33)))
+        for i in range(n)
+    ]
+    stats: dict = {}
+    t0 = time.time()
+    done = serve(params, cfg, requests, batch, kv_quant=kv_quant, stats=stats)
+    dt = time.time() - t0
+    total = sum(len(v) for v in done.values())
+    util = stats["active_slot_steps"] / max(stats["slot_steps"], 1)
+    print(f"serve done: {len(done)} requests, {total} tokens, "
+          f"{total / dt:.1f} tok/s, rounds={stats['rounds']}, "
+          f"slot utilization {util:.2f}")
+
+
 def static_schedule_slot_steps(requests: list, batch_size: int) -> int:
     """Slot-steps a STATIC batcher would execute on the same workload
     (fill a batch, run everyone for the batch's longest budget, repeat)

@@ -591,8 +591,20 @@ def worker_main() -> None:
     WORKLOAD_REMAT (1|true — rematerialize the loss: the long-context
     lever), WORKLOAD_SCHEDULE (gpipe|1f1b), WORKLOAD_MICROBATCHES,
     WORKLOAD_LOG_EVERY (progress-line cadence, default 10, 0 = off).
+    WORKLOAD_MODE=serve switches the slice to the continuous-batching
+    serving demo (serving.serve_demo_from_env: WORKLOAD_QUANT,
+    WORKLOAD_KV_QUANT, WORKLOAD_REQUESTS, WORKLOAD_SERVE_BATCH).
     """
     import os
+
+    # Honor an explicit JAX_PLATFORMS through the config API: an
+    # environment whose sitecustomize registers a PJRT plugin at
+    # interpreter startup (the axon tunnel) pins the platform regardless
+    # of the env var, and a worker told to run on cpu must not block
+    # dialing a busy tunnel (same guard bench.py's workload uses).
+    _plats = os.environ.get("JAX_PLATFORMS", "")
+    if _plats:
+        jax.config.update("jax_platforms", _plats)
 
     boot = bootstrap_from_env()
     if boot is not None and boot["num_processes"] > 1:
@@ -604,6 +616,19 @@ def worker_main() -> None:
         # run (plain Indexed Job on GKE): fall back to auto-discovery so
         # each host doesn't silently train as an independent process.
         jax.distributed.initialize()
+
+    # WORKLOAD_MODE=serve: the slice runs the continuous-batching
+    # serving demo instead of the training loop (same WORKLOAD_MODEL /
+    # WORKLOAD_CHECKPOINT_DIR / quantization env surface) — see
+    # serving.serve_demo_from_env.
+    mode = os.environ.get("WORKLOAD_MODE", "train")
+    if mode == "serve":
+        from tpu_bootstrap.workload.serving import serve_demo_from_env
+
+        serve_demo_from_env()
+        return
+    if mode != "train":
+        raise ValueError(f"WORKLOAD_MODE must be train|serve, got {mode!r}")
 
     steps = int(os.environ.get("WORKLOAD_STEPS", "100"))
     save_every = int(os.environ.get("WORKLOAD_SAVE_EVERY", "10"))
