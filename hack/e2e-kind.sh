@@ -9,9 +9,13 @@
 #
 # The daemons run on the HOST against the kind apiserver (token auth via
 # a ServiceAccount), mirroring how the fake-API suite runs them — the
-# delta under test is the API server, not the deployment topology. The
-# in-cluster deployment path (images, chart, webhook registration) is
-# covered by the chart tests and the image build.
+# delta under test is the API server, not the deployment topology.
+# The webhook e2e goes one step further: it registers a
+# MutatingWebhookConfiguration (failurePolicy=Fail) pointing back at the
+# host-run admission daemon across the docker bridge, so real
+# apiserver-in-the-loop admission is exercised too. The remaining
+# in-cluster deployment surface (images, chart) is covered by the chart
+# tests and the image build.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -55,6 +59,21 @@ kubectl patch node "$NODE" --subresource=status --type=json -p '[
 kubectl create serviceaccount tpubc-e2e --dry-run=client -o yaml | kubectl apply -f -
 kubectl create clusterrolebinding tpubc-e2e --clusterrole=cluster-admin \
   --serviceaccount=default:tpubc-e2e --dry-run=client -o yaml | kubectl apply -f -
+
+# 5. Host address as the kind NODE sees it (the docker network
+#    gateway): the webhook e2e registers a MutatingWebhookConfiguration
+#    whose URL must reach the HOST-run admission daemon from inside the
+#    apiserver container. Best-effort — the webhook test skips without
+#    it; everything else runs.
+if command -v docker >/dev/null 2>&1; then
+  # kind's docker network is dual-stack and IPAM.Config ordering is not
+  # guaranteed — pick the IPv4 gateway explicitly (an IPv6 literal would
+  # also need brackets in the webhook URL).
+  TPUBC_E2E_HOST_IP=$(docker network inspect kind \
+    -f '{{range .IPAM.Config}}{{println .Gateway}}{{end}}' 2>/dev/null \
+    | grep -Em1 '^[0-9]+\.[0-9]+\.[0-9]+\.[0-9]+$' || true)
+  export TPUBC_E2E_HOST_IP
+fi
 
 # Declaration split from assignment: `export V=$(cmd)` would mask a
 # kubectl failure from set -e, leaving V empty — and the pytest module

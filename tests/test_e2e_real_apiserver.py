@@ -48,12 +48,29 @@ class RealKube:
         else:
             self.ctx = ssl._create_unverified_context()  # noqa: S323 - test harness
 
-    def req(self, method: str, path: str, body=None, content_type="application/json"):
+    def req(self, method: str, path: str, body=None, content_type="application/json",
+            impersonate=None):
         data = json.dumps(body).encode() if body is not None else None
+        headers = {"Authorization": f"Bearer {self.token}",
+                   "Content-Type": content_type}
+        if impersonate is not None:
+            # Real-apiserver impersonation (cluster-admin may): the
+            # admission webhook then sees the impersonated identity in
+            # its AdmissionReview userInfo — kubectl --as/--as-group.
+            # urllib cannot send REPEATED headers, and Impersonate-Group
+            # must appear once per group — guard rather than silently
+            # testing only the last group.
+            user, groups = impersonate
+            if len(groups) > 1:
+                raise NotImplementedError(
+                    "urllib sends one Impersonate-Group header; multi-group "
+                    "impersonation needs a different client")
+            headers["Impersonate-User"] = user
+            for g in groups:
+                headers["Impersonate-Group"] = g
         r = urllib.request.Request(
             f"{self.base}/{path.lstrip('/')}", data=data, method=method,
-            headers={"Authorization": f"Bearer {self.token}",
-                     "Content-Type": content_type})
+            headers=headers)
         try:
             with urllib.request.urlopen(r, context=self.ctx, timeout=15) as resp:
                 return resp.status, json.loads(resp.read() or b"null")
@@ -236,3 +253,106 @@ def test_sheet_gate_and_node_inventory_on_real_apiserver(kube, tmp_path):
         for d in (sd, cd):
             code, err = d.stop()
             assert code == 0, err
+
+
+HOST_IP = os.environ.get("TPUBC_E2E_HOST_IP", "")
+
+
+@pytest.mark.skipif(not HOST_IP, reason="TPUBC_E2E_HOST_IP not set "
+                    "(hack/e2e-kind.sh exports the kind docker gateway)")
+def test_webhook_registered_on_real_apiserver(kube, tmp_path):
+    """The DEPLOYED admission topology against the real apiserver: the
+    C++ admission daemon runs on the host with an IP-SAN cert, a
+    MutatingWebhookConfiguration with failurePolicy=Fail points the kind
+    apiserver at it across the docker bridge, and impersonated writes
+    (kubectl --as/--as-group shape) prove a denied CREATE never persists
+    while an allowed one carries the webhook's mutations into etcd —
+    the same contract tests/test_webhook_in_path.py pins against the
+    fake apiserver, here with the real one in the loop."""
+    import base64
+    import subprocess
+
+    cert, key = tmp_path / "wh.crt", tmp_path / "wh.key"
+    port = free_port()
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=tpubc-admission",
+         "-addext", f"subjectAltName=IP:{HOST_IP}"],
+        check=True, capture_output=True)
+    from tests.test_integration_daemons import wait_healthy_tls
+
+    cfg_path = ("apis/admissionregistration.k8s.io/v1/"
+                "mutatingwebhookconfigurations")
+    cfg_name = "tpubc-e2e-webhook"
+    d = None
+    try:
+        d = Daemon("tpubc-admission", {
+            "CONF_LISTEN_ADDR": "0.0.0.0",  # reachable from the kind node
+            "CONF_LISTEN_PORT": str(port),
+            "CONF_CERT_PATH": str(cert),
+            "CONF_KEY_PATH": str(key),
+            "CONF_AUTHORIZED_GROUP_NAMES": "tpu,admin",
+        }, port)
+        wait_healthy_tls(d, port)
+        status, body = kube.req("POST", cfg_path, {
+            "apiVersion": "admissionregistration.k8s.io/v1",
+            "kind": "MutatingWebhookConfiguration",
+            "metadata": {"name": cfg_name},
+            "webhooks": [{
+                "name": "mutate.tpu.bacchus.io",
+                "admissionReviewVersions": ["v1"],
+                "sideEffects": "None",
+                "clientConfig": {
+                    "url": f"https://{HOST_IP}:{port}/mutate",
+                    "caBundle": base64.b64encode(cert.read_bytes()).decode(),
+                },
+                "rules": [{"apiGroups": ["tpu.bacchus.io"],
+                           "apiVersions": ["v1"],
+                           "resources": ["userbootstraps"],
+                           "operations": ["CREATE", "UPDATE", "DELETE"]}],
+                "failurePolicy": "Fail",
+                "timeoutSeconds": 10,
+            }],
+        })
+        assert status in (200, 201), body
+
+        def plain_cr(name):
+            return {"apiVersion": "tpu.bacchus.io/v1",
+                    "kind": "UserBootstrap",
+                    "metadata": {"name": name},
+                    "spec": {"tpu": {"accelerator": "tpu-v5-lite-podslice",
+                                     "topology": "2x2"}}}
+
+        # Unauthorized group: the webhook denies, the apiserver rejects,
+        # nothing reaches etcd.
+        status, body = kube.req("POST", CR_API, plain_cr("e2e-mallory"),
+                                impersonate=("oidc:e2e-mallory", ["students"]))
+        assert status == 400, body  # apiserver wraps the denial
+        assert kube.get(f"{CR_API}/e2e-mallory") is None
+
+        # Authorized self-service CREATE: persisted WITH the webhook's
+        # mutations — identity, defaulted rolebinding, computed geometry.
+        status, obj = kube.req("POST", CR_API, plain_cr("e2e-alice"),
+                               impersonate=("oidc:e2e-alice", ["tpu"]))
+        assert status == 201, obj
+        assert obj["spec"]["kube_username"] == "e2e-alice"
+        assert obj["spec"]["rolebinding"]["role_ref"]["name"] == "edit"
+        assert obj["spec"]["tpu"]["chips"] == 4
+        stored = kube.get(f"{CR_API}/e2e-alice")
+        assert stored["spec"]["kube_username"] == "e2e-alice"
+
+        # Normal users may not DELETE (reference policy) — through the
+        # real apiserver's webhook call, not a direct daemon POST.
+        status, _ = kube.req("DELETE", f"{CR_API}/e2e-alice",
+                             impersonate=("oidc:e2e-alice", ["tpu"]))
+        assert status == 400
+        assert kube.get(f"{CR_API}/e2e-alice") is not None
+    finally:
+        # Remove the registration BEFORE stopping the daemon: a
+        # leftover failurePolicy=Fail webhook pointing at a dead
+        # endpoint would block every later UserBootstrap write in the
+        # cluster (including the kube fixture's cleanup DELETEs).
+        kube.delete(f"{cfg_path}/{cfg_name}")
+        if d is not None:
+            d.stop()

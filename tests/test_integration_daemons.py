@@ -442,6 +442,29 @@ def test_admission_daemon_plain_http():
         assert code == 0, err
 
 
+def wait_healthy_tls(daemon: "Daemon", port: int, timeout: float = 15.0):
+    """Poll a TLS daemon's /health until it answers (Daemon.wait_healthy
+    is plain http) — shared by the webhook-in-path and real-apiserver
+    webhook harnesses."""
+    import ssl
+
+    ctx = ssl._create_unverified_context()  # noqa: S323 - health poll only
+    deadline = time.time() + timeout
+    while True:
+        try:
+            urllib.request.urlopen(f"https://127.0.0.1:{port}/health",
+                                   timeout=1, context=ctx)
+            return daemon
+        except OSError:
+            if daemon.proc.poll() is not None:
+                raise RuntimeError(
+                    f"{daemon.binary} exited early: "
+                    f"{daemon.proc.stderr.read().decode()}")
+            if time.time() > deadline:
+                raise
+            time.sleep(0.1)
+
+
 @pytest.fixture()
 def certs(tmp_path):
     def gen(cn):

@@ -49,6 +49,8 @@ UB_PATH = "/apis/tpu.bacchus.io/v1/userbootstraps"
 
 
 def start_admission_tls(certs_fixture, groups="tpu,admin"):
+    from tests.test_integration_daemons import wait_healthy_tls
+
     cert, key = certs_fixture("admission-webhook")
     port = free_port()
     daemon = Daemon(
@@ -62,22 +64,7 @@ def start_admission_tls(certs_fixture, groups="tpu,admin"):
         },
         port,
     )
-    # health is TLS too; poll /mutate-readiness via raw TLS connect
-    ctx = ssl.create_default_context()
-    ctx.check_hostname = False
-    ctx.verify_mode = ssl.CERT_NONE
-    deadline = time.time() + 10
-    while True:
-        try:
-            urllib.request.urlopen(
-                f"https://127.0.0.1:{port}/health", timeout=1, context=ctx)
-            break
-        except OSError:
-            if daemon.proc.poll() is not None:
-                raise RuntimeError(daemon.proc.stderr.read().decode())
-            if time.time() > deadline:
-                raise
-            time.sleep(0.1)
+    wait_healthy_tls(daemon, port)
     return daemon, port, cert
 
 
