@@ -372,7 +372,9 @@ def generate(params: Params, prompt: jax.Array, cfg: ModelConfig, steps: int,
              temperature: float = 0.0, key: jax.Array | None = None,
              top_k: int = 0, top_p: float = 1.0, kv_quant: bool = False,
              kv_kernel: bool | None = None, prefill_flash: bool = False,
-             prompt_lengths: jax.Array | None = None):
+             prompt_lengths: jax.Array | None = None,
+             row_keys: jax.Array | None = None,
+             row_key_offsets: jax.Array | None = None):
     """Greedy (temperature == 0) or sampled generation, with optional
     top-k and/or nucleus (top-p) filtering of the sampled distribution.
 
@@ -396,6 +398,17 @@ def generate(params: Params, prompt: jax.Array, cfg: ModelConfig, steps: int,
     prompt through the flash kernel in O(prompt) memory — the einsum
     prefill materializes (prompt, cache) score rows and caps servable
     prompt lengths.
+
+    row_keys: (B,) per-row PRNG keys for SAMPLED decoding whose streams
+    are a pure function of (row key, generated-token index): token k of
+    row r is drawn with fold_in(row_keys[r], row_key_offsets[r] + k)
+    instead of the shared split-chain. This makes a request's sampled
+    continuation independent of batch cohort and chunk boundaries — the
+    property continuous batching (serving.serve) needs to reproduce
+    identical streams however the scheduler slots and chunks the work.
+    row_key_offsets (default zeros) is the per-row count of tokens
+    generated BEFORE this call (history replay resumes mid-stream).
+    Ignored at temperature 0 (greedy needs no randomness).
 
     prompt_lengths: (B,) int32 true lengths for a RAGGED batch whose
     prompts arrive LEFT-padded to the shared (B, S) shape — rows behave
@@ -429,7 +442,8 @@ def generate(params: Params, prompt: jax.Array, cfg: ModelConfig, steps: int,
                      temperature=temperature, key=key, top_k=top_k,
                      top_p=top_p, kv_quant=kv_quant, kv_kernel=kv_kernel,
                      prefill_flash=prefill_flash,
-                     prompt_lengths=prompt_lengths)
+                     prompt_lengths=prompt_lengths, row_keys=row_keys,
+                     row_key_offsets=row_key_offsets)
 
 
 @partial(jax.jit, static_argnames=("cfg", "steps", "temperature", "top_k", "top_p",
@@ -438,11 +452,22 @@ def _generate(params: Params, prompt: jax.Array, cfg: ModelConfig, steps: int,
               temperature: float = 0.0, key: jax.Array | None = None,
               top_k: int = 0, top_p: float = 1.0, kv_quant: bool = False,
               kv_kernel: bool = True, prefill_flash: bool = False,
-              prompt_lengths: jax.Array | None = None):
+              prompt_lengths: jax.Array | None = None,
+              row_keys: jax.Array | None = None,
+              row_key_offsets: jax.Array | None = None):
     if steps < 1:
         raise ValueError(f"steps must be >= 1, got {steps}")
     if not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if row_key_offsets is not None and row_keys is None:
+        # Offsets without keys would silently take the shared
+        # split-chain — plausible tokens that are NOT the resumed stream
+        # the caller asked for.
+        raise ValueError("row_key_offsets requires row_keys")
+    if row_keys is not None and temperature == 0.0:
+        raise ValueError(
+            "row_keys given but temperature is 0 (greedy ignores them); "
+            "set temperature > 0 for per-row sampled streams")
     b, s = prompt.shape
     caches = init_cache(cfg, b, s + steps, quantized=kv_quant)
     pad = None
@@ -455,24 +480,35 @@ def _generate(params: Params, prompt: jax.Array, cfg: ModelConfig, steps: int,
                              flash=prefill_flash, lengths=lengths)
     if key is None:
         key = jax.random.PRNGKey(0)
+    if row_key_offsets is None:
+        row_key_offsets = jnp.zeros((b,), jnp.int32)
 
-    def pick(logits, key):
+    def pick(logits, key, idx):
         if temperature == 0.0:
             return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
         # Temperature BEFORE the filters (the standard semantics): the
         # nucleus must be the p-mass of the distribution actually sampled.
         logits = _filter_logits(logits / temperature, top_k, top_p)
+        if row_keys is not None:
+            # Per-ROW, per-GENERATED-INDEX keys: token k of row r is
+            # sampled with fold_in(row_keys[r], offsets[r] + k) — a pure
+            # function of the request's own stream position, so chunked
+            # or rescheduled decoding (serving.serve replays histories
+            # across rounds, in whatever slot/cohort the scheduler
+            # picked) reproduces the identical sampled stream.
+            ks = jax.vmap(jax.random.fold_in)(row_keys, row_key_offsets + idx)
+            return jax.vmap(jax.random.categorical)(ks, logits).astype(prompt.dtype)
         return jax.random.categorical(key, logits, axis=-1).astype(prompt.dtype)
 
     key, sub = jax.random.split(key)  # never reuse a consumed key
-    first = pick(logits, sub)
+    first = pick(logits, sub, 0)
 
     def step(carry, i):
         token, caches, key = carry
         key, sub = jax.random.split(key)
         logits, caches = decode_step(params, token, s + i, caches, cfg, kv_kernel,
                                      pad=pad)
-        nxt = pick(logits, sub)
+        nxt = pick(logits, sub, i + 1)
         return (nxt, caches, key), token
 
     (last, _, _), toks = lax.scan(step, (first, caches, key), jnp.arange(steps - 1))

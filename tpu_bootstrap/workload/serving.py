@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import dataclasses
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -65,6 +66,7 @@ class _Slot:
     history: list  # prompt + generated so far
     remaining: int
     generated: list
+    row_key: object = None  # per-request PRNG key, fixed at admission
 
 
 def _bucket_up(n: int) -> int:
@@ -83,13 +85,21 @@ def _bucket_down(n: int) -> int:
 
 def serve(params: Params, cfg: ModelConfig, requests: list,
           batch_size: int, *, kv_quant: bool = False,
-          eos_id: int | None = None, stats: dict | None = None) -> dict:
+          eos_id: int | None = None, temperature: float = 0.0,
+          top_k: int = 0, top_p: float = 1.0, key=None,
+          stats: dict | None = None) -> dict:
     """Run every request through a ``batch_size``-slot continuously
     batched pool; returns {rid: generated token list}. ``eos_id``
     finishes a row at the first emission of that token (inclusive) —
     the early exits that make slot recycling pay; a row may decode past
     its eos inside a chunk (the output is truncated; the extra steps
-    are the chunk granularity's price). ``stats``, if given, is filled
+    are the chunk granularity's price). temperature > 0 samples (with
+    optional top_k/top_p) under PER-REQUEST key streams — token k of
+    request r draws with fold_in(fold_in(fold_in(key, 1), r.rid), k) —
+    so a request's continuation is IDENTICAL whatever batch_size,
+    admission order, or chunk boundaries the scheduler happened to pick
+    (pinned by a test that reschedules the same workload two ways).
+    ``stats``, if given, is filled
     with the executed-schedule accounting ({"rounds", "slot_steps",
     "active_slot_steps"}) the tests assert utilization with — decode
     slot-steps only; the history-replay prefills are the (O(length),
@@ -98,6 +108,16 @@ def serve(params: Params, cfg: ModelConfig, requests: list,
         raise ValueError(f"batch_size must be >= 1, got {batch_size}")
     if len({r.rid for r in requests}) != len(requests):
         raise ValueError("duplicate request rids (results key by rid)")
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if temperature > 0 and key is None:
+        # A silent fixed seed would make every "sampled" workload return
+        # identical continuations (same rule as speculative_generate).
+        raise ValueError("temperature > 0 requires an explicit PRNG key")
+    # Dummy-row keys by slot, fixed once (domain 0; request keys use
+    # domain 1 at admission — disjoint by construction).
+    dummy_keys = ([jax.random.fold_in(jax.random.fold_in(key, 0), i)
+                   for i in range(batch_size)] if temperature > 0 else None)
     for r in requests:
         if r.max_new < 1:
             raise ValueError(f"request {r.rid}: max_new must be >= 1")
@@ -113,8 +133,12 @@ def serve(params: Params, cfg: ModelConfig, requests: list,
         for i in range(batch_size):
             if slots[i] is None and queue:
                 r = queue.pop(0)
-                slots[i] = _Slot(rid=r.rid, history=list(r.tokens),
-                                 remaining=r.max_new, generated=[])
+                slots[i] = _Slot(
+                    rid=r.rid, history=list(r.tokens),
+                    remaining=r.max_new, generated=[],
+                    row_key=(jax.random.fold_in(jax.random.fold_in(key, 1),
+                                                r.rid)
+                             if temperature > 0 else None))
         active = [s for s in slots if s is not None]
         # Chunk: largest power of two <= the smallest remaining budget —
         # at least one row retires or halves per round, and chunk sizes
@@ -128,9 +152,24 @@ def serve(params: Params, cfg: ModelConfig, requests: list,
         for i, s in enumerate(slots):
             if s is not None:
                 batch[i, width - len(s.history):] = s.history
+        sample_kw = {}
+        if temperature > 0:
+            # Per-request streams keyed by rid (fixed at admission) so
+            # rescheduling cannot change a request's tokens; dummy rows
+            # use their disjoint-domain slot keys — draws discarded.
+            sample_kw = {
+                "temperature": temperature, "top_k": top_k, "top_p": top_p,
+                "row_keys": jnp.stack([
+                    s.row_key if s is not None else dummy_keys[i]
+                    for i, s in enumerate(slots)]),
+                "row_key_offsets": jnp.asarray(
+                    [len(s.generated) if s is not None else 0 for s in slots],
+                    jnp.int32),
+            }
         out = generate(params, jnp.asarray(batch), cfg, chunk,
                        kv_quant=kv_quant,
-                       prompt_lengths=jnp.asarray(lens, jnp.int32))
+                       prompt_lengths=jnp.asarray(lens, jnp.int32),
+                       **sample_kw)
         out = np.asarray(out)
         rounds += 1
         slot_steps += batch_size * chunk
