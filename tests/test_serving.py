@@ -113,6 +113,28 @@ def test_sampled_serving_is_scheduling_independent(params):
         serve(params, CFG, requests, batch_size=2, temperature=0.7)
 
 
+def test_serve_over_sharded_params_matches_single_device(params):
+    """Continuous batching over a MESH-SHARDED model (heads over tensor,
+    batch over data): the scheduler is layout-agnostic — generate's
+    GSPMD path partitions each round — and every request's tokens equal
+    the single-device serve run's."""
+    from tpu_bootstrap.workload.sharding import (MeshConfig, build_mesh,
+                                                 param_shardings,
+                                                 shard_params)
+
+    mesh = build_mesh(MeshConfig(data=2, tensor=2))
+    sharded = shard_params(params, param_shardings(mesh, params))
+    rng = np.random.default_rng(4)
+    requests = [
+        Request(rid=i, tokens=rng.integers(0, 64, int(n)).tolist(),
+                max_new=int(m))
+        for i, (n, m) in enumerate([(3, 4), (6, 2), (2, 5)])
+    ]
+    want = serve(params, CFG, requests, batch_size=2)
+    got = serve(sharded, CFG, requests, batch_size=2)
+    assert got == want
+
+
 def test_serve_rejects_bad_requests(params):
     with pytest.raises(ValueError, match="max_new"):
         serve(params, CFG, [Request(0, [1], 0)], 1)
