@@ -818,18 +818,82 @@ def workload_bench(timeout_secs: int | None = None):
     hiccup."""
     if timeout_secs is None:
         timeout_secs = int(os.environ.get("TPUBC_WORKLOAD_TIMEOUT", "1400"))
+    # Fail-FAST on a dead tunnel: a healthy backend prints its first
+    # milestone (workload_backend/chip_alive) within seconds-to-a-couple-
+    # minutes; a held/dead tunnel hangs in backend init with ZERO output.
+    # Waiting the full cap in silence would burn the driver's bench
+    # budget before the control-plane sections ever run (the workload
+    # goes first), so silence past the init window kills the attempt.
+    init_secs = int(os.environ.get("TPUBC_WORKLOAD_INIT_TIMEOUT", "420"))
+    import threading
+
+    def _reader(stream, sink, flag):
+        for ln in iter(stream.readline, b""):
+            sink.append(ln.decode(errors="replace"))
+            flag.set()
+
     err = ""
     for _attempt in range(2):
-        stdout = ""
+        out_chunks: list = []
+        err_chunks: list = []
+        got_output = threading.Event()
+        proc = subprocess.Popen(
+            [sys.executable, "-u", "-c", WORKLOAD_BENCH_SCRIPT],
+            env={**os.environ, "TPUBC_REPO": str(REPO)},
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            cwd=str(REPO),
+        )
+        # BOTH pipes get reader threads: an undrained stderr (JAX/Mosaic
+        # compile warnings easily exceed the ~64KB pipe buffer) would
+        # block the child mid-run and masquerade as a timeout.
+        readers = [
+            threading.Thread(target=_reader,
+                             args=(proc.stdout, out_chunks, got_output),
+                             daemon=True),
+            threading.Thread(target=_reader,
+                             args=(proc.stderr, err_chunks, threading.Event()),
+                             daemon=True),
+        ]
+        for t in readers:
+            t.start()
+        # One deadline for the WHOLE attempt, from spawn — the init
+        # window must not extend it.
+        deadline = time.monotonic() + timeout_secs
+        init_deadline = time.monotonic() + min(init_secs, timeout_secs)
         try:
-            proc = subprocess.run(
-                [sys.executable, "-u", "-c", WORKLOAD_BENCH_SCRIPT],
-                env={**os.environ, "TPUBC_REPO": str(REPO)},
-                capture_output=True,
-                timeout=timeout_secs,
-                cwd=str(REPO),
-            )
-            stdout = proc.stdout.decode(errors="replace")
+            # Init window: wake on first output OR child exit (a fast
+            # crash must fall through to the retrying crash path in
+            # milliseconds, not sit out the window).
+            while (not got_output.is_set() and proc.poll() is None
+                   and time.monotonic() < init_deadline):
+                got_output.wait(timeout=0.25)
+            if not got_output.is_set() and proc.poll() is None:
+                # A retry would hang just as long — don't burn another
+                # window; the control-plane bench is waiting.
+                return _attach_cached_workload(
+                    {"workload_bench_error":
+                     f"no output after {init_secs}s (backend init hang — "
+                     "tunnel down?); failed fast to protect the "
+                     "control-plane budget"})
+            try:
+                proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+                for t in readers:
+                    t.join(timeout=5)
+                parsed = _last_json_line("".join(out_chunks))
+                if parsed is not None:
+                    _cache_workload(parsed)
+                    parsed.setdefault(
+                        "workload_bench_error",
+                        f"timed out after {timeout_secs}s with partial results")
+                    return parsed
+                err = f"timed out after {timeout_secs}s, unparseable output"
+                continue
+            for t in readers:
+                t.join(timeout=5)
+            stdout = "".join(out_chunks)
             if proc.returncode == 0:
                 parsed = _last_json_line(stdout)
                 if parsed is not None:
@@ -840,31 +904,24 @@ def workload_bench(timeout_secs: int | None = None):
                 # Crash after partial progress: keep the measured numbers,
                 # annotate the crash. Retry only if nothing was measured.
                 parsed = _last_json_line(stdout)
-                tail = proc.stderr.decode(errors="replace")[-400:]
+                tail = "".join(err_chunks)[-400:]
                 if parsed is not None:
                     _cache_workload(parsed)
                     parsed.setdefault("workload_bench_error",
                                       f"exited {proc.returncode}: {tail}")
                     return parsed
-                err = tail
-        except subprocess.TimeoutExpired as e:
-            stdout = (e.stdout or b"").decode(errors="replace")
-            parsed = _last_json_line(stdout)
-            if parsed is not None:
-                _cache_workload(parsed)
-                parsed.setdefault(
-                    "workload_bench_error",
-                    f"timed out after {timeout_secs}s with partial results")
-                return parsed
-            # Zero output after the full window = backend init hung (dead
-            # tunnel/relay). A retry would hang just as long — don't burn
-            # another window; the control-plane bench is waiting.
-            return _attach_cached_workload(
-                {"workload_bench_error":
-                 f"workload bench timed out after {timeout_secs}s with no "
-                 "output (backend init hang — tunnel down?)"})
+                err = tail or f"exited {proc.returncode} with no output"
         except Exception as e:  # noqa: BLE001
             err = str(e)[:400]
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait()  # never leave a zombie
+            for stream in (proc.stdout, proc.stderr):
+                try:
+                    stream.close()
+                except OSError:
+                    pass
     return _attach_cached_workload({"workload_bench_error": err})
 
 

@@ -86,6 +86,41 @@ def test_cache_merges_partial_runs(tmp_path, monkeypatch):
     assert bench._attach_cached_workload(dict(err)) == err
 
 
+def test_workload_bench_paths(tmp_path, monkeypatch):
+    """The three workload_bench outcomes, driven by substitute scripts:
+    clean completion returns (and caches) the JSON; a timeout AFTER
+    output keeps the partial milestones; silence past the init window
+    fails fast (a dead tunnel must not burn the driver's whole budget
+    before the control-plane sections run)."""
+    monkeypatch.setattr(bench, "WORKLOAD_CACHE", tmp_path / "cache.json")
+
+    monkeypatch.setattr(
+        bench, "WORKLOAD_BENCH_SCRIPT",
+        'import json; print(json.dumps({"chip_alive": True, "x": 1}))')
+    assert bench.workload_bench() == {"chip_alive": True, "x": 1}
+    assert json.loads((tmp_path / "cache.json").read_text())["results"]["x"] == 1
+
+    monkeypatch.setattr(
+        bench, "WORKLOAD_BENCH_SCRIPT",
+        'import json, time\n'
+        'print(json.dumps({"chip_alive": True, "a": 2}), flush=True)\n'
+        'time.sleep(120)')
+    out = bench.workload_bench(timeout_secs=3)
+    assert out["a"] == 2
+    assert "timed out" in out["workload_bench_error"]
+    assert json.loads((tmp_path / "cache.json").read_text())["results"]["a"] == 2
+
+    monkeypatch.setattr(bench, "WORKLOAD_BENCH_SCRIPT", "import time; time.sleep(120)")
+    monkeypatch.setenv("TPUBC_WORKLOAD_INIT_TIMEOUT", "2")
+    import time as _time
+
+    t0 = _time.time()
+    out = bench.workload_bench(timeout_secs=60)
+    assert _time.time() - t0 < 30
+    assert "failed fast" in out["workload_bench_error"]
+    assert out["cached_a"] == 2  # cached keys ride along, honestly labeled
+
+
 def test_committed_cache_is_fresh_and_complete():
     """The repo ships a seeded cache so a chip-held bench run still
     carries real numbers; it must parse and cover the headline metrics."""
