@@ -61,13 +61,41 @@ def test_kernel_respects_validity_mask(pos):
 def test_supports_block_divisors():
     # 128-multiples tile; any 8-multiple up to the VMEM ceiling runs as a
     # single tile (block == full axis satisfies Mosaic for any size).
-    assert supports(256) and supports(96) and supports(32) and supports(48)
-    assert supports(4096) and supports(512)
-    assert not supports(17) and not supports(520)
+    hk, d = 4, 64
+    assert (supports(256, hk, d) and supports(96, hk, d)
+            and supports(32, hk, d) and supports(48, hk, d))
+    assert supports(4096, hk, d) and supports(512, hk, d)
+    assert not supports(17, hk, d) and not supports(520, hk, d)
     q, kq, kscale, vq, vscale = _case(4, 4)
     with pytest.raises(ValueError, match="single tile"):
         decode_attention_int8(q, kq[:, :17], kscale[:, :17],
                               vq[:, :17], vscale[:, :17], jnp.ones(17, bool))
+
+
+def test_supports_vmem_ceiling_scales_with_heads():
+    """The tile budget folds Hk and D in (ADVICE r4): every tile carries
+    ALL kv heads, so a length-only ceiling would overflow VMEM for
+    large-head configs — those must fall back to the einsum path
+    (supports False), and mid-size ones must pick a SMALLER block rather
+    than fail."""
+    from tpu_bootstrap.workload.decode_attention import (
+        _TILE_BYTES_CEILING,
+        _pick_block,
+    )
+
+    # Default-ish config: full 512 block fits.
+    assert _pick_block(4096, 16, 64) == 512
+    # Bigger heads: the 512 block would exceed the budget; a smaller
+    # 128-multiple divisor that fits is chosen instead.
+    assert _pick_block(4096, 64, 128) == 256
+    assert 256 * 64 * 128 <= _TILE_BYTES_CEILING < 512 * 64 * 128
+    # Monster config: no block fits -> unsupported, einsum fallback.
+    assert _pick_block(4096, 512, 128) is None
+    assert not supports(4096, 512, 128)
+    # Single-tile path honors the byte budget too, not just the length
+    # ceiling.
+    assert supports(480, 16, 64)
+    assert not supports(480, 512, 128)
 
 
 def test_generate_int8kv_routes_through_kernel(monkeypatch):

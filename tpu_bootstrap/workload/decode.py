@@ -191,7 +191,9 @@ def _block_step(block: Params, x: jax.Array, cache: dict, positions: jax.Array,
             "v_scale": lax.dynamic_update_slice(cache["v_scale"], vs, (0, start, 0)),
         }
         if (kv_kernel and q.shape[1] == 1 and valid.ndim == 2
-                and decode_attention.supports(cache["k"].shape[1])):
+                and decode_attention.supports(cache["k"].shape[1],
+                                              cache["k"].shape[2],
+                                              cache["k"].shape[3])):
             # Single-query decode step: the Pallas kernel streams the
             # int8 cache directly (dequant in VMEM, online softmax) —
             # the 1-byte cache read is structural, not an XLA fusion

@@ -97,28 +97,40 @@ def _kernel(q_ref, k_ref, ks_ref, v_ref, vs_ref, bias_ref, o_ref,
         o_ref[:] = (acc_scr[:] / l_scr[:]).reshape(o_ref.shape).astype(o_ref.dtype)
 
 
-# Single-tile VMEM ceiling: a (L, Hk, D) int8 tile (x2 for k+v, x2 for
-# double buffering) must fit comfortably in the ~16 MB of VMEM.
+# VMEM tile budget: each (bl, Hk, D) int8 cache tile is fetched for k
+# AND v and double-buffered by Mosaic (x4), alongside the q block and
+# the hk*g_pad scratch rows, inside ~16 MB of VMEM. Capping bl*Hk*D at
+# 2 MiB holds the buffered cache tiles to <= 8 MiB with comfortable
+# headroom — a LENGTH-only ceiling would scale tiles linearly with the
+# head count and overflow VMEM for large-Hk configs (the old per-(b,hk)
+# grid never carried more than one head per tile; the full-Hk grid
+# does).
+_TILE_BYTES_CEILING = 2 ** 21
 _MAX_SINGLE_TILE = 512
 
 
-def _pick_block(length: int) -> int | None:
+def _pick_block(length: int, kv_heads: int, head_dim: int) -> int | None:
     """L block that divides the cache length (the cache is NOT padded —
     padding would copy the whole cache in HBM). Multi-tile blocks must be
     128-multiples: the bias row's (8, bl) block puts bl on the lane axis,
     where Mosaic wants 128-divisibility — unless the block IS the whole
     axis, which is why any 8-multiple length up to the VMEM ceiling works
-    as a single tile."""
+    as a single tile. Oversized (length, Hk, D) combinations return None
+    so decode._block_step falls back to the einsum path instead of
+    failing in Mosaic."""
+    def fits(bl: int) -> bool:
+        return bl * kv_heads * head_dim <= _TILE_BYTES_CEILING
+
     for bl in (512, 256, 128):
-        if length % bl == 0 and length > bl:
+        if length % bl == 0 and length > bl and fits(bl):
             return bl
-    if length % 8 == 0 and length <= _MAX_SINGLE_TILE:
+    if length % 8 == 0 and length <= _MAX_SINGLE_TILE and fits(length):
         return length
     return None
 
 
-def supports(length: int) -> bool:
-    return _pick_block(length) is not None
+def supports(length: int, kv_heads: int, head_dim: int) -> bool:
+    return _pick_block(length, kv_heads, head_dim) is not None
 
 
 def decode_attention_int8(q: jax.Array, kq: jax.Array, ks: jax.Array,
@@ -137,13 +149,15 @@ def decode_attention_int8(q: jax.Array, kq: jax.Array, ks: jax.Array,
     b, h, d = q.shape
     _, length, kv_heads, _ = kq.shape
     group = h // kv_heads
-    bl = _pick_block(length)
+    bl = _pick_block(length, kv_heads, d)
     if bl is None:
         raise ValueError(
-            f"cache length {length} is neither a 128-multiple nor a small "
-            f"(<= {_MAX_SINGLE_TILE}) 8-multiple single tile; gate direct "
-            "calls on supports(length) — decode._block_step does, falling "
-            "back to its einsum path")
+            f"cache (length={length}, kv_heads={kv_heads}, head_dim={d}) "
+            f"has no tileable block: length must be a 128-multiple or a "
+            f"small (<= {_MAX_SINGLE_TILE}) 8-multiple single tile, and "
+            f"bl*Hk*D must fit the {_TILE_BYTES_CEILING}-byte VMEM tile "
+            "budget; gate direct calls on supports(...) — "
+            "decode._block_step does, falling back to its einsum path")
 
     g_pad = max(8, -(-group // 8) * 8)
     q4 = q.reshape(b, kv_heads, group, d)

@@ -240,9 +240,15 @@ def dequantize_weight4(qw: Quantized4Weight) -> jax.Array:
 def _matmul4_kernel(x_ref, q_ref, s_ref, o_ref, *, group):
     # Unpack nibbles in VMEM: the weight never exists in HBM at more
     # than half a byte per element. Even k rides the low nibble.
-    q = q_ref[:]
-    lo = (q & 0xF).astype(jnp.int8) - 8
-    hi = (q >> 4).astype(jnp.int8) - 8
+    # Widen uint8 -> int32 BEFORE any arithmetic: Mosaic has no
+    # uint8->float lowering, and the int8-intermediate variant crashes
+    # its compile helper outright (hardware-bisected this round;
+    # interpret-mode tests cannot see either failure). int32 bit ops and
+    # the int32->f32 cast are supported, and the unpack is VMEM-local
+    # arithmetic off the critical MXU path.
+    q = q_ref[:].astype(jnp.int32)
+    lo = (q & 0xF) - 8
+    hi = (q >> 4) - 8
     k2, bn = q.shape
     w = jnp.stack([lo, hi], axis=1).reshape(2 * k2, bn).astype(jnp.float32)
     w = (w.reshape(-1, group, bn) * s_ref[:][:, None, :]).reshape(2 * k2, bn)
@@ -378,9 +384,13 @@ def quantize_params4(params: dict, *, group: int = 64,
     bytes/element too (the full-int4 bandwidth floor; measure the
     quality delta before shipping it), and False leaves the float
     embedding as the head."""
-    if head not in ("int8", "int4", True, False):
+    if not (head in ("int8", "int4") or isinstance(head, bool)):
         # Validate BEFORE quantizing every block — an argument typo must
-        # not pay the full model's packing work first.
+        # not pay the full model's packing work first. Booleans are
+        # matched by isinstance, not `in`: `1 in (True,)` is True under
+        # int/bool equality, so a tuple test would silently accept
+        # head=1 (as int8) and head=0 (as no-head) — integer typos the
+        # guard exists to catch.
         raise ValueError(f"head must be 'int8', 'int4', or False, got {head!r}")
     out = {**params, "blocks": [quantize_block4(b, group)
                                 for b in params["blocks"]]}
