@@ -723,18 +723,36 @@ class FakeKubeHandler(BaseHTTPRequestHandler):
         if sub == "status":
             if existing is None:
                 return self.send_status_error(404, f"{name} not found", "NotFound")
-            if "merge-patch" in ctype:
-                existing["status"] = merge_patch(existing.get("status"), body.get("status"))
-            else:
+            if "merge-patch" not in ctype:
                 return self.send_status_error(415, f"unsupported status patch type {ctype}")
             # The webhook matches the main resource only (reference
             # webhook.yaml rules name "userbootstraps", not the status
             # subresource) — but the apiserver's schema validation
-            # covers status writes too.
-            existing, handled = self._admit_status(key, name, existing)
-            if handled:
-                return
-            return self.send_json(200, self.store.upsert(key, name, existing, preserve_status=False))
+            # covers status writes too. Same base_rv capture /
+            # recheck-under-lock retry loop as the main-resource patch
+            # paths: validation runs outside the lock, so a concurrent
+            # status writer (synchronizer vs controller) could land in
+            # the window and be clobbered by state derived from the
+            # stale read — the exact race the other paths already close.
+            for _attempt in range(5):
+                base_rv = existing["metadata"]["resourceVersion"]
+                work = copy.deepcopy(existing)
+                work["status"] = merge_patch(work.get("status"),
+                                             copy.deepcopy(body.get("status")))
+                work, handled = self._admit_status(key, name, work)
+                if handled:
+                    return
+                with self.store.lock:
+                    cur = self.store.collection(key).get(name)
+                    if cur is None:
+                        return self.send_status_error(404, f"{name} not found", "NotFound")
+                    if cur["metadata"]["resourceVersion"] == base_rv:
+                        return self.send_json(
+                            200, self.store.upsert(key, name, work, preserve_status=False))
+                    existing = copy.deepcopy(cur)
+            return self.send_status_error(
+                409, "status patch retries exhausted against concurrent writers",
+                "Conflict")
 
         if "apply-patch" in ctype:
             manager = query.get("fieldManager", ["unknown"])[0]
