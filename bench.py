@@ -327,6 +327,63 @@ except Exception as e:  # noqa: BLE001
     out["train_bench_error"] = f"{type(e).__name__}: {e}"[:400]
 emit()
 
+# Quantization quality on a TRAINED model (VERDICT r4 weak #5): the
+# int8/int4 quality ladder and the speculative-acceptance claim were
+# only ever measured on random init — the worst case for argmax
+# stability and silent about task degradation. Continue the
+# already-compiled train step on the learnable noisy-permutation task
+# (workload/quality.py) until the model predicts confidently, then
+# measure what quantization actually does at task level. The chain runs
+# over a 4096-token sub-vocabulary so ~300 steps of the 134M bench
+# model see ~600 examples per bigram entry (full 32k vocab would need
+# 8x the steps for the same coverage).
+try:
+    from tpu_bootstrap.workload.quality import (
+        eval_quality, markov_batch, spec_acceptance)
+    from tpu_bootstrap.workload.quant import (
+        quantize_params as _qp, quantize_params4 as _qp4)
+
+    CHAIN_V = 4096
+    t0 = time.time()
+    for i in range(300):
+        qb = jax.device_put(
+            jnp.asarray(markov_batch(i, batch, cfg.model.max_seq_len, CHAIN_V)),
+            batch_shardings(mesh))
+        params, opt_state, loss = step(params, opt_state, qb)
+    out["quality_train_loss"] = round(float(loss), 3)
+    out["quality_train_s"] = round(time.time() - t0, 1)
+
+    def _bf16(p):
+        return jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x, p)
+
+    tbf = _bf16(params)
+    held_out = jnp.asarray(markov_batch(10_000, batch, 129, CHAIN_V))
+    q8 = eval_quality(tbf, _qp(params), cfg.model, held_out)
+    out.update({
+        "trained_int8_ppl_delta": q8["ppl_delta"],
+        "trained_int8_argmax_agreement_pct": q8["argmax_agreement_pct"],
+        "trained_ppl_base": q8["ppl_base"],
+    })
+    emit()
+    q4 = eval_quality(tbf, _qp4(params), cfg.model, held_out)
+    out.update({
+        "trained_int4_ppl_delta": q4["ppl_delta"],
+        "trained_int4_argmax_agreement_pct": q4["argmax_agreement_pct"],
+    })
+    emit()
+    # Speculative acceptance with the int8 self-draft on the TRAINED
+    # model — the number the "int8 rarely flips a trained argmax" claim
+    # predicts should beat the random-init speculative_mean_committed
+    # measured further down.
+    acc = spec_acceptance(tbf, _qp(params), cfg.model,
+                          jnp.asarray(markov_batch(20_000, batch, 16, CHAIN_V)),
+                          steps=48, gamma=4)
+    out["spec_accept_trained_mean_committed"] = acc["mean_committed"]
+except Exception as e:  # noqa: BLE001
+    out["quality_bench_error"] = f"{type(e).__name__}: {e}"[:400]
+emit()
+
 # Decode throughput: greedy generation with the KV cache (the serving
 # path) — tokens/sec at batch 8 on the single chip. Same ~134M-param
 # model as the train bench: decode is weight-bandwidth-bound, so the
@@ -430,14 +487,22 @@ try:
         "decode_int8_speedup": round(step_s / qstep_s, 3),
     })
     roofline("decode_int8", qparams, qstep_s)
-    emit()
+except Exception as e:  # noqa: BLE001
+    out["decode_bench_error"] = f"{type(e).__name__}: {e}"[:400]
+emit()
 
+# Each decode variant below fails ALONE: round 5's int4 Mosaic crash sat
+# in the shared try and took the xent/int8kv/gqa keys down with it — a
+# NameError from a dead prerequisite becomes that section's own error
+# key instead of a lost section.
+try:
     # int4 weight-only (VERDICT r3 item 8): 0.5 bytes/element through
     # the group-scaled nibble-packed kernel; head stays int8 (the
     # softmax decides there). Plus the quality ladder at CHECKPOINT size
     # — mean next-token xent delta vs the f32 master on the same batch
     # (random-init weights: this measures the FORMAT's noise at scale,
-    # not task degradation; no real checkpoints exist in this sandbox).
+    # not task degradation; the trained-model task-level numbers live in
+    # the quality section above).
     from tpu_bootstrap.workload.quant import quantize_params4, quantize_weight4
 
     qparams4 = quantize_params4(dmaster)
@@ -449,15 +514,15 @@ try:
     roofline("decode_int4", qparams4, q4step_s)
     emit()
 
-    from tpu_bootstrap.workload.decode import init_cache as _ic, prefill as _pf
+    # ONE jitted program per scoring call (quality.score): the eager
+    # prefill's per-op program spray crashed the tunnel's compile helper
+    # (exit 1) — the reason these keys never appeared in r3/r4 BENCH.
+    from tpu_bootstrap.workload.quality import score as _score
 
     def mean_xent(params):
         toks = jax.random.randint(jax.random.PRNGKey(9), (dbatch, 65), 0,
                                   dcfg.vocab_size)
-        logits, _ = _pf(params, toks[:, :-1], _ic(dcfg, dbatch, 64), dcfg,
-                        all_logits=True)
-        lp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
-        return -float(jnp.mean(jnp.take_along_axis(lp, toks[:, 1:, None], -1)))
+        return float(_score(params, toks, dcfg)[0])
 
     xb = mean_xent(dmaster)
     out.update({
@@ -471,8 +536,11 @@ try:
             {**qparams4, "lm_head": quantize_weight4(dmaster["embed"].T)})
             - xb), 4),
     })
-    emit()
+except Exception as e:  # noqa: BLE001
+    out["decode_int4_bench_error"] = f"{type(e).__name__}: {e}"[:400]
+emit()
 
+try:
     # int8 KV cache ON TOP of int8 weights: after weight quantization the
     # remaining per-step HBM read is the cache; int8 KV halves it (the
     # decode.init_cache quantized layout).
@@ -494,7 +562,7 @@ try:
         "decode_gqa4_speedup": round(step_s / gstep_s, 3),
     })
 except Exception as e:  # noqa: BLE001
-    out["decode_bench_error"] = f"{type(e).__name__}: {e}"[:400]
+    out["decode_kv_bench_error"] = f"{type(e).__name__}: {e}"[:400]
 emit()
 
 # Speculative decoding (VERDICT r3 item 5): committed-tokens/s for int8
@@ -551,23 +619,26 @@ try:
     DL = 4096
     dlb = 8
 
+    # params is an EXPLICIT jit argument, not a closure: closed-over
+    # concrete arrays lower as HLO literal constants, and 268 MB of
+    # baked-in weights overflows the tunnel's remote-compile request
+    # body (HTTP 413 — bisected on hardware this round).
+    @jax.jit
+    def longctx_run(params, tok, caches):
+        def body(carry, i):
+            tok, caches = carry
+            logits, caches = decode_step(params, tok, 64 + i, caches, dcfg)
+            return (jnp.argmax(logits, -1).astype(tok.dtype), caches), ()
+        (tok, caches), _ = lax.scan(body, (tok, caches), jnp.arange(64))
+        return tok
+
     def longctx_step_ms(params, quantized):
         caches = init_cache(dcfg, dlb, DL, quantized=quantized)
         _, caches = prefill(params, dprompt, caches, dcfg)
-
-        @jax.jit
-        def run(tok, caches):
-            def body(carry, i):
-                tok, caches = carry
-                logits, caches = decode_step(params, tok, 64 + i, caches, dcfg)
-                return (jnp.argmax(logits, -1).astype(tok.dtype), caches), ()
-            (tok, caches), _ = lax.scan(body, (tok, caches), jnp.arange(64))
-            return tok
-
         tok0 = dprompt[:, -1]
-        int(run(tok0, caches)[0])  # compile + warm
+        int(longctx_run(params, tok0, caches)[0])  # compile + warm
         t0 = time.time()
-        int(run(tok0, caches)[0])
+        int(longctx_run(params, tok0, caches)[0])
         return (time.time() - t0) / 64 * 1e3
 
     base_ms = longctx_step_ms(dparams, quantized=False)
@@ -771,6 +842,76 @@ def _cache_workload(parsed: dict) -> None:
         pass
 
 
+# Direction-aware regression guard (VERDICT r4 item 4): between the r3
+# and r4 caches, flash-seq2048 and MFU silently regressed and nobody
+# could say when — the bench now self-reports any live key that moved
+# >15% the wrong way against the previous cache, instead of needing a
+# judge to diff rounds. Matched by suffix; keys that match neither
+# family (booleans, configuration echoes like speculative_gamma) are
+# not judged.
+_HIGHER_BETTER = ("per_sec", "speedup", "mfu_pct", "gbps",
+                  "roofline_frac", "mean_committed", "temp_reduction",
+                  "agreement_pct")
+# "_ms" must stay an endswith match (as a substring it would grab
+# unrelated keys); the rest are distinctive enough to match anywhere —
+# quality deltas carry format suffixes (quant_xent_delta_int8).
+_LOWER_BETTER_SUFFIX = ("_ms",)
+_LOWER_BETTER_ANYWHERE = ("bytes_per_token", "xent_delta", "ppl_delta",
+                          "temp_mb")
+# Excluded despite a matching suffix: pure tunnel/backend noise.
+_REGRESSION_EXEMPT = ("backend_init_s",)
+
+
+def _flag_regressions(parsed: dict, prev_results: dict,
+                      threshold: float = 0.15) -> None:
+    """Annotate ``parsed`` (in place) with workload_regressions /
+    workload_regression_count comparing each freshly measured numeric key
+    against the previous cache. Runs AFTER the cache is rewritten so the
+    flags never persist into it — each round is judged against the round
+    before, not against its own output."""
+    regressions = {}
+    for key, now in sorted(parsed.items()):
+        if key in _REGRESSION_EXEMPT or key.endswith("_error"):
+            continue
+        prev = prev_results.get(key)
+        if (isinstance(now, bool) or isinstance(prev, bool)
+                or not isinstance(now, (int, float))
+                or not isinstance(prev, (int, float))):
+            continue
+        # Sign-robust margin: a plain multiplicative threshold misreads
+        # signed metrics (prev = now = -0.02 would flag, since
+        # -0.02 > -0.023) and flags meaningless near-zero jitter. The
+        # wrong-way move must clear BOTH a relative margin on the
+        # metric's magnitude and a small absolute floor.
+        scale = max(abs(prev), abs(now))
+        if any(s in key for s in _HIGHER_BETTER):
+            move = prev - now  # positive = got worse
+        elif (any(key.endswith(s) for s in _LOWER_BETTER_SUFFIX)
+              or any(s in key for s in _LOWER_BETTER_ANYWHERE)):
+            move = now - prev
+        else:
+            continue
+        bad = move > threshold * scale and move > 1e-3
+        if bad:
+            regressions[key] = {"prev": prev, "now": now}
+    if regressions:
+        parsed["workload_regression_count"] = len(regressions)
+        parsed["workload_regressions"] = dict(list(regressions.items())[:20])
+
+
+def _finish_workload(parsed: dict) -> dict:
+    """Cache the fresh results, then judge them against the cache they
+    replaced."""
+    prev = {}
+    try:
+        prev = json.loads(WORKLOAD_CACHE.read_text()).get("results", {})
+    except (OSError, json.JSONDecodeError):
+        pass
+    _cache_workload(parsed)
+    _flag_regressions(parsed, prev)
+    return parsed
+
+
 def _attach_cached_workload(err_result: dict) -> dict:
     try:
         cache = json.loads(WORKLOAD_CACHE.read_text())
@@ -884,10 +1025,14 @@ def workload_bench(timeout_secs: int | None = None):
                     t.join(timeout=5)
                 parsed = _last_json_line("".join(out_chunks))
                 if parsed is not None:
-                    _cache_workload(parsed)
+                    # Error key BEFORE caching: _cache_workload decides
+                    # merge-vs-replace by the presence of error keys, and
+                    # a truncated run cached as "complete" would REPLACE
+                    # the cache and drop every carried-over key.
                     parsed.setdefault(
                         "workload_bench_error",
                         f"timed out after {timeout_secs}s with partial results")
+                    _finish_workload(parsed)
                     return parsed
                 err = f"timed out after {timeout_secs}s, unparseable output"
                 continue
@@ -897,7 +1042,7 @@ def workload_bench(timeout_secs: int | None = None):
             if proc.returncode == 0:
                 parsed = _last_json_line(stdout)
                 if parsed is not None:
-                    _cache_workload(parsed)
+                    _finish_workload(parsed)
                     return parsed
                 err = "no JSON output: " + stdout[-200:]
             else:
@@ -906,9 +1051,11 @@ def workload_bench(timeout_secs: int | None = None):
                 parsed = _last_json_line(stdout)
                 tail = "".join(err_chunks)[-400:]
                 if parsed is not None:
-                    _cache_workload(parsed)
+                    # Same ordering as the timeout path: the error key
+                    # must precede caching to keep the merge behavior.
                     parsed.setdefault("workload_bench_error",
                                       f"exited {proc.returncode}: {tail}")
+                    _finish_workload(parsed)
                     return parsed
                 err = tail or f"exited {proc.returncode} with no output"
         except Exception as e:  # noqa: BLE001

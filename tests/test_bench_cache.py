@@ -105,7 +105,10 @@ def test_workload_bench_paths(tmp_path, monkeypatch):
         'import json, time\n'
         'print(json.dumps({"chip_alive": True, "a": 2}), flush=True)\n'
         'time.sleep(120)')
-    out = bench.workload_bench(timeout_secs=3)
+    # 8s, not lower: interpreter startup alone costs ~2.2s (the
+    # sitecustomize PJRT hook), so a 3s window misses the child's first
+    # print under any concurrent load.
+    out = bench.workload_bench(timeout_secs=8)
     assert out["a"] == 2
     assert "timed out" in out["workload_bench_error"]
     assert json.loads((tmp_path / "cache.json").read_text())["results"]["a"] == 2
@@ -130,3 +133,56 @@ def test_committed_cache_is_fresh_and_complete():
     for key in ("train_mfu_pct", "train_seq8192_mfu_pct", "flash_attn_speedup",
                 "decode_int8_speedup", "decode_gqa4_speedup"):
         assert key in r, key
+
+
+def test_regression_flags_direction_aware():
+    """The guard judges direction per key family: throughput falling and
+    latency rising both flag; moves the RIGHT way, within-threshold
+    noise, booleans, and configuration echoes never do."""
+    prev = {"decode_tokens_per_sec": 100.0, "train_step_ms": 10.0,
+            "flash_attn_speedup": 2.0, "speculative_gamma": 4,
+            "chip_alive": True, "backend_init_s": 0.1,
+            "quant_xent_delta_int8": 0.01}
+    parsed = {"decode_tokens_per_sec": 80.0,   # -20% throughput: flag
+              "train_step_ms": 12.0,           # +20% latency: flag
+              "flash_attn_speedup": 1.95,      # -2.5%: noise, no flag
+              "speculative_gamma": 8,          # config echo, never judged
+              "chip_alive": True,
+              "backend_init_s": 30.0,          # exempt tunnel noise
+              "quant_xent_delta_int8": 0.5}    # worse quality delta: flag
+    bench._flag_regressions(parsed, prev)
+    assert parsed["workload_regression_count"] == 3
+    flagged = parsed["workload_regressions"]
+    assert set(flagged) == {"decode_tokens_per_sec", "train_step_ms",
+                            "quant_xent_delta_int8"}
+    assert flagged["decode_tokens_per_sec"] == {"prev": 100.0, "now": 80.0}
+
+    improved = {"decode_tokens_per_sec": 130.0, "train_step_ms": 8.0}
+    bench._flag_regressions(improved, prev)
+    assert "workload_regressions" not in improved
+
+    # Signed and near-zero metrics: an unchanged negative ppl_delta and
+    # sub-milli jitter must not flag (the multiplicative-threshold trap:
+    # -0.02 > -0.02*1.15 is True).
+    signed_prev = {"trained_int8_ppl_delta": -0.02,
+                   "quant_xent_delta_int8": 0.0001}
+    signed_now = {"trained_int8_ppl_delta": -0.02,
+                  "quant_xent_delta_int8": 0.0004}
+    bench._flag_regressions(signed_now, signed_prev)
+    assert "workload_regressions" not in signed_now
+
+
+def test_finish_workload_judges_against_prior_cache(tmp_path, monkeypatch):
+    """_finish_workload compares the live run against the cache it
+    REPLACES, and the flags themselves never persist into the new cache
+    (a round is judged against the round before, not its own output)."""
+    monkeypatch.setattr(bench, "WORKLOAD_CACHE", tmp_path / "cache.json")
+    bench._cache_workload({"chip_alive": True, "decode_tokens_per_sec": 100.0})
+    fresh = {"chip_alive": True, "decode_tokens_per_sec": 50.0}
+    bench._finish_workload(fresh)
+    assert fresh["workload_regression_count"] == 1
+    assert "decode_tokens_per_sec" in fresh["workload_regressions"]
+    cache = json.loads((tmp_path / "cache.json").read_text())
+    assert "workload_regressions" not in cache["results"]
+    assert "workload_regression_count" not in cache["results"]
+    assert cache["results"]["decode_tokens_per_sec"] == 50.0

@@ -1,0 +1,123 @@
+"""Task-level quantization quality on a TRAINED model (VERDICT r4 weak
+#5): the int8/int4 serving claims measured where they live — perplexity
+delta, argmax agreement, and speculative acceptance on a model with
+confident predictions, not random init.
+
+The model trains on workload/quality.py's noisy-permutation Markov chain
+(learnable by a bigram lookup, so a small model reaches confident
+argmaxes in a few hundred CPU steps). Bounds are deliberately loose —
+they pin the CLAIM (quantization rarely flips a trained argmax; the int8
+copy is a high-acceptance draft), not a particular number.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_bootstrap.workload.model import ModelConfig, init_params
+from tpu_bootstrap.workload.quality import (
+    eval_quality,
+    markov_batch,
+    spec_acceptance,
+)
+from tpu_bootstrap.workload.quant import quantize_params, quantize_params4
+from tpu_bootstrap.workload.sharding import MeshConfig, build_mesh
+from tpu_bootstrap.workload.train import (
+    TrainConfig,
+    init_train_state,
+    make_train_step,
+)
+
+VOCAB = 128
+SEQ = 32
+
+
+def _to_bf16(params):
+    return jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x,
+        params)
+
+
+@pytest.fixture(scope="module")
+def trained():
+    """A small model trained to confidence on the Markov task, plus its
+    f32 masters (quantization quantizes masters, serving runs bf16)."""
+    cfg = TrainConfig(
+        model=ModelConfig(vocab_size=VOCAB, num_layers=2, num_heads=4,
+                          head_dim=16, embed_dim=64, mlp_dim=256,
+                          max_seq_len=SEQ),
+        mesh=MeshConfig(),
+    )
+    mesh = build_mesh(cfg.mesh, jax.devices()[:1])
+    params, opt_state, p_sh = init_train_state(cfg, mesh, jax.random.PRNGKey(0))
+    step = make_train_step(cfg, mesh, p_sh)
+    first = last = None
+    for i in range(400):
+        batch = jnp.asarray(markov_batch(i, 16, SEQ, VOCAB, p=0.9))
+        params, opt_state, loss = step(params, opt_state, batch)
+        if i == 0:
+            first = float(loss)
+    last = float(loss)
+    # The task was actually learned (floor ~0.81 nats at p=0.9, V=128);
+    # without this the quality numbers below would be measured on noise.
+    assert last < first * 0.6, (first, last)
+    assert last < 1.6, last
+    return cfg.model, params
+
+
+def test_markov_batch_deterministic_and_learnable():
+    a = markov_batch(3, 4, SEQ, VOCAB, p=0.9)
+    b = markov_batch(3, 4, SEQ, VOCAB, p=0.9)
+    np.testing.assert_array_equal(a, b)
+    c = markov_batch(4, 4, SEQ, VOCAB, p=0.9)
+    assert not np.array_equal(a, c)
+    # The chain follows ONE fixed permutation: successor sets are
+    # near-singletons (noise aside), which is what makes it learnable.
+    follow = 0
+    perm_guess = {}
+    for row in a:
+        for t in range(1, SEQ):
+            perm_guess.setdefault(int(row[t - 1]), []).append(int(row[t]))
+    for succ in perm_guess.values():
+        vals, counts = np.unique(succ, return_counts=True)
+        follow += counts.max()
+    total = sum(len(s) for s in perm_guess.values())
+    assert follow / total > 0.7  # ~p plus chance collisions
+
+
+def test_trained_int8_quality(trained):
+    cfg, params = trained
+    out = eval_quality(_to_bf16(params), quantize_params(params), cfg,
+                       jnp.asarray(markov_batch(10_000, 8, SEQ, VOCAB, p=0.9)))
+    # The serving claim: int8 weight-only quantization rarely flips a
+    # TRAINED argmax and barely moves perplexity.
+    assert out["argmax_agreement_pct"] > 85, out
+    assert abs(out["ppl_delta"]) < 0.5, out
+    assert out["ppl_base"] < 5.0, out  # trained, not noise
+
+
+def test_trained_int4_quality(trained):
+    cfg, params = trained
+    out = eval_quality(_to_bf16(params), quantize_params4(params), cfg,
+                       jnp.asarray(markov_batch(10_000, 8, SEQ, VOCAB, p=0.9)))
+    # int4 is the aggressive format: looser bounds, same claim shape.
+    assert out["argmax_agreement_pct"] > 60, out
+    assert abs(out["ppl_delta"]) < 2.0, out
+
+
+def test_trained_spec_acceptance_beats_random_init(trained):
+    """The int8-as-own-draft claim: acceptance on a TRAINED model beats
+    the random-init acceptance the bench has always reported (confident
+    argmaxes survive quantization; near-ties flip)."""
+    cfg, params = trained
+    prompt = jnp.asarray(markov_batch(20_000, 4, 16, VOCAB, p=0.9))
+    trained_acc = spec_acceptance(_to_bf16(params), quantize_params(params),
+                                  cfg, prompt, steps=48, gamma=4)
+    assert trained_acc["mean_committed"] > 1.5, trained_acc
+
+    rand = init_params(cfg, jax.random.PRNGKey(7))
+    rand_acc = spec_acceptance(_to_bf16(rand), quantize_params(rand), cfg,
+                               prompt, steps=48, gamma=4)
+    assert trained_acc["mean_committed"] >= rand_acc["mean_committed"], (
+        trained_acc, rand_acc)
