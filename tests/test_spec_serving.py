@@ -1,0 +1,125 @@
+"""Speculative decoding composed with continuous batching (VERDICT r4
+weak #4): the slot pool steps through the verify-commit loop, and the
+two serving levers — slot recycling and several-committed-tokens-per-
+target-stream — multiply.
+
+Exactness oracle is the same as plain serving's: every request's tokens
+must bit-match its solo greedy `generate` output (greedy speculative is
+bit-identical to the target's own greedy path, so the pool mode cannot
+change any request's tokens)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_bootstrap.workload.decode import generate
+from tpu_bootstrap.workload.model import ModelConfig, init_params
+from tpu_bootstrap.workload.quant import quantize_params
+from tpu_bootstrap.workload.serving import (
+    Request,
+    serve,
+    static_schedule_slot_steps,
+)
+from tpu_bootstrap.workload.speculative import speculative_generate
+
+CFG = ModelConfig(vocab_size=128, num_layers=2, num_heads=4, head_dim=16,
+                  embed_dim=64, mlp_dim=128, max_seq_len=64)
+
+
+def _params():
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    return params, quantize_params(params)
+
+
+def _requests(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [Request(rid=i, tokens=rng.integers(1, CFG.vocab_size,
+                                               int(rng.integers(2, 9))).tolist(),
+                    max_new=int(rng.integers(1, 13))) for i in range(n)]
+
+
+def test_ragged_speculative_matches_solo_greedy():
+    """speculative_generate(prompt_lengths=...) is bit-exact per row
+    against each row's SOLO greedy generate — the property that lets the
+    slot pool replay ragged histories through the verify-commit loop."""
+    params, draft = _params()
+    rng = np.random.default_rng(1)
+    lens = [3, 7, 5, 8]
+    width = 8
+    batch = np.zeros((4, width), np.int32)
+    rows = [rng.integers(1, CFG.vocab_size, n).tolist() for n in lens]
+    for i, r in enumerate(rows):
+        batch[i, width - len(r):] = r
+    out, stats = speculative_generate(
+        params, draft, jnp.asarray(batch), CFG, CFG, steps=12, gamma=3,
+        with_stats=True, prompt_lengths=jnp.asarray(lens, jnp.int32))
+    for i, r in enumerate(rows):
+        solo = generate(params, jnp.asarray([r], jnp.int32), CFG, 12,
+                        kv_kernel=False)
+        np.testing.assert_array_equal(np.asarray(solo[0]), np.asarray(out[i]))
+    # int8 self-draft on a tiny model still commits more than one token
+    # per verify round (the lift's precondition).
+    assert float(stats["mean_committed"]) > 1.0
+
+
+def test_speculative_serve_bit_matches_plain_and_solo():
+    params, draft = _params()
+    requests = _requests(10)
+    plain_stats, spec_stats = {}, {}
+    plain = serve(params, CFG, requests, batch_size=4, stats=plain_stats)
+    spec = serve(params, CFG, requests, batch_size=4, stats=spec_stats,
+                 draft_params=draft, draft_cfg=CFG, gamma=3)
+    assert plain == spec
+    for r in requests:
+        solo = generate(params, jnp.asarray([r.tokens], jnp.int32), CFG,
+                        r.max_new, kv_kernel=False)
+        assert spec[r.rid] == np.asarray(solo[0]).tolist(), r.rid
+    # The slot-recycling accounting is mode-independent: same schedule,
+    # same utilization, on top of the per-stream lift below.
+    assert spec_stats["rounds"] == plain_stats["rounds"]
+    assert spec_stats["slot_steps"] == plain_stats["slot_steps"]
+    assert spec_stats["active_slot_steps"] == plain_stats["active_slot_steps"]
+
+
+def test_speculative_serve_commits_more_than_one_token_per_stream():
+    """The lever itself: committed tokens per TARGET weight stream
+    (verify round) > 1 — plain decode is exactly 1 by construction, so
+    any excess is decode-bandwidth won back. The analytic accounting the
+    bench section reports on chip."""
+    params, draft = _params()
+    stats: dict = {}
+    serve(params, CFG, _requests(8, seed=3), batch_size=4, stats=stats,
+          draft_params=draft, draft_cfg=CFG, gamma=3)
+    assert stats["verify_rounds"] > 0
+    tokens_per_stream = stats["committed_tokens"] / stats["verify_rounds"]
+    assert tokens_per_stream > 1.0, stats
+    # Draft-step accounting rides along for the cost model: gamma+1
+    # draft steps per verify round, exactly.
+    assert stats["draft_steps"] == stats["verify_rounds"] * 4
+
+
+def test_speculative_serve_beats_static_schedule_too():
+    """Both levers at once on a skewed workload: slot recycling saves
+    slot-steps vs the static batcher AND the verify loop commits > 1
+    token per target stream."""
+    params, draft = _params()
+    rng = np.random.default_rng(7)
+    requests = [Request(rid=i, tokens=rng.integers(1, 128, 4).tolist(),
+                        max_new=1 if i % 2 else 12) for i in range(12)]
+    stats: dict = {}
+    out = serve(params, CFG, requests, batch_size=4, stats=stats,
+                draft_params=draft, draft_cfg=CFG, gamma=3)
+    assert len(out) == len(requests)
+    assert stats["active_slot_steps"] < static_schedule_slot_steps(requests, 4)
+    assert stats["committed_tokens"] / stats["verify_rounds"] > 1.0
+
+
+def test_speculative_serve_rejects_sampling():
+    params, draft = _params()
+    try:
+        serve(params, CFG, _requests(2), batch_size=2, temperature=0.7,
+              key=jax.random.PRNGKey(0), draft_params=draft, draft_cfg=CFG)
+    except ValueError as e:
+        assert "greedy-only" in str(e)
+    else:
+        raise AssertionError("sampled speculative serving must be rejected")

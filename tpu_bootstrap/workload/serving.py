@@ -27,6 +27,22 @@ shape:
   rows — so at every round boundary at least one row retires (or
   halves its remaining budget), and the pool refills.
 
+The engine is `SlotPool` — admission, one-round stepping, retirement —
+so the same scheduler serves two drivers: `serve()` runs a fixed request
+list to completion (the benchable, exactness-testable form), and
+workload/ingress.py steps the pool against live HTTP queues.
+
+Speculative composition (VERDICT r4 weak #4): constructed with
+``draft_params``, the pool steps each round through
+``speculative_generate``'s verify-commit loop instead of plain decode —
+the draft (typically the target's int8 copy) proposes ``gamma`` tokens
+per verify, the target commits its own argmaxes, and the pool's
+exactness guarantee is UNCHANGED because greedy speculative output is
+bit-identical to the target's own greedy path per row. The two serving
+levers — slot recycling and fewer-target-streams-per-token — multiply:
+stats gain ``verify_rounds`` and ``committed_tokens`` so tests can
+assert tokens-per-target-stream > 1 analytically.
+
 Exactness: every request's tokens equal its solo
 ``generate(prompt, steps)`` greedy output, because the ragged batch
 path is bit-exact per row (pinned by tests/test_decode.py) and history
@@ -83,11 +99,198 @@ def _bucket_down(n: int) -> int:
     return b
 
 
+class SlotPool:
+    """The continuous-batching engine: a fixed pool of decode slots with
+    ragged history replay. Drive it with `admit` + `step_round`; every
+    scheduling rule documented in the module docstring lives here.
+
+    With ``draft_params`` set, rounds run the speculative verify-commit
+    loop (greedy only — sampled speculative uses a shared key chain, so
+    a request's stream would depend on its batch cohort, breaking the
+    scheduling-independence contract sampling relies on)."""
+
+    def __init__(self, params: Params, cfg: ModelConfig, batch_size: int, *,
+                 kv_quant: bool = False, eos_id: int | None = None,
+                 temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
+                 key=None, draft_params: Params | None = None,
+                 draft_cfg: ModelConfig | None = None, gamma: int = 4):
+        if batch_size < 1:
+            raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+        if temperature < 0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
+        if temperature > 0 and key is None:
+            # A silent fixed seed would make every "sampled" workload
+            # return identical continuations (same rule as
+            # speculative_generate).
+            raise ValueError("temperature > 0 requires an explicit PRNG key")
+        if draft_params is not None:
+            if temperature > 0:
+                raise ValueError(
+                    "speculative serving is greedy-only: sampled "
+                    "speculative draws from a shared key chain, so a "
+                    "request's tokens would depend on its batch cohort")
+            if draft_cfg is None:
+                raise ValueError("draft_params requires draft_cfg")
+            if gamma < 1:
+                raise ValueError(f"gamma must be >= 1, got {gamma}")
+        self.params, self.cfg = params, cfg
+        self.batch_size = batch_size
+        self.kv_quant = kv_quant
+        self.eos_id = eos_id
+        self.temperature, self.top_k, self.top_p = temperature, top_k, top_p
+        self.key = key
+        self.draft_params, self.draft_cfg, self.gamma = (
+            draft_params, draft_cfg, gamma)
+        # Dummy-row keys by slot, fixed once (domain 0; request keys use
+        # domain 1 at admission — disjoint by construction).
+        self._dummy_keys = (
+            [jax.random.fold_in(jax.random.fold_in(key, 0), i)
+             for i in range(batch_size)] if temperature > 0 else None)
+        self.slots: list = [None] * batch_size
+        self.stats = {"rounds": 0, "slot_steps": 0, "active_slot_steps": 0}
+        if draft_params is not None:
+            self.stats.update({"verify_rounds": 0, "committed_tokens": 0,
+                               "draft_steps": 0})
+
+    @staticmethod
+    def validate(r: Request, cfg: ModelConfig) -> None:
+        """Loud construction-time admission checks (shared by serve()'s
+        upfront pass and live `admit`)."""
+        if r.max_new < 1:
+            raise ValueError(f"request {r.rid}: max_new must be >= 1")
+        if not r.tokens:
+            raise ValueError(f"request {r.rid}: empty prompt")
+        # Context-window admission: histories bucket UP to powers of two,
+        # so a request near the limit would otherwise silently allocate
+        # caches and decode at positions past the model's configured
+        # context instead of failing loudly here.
+        if _bucket_up(len(r.tokens) + r.max_new) > cfg.max_seq_len:
+            raise ValueError(
+                f"request {r.rid}: prompt ({len(r.tokens)}) + max_new "
+                f"({r.max_new}) buckets to "
+                f"{_bucket_up(len(r.tokens) + r.max_new)} > the model's "
+                f"max_seq_len ({cfg.max_seq_len})")
+
+    def free_slots(self) -> int:
+        return sum(1 for s in self.slots if s is None)
+
+    def has_active(self) -> bool:
+        return any(s is not None for s in self.slots)
+
+    def admit(self, r: Request) -> None:
+        """Place a validated request in a free slot (raises when full —
+        callers check free_slots; the pool never queues)."""
+        self.validate(r, self.cfg)
+        for i in range(self.batch_size):
+            if self.slots[i] is None:
+                self.slots[i] = _Slot(
+                    rid=r.rid, history=list(r.tokens),
+                    remaining=r.max_new, generated=[],
+                    row_key=(jax.random.fold_in(
+                        jax.random.fold_in(self.key, 1), r.rid)
+                        if self.temperature > 0 else None))
+                return
+        raise RuntimeError("no free slot (check free_slots before admit)")
+
+    def _decode_round(self, batch, lens, chunk):
+        """One chunk of plain (or sampled) decoding for the whole pool."""
+        sample_kw = {}
+        if self.temperature > 0:
+            # Per-request streams keyed by rid (fixed at admission) so
+            # rescheduling cannot change a request's tokens; dummy rows
+            # use their disjoint-domain slot keys — draws discarded.
+            sample_kw = {
+                "temperature": self.temperature, "top_k": self.top_k,
+                "top_p": self.top_p,
+                "row_keys": jnp.stack([
+                    s.row_key if s is not None else self._dummy_keys[i]
+                    for i, s in enumerate(self.slots)]),
+                "row_key_offsets": jnp.asarray(
+                    [len(s.generated) if s is not None else 0
+                     for s in self.slots], jnp.int32),
+            }
+        return generate(self.params, jnp.asarray(batch), self.cfg, chunk,
+                        kv_quant=self.kv_quant,
+                        prompt_lengths=jnp.asarray(lens, jnp.int32),
+                        **sample_kw)
+
+    def _speculative_round(self, batch, lens, chunk):
+        """One chunk through the verify-commit loop: the draft proposes
+        gamma tokens per verify, the target commits its own argmaxes —
+        bit-identical output to _decode_round's greedy path, at
+        (potentially) several committed tokens per target weight
+        stream."""
+        from tpu_bootstrap.workload.speculative import speculative_generate
+
+        out, stats = speculative_generate(
+            self.params, self.draft_params, jnp.asarray(batch),
+            self.cfg, self.draft_cfg, steps=chunk, gamma=self.gamma,
+            kv_quant=self.kv_quant, with_stats=True,
+            prompt_lengths=jnp.asarray(lens, jnp.int32))
+        rounds = int(stats["verify_rounds"])
+        self.stats["verify_rounds"] += rounds
+        # gamma+1 draft steps per verify round (the +1 keeps the draft
+        # cache gapless — speculative.py's draft-cache-hole note).
+        self.stats["draft_steps"] += rounds * (self.gamma + 1)
+        return out
+
+    def step_round(self) -> dict:
+        """Run one scheduling round over the current slots. Returns
+        {rid: {"new": [tokens...], "done": bool}} for every active slot
+        — ingress streams `new` immediately; `done` frees the slot."""
+        active = [s for s in self.slots if s is not None]
+        if not active:
+            return {}
+        # Chunk: largest power of two <= the smallest remaining budget —
+        # at least one row retires or halves per round, and chunk sizes
+        # stay a log-bounded compile set.
+        chunk = _bucket_down(min(s.remaining for s in active))
+        # Histories replay left-padded to a power-of-two bucket; free
+        # slots ride a length-1 dummy row (their output is discarded).
+        lens = [len(s.history) if s is not None else 1 for s in self.slots]
+        width = _bucket_up(max(lens))
+        batch = np.zeros((self.batch_size, width), np.int32)
+        for i, s in enumerate(self.slots):
+            if s is not None:
+                batch[i, width - len(s.history):] = s.history
+        if self.draft_params is not None:
+            out = self._speculative_round(batch, lens, chunk)
+            self.stats["committed_tokens"] += len(active) * chunk
+        else:
+            out = self._decode_round(batch, lens, chunk)
+        out = np.asarray(out)
+        self.stats["rounds"] += 1
+        self.stats["slot_steps"] += self.batch_size * chunk
+        # chunk <= every active row's remaining by construction, so each
+        # active slot consumes exactly chunk steps this round.
+        self.stats["active_slot_steps"] += len(active) * chunk
+        events = {}
+        for i, s in enumerate(self.slots):
+            if s is None:
+                continue
+            got = out[i, :chunk].tolist()
+            s.generated += got
+            s.history += got
+            s.remaining -= chunk
+            if self.eos_id is not None and self.eos_id in got:
+                cut = len(s.generated) - len(got) + got.index(self.eos_id) + 1
+                got = s.generated[len(s.generated) - len(got):cut]
+                s.generated = s.generated[:cut]
+                s.remaining = 0
+            done = s.remaining == 0
+            events[s.rid] = {"new": got, "done": done,
+                             "generated": s.generated}
+            if done:
+                self.slots[i] = None
+        return events
+
+
 def serve(params: Params, cfg: ModelConfig, requests: list,
           batch_size: int, *, kv_quant: bool = False,
           eos_id: int | None = None, temperature: float = 0.0,
           top_k: int = 0, top_p: float = 1.0, key=None,
-          stats: dict | None = None) -> dict:
+          stats: dict | None = None, draft_params: Params | None = None,
+          draft_cfg: ModelConfig | None = None, gamma: int = 4) -> dict:
     """Run every request through a ``batch_size``-slot continuously
     batched pool; returns {rid: generated token list}. ``eos_id``
     finishes a row at the first emission of that token (inclusive) —
@@ -99,100 +302,34 @@ def serve(params: Params, cfg: ModelConfig, requests: list,
     so a request's continuation is IDENTICAL whatever batch_size,
     admission order, or chunk boundaries the scheduler happened to pick
     (pinned by a test that reschedules the same workload two ways).
-    ``stats``, if given, is filled
-    with the executed-schedule accounting ({"rounds", "slot_steps",
-    "active_slot_steps"}) the tests assert utilization with — decode
-    slot-steps only; the history-replay prefills are the (O(length),
-    flash-kernel-served) price of admission."""
-    if batch_size < 1:
-        raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+    ``draft_params``/``draft_cfg``/``gamma`` switch the pool's rounds to
+    the speculative verify-commit loop (greedy only; output unchanged —
+    the exactness test covers both modes with the same oracle).
+    ``stats``, if given, is filled with the executed-schedule accounting
+    ({"rounds", "slot_steps", "active_slot_steps"}, plus
+    {"verify_rounds", "committed_tokens", "draft_steps"} in speculative
+    mode) the tests assert utilization with — decode slot-steps only;
+    the history-replay prefills are the (O(length), flash-kernel-served)
+    price of admission."""
     if len({r.rid for r in requests}) != len(requests):
         raise ValueError("duplicate request rids (results key by rid)")
-    if temperature < 0:
-        raise ValueError(f"temperature must be >= 0, got {temperature}")
-    if temperature > 0 and key is None:
-        # A silent fixed seed would make every "sampled" workload return
-        # identical continuations (same rule as speculative_generate).
-        raise ValueError("temperature > 0 requires an explicit PRNG key")
-    # Dummy-row keys by slot, fixed once (domain 0; request keys use
-    # domain 1 at admission — disjoint by construction).
-    dummy_keys = ([jax.random.fold_in(jax.random.fold_in(key, 0), i)
-                   for i in range(batch_size)] if temperature > 0 else None)
+    pool = SlotPool(params, cfg, batch_size, kv_quant=kv_quant,
+                    eos_id=eos_id, temperature=temperature, top_k=top_k,
+                    top_p=top_p, key=key, draft_params=draft_params,
+                    draft_cfg=draft_cfg, gamma=gamma)
     for r in requests:
-        if r.max_new < 1:
-            raise ValueError(f"request {r.rid}: max_new must be >= 1")
-        if not r.tokens:
-            raise ValueError(f"request {r.rid}: empty prompt")
+        pool.validate(r, cfg)  # ALL requests fail loudly before any compute
     queue = list(requests)
-    slots: list = [None] * batch_size
     done: dict = {}
-    rounds = slot_steps = active_slot_steps = 0
-
-    while queue or any(s is not None for s in slots):
+    while queue or pool.has_active():
         # Admission: free slots take queued requests (FIFO).
-        for i in range(batch_size):
-            if slots[i] is None and queue:
-                r = queue.pop(0)
-                slots[i] = _Slot(
-                    rid=r.rid, history=list(r.tokens),
-                    remaining=r.max_new, generated=[],
-                    row_key=(jax.random.fold_in(jax.random.fold_in(key, 1),
-                                                r.rid)
-                             if temperature > 0 else None))
-        active = [s for s in slots if s is not None]
-        # Chunk: largest power of two <= the smallest remaining budget —
-        # at least one row retires or halves per round, and chunk sizes
-        # stay a log-bounded compile set.
-        chunk = _bucket_down(min(s.remaining for s in active))
-        # Histories replay left-padded to a power-of-two bucket; free
-        # slots ride a length-1 dummy row (their output is discarded).
-        lens = [len(s.history) if s is not None else 1 for s in slots]
-        width = _bucket_up(max(lens))
-        batch = np.zeros((batch_size, width), np.int32)
-        for i, s in enumerate(slots):
-            if s is not None:
-                batch[i, width - len(s.history):] = s.history
-        sample_kw = {}
-        if temperature > 0:
-            # Per-request streams keyed by rid (fixed at admission) so
-            # rescheduling cannot change a request's tokens; dummy rows
-            # use their disjoint-domain slot keys — draws discarded.
-            sample_kw = {
-                "temperature": temperature, "top_k": top_k, "top_p": top_p,
-                "row_keys": jnp.stack([
-                    s.row_key if s is not None else dummy_keys[i]
-                    for i, s in enumerate(slots)]),
-                "row_key_offsets": jnp.asarray(
-                    [len(s.generated) if s is not None else 0 for s in slots],
-                    jnp.int32),
-            }
-        out = generate(params, jnp.asarray(batch), cfg, chunk,
-                       kv_quant=kv_quant,
-                       prompt_lengths=jnp.asarray(lens, jnp.int32),
-                       **sample_kw)
-        out = np.asarray(out)
-        rounds += 1
-        slot_steps += batch_size * chunk
-        # chunk <= every active row's remaining by construction, so each
-        # active slot consumes exactly chunk steps this round.
-        active_slot_steps += len(active) * chunk
-        for i, s in enumerate(slots):
-            if s is None:
-                continue
-            got = out[i, :chunk].tolist()
-            s.generated += got
-            s.history += got
-            s.remaining -= chunk
-            if eos_id is not None and eos_id in got:
-                s.generated = s.generated[:len(s.generated) - len(got)
-                                          + got.index(eos_id) + 1]
-                s.remaining = 0
-            if s.remaining == 0:
-                done[s.rid] = s.generated
-                slots[i] = None
+        while queue and pool.free_slots() > 0:
+            pool.admit(queue.pop(0))
+        for rid, ev in pool.step_round().items():
+            if ev["done"]:
+                done[rid] = ev["generated"]
     if stats is not None:
-        stats.update({"rounds": rounds, "slot_steps": slot_steps,
-                      "active_slot_steps": active_slot_steps})
+        stats.update(pool.stats)
     return done
 
 
@@ -206,7 +343,11 @@ def serve_demo_from_env() -> None:
     requests of mixed prompt/budget sizes through the continuous
     batcher (WORKLOAD_SERVE_BATCH slots) and print tokens/s plus slot
     utilization — the slice-serving counterpart of the training
-    demo, reachable from a CR through spec.tpu.env."""
+    demo, reachable from a CR through spec.tpu.env.
+
+    With WORKLOAD_SERVE_PORT set (> 0), the slice instead serves LIVE
+    HTTP requests on that port (workload/ingress.py) — the front door a
+    serve-mode CR's Service routes to; no synthetic demo runs."""
     import os
     import time
 
@@ -239,6 +380,7 @@ def serve_demo_from_env() -> None:
             params = jax.tree.map(jnp.asarray, out[ck.STATE_KEY]["params"])
             print(f"serve: restored checkpoint step {step} from {ckpt}")
 
+    draft_params = draft_cfg = None
     q = os.environ.get("WORKLOAD_QUANT", "")
     if q == "int8":
         params = quant.quantize_params(params)
@@ -247,6 +389,24 @@ def serve_demo_from_env() -> None:
     elif q:
         raise ValueError(f"WORKLOAD_QUANT must be int8|int4, got {q!r}")
     kv_quant = os.environ.get("WORKLOAD_KV_QUANT", "").lower() in ("1", "true")
+    # WORKLOAD_SPECULATIVE=1: the bf16 target drafts with its own int8
+    # copy (only meaningful when the target itself is unquantized).
+    if os.environ.get("WORKLOAD_SPECULATIVE", "").lower() in ("1", "true"):
+        if q:
+            raise ValueError(
+                "WORKLOAD_SPECULATIVE drafts with the target's int8 copy; "
+                "combine it with an UNQUANTIZED target (unset WORKLOAD_QUANT)")
+        draft_params, draft_cfg = quant.quantize_params(params), cfg
+
+    port = int(os.environ.get("WORKLOAD_SERVE_PORT", "0"))
+    if port > 0:
+        from tpu_bootstrap.workload.ingress import IngressServer
+
+        IngressServer(params, cfg, port=port,
+                      batch_size=int(os.environ.get("WORKLOAD_SERVE_BATCH", "8")),
+                      kv_quant=kv_quant, draft_params=draft_params,
+                      draft_cfg=draft_cfg).serve_forever()
+        return
 
     n = int(os.environ.get("WORKLOAD_REQUESTS", "32"))
     batch = int(os.environ.get("WORKLOAD_SERVE_BATCH", "8"))
@@ -260,7 +420,8 @@ def serve_demo_from_env() -> None:
     ]
     stats: dict = {}
     t0 = time.time()
-    done = serve(params, cfg, requests, batch, kv_quant=kv_quant, stats=stats)
+    done = serve(params, cfg, requests, batch, kv_quant=kv_quant, stats=stats,
+                 draft_params=draft_params, draft_cfg=draft_cfg)
     dt = time.time() - t0
     total = sum(len(v) for v in done.values())
     util = stats["active_slot_steps"] / max(stats["slot_steps"], 1)
@@ -281,4 +442,4 @@ def static_schedule_slot_steps(requests: list, batch_size: int) -> int:
     return total
 
 
-__all__ = ["Request", "serve", "static_schedule_slot_steps"]
+__all__ = ["Request", "SlotPool", "serve", "static_schedule_slot_steps"]

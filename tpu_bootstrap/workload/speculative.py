@@ -73,19 +73,35 @@ from tpu_bootstrap.workload.model import ModelConfig, Params
 
 
 def _verify_chunk(params: Params, tokens: jax.Array, pos, caches: list,
-                  cfg: ModelConfig, kv_kernel: bool):
+                  cfg: ModelConfig, kv_kernel: bool,
+                  pad: jax.Array | None = None):
     """Run a (B, C) chunk of candidate tokens through the target at
-    positions pos..pos+C-1 (traced start), returning logits for EVERY
-    chunk position — the multi-query analogue of decode_step."""
+    cache slots pos..pos+C-1 (traced start), returning logits for EVERY
+    chunk position — the multi-query analogue of decode_step. pad: (B,)
+    per-row left-pad widths for RAGGED batches — pad columns stay
+    excluded from every mask and rotary phases run at slot - pad per
+    row (cache slots stay uniform across rows, exactly as in
+    decode.decode_step's ragged path)."""
     b, c = tokens.shape
     max_len = caches[0]["k"].shape[1]
-    positions = pos + jnp.arange(c)
-    # Chunk row i may see cache columns 0..pos+i.
-    valid = jnp.arange(max_len)[None, :] <= positions[:, None]
+    slots = pos + jnp.arange(c)
+    if pad is None:
+        positions = slots
+        # Chunk row i may see cache columns 0..pos+i.
+        valid = jnp.arange(max_len)[None, :] <= slots[:, None]
+        slot = None
+    else:
+        positions = slots[None, :] - pad[:, None]  # (B, C) rotary phases
+        cols = jnp.arange(max_len)
+        # (B, C, L): col visible iff real (>= pad_b) and causal.
+        valid = ((cols[None, None, :] >= pad[:, None, None])
+                 & (cols[None, None, :] <= slots[None, :, None]))
+        slot = pos
     x = params["embed"].astype(cfg.compute_dtype)[tokens]
     new_caches = []
     for block, cache in zip(params["blocks"], caches):
-        x, cache = _block_step(block, x, cache, positions, valid, cfg, kv_kernel)
+        x, cache = _block_step(block, x, cache, positions, valid, cfg, kv_kernel,
+                               slot=slot)
         new_caches.append(cache)
     return _logits(params, x), new_caches  # (B, C, vocab)
 
@@ -93,7 +109,8 @@ def _verify_chunk(params: Params, tokens: jax.Array, pos, caches: list,
 @partial(jax.jit, static_argnames=("target_cfg", "draft_cfg", "steps", "gamma",
                                    "temperature", "kv_quant", "kv_kernel"))
 def _speculative(target_params, draft_params, prompt, key, target_cfg,
-                 draft_cfg, steps, gamma, temperature, kv_quant, kv_kernel):
+                 draft_cfg, steps, gamma, temperature, kv_quant, kv_kernel,
+                 prompt_lengths=None):
     """One implementation for both decoding modes; ``temperature`` is a
     STATIC argument, so the greedy (== 0) and sampled (> 0) variants are
     separate compiled programs sharing all scaffolding — cache handling,
@@ -112,10 +129,20 @@ def _speculative(target_params, draft_params, prompt, key, target_cfg,
     sampled = temperature > 0
     b, s = prompt.shape
     cap = s + steps + gamma + 1  # slack: the last iteration may overshoot
+    pad = None
+    lengths = None
+    if prompt_lengths is not None:
+        # Ragged LEFT-padded prompts (serving.serve's history replay):
+        # cache slots stay uniform, rotary phases and masks run per row
+        # — the same contract as decode.generate's prompt_lengths.
+        lengths = jnp.clip(prompt_lengths, 1, s).astype(jnp.int32)
+        pad = s - lengths
     tcaches = init_cache(target_cfg, b, cap, quantized=kv_quant)
     dcaches = init_cache(draft_cfg, b, cap, quantized=kv_quant)
-    tlogits, tcaches = prefill(target_params, prompt, tcaches, target_cfg, kv_kernel)
-    _, dcaches = prefill(draft_params, prompt, dcaches, draft_cfg, kv_kernel)
+    tlogits, tcaches = prefill(target_params, prompt, tcaches, target_cfg,
+                               kv_kernel, lengths=lengths)
+    _, dcaches = prefill(draft_params, prompt, dcaches, draft_cfg, kv_kernel,
+                         lengths=lengths)
 
     dt = prompt.dtype
     if sampled:
@@ -141,7 +168,7 @@ def _speculative(target_params, draft_params, prompt, key, target_cfg,
         def draft_one(carry, i):
             tok, caches = carry
             logits, caches = decode_step(draft_params, tok, pos + i, caches,
-                                         draft_cfg, kv_kernel)
+                                         draft_cfg, kv_kernel, pad=pad)
             if sampled:
                 logq = jax.nn.log_softmax(logits / temperature, axis=-1)
                 nxt = jax.random.categorical(
@@ -165,7 +192,7 @@ def _speculative(target_params, draft_params, prompt, key, target_cfg,
 
         chunk = jnp.concatenate([last[:, None], drafts], axis=1)  # (B, gamma+1)
         vlogits, tcaches2 = _verify_chunk(target_params, chunk, pos, tcaches,
-                                          target_cfg, kv_kernel)
+                                          target_cfg, kv_kernel, pad=pad)
 
         if sampled:
             logq = logq.swapaxes(0, 1)[:, :gamma]  # (B, gamma, V)
@@ -241,7 +268,8 @@ def speculative_generate(target_params: Params, draft_params: Params,
                          kv_kernel: bool | None = None,
                          with_stats: bool = False,
                          temperature: float = 0.0,
-                         key: jax.Array | None = None):
+                         key: jax.Array | None = None,
+                         prompt_lengths: jax.Array | None = None):
     """Greedy generation of (B, steps) continuations, bit-identical to
     `decode.generate(target_params, ...)`'s greedy output for every row,
     at up to (gamma+1)x fewer target weight streams per token.
@@ -262,11 +290,32 @@ def speculative_generate(target_params: Params, draft_params: Params,
     with_stats=True additionally returns {"verify_rounds",
     "mean_committed"} — committed tokens per verify round is the
     acceptance telemetry (gamma+1 = every proposal accepted).
+
+    prompt_lengths: (B,) int32 true lengths for a RAGGED batch whose
+    prompts arrive LEFT-padded to the shared (B, S) shape — the same
+    contract as decode.generate's prompt_lengths (per-row masks and
+    rotary offsets; rows behave as if generated alone at their true
+    length). Forces the einsum attention path, as in generate — this is
+    what lets continuous batching (serving.serve) step its slot pool
+    through the verify-commit loop.
     """
     if steps < 1:
         raise ValueError(f"steps must be >= 1, got {steps}")
     if gamma < 1:
         raise ValueError(f"gamma must be >= 1, got {gamma}")
+    if prompt_lengths is not None:
+        if not isinstance(prompt_lengths, jax.core.Tracer):
+            # Same loud out-of-range rejection as generate: a clamped
+            # length-0 row would silently decode from a pad token.
+            import jax.numpy as _jnp
+
+            lo = int(_jnp.min(_jnp.asarray(prompt_lengths)))
+            hi = int(_jnp.max(_jnp.asarray(prompt_lengths)))
+            if lo < 1 or hi > prompt.shape[1]:
+                raise ValueError(
+                    f"prompt_lengths must be in [1, {prompt.shape[1]}] "
+                    f"(the padded prompt width); got [{lo}, {hi}]")
+        kv_kernel = False  # per-row masks: einsum path
     if target_cfg.vocab_size != draft_cfg.vocab_size:
         raise ValueError(
             f"target and draft must share a vocab: {target_cfg.vocab_size} "
@@ -287,7 +336,8 @@ def speculative_generate(target_params: Params, draft_params: Params,
         target_params, draft_params, prompt,
         jax.random.PRNGKey(0) if key is None else key, target_cfg,
         draft_cfg, steps=steps, gamma=gamma, temperature=float(temperature),
-        kv_quant=kv_quant, kv_kernel=kv_kernel)
+        kv_quant=kv_quant, kv_kernel=kv_kernel,
+        prompt_lengths=prompt_lengths)
     return (out, stats) if with_stats else out
 
 
