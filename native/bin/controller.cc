@@ -327,7 +327,7 @@ class EventSink {
 // gone (callers must not requeue it).
 bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::string& name,
                    EventSink& events, const ObjectCache& cache, KnownAbsent& rb_absent,
-                   EmittedPhases& emitted) {
+                   KnownAbsent& svc_absent, EmittedPhases& emitted) {
   // Whole-pass latency histogram: the in-daemon half of the BASELINE
   // metric surface, scrapeable at /metrics and read back by bench.py.
   struct PassTimer {
@@ -347,12 +347,22 @@ bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::s
   if (!cache.get(name, &ub)) {
     emitted.erase(name);  // CR deleted: drop the per-CR emission record
     rb_absent.erase(name);
+    svc_absent.erase(name);
     return false;
   }
 
   log_info("reconciling", {{"name", name}});
   const std::string ns = target_namespace(ub);
   std::vector<Json> children = desired_children(ub, cfg.core);
+  // Whether THIS pass applies a serve Service — the single source of
+  // truth for the prune below: any exit that stops the emission
+  // (revoked, spec.tpu removed, serve mode off, one-shot slice
+  // finished) must also remove the already-applied Service, because
+  // SSA never garbage-collects.
+  bool emitting_service = false;
+  for (const Json& child : children) {
+    if (child.get("kind").as_string() == "Service") emitting_service = true;
+  }
   Json applied_jobset;  // the apply response doubles as the observation
   bool have_applied_jobset = false;
 
@@ -458,6 +468,9 @@ bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::s
       if (kind == "RoleBinding") applying_rolebinding = true;
       wave2.push_back(&child);
     } else {
+      // A Service is being (re)applied: clear the learned-absent mark
+      // so a later mode-switch prune fires again.
+      if (kind == "Service") svc_absent.erase(name);
       wave1.push_back(&child);
     }
   }
@@ -534,6 +547,25 @@ bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::s
                {{"name", name}, {"jobset", js_name}});
     }
     pruned_jobset = true;
+  }
+  // The serve-mode front door rides the Service EMISSION, not any one
+  // gate: whenever desired_children stopped emitting it — revoked
+  // sheet gate, spec.tpu removed, serve mode switched off, or a
+  // one-shot slice reaching its terminal phase — the already-applied
+  // Service must go (it would select pods that no longer serve, or no
+  // longer exist). Gated by the same learned-absent pattern as the
+  // RoleBinding prune (one 404 per CR per process lifetime, not one
+  // per resync).
+  if (!emitting_service && slice_may_exist && !svc_absent.contains(name)) {
+    try {
+      client.remove("v1", "Service", ns, ns + "-serve");
+      Metrics::instance().inc("prunes_total");
+      log_info("pruned serve service (revoked, tpu removed, or serve mode off)",
+               {{"name", name}});
+    } catch (const KubeError& e) {
+      if (e.status != 404) throw;
+    }
+    svc_absent.insert(name);
   }
 
   // Maintain status.slice (merge-patch: never touches the
@@ -671,6 +703,7 @@ int main() {
   EventSink events(client);
   ObjectCache cache;
   KnownAbsent rb_absent;
+  KnownAbsent svc_absent;
   EmittedPhases emitted_phases;
 
   // Reconcile workers.
@@ -692,7 +725,7 @@ int main() {
         }
         try {
           bool exists = reconcile_one(client, cfg, name, events, cache, rb_absent,
-                                      emitted_phases);
+                                      svc_absent, emitted_phases);
           queue.done(name);
           if (exists) queue.add(name, cfg.requeue_secs * 1000);  // controller.rs:154
         } catch (const std::exception& e) {
@@ -767,6 +800,7 @@ int main() {
   const std::pair<const char*, const char*> kOwnedKinds[] = {
       {"v1", "Namespace"},
       {"v1", "ResourceQuota"},
+      {"v1", "Service"},  // serve-mode front door (reconcile_core)
       {"rbac.authorization.k8s.io/v1", "Role"},
       {"rbac.authorization.k8s.io/v1", "RoleBinding"},
       {"jobset.x-k8s.io/v1alpha2", "JobSet"},
@@ -807,6 +841,7 @@ int main() {
             cache.remove(name);
             queue.remove(name);  // GC handles children; stop requeueing
             rb_absent.erase(name);  // don't grow unbounded across CR churn
+            svc_absent.erase(name);
             // A recreated CR must re-emit its phase history; a stale
             // record would swallow its transitions forever.
             emitted_phases.erase(name);

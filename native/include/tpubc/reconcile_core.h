@@ -44,6 +44,22 @@ std::vector<Json> desired_children(const Json& ub, const Json& config);
 // tooling. Throws JsonError if spec.tpu is absent/invalid.
 Json build_jobset(const Json& ub, const Json& config);
 
+// True when the CR's workload env selects the serving entry point
+// (spec.tpu.env.WORKLOAD_MODE == "serve"): the slice runs the HTTP
+// front door (tpu_bootstrap/workload/ingress.py) and desired_children
+// additionally emits build_service's ClusterIP Service for it.
+bool serve_mode(const Json& ub);
+
+// The ClusterIP Service routing to worker 0 of a serve-mode slice —
+// the consumable front door for a provisioned serving JobSet. Port 80
+// -> the worker's WORKLOAD_SERVE_PORT (defaulted by build_jobset when
+// the CR does not set it). Mirrors how the reference exposes its
+// admission daemon through a chart Service (reference
+// charts/bacchus-gpu-controller/templates/service.yaml:1-15), but per
+// CR: the Service is a reconciled child with an owner reference, not a
+// chart constant. Throws JsonError if spec.tpu is absent.
+Json build_service(const Json& ub);
+
 // Labels stamped on emitted JobSets (build_jobset):
 //   generation — the CR metadata.generation the JobSet was built from;
 //                slice_status reads it back so observed outcomes are

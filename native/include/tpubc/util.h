@@ -36,6 +36,13 @@ std::string trim(const std::string& s);
 bool starts_with(const std::string& s, const std::string& prefix);
 bool contains(const std::string& s, const std::string& needle);
 
+// Strict TCP port parse: every character consumed, range (0, 65536).
+// ONE rule shared by admission (which rejects invalid
+// WORKLOAD_SERVE_PORT values) and the reconcile planner (which wires
+// the serve Service to the same value) — two copies drifting apart
+// would reintroduce the Service-routes-to-nowhere mismatch.
+bool parse_port(const std::string& s, int64_t* out);
+
 // Read an entire file; throws std::runtime_error on failure.
 std::string read_file(const std::string& path);
 

@@ -291,6 +291,21 @@ Json mutate(const Json& request, const Json& config) {
                                    "\" is reserved for the slice bootstrap contract");
         }
       }
+      // Serve-mode port sanity: the controller wires a Service to this
+      // value (reconcile_core serve_port), so an unparseable or
+      // out-of-range port must fail HERE, loudly — not ship a front
+      // door that routes to a port the worker never listens on. Same
+      // parse_port rule the planner uses (util.h) — one definition of
+      // "valid" on both sides of the write path.
+      if (user_env.get_string("WORKLOAD_MODE") == "serve") {
+        const std::string p = user_env.get_string("WORKLOAD_SERVE_PORT");
+        int64_t parsed = 0;
+        if (!p.empty() && !parse_port(p, &parsed)) {
+          return deny(request,
+                      "spec.tpu.env WORKLOAD_SERVE_PORT \"" + p +
+                          "\" is not a valid port (1-65535)");
+        }
+      }
     }
 
     // JSON Patch "add" on an object member upserts, so these also correct

@@ -30,6 +30,7 @@ const KindInfo kKinds[] = {
     {"v1", "Namespace", "namespaces", false},
     {"v1", "Node", "nodes", false},
     {"v1", "ResourceQuota", "resourcequotas", true},
+    {"v1", "Service", "services", true},
     {"v1", "Pod", "pods", true},
     {"v1", "Event", "events", true},
     {"coordination.k8s.io/v1", "Lease", "leases", true},

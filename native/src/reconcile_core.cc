@@ -28,6 +28,26 @@ Json meta_ns(const std::string& name, const std::string& ns, const Json& oref) {
   return m;
 }
 
+// Default port the serving front door listens on when the CR does not
+// set WORKLOAD_SERVE_PORT (tpu_bootstrap/workload/ingress.py reads the
+// env; 8471/8080 are taken by the TPU runtime and the JAX coordinator).
+constexpr int64_t kDefaultServePort = 8476;
+
+// The worker's serving port for a serve-mode CR: the CR's own
+// WORKLOAD_SERVE_PORT when VALID, else the default. Invalid values are
+// rejected by admission (admission_core) for new CRs; for pre-webhook
+// CRs build_jobset drops the invalid env entry and injects the same
+// default this returns, so the Service and the worker can never
+// disagree on the port.
+int64_t serve_port(const Json& tpu) {
+  const Json& env = tpu.get("env");
+  if (env.is_object()) {
+    int64_t v = 0;
+    if (parse_port(env.get_string("WORKLOAD_SERVE_PORT"), &v)) return v;
+  }
+  return kDefaultServePort;
+}
+
 }  // namespace
 
 Json owner_reference(const Json& ub) {
@@ -115,9 +135,45 @@ Json build_jobset(const Json& ub, const Json& config) {
       // throwing here would wedge the CR in a reconcile error-requeue
       // loop, the failure mode the admission check exists to prevent.
       if (reserved_worker_env_name(kv.first) || !kv.second.is_string()) continue;
+      if (kv.first == "WORKLOAD_SERVE_PORT" && serve_mode(ub)) {
+        // An INVALID user port must not reach the pod: serve_port()
+        // falls back to the default for the Service, and copying the
+        // raw value would leave the worker listening nowhere the
+        // Service routes. Drop it; the serve block below injects the
+        // canonical value. (Admission rejects this for new CRs — this
+        // is the pre-webhook-CR safety net.)
+        int64_t ignored = 0;
+        if (!parse_port(kv.second.as_string(), &ignored)) continue;
+      }
       env.push_back(Json::object({{"name", kv.first},
                                   {"value", kv.second.as_string()}}));
     }
+  }
+
+  // Serve mode: guarantee the worker and the Service agree on a port —
+  // when the CR opted into serving but set no WORKLOAD_SERVE_PORT, the
+  // default is injected HERE (the env the pod actually sees) and
+  // build_service derives the same value from the same rule.
+  Json ports = Json::array({
+      Json::object({{"containerPort", 8471}, {"name", "tpu-runtime"}}),
+      Json::object({{"containerPort", 8080}, {"name", "coordinator"}}),
+  });
+  if (serve_mode(ub)) {
+    const int64_t sp = serve_port(tpu);
+    // "Set" means set to a VALID port: an invalid value was dropped by
+    // the copy loop above, so the canonical default must be injected
+    // here or the worker would fall back to the demo mode while the
+    // Service routes to the serve port.
+    bool user_set = false;
+    if (tpu.get("env").is_object()) {
+      int64_t v = 0;
+      user_set = parse_port(tpu.get("env").get_string("WORKLOAD_SERVE_PORT"), &v);
+    }
+    if (!user_set) {
+      env.push_back(Json::object({{"name", "WORKLOAD_SERVE_PORT"},
+                                  {"value", std::to_string(sp)}}));
+    }
+    ports.push_back(Json::object({{"containerPort", sp}, {"name", "serve"}}));
   }
 
   Json container = Json::object({
@@ -125,10 +181,7 @@ Json build_jobset(const Json& ub, const Json& config) {
       {"image", image},
       // Port 8471 is the TPU runtime's inter-host ICI bootstrap port; 8080
       // serves the JAX coordinator (megascale) endpoint on worker 0.
-      {"ports", Json::array({
-                    Json::object({{"containerPort", 8471}, {"name", "tpu-runtime"}}),
-                    Json::object({{"containerPort", 8080}, {"name", "coordinator"}}),
-                })},
+      {"ports", ports},
       {"env", env},
       {"resources", Json::object({
                         {"requests", Json::object({{kTpuResource, geom.chips_per_host}})},
@@ -239,6 +292,47 @@ Json build_jobset(const Json& ub, const Json& config) {
   });
 }
 
+bool serve_mode(const Json& ub) {
+  const Json& tpu = ub.get("spec").get("tpu");
+  if (!tpu.is_object()) return false;
+  const Json& env = tpu.get("env");
+  return env.is_object() && env.get_string("WORKLOAD_MODE") == "serve";
+}
+
+Json build_service(const Json& ub) {
+  const Json& tpu = ub.get("spec").get("tpu");
+  if (!tpu.is_object()) throw JsonError("build_service: spec.tpu is absent");
+  const std::string ns = target_namespace(ub);
+  const std::string name = ns + "-slice";
+  // Route to worker 0 of slice 0 — the pod running the ingress engine
+  // (ingress is single-engine by design: one thread owns the pool and
+  // the JAX trace caches). JobSet stamps jobset-name/replicatedjob-name/
+  // job-index on every pod; Indexed Jobs add the completion-index label,
+  // which pins pod 0 of the gang.
+  return Json::object({
+      {"apiVersion", "v1"},
+      {"kind", "Service"},
+      {"metadata", meta_ns(ns + "-serve", ns, owner_reference(ub))},
+      {"spec",
+       Json::object({
+           {"type", "ClusterIP"},
+           {"selector",
+            Json::object({
+                {"jobset.sigs.k8s.io/jobset-name", name},
+                {"jobset.sigs.k8s.io/replicatedjob-name", "workers"},
+                {"jobset.sigs.k8s.io/job-index", "0"},
+                {"batch.kubernetes.io/job-completion-index", "0"},
+            })},
+           {"ports", Json::array({Json::object({
+                {"name", "http"},
+                {"protocol", "TCP"},
+                {"port", 80},
+                {"targetPort", serve_port(tpu)},
+            })})},
+       })},
+  });
+}
+
 bool jobset_spec_changed(const Json& ub, const Json& desired_jobset) {
   const std::string recorded =
       ub.get("status").get("slice").get_string("spec_hash");
@@ -346,6 +440,16 @@ std::vector<Json> desired_children(const Json& ub, const Json& config) {
     if (!(one_shot && same_spec &&
           (phase == "Succeeded" || phase == "Failed"))) {
       children.push_back(build_jobset(ub, config));
+      // 6. Service — iff the slice serves (WORKLOAD_MODE=serve): the
+      // consumable front door for the provisioned JobSet, gated and
+      // lifecycled exactly with it (a one-shot-finished slice keeps no
+      // dangling Service). Reference analogue: the chart Service in
+      // front of the admission daemon
+      // (charts/bacchus-gpu-controller/templates/service.yaml:1-15) —
+      // here per CR, as a reconciled owned child.
+      if (serve_mode(ub)) {
+        children.push_back(build_service(ub));
+      }
     }
   }
 

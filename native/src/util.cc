@@ -4,6 +4,7 @@
 #include <cctype>
 #include <chrono>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <fstream>
 #include <sstream>
@@ -218,6 +219,19 @@ std::string now_rfc3339() {
                 tm_utc.tm_mon + 1, tm_utc.tm_mday, tm_utc.tm_hour, tm_utc.tm_min, tm_utc.tm_sec,
                 static_cast<long long>(ms));
   return buf;
+}
+
+}  // namespace tpubc
+
+namespace tpubc {
+
+bool parse_port(const std::string& s, int64_t* out) {
+  if (s.empty()) return false;
+  char* end = nullptr;
+  const long long v = std::strtoll(s.c_str(), &end, 10);
+  if (end == nullptr || *end != '\0' || v <= 0 || v >= 65536) return false;
+  *out = v;
+  return true;
 }
 
 }  // namespace tpubc

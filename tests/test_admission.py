@@ -450,3 +450,33 @@ def test_mutate_review_without_request(lib):
     out = lib.mutate_review({"kind": "AdmissionReview"}, lib.default_admission_config())
     assert out["response"]["allowed"] is False
     assert out["response"]["status"]["code"] == 400
+
+
+def test_serve_mode_invalid_port_denied(lib):
+    """The controller wires a Service to WORKLOAD_SERVE_PORT, so an
+    unparseable/out-of-range value fails at admission instead of
+    shipping a front door that routes nowhere."""
+    for bad in ("0", "65536", "http", "-5"):
+        resp = lib.mutate(
+            req(spec={"tpu": {"accelerator": "tpu-v5-lite-podslice",
+                              "topology": "2x2",
+                              "env": {"WORKLOAD_MODE": "serve",
+                                      "WORKLOAD_SERVE_PORT": bad}}}),
+            lib.default_admission_config())
+        assert resp["allowed"] is False, bad
+        assert "WORKLOAD_SERVE_PORT" in resp["status"]["message"]
+    # Valid port and non-serve mode both pass.
+    ok = lib.mutate(
+        req(spec={"tpu": {"accelerator": "tpu-v5-lite-podslice",
+                          "topology": "2x2",
+                          "env": {"WORKLOAD_MODE": "serve",
+                                  "WORKLOAD_SERVE_PORT": "9000"}}}),
+        lib.default_admission_config())
+    assert ok["allowed"] is True
+    trainy = lib.mutate(
+        req(spec={"tpu": {"accelerator": "tpu-v5-lite-podslice",
+                          "topology": "2x2",
+                          "env": {"WORKLOAD_SERVE_PORT": "not-a-port"}}}),
+        lib.default_admission_config())
+    # Not serve mode: the knob is inert, admission leaves it alone.
+    assert trainy["allowed"] is True

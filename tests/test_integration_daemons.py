@@ -374,10 +374,11 @@ def test_controller_watch_resumes_without_relist(fake):
         wait_for(lambda: fake.get(KEY_NS, "bob"), timeout=15, desc="post-sever converge")
         # Whether the severed stream surfaced as a clean end or an error,
         # the watcher must resume from its rv — never a full relist. Same
-        # contract for all five child-kind watchers (they seed exactly
-        # once at startup).
+        # contract for all six child-kind watchers (Namespace,
+        # ResourceQuota, Service, Role, RoleBinding, JobSet — they seed
+        # exactly once at startup).
         assert d.metrics().get("relists_total") == 1, "no relist on benign stream failure"
-        assert d.metrics().get("child_relists_total") == 5, \
+        assert d.metrics().get("child_relists_total") == 6, \
             "child watchers must resume, not relist, on benign stream failure"
     finally:
         code, err = d.stop()

