@@ -1,0 +1,176 @@
+"""Two-process sharded-train-step dryrun (VERDICT r4 item 6).
+
+The multi-host story was proven at the rendezvous level (two processes
+boot jax.distributed under the JobSet env contract) but the FULL sharded
+train step never crossed a process boundary — collectives all ran inside
+one runtime. This module runs the real thing at toy scale: 2 OS
+processes x 4 virtual CPU devices = one 8-device dp x fsdp mesh whose
+psums/all-gathers traverse the distributed runtime, on the same
+step-addressed synthetic batches as any single-process run — so the loss
+can be asserted EQUAL to the 8-device single-process result.
+
+Used by tests/test_multihost_bootstrap.py (with the env derived from the
+controller's emitted JobSet) and by __graft_entry__.dryrun_multichip's
+multiprocess pass (driver-visible validation without hardware).
+
+Reference parity note: the reference (bacchus-gpu-controller) schedules
+opaque pods and never runs collectives (SURVEY.md §2); this validates
+the multi-host compute contract its JobSets exist to launch.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent.parent
+
+# One tiny config shared by workers and the reference so "equality" is
+# meaningful: dp=2 x fsdp=4 covers both cross-process data parallelism
+# and cross-process ZeRO-3 gathers.
+TINY_MODEL = dict(vocab_size=128, num_layers=2, num_heads=4, head_dim=16,
+                  embed_dim=64, mlp_dim=128, max_seq_len=32)
+MESH = dict(data=2, fsdp=4)
+STEPS = 2
+
+
+def _build():
+    import jax
+
+    from tpu_bootstrap.workload.model import ModelConfig
+    from tpu_bootstrap.workload.sharding import MeshConfig, build_mesh
+    from tpu_bootstrap.workload.train import (
+        TrainConfig,
+        init_train_state,
+        make_train_step,
+    )
+
+    cfg = TrainConfig(model=ModelConfig(**TINY_MODEL), mesh=MeshConfig(**MESH))
+    mesh = build_mesh(cfg.mesh)
+    params, opt_state, p_sh = init_train_state(cfg, mesh, jax.random.PRNGKey(0))
+    return cfg, mesh, params, opt_state, make_train_step(cfg, mesh, p_sh)
+
+
+def worker_main() -> None:
+    """One of the two processes: rendezvous from the JobSet env contract,
+    run STEPS sharded steps, print the (replicated) loss."""
+    import jax
+
+    from tpu_bootstrap.workload.data import host_rows
+    from tpu_bootstrap.workload.sharding import batch_shardings
+    from tpu_bootstrap.workload.train import (
+        bootstrap_from_env,
+        global_batch_size,
+        synthetic_batch,
+    )
+
+    boot = bootstrap_from_env()
+    assert boot is not None and boot["num_processes"] == 2, boot
+    jax.distributed.initialize(**boot)
+    assert jax.process_count() == 2 and jax.device_count() == 8, (
+        jax.process_count(), jax.device_count())
+
+    import numpy as np
+
+    cfg, mesh, params, opt_state, step = _build()
+    b = global_batch_size(cfg)
+    for i in range(STEPS):
+        tokens = np.asarray(synthetic_batch(cfg, i, 0))  # global, both hosts
+        arr = jax.make_array_from_process_local_data(
+            batch_shardings(mesh), tokens[host_rows(b)], tokens.shape)
+        params, opt_state, loss = step(params, opt_state, arr)
+    print("DRYRUN_MP_LOSS", float(loss), flush=True)
+
+
+def reference_loss() -> float:
+    """The single-process 8-device result on the identical schedule.
+    Caller's process must already expose >= 8 devices."""
+    import jax
+
+    from tpu_bootstrap.workload.sharding import batch_shardings
+    from tpu_bootstrap.workload.train import synthetic_batch
+
+    cfg, mesh, params, opt_state, step = _build()
+    for i in range(STEPS):
+        tokens = jax.device_put(synthetic_batch(cfg, i, 0),
+                                batch_shardings(mesh))
+        params, opt_state, loss = step(params, opt_state, tokens)
+    return float(loss)
+
+
+def run(env_overrides: dict | None = None, timeout: int = 600) -> list:
+    """Spawn the 2-process dryrun; returns both workers' losses. The env
+    contract (names AND meanings) is build_jobset's; ``env_overrides``
+    lets tests substitute the env block of an actually-emitted JobSet."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    base = {
+        "TPUBC_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+        "TPUBC_NUM_HOSTS": "2",
+        "TPUBC_JOBSET_NAME": "dryrun-mp",
+    }
+    base.update(env_overrides or {})
+    base["TPUBC_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"  # always loopback
+    import tempfile
+
+    procs = []
+    outputs = []
+    try:
+        for idx in range(2):
+            env = {
+                **os.environ,
+                **base,
+                "JOB_COMPLETION_INDEX": str(idx),
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            }
+            # stdout/stderr to FILES, not pipes: the workers are
+            # interdependent (cross-process collectives), and reaping
+            # them sequentially over pipes would deadlock the moment the
+            # not-yet-reaped one fills its 64 KiB pipe with JAX warnings
+            # and blocks mid-collective.
+            out_f = tempfile.TemporaryFile()
+            err_f = tempfile.TemporaryFile()
+            outputs.append((out_f, err_f))
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "tpu_bootstrap.workload.dryrun_mp"],
+                env=env, cwd=str(REPO), stdout=out_f, stderr=err_f))
+        losses = []
+        for idx, p in enumerate(procs):
+            p.wait(timeout=timeout)
+        for idx, p in enumerate(procs):
+            out_f, err_f = outputs[idx]
+            out_f.seek(0)
+            err_f.seek(0)
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"dryrun_mp worker {idx} failed:\n"
+                    f"{err_f.read().decode()[-3000:]}")
+            line = [ln for ln in out_f.read().decode().splitlines()
+                    if ln.startswith("DRYRUN_MP_LOSS")][0]
+            losses.append(float(line.split()[1]))
+        return losses
+    finally:
+        # One worker failing (or timing out) leaves its peer blocked in
+        # cross-process collectives against a dead coordinator — kill
+        # BOTH on any exit path so no orphan outlives the call.
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        for out_f, err_f in outputs:
+            out_f.close()
+            err_f.close()
+
+
+if __name__ == "__main__":
+    # Workers must pin CPU BEFORE any backend init (the sitecustomize
+    # axon hook pins the platform otherwise).
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    worker_main()
