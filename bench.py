@@ -565,6 +565,53 @@ except Exception as e:  # noqa: BLE001
     out["decode_kv_bench_error"] = f"{type(e).__name__}: {e}"[:400]
 emit()
 
+# Continuous batching (serving.serve): wall-clock tokens/s through the
+# slot pool on a ragged synthetic workload, plain decode vs the
+# speculative verify-commit composition — the two serving levers
+# together. The analytic accounting (slot utilization, committed tokens
+# per target stream) rides along so the chip numbers stay interpretable:
+# spec mode's wall clock only wins when mean committed/stream outruns
+# the draft's overhead, which random-init acceptance rarely buys —
+# tokens-per-stream is the structural number, wall-clock the honest one.
+try:
+    from tpu_bootstrap.workload.serving import Request, serve
+
+    import numpy as _np
+
+    def serve_workload(n=24):
+        rng = _np.random.default_rng(7)
+        return [Request(rid=i,
+                        tokens=rng.integers(1, dcfg.vocab_size, 8).tolist(),
+                        max_new=int(rng.choice([4, 8, 16, 32])))
+                for i in range(n)]
+
+    def timed_serve(**kw):
+        serve(dparams, dcfg, serve_workload(), 8, **kw)  # compile all shapes
+        stats = {}
+        t0 = time.time()
+        done = serve(dparams, dcfg, serve_workload(), 8, stats=stats, **kw)
+        dt = time.time() - t0
+        toks = sum(len(v) for v in done.values())
+        return toks / dt, stats
+
+    plain_tps, pstats = timed_serve()
+    out.update({
+        "serve_tokens_per_sec": round(plain_tps, 1),
+        "serve_slot_utilization": round(
+            pstats["active_slot_steps"] / max(pstats["slot_steps"], 1), 3),
+    })
+    emit()
+    spec_tps, sstats = timed_serve(draft_params=qparams, draft_cfg=dcfg,
+                                   gamma=4)
+    out.update({
+        "serve_spec_tokens_per_sec": round(spec_tps, 1),
+        "serve_spec_committed_per_stream": round(
+            sstats["committed_tokens"] / max(sstats["verify_rounds"], 1), 2),
+    })
+except Exception as e:  # noqa: BLE001
+    out["serve_bench_error"] = f"{type(e).__name__}: {e}"[:400]
+emit()
+
 # Speculative decoding (VERDICT r3 item 5): committed-tokens/s for int8
 # SELF-speculation — the target's own int8 copy drafts gamma tokens, the
 # bf16 target verifies the chunk in one weight stream. The only reason
@@ -850,8 +897,8 @@ def _cache_workload(parsed: dict) -> None:
 # family (booleans, configuration echoes like speculative_gamma) are
 # not judged.
 _HIGHER_BETTER = ("per_sec", "speedup", "mfu_pct", "gbps",
-                  "roofline_frac", "mean_committed", "temp_reduction",
-                  "agreement_pct")
+                  "roofline_frac", "mean_committed", "committed_per_stream",
+                  "slot_utilization", "temp_reduction", "agreement_pct")
 # "_ms" must stay an endswith match (as a substring it would grab
 # unrelated keys); the rest are distinctive enough to match anywhere —
 # quality deltas carry format suffixes (quant_xent_delta_int8).
@@ -945,12 +992,15 @@ def workload_bench(timeout_secs: int | None = None):
     isolated (VERDICT r1 item 1): explicit JAX_PLATFORMS passthrough and
     a hard timeout. Fast failures (crash, no JSON) get one retry; a
     timeout with ZERO output — hung backend init, i.e. a dead tunnel —
-    does NOT retry (it would hang just as long again). The 1400s default
-    cap (TPUBC_WORKLOAD_TIMEOUT overrides): a fully cold run through the
-    tunnel measured ~900s through the speculative section (20+ Mosaic
-    compiles), and the round-3 900s cap cost that run its long-context
-    sections; sections are ordered never-measured-first so a timeout
-    loses the already-proven tail, whose numbers ride the merged cache.
+    does NOT retry (it would hang just as long again). The 1700s default
+    cap (TPUBC_WORKLOAD_TIMEOUT overrides; hack/tpu-probe-loop.sh's
+    fallback must track it): a fully cold run through the tunnel
+    measured ~900s through the speculative section (20+ Mosaic
+    compiles), the round-3 900s cap cost that run its long-context
+    sections, and the round-5 sections (trained-model quality,
+    continuous batching) add ~20 fresh cold compiles over the 1400s
+    r4 budget; a timeout loses the tail, whose numbers ride the merged
+    cache.
     The subprocess emits its accumulated results after every milestone,
     so even a timeout or crash returns whatever was measured up to that
     point — and those partials are cached (merged) too. On total failure
@@ -958,7 +1008,10 @@ def workload_bench(timeout_secs: int | None = None):
     metric is the primary and must never be lost to a workload
     hiccup."""
     if timeout_secs is None:
-        timeout_secs = int(os.environ.get("TPUBC_WORKLOAD_TIMEOUT", "1400"))
+        # 1700s: the r5 sections (trained-model quality, continuous
+        # batching) add ~20 fresh compiles on a cold tunnel cache; 1400s
+        # covered the r4 section set.
+        timeout_secs = int(os.environ.get("TPUBC_WORKLOAD_TIMEOUT", "1700"))
     # Fail-FAST on a dead tunnel: a healthy backend prints its first
     # milestone (workload_backend/chip_alive) within seconds-to-a-couple-
     # minutes; a held/dead tunnel hangs in backend init with ZERO output.

@@ -8,7 +8,9 @@ set -u
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 OUT="$REPO/.tpu_workload_probe.json"
 LOG="$REPO/.tpu_workload_probe.log"
-WB_CAP="${TPUBC_WORKLOAD_TIMEOUT:-1400}"
+# Fallback MUST match workload_bench's own default in bench.py — a
+# stale smaller value here would SIGTERM python mid-attempt.
+WB_CAP="${TPUBC_WORKLOAD_TIMEOUT:-1700}"
 # Outer bound derives from the same knob the inner cap reads: two
 # attempts (workload_bench retries once) plus slack — a hardcoded
 # bound would SIGTERM python mid-attempt under a larger override,
