@@ -65,12 +65,20 @@ def distill_loss(student_params: Params, teacher_params: Params,
 def make_distill_step(student_cfg: ModelConfig, teacher_params: Params,
                       teacher_cfg: ModelConfig, mesh, *,
                       learning_rate: float = 1e-3, temperature: float = 1.0,
-                      hard_weight: float = 0.0, weight_decay: float = 1e-4):
-    """Returns (jitted step(student, opt_state, tokens) -> (student,
-    opt_state, loss), optimizer). The teacher is closed over frozen —
-    gradients and optimizer state exist only for the student. Student
-    and teacher must share a vocabulary; everything else (depth, width,
-    heads) is free, which is the point."""
+                      hard_weight: float = 0.0, weight_decay: float = 1e-4,
+                      teacher_as_arg: bool = False):
+    """Returns (jitted step, optimizer). The teacher is frozen either
+    way — gradients and optimizer state exist only for the student.
+    Student and teacher must share a vocabulary; everything else (depth,
+    width, heads) is free, which is the point.
+
+    teacher_as_arg=False (default): step(student, opt_state, tokens),
+    teacher closed over. teacher_as_arg=True:
+    step(student, teacher, opt_state, tokens) — the teacher rides as an
+    explicit jit argument, which tunneled single-chip backends REQUIRE
+    at real teacher sizes (closed-over concrete arrays lower as HLO
+    literal constants, and the remote-compile endpoint rejects
+    multi-hundred-MB request bodies; hardware-measured)."""
     if student_cfg.vocab_size != teacher_cfg.vocab_size:
         raise ValueError(
             f"student and teacher must share a vocab: "
@@ -99,15 +107,30 @@ def make_distill_step(student_cfg: ModelConfig, teacher_params: Params,
             jax.device_put, teacher_params,
             param_shardings(mesh, teacher_params))
 
-    def loss(student, tokens):
-        return distill_loss(student, teacher_params, tokens, student_cfg,
-                            teacher_cfg, temperature, hard_weight)
-
-    def step(student, opt_state, tokens):
-        loss_value, grads = jax.value_and_grad(loss)(student, tokens)
+    def _update(student, teacher, opt_state, tokens):
+        loss_value, grads = jax.value_and_grad(distill_loss)(
+            student, teacher, tokens, student_cfg, teacher_cfg,
+            temperature, hard_weight)
         updates, opt_state = opt.update(grads, opt_state, student)
         student = optax.apply_updates(student, updates)
         return student, opt_state, loss_value
+
+    if teacher_as_arg:
+        def step_arg(student, teacher, opt_state, tokens):
+            return _update(student, teacher, opt_state, tokens)
+
+        if degenerate_mesh(mesh):
+            return jax.jit(step_arg, donate_argnums=(0, 2)), opt
+        return jax.jit(
+            step_arg,
+            in_shardings=(replicated(mesh), None, None,
+                          batch_shardings(mesh)),
+            out_shardings=(replicated(mesh), None, replicated(mesh)),
+            donate_argnums=(0, 2),
+        ), opt
+
+    def step(student, opt_state, tokens):
+        return _update(student, teacher_params, opt_state, tokens)
 
     if degenerate_mesh(mesh):
         return jax.jit(step, donate_argnums=(0, 1)), opt

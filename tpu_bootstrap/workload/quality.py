@@ -115,21 +115,55 @@ def eval_quality(base_params: Params, quant_params: Params,
     }
 
 
+def distill_draft(teacher_params: Params, teacher_cfg: ModelConfig,
+                  student_cfg: ModelConfig, *, steps: int, batch_fn,
+                  learning_rate: float = 1e-3, temperature: float = 1.0,
+                  key: jax.Array | None = None):
+    """Train a small draft against the frozen teacher — a thin driver
+    over distill.make_distill_step(teacher_as_arg=True), the mode
+    tunneled backends require (a closed-over teacher lowers as HLO
+    literal constants that overflow the remote-compile request body
+    past ~100 MB — the same 413 the long-context bench hit). Returns
+    (student_params, final_loss). ``batch_fn(i)`` supplies the step's
+    (B, S) tokens — the TEACHER's training distribution, which is what
+    acceptance is measured on."""
+    from tpu_bootstrap.workload.distill import make_distill_step
+    from tpu_bootstrap.workload.model import init_params
+    from tpu_bootstrap.workload.sharding import MeshConfig, build_mesh
+
+    student = init_params(student_cfg,
+                          jax.random.PRNGKey(1) if key is None else key)
+    mesh = build_mesh(MeshConfig(), jax.devices()[:1])
+    step, opt = make_distill_step(student_cfg, teacher_params, teacher_cfg,
+                                  mesh, learning_rate=learning_rate,
+                                  temperature=temperature,
+                                  teacher_as_arg=True)
+    opt_state = opt.init(student)
+    loss = None
+    for i in range(steps):
+        student, opt_state, loss = step(student, teacher_params, opt_state,
+                                        jnp.asarray(batch_fn(i)))
+    return student, float(loss)
+
+
 def spec_acceptance(target_params: Params, draft_params: Params,
                     cfg: ModelConfig, prompt: jax.Array, *, steps: int = 64,
-                    gamma: int = 4) -> dict:
+                    gamma: int = 4,
+                    draft_cfg: ModelConfig | None = None) -> dict:
     """Measured speculative acceptance of ``draft_params`` proposing for
     ``target_params`` on ``prompt`` (greedy): {"mean_committed",
     "gamma"}. mean_committed / (gamma+1) -> 1 as the draft's argmaxes
     converge to the target's — the trained-model acceptance the int8
-    self-draft claim rests on."""
+    self-draft claim rests on. ``draft_cfg`` for architecture-mismatched
+    drafts (a distilled small student); defaults to the target's."""
     from tpu_bootstrap.workload.speculative import speculative_generate
 
     _, stats = speculative_generate(target_params, draft_params, prompt,
-                                    cfg, cfg, steps, gamma=gamma,
-                                    with_stats=True)
+                                    cfg, draft_cfg or cfg, steps,
+                                    gamma=gamma, with_stats=True)
     return {"mean_committed": round(float(stats["mean_committed"]), 3),
             "gamma": gamma}
 
 
-__all__ = ["markov_batch", "score", "eval_quality", "spec_acceptance"]
+__all__ = ["markov_batch", "score", "eval_quality", "distill_draft",
+           "spec_acceptance"]
