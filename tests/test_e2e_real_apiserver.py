@@ -108,11 +108,12 @@ def kube():
     # Cleanup between tests: CR deletion cascades (owner refs) on a real
     # cluster; namespace GC may take a few seconds, so wait it out to keep
     # tests independent.
-    for name in ("e2e-alice", "e2e-bob"):
+    names = ("e2e-alice", "e2e-bob", "e2e-serve")
+    for name in names:
         k.delete(f"{CR_API}/{name}")
     deadline = time.time() + 60
     while time.time() < deadline:
-        if not k.get("api/v1/namespaces/e2e-alice") and not k.get("api/v1/namespaces/e2e-bob"):
+        if not any(k.get(f"api/v1/namespaces/{n}") for n in names):
             return
         time.sleep(1)
 
@@ -356,3 +357,56 @@ def test_webhook_registered_on_real_apiserver(kube, tmp_path):
         kube.delete(f"{cfg_path}/{cfg_name}")
         if d is not None:
             d.stop()
+
+
+def test_serve_mode_service_on_real_apiserver(kube):
+    """Serve-mode CR against the real apiserver: the controller emits
+    the ClusterIP Service wired to the JobSet's serve port, real SSA
+    accepts it (Service has apiserver-side defaulting/validation the
+    fake cannot prove), and switching serve mode off prunes it."""
+    cr = make_cr("e2e-serve", synced=True)
+    cr["spec"]["tpu"]["env"] = {"WORKLOAD_MODE": "serve"}
+    status, _ = kube.req("POST", CR_API, cr)
+    assert status in (200, 201)
+    # Everything past the POST runs under try/finally: an early assert
+    # must still delete the CR (the fixture cleanup also lists
+    # e2e-serve, belt and braces) and stop the daemon.
+    port = free_port()
+    d = None
+    try:
+        obj = kube.get(f"{CR_API}/e2e-serve")
+        obj["status"] = {"synchronized_with_sheet": True}
+        status, body = kube.req("PUT", f"{CR_API}/e2e-serve/status", obj)
+        assert status == 200, body
+
+        d = Daemon("tpubc-controller",
+                   daemon_env({"CONF_LISTEN_PORT": str(port)}), port)
+        d.wait_healthy()
+        svc = wait_for(
+            lambda: kube.get("api/v1/namespaces/e2e-serve/services/e2e-serve-serve"),
+            timeout=60, desc="serve service")
+        assert svc["spec"]["selector"]["jobset.sigs.k8s.io/jobset-name"] == \
+            "e2e-serve-slice"
+        [p] = svc["spec"]["ports"]
+        assert p["port"] == 80 and p["targetPort"] == 8476
+        js = kube.get(
+            "apis/jobset.x-k8s.io/v1alpha2/namespaces/e2e-serve/jobsets/e2e-serve-slice")
+        env = {e["name"]: e.get("value") for e in
+               js["spec"]["replicatedJobs"][0]["template"]["spec"]["template"]
+               ["spec"]["containers"][0]["env"]}
+        assert env["WORKLOAD_SERVE_PORT"] == "8476"
+
+        # Mode switch off -> the Service is pruned (SSA cannot GC it).
+        obj = kube.get(f"{CR_API}/e2e-serve")
+        obj["spec"]["tpu"]["env"] = {}
+        status, body = kube.req("PUT", f"{CR_API}/e2e-serve", obj)
+        assert status == 200, body
+        wait_for(
+            lambda: kube.get(
+                "api/v1/namespaces/e2e-serve/services/e2e-serve-serve") is None,
+            timeout=60, desc="service pruned")
+    finally:
+        kube.delete(f"{CR_API}/e2e-serve")
+        if d is not None:
+            code, err = d.stop()
+            assert code == 0, err

@@ -251,3 +251,31 @@ def test_serve_service_pruned_on_mode_switch_and_revocation():
     finally:
         ctrl.stop()
         fake.stop()
+
+
+def test_sampled_ingress_reproducible_and_distinct_from_greedy():
+    """Pool-level sampling through the front door: two servers built
+    with the SAME pool key, fed the same requests in the same order,
+    stream identical tokens (per-request PRNG streams keyed by the
+    deterministic rid assignment) — and the draws differ from greedy."""
+    jobs = [([3, 5, 7], 12), ([9, 2], 8), ([4, 4, 4, 4], 10)]
+
+    def run_server(**kw):
+        srv = IngressServer(PARAMS, CFG, port=0, batch_size=2,
+                            host="127.0.0.1", **kw).start()
+        try:
+            # Sequential submission pins the rid order.
+            return [_generate_via_http(srv.port, t, m) for t, m in jobs]
+        finally:
+            srv.stop()
+
+    kw = {"temperature": 1.5, "key": jax.random.PRNGKey(11)}
+    a = run_server(**kw)
+    b = run_server(**kw)
+    assert a == b
+    greedy = run_server()
+    assert a != greedy
+    for outs in (a, greedy):
+        for (tokens, max_new), got in zip(jobs, outs):
+            assert len(got) == max_new
+            assert all(0 <= t < CFG.vocab_size for t in got)

@@ -95,12 +95,13 @@ def make_distill_step(student_cfg: ModelConfig, teacher_params: Params,
             "carries no load-balancing aux); use a dense student_cfg")
     opt = optax.adamw(learning_rate, weight_decay=weight_decay)
 
-    if not degenerate_mesh(mesh):
+    if not degenerate_mesh(mesh) and not teacher_as_arg:
         # The TEACHER — much larger than the student, the premise of
         # draft distillation — is laid out onto the mesh BEFORE the
         # closure captures it: an uncommitted closure constant would be
         # replicated per device, defeating fsdp exactly where
-        # distillation needs it.
+        # distillation needs it. (In teacher_as_arg mode the caller owns
+        # placement; transferring here would be a dead copy.)
         from tpu_bootstrap.workload.sharding import param_shardings
 
         teacher_params = jax.tree.map(

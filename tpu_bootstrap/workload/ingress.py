@@ -56,13 +56,21 @@ class IngressServer:
 
     def __init__(self, params: Params, cfg: ModelConfig, *, port: int,
                  batch_size: int = 8, kv_quant: bool = False,
-                 eos_id: int | None = None,
+                 eos_id: int | None = None, temperature: float = 0.0,
+                 top_k: int = 0, top_p: float = 1.0, key=None,
                  draft_params: Params | None = None,
                  draft_cfg: ModelConfig | None = None, gamma: int = 4,
                  host: str = "0.0.0.0"):
         self.cfg = cfg
+        # Sampling is a POOL property, not per request: temperature is a
+        # static jit argument (one compiled program per value), and the
+        # per-request PRNG streams (keyed by server-assigned rid) make a
+        # request's draw sequence independent of scheduling — but the
+        # temperature itself comes from the slice's env, like the model.
         self.pool = SlotPool(params, cfg, batch_size, kv_quant=kv_quant,
-                             eos_id=eos_id, draft_params=draft_params,
+                             eos_id=eos_id, temperature=temperature,
+                             top_k=top_k, top_p=top_p, key=key,
+                             draft_params=draft_params,
                              draft_cfg=draft_cfg, gamma=gamma)
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)

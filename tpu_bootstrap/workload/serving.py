@@ -398,6 +398,30 @@ def serve_demo_from_env() -> None:
                 "combine it with an UNQUANTIZED target (unset WORKLOAD_QUANT)")
         draft_params, draft_cfg = quant.quantize_params(params), cfg
 
+    # Sampling knobs are pool-level (temperature is a static jit arg and
+    # the per-request key streams hang off one pool key): the CR's env
+    # selects them for the whole serving slice. Greedy (0) remains the
+    # default; sampling composes with the ingress and the demo, but not
+    # with speculative mode (SlotPool rejects that combination loudly).
+    temperature = float(os.environ.get("WORKLOAD_TEMPERATURE", "0"))
+    top_k = int(os.environ.get("WORKLOAD_TOP_K", "0"))
+    top_p = float(os.environ.get("WORKLOAD_TOP_P", "1.0"))
+    if temperature == 0 and (top_k > 0 or top_p < 1.0):
+        # Filters only shape a SAMPLED distribution; at temperature 0
+        # the slice would silently serve greedy output while the
+        # operator believes nucleus/top-k sampling is on — the same
+        # silent-misconfiguration class every other serve knob rejects
+        # loudly.
+        raise ValueError(
+            "WORKLOAD_TOP_K/WORKLOAD_TOP_P require WORKLOAD_TEMPERATURE > 0 "
+            "(greedy decoding ignores the sampling filters)")
+    eos_env = os.environ.get("WORKLOAD_EOS_ID", "")
+    eos_id = int(eos_env) if eos_env else None
+    sample_kw = {"temperature": temperature, "top_k": top_k, "top_p": top_p,
+                 "eos_id": eos_id,
+                 "key": (jax.random.PRNGKey(seed + 1)
+                         if temperature > 0 else None)}
+
     port = int(os.environ.get("WORKLOAD_SERVE_PORT", "0"))
     if port > 0:
         from tpu_bootstrap.workload.ingress import IngressServer
@@ -405,7 +429,7 @@ def serve_demo_from_env() -> None:
         IngressServer(params, cfg, port=port,
                       batch_size=int(os.environ.get("WORKLOAD_SERVE_BATCH", "8")),
                       kv_quant=kv_quant, draft_params=draft_params,
-                      draft_cfg=draft_cfg).serve_forever()
+                      draft_cfg=draft_cfg, **sample_kw).serve_forever()
         return
 
     n = int(os.environ.get("WORKLOAD_REQUESTS", "32"))
@@ -421,7 +445,7 @@ def serve_demo_from_env() -> None:
     stats: dict = {}
     t0 = time.time()
     done = serve(params, cfg, requests, batch, kv_quant=kv_quant, stats=stats,
-                 draft_params=draft_params, draft_cfg=draft_cfg)
+                 draft_params=draft_params, draft_cfg=draft_cfg, **sample_kw)
     dt = time.time() - t0
     total = sum(len(v) for v in done.values())
     util = stats["active_slot_steps"] / max(stats["slot_steps"], 1)
