@@ -591,9 +591,15 @@ def worker_main() -> None:
     WORKLOAD_REMAT (1|true — rematerialize the loss: the long-context
     lever), WORKLOAD_SCHEDULE (gpipe|1f1b), WORKLOAD_MICROBATCHES,
     WORKLOAD_LOG_EVERY (progress-line cadence, default 10, 0 = off).
-    WORKLOAD_MODE=serve switches the slice to the continuous-batching
-    serving demo (serving.serve_demo_from_env: WORKLOAD_QUANT,
-    WORKLOAD_KV_QUANT, WORKLOAD_REQUESTS, WORKLOAD_SERVE_BATCH).
+    WORKLOAD_MODE=serve switches the slice to continuous-batching
+    serving (serving.serve_demo_from_env: WORKLOAD_QUANT,
+    WORKLOAD_KV_QUANT, WORKLOAD_REQUESTS, WORKLOAD_SERVE_BATCH,
+    WORKLOAD_SPECULATIVE for the int8 self-draft verify-commit loop,
+    WORKLOAD_TEMPERATURE / WORKLOAD_TOP_K / WORKLOAD_TOP_P /
+    WORKLOAD_EOS_ID for pool-level sampling). With WORKLOAD_SERVE_PORT
+    set the slice serves live HTTP on that port (workload/ingress.py —
+    the front door the controller's serve-mode Service routes to)
+    instead of running the synthetic demo.
     """
     import os
 
