@@ -279,3 +279,47 @@ def test_sampled_ingress_reproducible_and_distinct_from_greedy():
         for (tokens, max_new), got in zip(jobs, outs):
             assert len(got) == max_new
             assert all(0 <= t < CFG.vocab_size for t in got)
+
+
+def test_engine_survives_a_failed_round_and_reports_health():
+    """A transient backend error inside a scheduling round must not kill
+    the engine: in-flight requests fail LOUDLY (error event, stream
+    closes), /healthz records the error, and the very next request is
+    served normally — the recovery the Service's readiness probe relies
+    on."""
+    srv = IngressServer(PARAMS, CFG, port=0, batch_size=2,
+                        host="127.0.0.1").start()
+    real_step = srv.pool.step_round
+    boom = {"armed": True}
+
+    def flaky_step():
+        if boom["armed"]:
+            boom["armed"] = False
+            raise RuntimeError("injected backend failure")
+        return real_step()
+
+    srv.pool.step_round = flaky_step
+    try:
+        # The in-flight request sees the failure as a terminal error
+        # event: the stream stays HTTP 200 but its last line carries
+        # {"done": true, "error": ...} (read raw — _generate_via_http
+        # asserts success).
+        with _post(srv.port, {"tokens": [1, 2], "max_new": 4}) as resp:
+            lines = [json.loads(ln) for ln in resp if ln.strip()]
+        assert lines[-1]["done"] is True
+        assert "injected backend failure" in lines[-1]["error"]
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz", timeout=30) as r:
+            h = json.loads(r.read())
+        assert h["ok"] is True  # engine alive — that is the point
+        assert "injected backend failure" in h.get("last_error", "")
+
+        # Recovery: the next request decodes end to end, bit-exact.
+        got = _generate_via_http(srv.port, [5, 6], 4)
+        solo = generate(PARAMS, jnp.asarray([[5, 6]], jnp.int32), CFG, 4,
+                        kv_kernel=False)
+        assert got == np.asarray(solo[0]).tolist()
+    finally:
+        srv.pool.step_round = real_step
+        srv.stop()
