@@ -151,3 +151,24 @@ def test_two_process_sharded_train_step_matches_single_process(lib):
     assert losses[0] == losses[1], losses  # replicated scalar
     np.testing.assert_allclose(losses[0], dryrun_mp.reference_loss(),
                                rtol=1e-5)
+
+
+def test_dryrun_mp_failure_surfaces_and_reaps_workers():
+    """A worker that dies at rendezvous (here: an env contract the
+    workers reject) must surface as RuntimeError with the worker's
+    stderr, quickly — and the finally-kill reaps the peer rather than
+    leaving it blocked on the dead coordinator until some distant
+    timeout."""
+    import time as _time
+
+    import pytest
+
+    from tpu_bootstrap.workload import dryrun_mp
+
+    t0 = _time.time()
+    with pytest.raises(RuntimeError) as e:
+        dryrun_mp.run(env_overrides={"TPUBC_NUM_HOSTS": "3"}, timeout=120)
+    assert "worker 0 failed" in str(e.value)
+    # Fast failure, not a collective hang: both workers assert on the
+    # bad contract at startup.
+    assert _time.time() - t0 < 60
