@@ -323,3 +323,23 @@ def test_engine_survives_a_failed_round_and_reports_health():
     finally:
         srv.pool.step_round = real_step
         srv.stop()
+
+
+def test_latency_telemetry_surfaces_in_healthz():
+    """After completed requests, /healthz reports served count and
+    rolling p50 time-to-first-token / total latency — the operator
+    numbers a serving deployment is judged by."""
+    srv = IngressServer(PARAMS, CFG, port=0, batch_size=2,
+                        host="127.0.0.1").start()
+    try:
+        for tokens, max_new in ([1, 2], 4), ([3], 6), ([2, 2, 2], 2):
+            _generate_via_http(srv.port, tokens, max_new)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz", timeout=30) as r:
+            h = json.loads(r.read())
+        assert h["served"] == 3
+        assert h["p50_ttft_ms"] > 0
+        assert h["p50_total_ms"] >= h["p50_ttft_ms"]
+        assert h["active"] == 0 and h["queued"] == 0
+    finally:
+        srv.stop()

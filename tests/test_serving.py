@@ -190,3 +190,24 @@ def test_worker_serve_mode(tmp_path):
     assert "restored checkpoint step" in proc.stdout
     assert "serve done: 6 requests" in proc.stdout
     assert "slot utilization" in proc.stdout
+
+
+def test_replayed_tokens_accounting():
+    """stats['replayed_tokens'] counts the admission price (every round
+    re-prefills each active row's history) — it must equal the sum of
+    per-round history lengths implied by the schedule."""
+    import jax
+
+    from tpu_bootstrap.workload.model import ModelConfig, init_params
+
+    cfg = ModelConfig(vocab_size=64, num_layers=1, num_heads=2, head_dim=8,
+                      embed_dim=16, mlp_dim=32, max_seq_len=64)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    reqs = [Request(rid=0, tokens=[1, 2, 3], max_new=4),
+            Request(rid=1, tokens=[4, 5], max_new=2)]
+    stats = {}
+    serve(params, cfg, reqs, batch_size=2, stats=stats)
+    # Round 1: chunk=2 (min remaining 2), histories 3 and 2 -> 5 replayed.
+    # Round 2: only rid 0 remains, history 5, chunk 2 -> 5 replayed.
+    assert stats["replayed_tokens"] == 10, stats
+    assert stats["rounds"] == 2

@@ -29,8 +29,12 @@ philosophy):
   JSON-lines, one ``{"tokens": [...]}`` object per scheduling round
   and a final ``{"tokens": [...], "done": true}``; stream=false
   answers one ``{"tokens": [all], "done": true}``.
-* ``GET /healthz`` -> ``{"ok": true, "active": A, "queued": Q}`` —
-  the Service readiness probe surface.
+* ``GET /healthz`` -> ``{"ok": bool, "active": A, "queued": Q,
+  "served": N, "p50_ttft_ms": ..., "p50_total_ms": ...,
+  "last_error": ...}`` — the Service readiness probe surface. ``ok``
+  tracks the ENGINE thread (503 when dead); the p50s are rolling
+  windows over the last 256 completions; last_error records the most
+  recent failed round.
 
 Exactness rides the pool's guarantee: a request's concatenated stream
 bit-matches its solo `decode.generate` greedy output regardless of what
@@ -40,9 +44,11 @@ through the speculative verify-commit mode).
 
 from __future__ import annotations
 
+import collections
 import json
 import queue
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from tpu_bootstrap.workload.model import ModelConfig, Params
@@ -79,6 +85,15 @@ class IngressServer:
         self._next_rid = 0
         self._stop = False
         self.last_error: str | None = None  # last failed round, /healthz
+        # Serving latency telemetry: per-rid submit time while in
+        # flight; rolling windows of time-to-first-token and total
+        # latency for completed requests (the operator-facing numbers a
+        # serving deployment is judged by). Maxlen bounds memory on
+        # long-lived slices.
+        self._submit_t: dict = {}   # rid -> (t_submit, t_first or None)
+        self._ttft_ms = collections.deque(maxlen=256)
+        self._total_ms = collections.deque(maxlen=256)
+        self._served = 0
 
         outer = self
 
@@ -96,11 +111,20 @@ class IngressServer:
                     active = sum(1 for s in outer.pool.slots if s is not None)
                     queued = len(outer._pending)
                     last_error = outer.last_error
+                    served = outer._served
+                    ttft = sorted(outer._ttft_ms)
+                    total = sorted(outer._total_ms)
                 # ok tracks the ENGINE, not just the counters: a dead
                 # engine thread means every request will hang, and the
                 # Service's readiness probe must see that.
                 health = {"ok": outer._engine.is_alive(), "active": active,
-                          "queued": queued}
+                          "queued": queued, "served": served}
+                if ttft:
+                    # Rolling p50s over the last 256 completions — the
+                    # numbers a serving deployment is judged by.
+                    health["p50_ttft_ms"] = round(ttft[len(ttft) // 2], 2)
+                if total:
+                    health["p50_total_ms"] = round(total[len(total) // 2], 2)
                 if last_error:
                     health["last_error"] = last_error
                 self._json(200 if health["ok"] else 503, health)
@@ -185,6 +209,7 @@ class IngressServer:
             req.rid = self._next_rid
             self._next_rid += 1
             self._pending.append((req, out_q))
+            self._submit_t[req.rid] = (time.monotonic(), None)
             self._work.notify()
         return out_q
 
@@ -224,13 +249,22 @@ class IngressServer:
                             # the engine alive
                             q.put({"new": [], "done": True, "error": msg,
                                    "generated": s.generated})
+                        self._submit_t.pop(s.rid, None)
                         self.pool.slots[i] = None
                 continue
+            now = time.monotonic()
             with self._work:
                 for rid, ev in events.items():
                     self._streams[rid].put(ev)
+                    t_submit, t_first = self._submit_t.get(rid, (now, None))
+                    if t_first is None and ev["new"]:
+                        self._submit_t[rid] = (t_submit, now)
+                        self._ttft_ms.append((now - t_submit) * 1e3)
                     if ev["done"]:
                         del self._streams[rid]
+                        self._submit_t.pop(rid, None)
+                        self._total_ms.append((now - t_submit) * 1e3)
+                        self._served += 1
 
     # ---- lifecycle -------------------------------------------------------
 

@@ -147,7 +147,8 @@ class SlotPool:
             [jax.random.fold_in(jax.random.fold_in(key, 0), i)
              for i in range(batch_size)] if temperature > 0 else None)
         self.slots: list = [None] * batch_size
-        self.stats = {"rounds": 0, "slot_steps": 0, "active_slot_steps": 0}
+        self.stats = {"rounds": 0, "slot_steps": 0, "active_slot_steps": 0,
+                      "replayed_tokens": 0}
         if draft_params is not None:
             self.stats.update({"verify_rounds": 0, "committed_tokens": 0,
                                "draft_steps": 0})
@@ -260,6 +261,11 @@ class SlotPool:
             out = self._decode_round(batch, lens, chunk)
         out = np.asarray(out)
         self.stats["rounds"] += 1
+        # The admission price, counted: every round re-prefills each
+        # active row's full history (the O(length) cost the slot-step
+        # accounting deliberately excludes) — replayed_tokens makes the
+        # total-work model checkable instead of a docstring claim.
+        self.stats["replayed_tokens"] += sum(len(s.history) for s in active)
         self.stats["slot_steps"] += self.batch_size * chunk
         # chunk <= every active row's remaining by construction, so each
         # active slot consumes exactly chunk steps this round.
@@ -306,11 +312,12 @@ def serve(params: Params, cfg: ModelConfig, requests: list,
     the speculative verify-commit loop (greedy only; output unchanged —
     the exactness test covers both modes with the same oracle).
     ``stats``, if given, is filled with the executed-schedule accounting
-    ({"rounds", "slot_steps", "active_slot_steps"}, plus
-    {"verify_rounds", "committed_tokens", "draft_steps"} in speculative
-    mode) the tests assert utilization with — decode slot-steps only;
-    the history-replay prefills are the (O(length), flash-kernel-served)
-    price of admission."""
+    ({"rounds", "slot_steps", "active_slot_steps", "replayed_tokens"},
+    plus {"verify_rounds", "committed_tokens", "draft_steps"} in
+    speculative mode) the tests assert utilization with — slot-steps
+    count decode work only; replayed_tokens counts the history-replay
+    prefills that are the (O(length), flash-kernel-served) price of
+    admission."""
     if len({r.rid for r in requests}) != len(requests):
         raise ValueError("duplicate request rids (results key by rid)")
     pool = SlotPool(params, cfg, batch_size, kv_quant=kv_quant,
