@@ -93,6 +93,22 @@ def init_cache(cfg: ModelConfig, batch: int, max_len: int, quantized: bool = Fal
     ]
 
 
+def _row_scatter(cache_arr: jax.Array, new: jax.Array, starts: jax.Array):
+    """Per-row cache write: row b of ``new`` lands at ``starts[b]`` in
+    row b of the cache — vmapped dynamic_update_slice, which XLA lowers
+    to a batched in-place scatter (row starts are unique by
+    construction: one slot, one frontier). This is what lets serving
+    keep RESIDENT per-slot caches whose frontiers differ, instead of
+    replaying histories to share one uniform frontier."""
+    if cache_arr.ndim == 4:  # (B, L, Hk, D) values
+        return jax.vmap(
+            lambda c, n, s: lax.dynamic_update_slice(c, n, (s, 0, 0)))(
+                cache_arr, new, starts)
+    return jax.vmap(  # (B, L, Hk) scales
+        lambda c, n, s: lax.dynamic_update_slice(c, n, (s, 0)))(
+            cache_arr, new, starts)
+
+
 def _quantize_kv(x: jax.Array):
     """(B, S, Hk, D) -> int8 values + per-(B, S, Hk) scales. Symmetric
     max-abs scaling over the head_dim axis — one scale per cached vector,
@@ -181,15 +197,27 @@ def _block_step(block: Params, x: jax.Array, cache: dict, positions: jax.Array,
         q = _rotary(q, positions)
         k, v = _project_kv(block, h, positions, cfg)
     start = positions[0] if slot is None else slot
+    # slot as a (B,) VECTOR: per-row frontiers (resident-cache serving)
+    # — each row's KV lands at its own cache position via the batched
+    # scatter; scalar/None slots keep the single-slice fast path.
+    per_row = isinstance(start, jax.Array) and start.ndim == 1
     if "k_scale" in cache:
         kq, ks = _quantize_kv(k)
         vq, vs = _quantize_kv(v)
-        cache = {
-            "k": lax.dynamic_update_slice(cache["k"], kq, (0, start, 0, 0)),
-            "k_scale": lax.dynamic_update_slice(cache["k_scale"], ks, (0, start, 0)),
-            "v": lax.dynamic_update_slice(cache["v"], vq, (0, start, 0, 0)),
-            "v_scale": lax.dynamic_update_slice(cache["v_scale"], vs, (0, start, 0)),
-        }
+        if per_row:
+            cache = {
+                "k": _row_scatter(cache["k"], kq, start),
+                "k_scale": _row_scatter(cache["k_scale"], ks, start),
+                "v": _row_scatter(cache["v"], vq, start),
+                "v_scale": _row_scatter(cache["v_scale"], vs, start),
+            }
+        else:
+            cache = {
+                "k": lax.dynamic_update_slice(cache["k"], kq, (0, start, 0, 0)),
+                "k_scale": lax.dynamic_update_slice(cache["k_scale"], ks, (0, start, 0)),
+                "v": lax.dynamic_update_slice(cache["v"], vq, (0, start, 0, 0)),
+                "v_scale": lax.dynamic_update_slice(cache["v_scale"], vs, (0, start, 0)),
+            }
         if (kv_kernel and q.shape[1] == 1 and valid.ndim == 2
                 and decode_attention.supports(cache["k"].shape[1],
                                               cache["k"].shape[2],
@@ -204,6 +232,12 @@ def _block_step(block: Params, x: jax.Array, cache: dict, positions: jax.Array,
             x = x + _linear(out[:, None], block["wo"], 2, dtype)
             return _mlp_tail(block, x, cfg), cache
         quantized = True
+    elif per_row:
+        cache = {
+            "k": _row_scatter(cache["k"], k, start),
+            "v": _row_scatter(cache["v"], v, start),
+        }
+        quantized = False
     else:
         cache = {
             "k": lax.dynamic_update_slice(cache["k"], k, (0, start, 0, 0)),
@@ -311,9 +345,23 @@ def decode_step(params: Params, token: jax.Array, pos: jax.Array, caches: list,
     """One token (B,) at cache slot `pos` (traced scalar). Returns
     (next-token logits (B, vocab), updated caches). pad: (B,) per-row
     left-pad widths for ragged batches — pad columns stay masked and
-    rotary phases run at pos - pad per row."""
+    rotary phases run at pos - pad per row.
+
+    pos as a (B,) VECTOR (pad must be None) selects the PER-ROW
+    FRONTIER mode for resident-cache serving: row b's token writes cache
+    slot pos[b], attends columns [0, pos[b]], and takes rotary phase
+    pos[b] — rows start at position 0 in their own cache row, so slots
+    differ per row and the cache write is a batched scatter. Columns
+    past a row's frontier may hold a previous occupant's garbage; the
+    mask never admits them, and the row's own later writes overwrite
+    them before its frontier arrives."""
     max_len = caches[0]["k"].shape[1]
-    if pad is None:
+    if pad is None and getattr(pos, "ndim", 0) == 1:
+        positions = pos[:, None]  # (B, 1) true per-row positions
+        cols = jnp.arange(max_len)
+        valid = (cols[None, :] <= pos[:, None])[:, None, :]  # (B, 1, L)
+        slot = pos  # vector -> per-row scatter in _block_step
+    elif pad is None:
         positions = pos[None] if pos.ndim == 0 else pos
         valid = (jnp.arange(max_len) <= positions[0])[None, :]
         slot = None

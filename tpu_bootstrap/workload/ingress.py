@@ -52,7 +52,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from tpu_bootstrap.workload.model import ModelConfig, Params
-from tpu_bootstrap.workload.serving import Request, SlotPool
+from tpu_bootstrap.workload.serving import Request, ResidentPool, SlotPool
 
 
 class IngressServer:
@@ -66,18 +66,28 @@ class IngressServer:
                  top_k: int = 0, top_p: float = 1.0, key=None,
                  draft_params: Params | None = None,
                  draft_cfg: ModelConfig | None = None, gamma: int = 4,
-                 host: str = "0.0.0.0"):
+                 resident: bool = False, host: str = "0.0.0.0"):
         self.cfg = cfg
         # Sampling is a POOL property, not per request: temperature is a
         # static jit argument (one compiled program per value), and the
         # per-request PRNG streams (keyed by server-assigned rid) make a
         # request's draw sequence independent of scheduling — but the
         # temperature itself comes from the slice's env, like the model.
-        self.pool = SlotPool(params, cfg, batch_size, kv_quant=kv_quant,
-                             eos_id=eos_id, temperature=temperature,
-                             top_k=top_k, top_p=top_p, key=key,
-                             draft_params=draft_params,
-                             draft_cfg=draft_cfg, gamma=gamma)
+        if resident:
+            # Resident-cache engine: no history replay, per-row
+            # frontiers (greedy-plain for now — see serving.serve).
+            if temperature > 0 or draft_params is not None:
+                raise ValueError(
+                    "resident serving is greedy-plain for now (sampling "
+                    "and speculative mode run on the replay pool)")
+            self.pool = ResidentPool(params, cfg, batch_size,
+                                     kv_quant=kv_quant, eos_id=eos_id)
+        else:
+            self.pool = SlotPool(params, cfg, batch_size, kv_quant=kv_quant,
+                                 eos_id=eos_id, temperature=temperature,
+                                 top_k=top_k, top_p=top_p, key=key,
+                                 draft_params=draft_params,
+                                 draft_cfg=draft_cfg, gamma=gamma)
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._pending: list = []  # [(Request, out_queue)] awaiting a slot
@@ -221,36 +231,46 @@ class IngressServer:
                     self._work.wait()
                 if self._stop:
                     return
-                # Admission at the round boundary, FIFO.
-                while self._pending and self.pool.free_slots() > 0:
+                # Dequeue this round's admissions under the lock; the
+                # admits themselves run OUTSIDE it — ResidentPool.admit
+                # does real device work (prefill + first-bucket compile,
+                # seconds), and /healthz and _submit must not block on
+                # it. Streams register before admit so the failure path
+                # below can always reach the client.
+                to_admit = []
+                while (self._pending
+                       and self.pool.free_slots() > len(to_admit)):
                     req, out_q = self._pending.pop(0)
-                    self.pool.admit(req)
                     self._streams[req.rid] = out_q
-            # Step OUTSIDE the lock: a decode round is the long pole and
-            # must not block health checks or submissions.
+                    to_admit.append(req)
+            # Admission + the round share one failure domain: either
+            # raises for the same reasons (backend error mid-program),
+            # and the engine must survive both.
             try:
+                for req in to_admit:
+                    self.pool.admit(req)
                 events = self.pool.step_round()
             except Exception as e:  # noqa: BLE001
                 # The engine must SURVIVE a failed round (a transient
                 # backend error would otherwise kill the thread and
                 # leave every client blocked on out_q.get() forever,
-                # with /healthz still green). Fail the in-flight
-                # requests loudly, clear their slots, record the error
-                # for /healthz, and keep serving new traffic.
+                # with /healthz still green). Fail EVERY in-flight
+                # request loudly — including ones whose admit never
+                # finished — reset the pool (the resident engine's
+                # donated caches may be consumed; reset rebuilds them),
+                # record the error for /healthz, and keep serving new
+                # traffic.
                 msg = f"{type(e).__name__}: {e}"[:300]
                 with self._work:
                     self.last_error = msg
-                    for i, s in enumerate(self.pool.slots):
-                        if s is None:
-                            continue
-                        q = self._streams.pop(s.rid, None)
-                        if q is not None:  # a slot without a stream must
-                            # not crash the recovery that exists to keep
-                            # the engine alive
-                            q.put({"new": [], "done": True, "error": msg,
-                                   "generated": s.generated})
-                        self._submit_t.pop(s.rid, None)
-                        self.pool.slots[i] = None
+                    generated = {s.rid: s.generated
+                                 for s in self.pool.slots if s is not None}
+                    for rid, q in list(self._streams.items()):
+                        q.put({"new": [], "done": True, "error": msg,
+                               "generated": generated.get(rid, [])})
+                    self._streams.clear()
+                    self._submit_t.clear()
+                    self.pool.reset()
                 continue
             now = time.monotonic()
             with self._work:
@@ -281,7 +301,9 @@ class IngressServer:
         self._engine.start()
         print(f"ingress: serving on :{self.port} "
               f"(pool={self.pool.batch_size}, "
-              f"speculative={self.pool.draft_params is not None})")
+              f"speculative="
+              f"{getattr(self.pool, 'draft_params', None) is not None}, "
+              f"resident={isinstance(self.pool, ResidentPool)})")
         self.httpd.serve_forever()
 
     def stop(self) -> None:
