@@ -100,17 +100,6 @@ def test_resident_eos_and_utilization():
     assert a == b
 
 
-def test_resident_rejects_sampling_and_speculative():
-    from tpu_bootstrap.workload.quant import quantize_params
-
-    with pytest.raises(ValueError, match="greedy-plain"):
-        serve(PARAMS, CFG, _requests(2), 2, resident=True, temperature=0.5,
-              key=jax.random.PRNGKey(0))
-    with pytest.raises(ValueError, match="greedy-plain"):
-        serve(PARAMS, CFG, _requests(2), 2, resident=True,
-              draft_params=quantize_params(PARAMS), draft_cfg=CFG)
-
-
 def test_resident_through_the_ingress():
     """The front door swaps engines freely: resident-mode HTTP responses
     bit-match solo generation under concurrent clients."""
@@ -153,3 +142,32 @@ def test_resident_through_the_ingress():
             assert results[i] == _solo(tokens, max_new), i
     finally:
         srv.stop()
+
+
+def test_resident_sampled_streams_match_replay_and_solo():
+    """Sampled resident serving draws from the SAME per-request key
+    streams as the replay pool (fold_in(rid-key, stream index)), so the
+    same workload under either engine — or solo with the same row key —
+    yields identical tokens, whatever the scheduling."""
+    key = jax.random.PRNGKey(21)
+    reqs = _requests(6, seed=11)
+    res = serve(PARAMS, CFG, reqs, batch_size=3, resident=True,
+                temperature=0.9, top_k=20, key=key)
+    rep = serve(PARAMS, CFG, reqs, batch_size=2, temperature=0.9, top_k=20,
+                key=key)  # different batch size on purpose
+    assert res == rep
+    for r in reqs:
+        row_key = jax.random.fold_in(jax.random.fold_in(key, 1), r.rid)
+        solo = generate(PARAMS, jnp.asarray([r.tokens], jnp.int32), CFG,
+                        r.max_new, temperature=0.9, top_k=20,
+                        row_keys=jnp.stack([row_key]),
+                        row_key_offsets=jnp.asarray([0], jnp.int32))
+        assert res[r.rid] == np.asarray(solo[0]).tolist(), r.rid
+
+
+def test_resident_rejects_speculative_draft():
+    from tpu_bootstrap.workload.quant import quantize_params
+
+    with pytest.raises(ValueError, match="speculative draft"):
+        serve(PARAMS, CFG, _requests(2), 2, resident=True,
+              draft_params=quantize_params(PARAMS), draft_cfg=CFG)

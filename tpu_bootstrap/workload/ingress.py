@@ -75,13 +75,16 @@ class IngressServer:
         # temperature itself comes from the slice's env, like the model.
         if resident:
             # Resident-cache engine: no history replay, per-row
-            # frontiers (greedy-plain for now — see serving.serve).
-            if temperature > 0 or draft_params is not None:
+            # frontiers; sampling composes (same per-request streams),
+            # the speculative draft stays on the replay pool.
+            if draft_params is not None:
                 raise ValueError(
-                    "resident serving is greedy-plain for now (sampling "
-                    "and speculative mode run on the replay pool)")
+                    "resident serving does not take a speculative draft "
+                    "(the verify-commit loop runs on the replay pool)")
             self.pool = ResidentPool(params, cfg, batch_size,
-                                     kv_quant=kv_quant, eos_id=eos_id)
+                                     kv_quant=kv_quant, eos_id=eos_id,
+                                     temperature=temperature, top_k=top_k,
+                                     top_p=top_p, key=key)
         else:
             self.pool = SlotPool(params, cfg, batch_size, kv_quant=kv_quant,
                                  eos_id=eos_id, temperature=temperature,
