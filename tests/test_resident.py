@@ -171,3 +171,21 @@ def test_resident_rejects_speculative_draft():
     with pytest.raises(ValueError, match="speculative draft"):
         serve(PARAMS, CFG, _requests(2), 2, resident=True,
               draft_params=quantize_params(PARAMS), draft_cfg=CFG)
+
+
+def test_resident_removes_replay_work():
+    """The analytic form of the engine's win: total model work =
+    admission prefill + decode slot-steps. On a long-budget workload the
+    replay pool's per-round history replay dominates; the resident
+    engine's admission-only prefill makes its total a small fraction."""
+    rng = np.random.default_rng(13)
+    reqs = [Request(rid=i, tokens=rng.integers(1, 128, 4).tolist(),
+                    max_new=int(rng.integers(16, 33))) for i in range(8)]
+    rstats: dict = {}
+    sstats: dict = {}
+    res = serve(PARAMS, CFG, reqs, batch_size=4, resident=True, stats=rstats)
+    rep = serve(PARAMS, CFG, reqs, batch_size=4, stats=sstats)
+    assert res == rep
+    resident_work = rstats["prefill_tokens"] + rstats["active_slot_steps"]
+    replay_work = sstats["replayed_tokens"] + sstats["active_slot_steps"]
+    assert resident_work < 0.5 * replay_work, (rstats, sstats)
