@@ -189,3 +189,23 @@ def test_resident_removes_replay_work():
     resident_work = rstats["prefill_tokens"] + rstats["active_slot_steps"]
     replay_work = sstats["replayed_tokens"] + sstats["active_slot_steps"]
     assert resident_work < 0.5 * replay_work, (rstats, sstats)
+
+
+def test_resident_over_sharded_params_matches_single_device():
+    """The resident engine over a MESH-SHARDED model: GSPMD partitions
+    the per-row scatter writes and masked attention like any other op,
+    so the engine is layout-agnostic — tokens equal the single-device
+    run's (and therefore solo generation's)."""
+    from tpu_bootstrap.workload.sharding import (
+        MeshConfig,
+        build_mesh,
+        param_shardings,
+        shard_params,
+    )
+
+    mesh = build_mesh(MeshConfig(data=2, tensor=2))
+    sharded = shard_params(PARAMS, param_shardings(mesh, PARAMS))
+    reqs = _requests(6, seed=17)
+    want = serve(PARAMS, CFG, reqs, batch_size=3, resident=True)
+    got = serve(sharded, CFG, reqs, batch_size=3, resident=True)
+    assert got == want
