@@ -355,15 +355,24 @@ def _paste_row(big, temp, row):
 
 
 @partial(jax.jit,
-         static_argnames=("cfg", "chunk", "temperature", "top_k", "top_p"),
+         static_argnames=("cfg", "chunk", "lb", "temperature", "top_k",
+                          "top_p"),
          donate_argnums=(1,))
-def _resident_chunk(params, caches, last, pos, cfg, chunk,
+def _resident_chunk(params, caches, last, pos, cfg, chunk, lb,
                     temperature=0.0, top_k=0, top_p=1.0,
                     row_keys=None, row_key_offsets=None):
     """``chunk`` decode steps over the RESIDENT caches at per-row
     frontiers ``pos`` (B,): the whole pool advances together, each row
     at its own position, no history replay. Caches are donated — the
     pool owns exactly one copy and threads it through rounds.
+
+    ``lb`` (static, power of two >= every frontier this round will
+    reach) bounds the ATTENTION WINDOW: the round slices cache columns
+    [0, lb) out, decodes over the slab, and splices it back — one
+    2*lb copy instead of chunk full-cap reads. Without it every step
+    would stream the whole cap-length cache, over-reading massively at
+    short histories; with it the per-round read cost matches the replay
+    pool's bucketed widths while still never replaying history.
 
     Sampled mode mirrors decode.generate's row_keys contract exactly:
     token k of row r draws with fold_in(row_keys[r], offsets[r] + k), a
@@ -372,20 +381,28 @@ def _resident_chunk(params, caches, last, pos, cfg, chunk,
     pool and as solo generation with the same row key."""
     from tpu_bootstrap.workload.decode import _filter_logits
 
+    window = [{name: lax.slice_in_dim(arr, 0, lb, axis=1)
+               for name, arr in layer.items()} for layer in caches]
+
     def step(carry, i):
-        tok, caches, p = carry
-        logits, caches = decode_step(params, tok, p, caches, cfg,
-                                     kv_kernel=False)
+        tok, win, p = carry
+        logits, win = decode_step(params, tok, p, win, cfg,
+                                  kv_kernel=False)
         if temperature == 0.0:
             nxt = jnp.argmax(logits, -1).astype(tok.dtype)
         else:
             filt = _filter_logits(logits / temperature, top_k, top_p)
             ks = jax.vmap(jax.random.fold_in)(row_keys, row_key_offsets + i)
             nxt = jax.vmap(jax.random.categorical)(ks, filt).astype(tok.dtype)
-        return (nxt, caches, p + 1), nxt
+        return (nxt, win, p + 1), nxt
 
-    (last, caches, pos), toks = lax.scan(
-        step, (last, caches, pos), jnp.arange(chunk))
+    (last, window, pos), toks = lax.scan(
+        step, (last, window, pos), jnp.arange(chunk))
+    caches = [
+        {name: lax.dynamic_update_slice(arr, window[li][name],
+                                        (0,) * arr.ndim)
+         for name, arr in layer.items()}
+        for li, layer in enumerate(caches)]
     return toks.swapaxes(0, 1), caches, pos
 
 
@@ -490,8 +507,16 @@ class ResidentPool(_PoolBase):
                     [len(s.generated) if s is not None else 0
                      for s in self.slots], jnp.int32),
             }
+        # Attention window for the round: frontiers start at
+        # len(history)-1, so the highest slot any row writes is
+        # len(history) + chunk - 2, needing len(history) + chunk - 1
+        # columns; bucket UP so the compiled set stays O(log), cap at
+        # the cache length.
+        lb = min(_bucket_up(int(max(
+            len(s.history) for s in active)) + chunk - 1),
+            self.cfg.max_seq_len)
         out, self.caches, _ = _resident_chunk(
-            self.params, self.caches, last, pos, self.cfg, chunk,
+            self.params, self.caches, last, pos, self.cfg, chunk, lb,
             **sample_kw)
         out = np.asarray(out)
         self.stats["rounds"] += 1
