@@ -595,6 +595,7 @@ def worker_main() -> None:
     serving (serving.serve_demo_from_env: WORKLOAD_QUANT,
     WORKLOAD_KV_QUANT, WORKLOAD_REQUESTS, WORKLOAD_SERVE_BATCH,
     WORKLOAD_SPECULATIVE for the int8 self-draft verify-commit loop,
+    WORKLOAD_RESIDENT for the replay-free resident-cache engine,
     WORKLOAD_TEMPERATURE / WORKLOAD_TOP_K / WORKLOAD_TOP_P /
     WORKLOAD_EOS_ID for pool-level sampling). With WORKLOAD_SERVE_PORT
     set the slice serves live HTTP on that port (workload/ingress.py —
