@@ -675,6 +675,19 @@ try:
         "serve_replayed_tokens": pstats.get("replayed_tokens", 0),
         "serve_resident_prefill_tokens": resstats.get("prefill_tokens", 0),
     })
+    emit()
+    # Per-row speculative on the resident engine: one target weight
+    # stream per verify round, each row committing its OWN accepted
+    # count (no lockstep min) — the committed-per-stream number is
+    # batch-aggregate and should beat the replay pool's lockstep figure.
+    rs_tps, rsstats = timed_serve(resident=True, draft_params=qparams,
+                                  draft_cfg=dcfg, gamma=4)
+    out.update({
+        "serve_resident_spec_tokens_per_sec": round(rs_tps, 1),
+        "serve_resident_spec_committed_per_stream": round(
+            rsstats["committed_tokens"] / max(rsstats["verify_rounds"], 1),
+            2),
+    })
 except Exception as e:  # noqa: BLE001
     out["serve_bench_error"] = f"{type(e).__name__}: {e}"[:400]
 emit()

@@ -165,12 +165,44 @@ def test_resident_sampled_streams_match_replay_and_solo():
         assert res[r.rid] == np.asarray(solo[0]).tolist(), r.rid
 
 
-def test_resident_rejects_speculative_draft():
+def test_resident_speculative_commits_per_row_and_bit_matches():
+    """Speculative decoding on the resident engine: each row commits its
+    OWN accepted count per verify round (no lockstep min), output stays
+    the target's own greedy argmaxes — bit-matching solo generation AND
+    the replay pool's speculative mode."""
     from tpu_bootstrap.workload.quant import quantize_params
 
-    with pytest.raises(ValueError, match="speculative draft"):
-        serve(PARAMS, CFG, _requests(2), 2, resident=True,
-              draft_params=quantize_params(PARAMS), draft_cfg=CFG)
+    draft = quantize_params(PARAMS)
+    reqs = _requests(8, seed=23)
+    stats: dict = {}
+    res = serve(PARAMS, CFG, reqs, batch_size=4, resident=True,
+                draft_params=draft, draft_cfg=CFG, gamma=3, stats=stats)
+    rep = serve(PARAMS, CFG, reqs, batch_size=4,
+                draft_params=draft, draft_cfg=CFG, gamma=3)
+    assert res == rep
+    for r in reqs:
+        assert res[r.rid] == _solo(r.tokens, r.max_new), r.rid
+    # One target weight stream per round; per-row commits make the
+    # batch-aggregate tokens-per-stream exceed one-per-row trivially.
+    assert stats["verify_rounds"] == stats["rounds"]
+    assert stats["committed_tokens"] == sum(len(v) for v in res.values())
+    assert stats["committed_tokens"] / stats["verify_rounds"] > 1.0
+    assert stats["draft_steps"] == stats["verify_rounds"] * 4
+
+
+def test_resident_speculative_respects_gamma_headroom():
+    """Spec rounds write up to gamma slots past the frontier, so
+    admission must reject budgets that leave no headroom below the
+    cap."""
+    from tpu_bootstrap.workload.quant import quantize_params
+
+    near_cap = Request(rid=0, tokens=[1] * 8, max_new=CFG.max_seq_len - 9)
+    # Fine without a draft...
+    serve(PARAMS, CFG, [near_cap], 1, resident=True)
+    # ...but the speculative pool needs gamma slots of headroom.
+    with pytest.raises(ValueError, match="gamma"):
+        serve(PARAMS, CFG, [near_cap], 1, resident=True,
+              draft_params=quantize_params(PARAMS), draft_cfg=CFG, gamma=4)
 
 
 def test_resident_removes_replay_work():

@@ -76,15 +76,14 @@ class IngressServer:
         if resident:
             # Resident-cache engine: no history replay, per-row
             # frontiers; sampling composes (same per-request streams),
-            # the speculative draft stays on the replay pool.
-            if draft_params is not None:
-                raise ValueError(
-                    "resident serving does not take a speculative draft "
-                    "(the verify-commit loop runs on the replay pool)")
+            # and a speculative draft commits PER ROW instead of the
+            # replay pool's lockstep min.
             self.pool = ResidentPool(params, cfg, batch_size,
                                      kv_quant=kv_quant, eos_id=eos_id,
                                      temperature=temperature, top_k=top_k,
-                                     top_p=top_p, key=key)
+                                     top_p=top_p, key=key,
+                                     draft_params=draft_params,
+                                     draft_cfg=draft_cfg, gamma=gamma)
         else:
             self.pool = SlotPool(params, cfg, batch_size, kv_quant=kv_quant,
                                  eos_id=eos_id, temperature=temperature,
@@ -162,11 +161,14 @@ class IngressServer:
                     return self._json(400, {"error": f"bad request: {e}"})
                 req = Request(rid=-1, tokens=tokens, max_new=max_new)
                 try:
-                    # Validate BEFORE enqueueing: the context-window and
-                    # budget rules must reject at the front door, not
-                    # poison the engine loop. (validate only reads the
-                    # request; the placeholder rid is fine in messages.)
-                    SlotPool.validate(req, outer.cfg)
+                    # Validate BEFORE enqueueing, with the POOL'S OWN
+                    # rules: the context-window/budget checks — and any
+                    # engine-specific ones like the speculative pool's
+                    # gamma headroom — must reject at the front door,
+                    # not poison the engine loop. (validate only reads
+                    # the request; the placeholder rid is fine in
+                    # messages.)
+                    outer.pool.validate(req, outer.cfg)
                 except ValueError as e:
                     return self._json(400, {"error": str(e)})
                 out_q = outer._submit(req)

@@ -40,8 +40,10 @@ decode steps — no O(history) replay. Shape discipline actually
 TIGHTENS: one cache length (cfg.max_seq_len), O(log) admission-prefill
 widths, O(log) chunk sizes. Sampling composes (the same
 per-request key streams as the replay pool, so a request's tokens are
-scheduling-independent either way); the speculative verify-commit loop
-stays on the replay pool.
+scheduling-independent either way), and so does the speculative draft —
+with PER-ROW commits: divergent frontiers let every row keep its own
+accepted count each verify round instead of the replay pool's lockstep
+min over the batch.
 
 Speculative composition (VERDICT r4 weak #4): constructed with
 ``draft_params``, the pool steps each round through
@@ -150,16 +152,21 @@ class _PoolBase:
                 return i
         raise RuntimeError("no free slot (check free_slots before admit)")
 
-    def _emit_events(self, out, chunk: int) -> dict:
+    def _emit_events(self, out, chunk: int, counts=None) -> dict:
         """Fold one round's (B, >=chunk) outputs into slot state:
         extends histories, truncates at eos (a row may decode past its
         eos inside a chunk — the output is cut, the extra steps are the
         chunk granularity's price), retires exhausted rows, and returns
-        {rid: {"new", "done", "generated"}}."""
+        {rid: {"new", "done", "generated"}}. ``counts`` (per-slot kept
+        token counts, already budget-clamped) overrides the uniform
+        ``chunk`` for engines whose rows advance at different rates
+        (per-row speculative commits)."""
         events = {}
         for i, s in enumerate(self.slots):
             if s is None:
                 continue
+            if counts is not None:
+                chunk = counts[i]
             got = out[i, :chunk].tolist()
             s.generated += got
             s.history += got
@@ -406,6 +413,66 @@ def _resident_chunk(params, caches, last, pos, cfg, chunk, lb,
     return toks.swapaxes(0, 1), caches, pos
 
 
+@partial(jax.jit, static_argnames=("cfg", "draft_cfg", "gamma", "lb"),
+         donate_argnums=(1, 2))
+def _resident_spec_round(params, caches, dcaches, draft_params, last, pos,
+                         cfg, draft_cfg, gamma, lb):
+    """One PER-ROW speculative verify-commit round over resident caches:
+    the draft proposes gamma tokens from each row's own frontier, the
+    target scores the (B, gamma+1) chunk in ONE weight stream, and —
+    unlike the replay pool's lockstep loop — each row commits ITS OWN
+    accepted count a_r + 1. Divergent frontiers are exactly what the
+    resident engine supports, so a low-acceptance row no longer
+    throttles the batch.
+
+    Returns (committed (B, gamma+1) target argmaxes, counts (B,),
+    caches, dcaches, next last, next pos). Speculated-but-rejected
+    cache entries beyond each row's new frontier stay masked and are
+    overwritten by that row's own later writes (speculative.py's
+    no-rollback argument, per row)."""
+    window = [{n: lax.slice_in_dim(a, 0, lb, axis=1)
+               for n, a in layer.items()} for layer in caches]
+    dwindow = [{n: lax.slice_in_dim(a, 0, lb, axis=1)
+                for n, a in layer.items()} for layer in dcaches]
+
+    def draft_one(carry, i):
+        tok, dw = carry
+        logits, dw = decode_step(draft_params, tok, pos + i, dw, draft_cfg,
+                                 kv_kernel=False)
+        nxt = jnp.argmax(logits, -1).astype(tok.dtype)
+        return (nxt, dw), nxt
+
+    # gamma+1 draft steps for gamma proposals: the extra step writes the
+    # last proposal's draft KV so full-acceptance rounds leave no cache
+    # hole (speculative.py's draft-cache-hole note, per row).
+    (_, dwindow), drafts = lax.scan(draft_one, (last, dwindow),
+                                    jnp.arange(gamma + 1))
+    drafts = drafts.swapaxes(0, 1)[:, :gamma]  # (B, gamma)
+
+    # The shared verify-chunk forward, in its per-row-frontier mode
+    # (pos as a (B,) vector — see speculative._verify_chunk).
+    from tpu_bootstrap.workload.speculative import _verify_chunk
+
+    chunk = jnp.concatenate([last[:, None], drafts], axis=1)  # (B, gamma+1)
+    vlogits, window = _verify_chunk(params, chunk, pos, window, cfg,
+                                    kv_kernel=False)
+    greedy = jnp.argmax(vlogits, -1).astype(last.dtype)  # (B, gamma+1)
+    # Accepted prefix per row: draft i+1 accepted iff it matches the
+    # target's argmax after chunk position i. Committed tokens are each
+    # row's OWN argmaxes — bit-exact regardless of the draft.
+    match = drafts == greedy[:, :-1]
+    counts = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1) + 1
+
+    caches = [
+        {n: lax.dynamic_update_slice(a, window[li][n], (0,) * a.ndim)
+         for n, a in layer.items()} for li, layer in enumerate(caches)]
+    dcaches = [
+        {n: lax.dynamic_update_slice(a, dwindow[li][n], (0,) * a.ndim)
+         for n, a in layer.items()} for li, layer in enumerate(dcaches)]
+    last2 = jnp.take_along_axis(greedy, counts[:, None] - 1, axis=1)[:, 0]
+    return greedy, counts, caches, dcaches, last2, pos + counts
+
+
 class ResidentPool(_PoolBase):
     """Continuous batching WITHOUT history replay: every slot owns a
     resident region of one cap-length KV cache, rows keep PER-ROW
@@ -418,28 +485,45 @@ class ResidentPool(_PoolBase):
     (cfg.max_seq_len), O(log) prefill widths, O(log) chunk sizes.
 
     Sampling composes (decode.generate's row_keys contract: per-request
-    streams keyed by rid, scheduling-independent); the speculative
-    verify-commit loop stays on SlotPool. Same admit/step_round
-    interface, so serve(resident=True) and the ingress swap pools
-    freely. Exactness oracle unchanged: every request's tokens equal
-    its solo greedy generate() (or its solo row-keyed sampled stream)."""
+    streams keyed by rid, scheduling-independent). The speculative
+    verify-commit loop composes too — BETTER than on the replay pool:
+    divergent frontiers mean each row commits its OWN accepted count
+    per round (no lockstep min over the batch throttling everyone), at
+    one target weight stream per round. Greedy-only with a draft, as
+    everywhere. Same admit/step_round interface, so
+    serve(resident=True) and the ingress swap pools freely. Exactness
+    oracle unchanged: every request's tokens equal its solo greedy
+    generate() (or its solo row-keyed sampled stream)."""
 
     def __init__(self, params: Params, cfg: ModelConfig, batch_size: int, *,
                  kv_quant: bool = False, eos_id: int | None = None,
                  temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
-                 key=None):
+                 key=None, draft_params: Params | None = None,
+                 draft_cfg: ModelConfig | None = None, gamma: int = 4):
         if batch_size < 1:
             raise ValueError(f"batch_size must be >= 1, got {batch_size}")
         if temperature < 0:
             raise ValueError(f"temperature must be >= 0, got {temperature}")
         if temperature > 0 and key is None:
             raise ValueError("temperature > 0 requires an explicit PRNG key")
+        if draft_params is not None:
+            if temperature > 0:
+                raise ValueError(
+                    "speculative serving is greedy-only: sampled "
+                    "speculative draws from a shared key chain, so a "
+                    "request's tokens would depend on its batch cohort")
+            if draft_cfg is None:
+                raise ValueError("draft_params requires draft_cfg")
+            if gamma < 1:
+                raise ValueError(f"gamma must be >= 1, got {gamma}")
         self.params, self.cfg = params, cfg
         self.batch_size = batch_size
         self.kv_quant = kv_quant
         self.eos_id = eos_id
         self.temperature, self.top_k, self.top_p = temperature, top_k, top_p
         self.key = key
+        self.draft_params, self.draft_cfg, self.gamma = (
+            draft_params, draft_cfg, gamma)
         # Same key-domain discipline as SlotPool: dummy rows draw from
         # slot keys in domain 0, requests from rid keys in domain 1.
         self._dummy_keys = (
@@ -447,9 +531,28 @@ class ResidentPool(_PoolBase):
              for i in range(batch_size)] if temperature > 0 else None)
         self.caches = init_cache(cfg, batch_size, cfg.max_seq_len,
                                  quantized=kv_quant)
+        self.dcaches = (init_cache(draft_cfg, batch_size, cfg.max_seq_len,
+                                   quantized=kv_quant)
+                        if draft_params is not None else None)
         self.slots: list = [None] * batch_size
         self.stats = {"rounds": 0, "slot_steps": 0, "active_slot_steps": 0,
                       "prefill_tokens": 0}
+        if draft_params is not None:
+            self.stats.update({"verify_rounds": 0, "committed_tokens": 0,
+                               "draft_steps": 0})
+
+    def validate(self, r: Request, cfg: ModelConfig) -> None:
+        _PoolBase.validate(r, cfg)
+        if self.draft_params is not None:
+            # Speculative rounds overshoot: drafting and verifying write
+            # cache slots up to gamma past a row's frontier, so the
+            # budget must leave that headroom below the cap.
+            if len(r.tokens) + r.max_new + self.gamma > cfg.max_seq_len:
+                raise ValueError(
+                    f"request {r.rid}: prompt + max_new + gamma "
+                    f"({len(r.tokens)} + {r.max_new} + {self.gamma}) "
+                    f"exceeds max_seq_len ({cfg.max_seq_len}); speculative "
+                    "rounds write up to gamma slots past the frontier")
 
     def reset(self) -> None:
         """Abandon every in-flight row AND rebuild the resident buffers:
@@ -461,6 +564,10 @@ class ResidentPool(_PoolBase):
         self.caches = init_cache(self.cfg, self.batch_size,
                                  self.cfg.max_seq_len,
                                  quantized=self.kv_quant)
+        if self.draft_params is not None:
+            self.dcaches = init_cache(self.draft_cfg, self.batch_size,
+                                      self.cfg.max_seq_len,
+                                      quantized=self.kv_quant)
 
     def admit(self, r: Request) -> None:
         self.validate(r, self.cfg)
@@ -472,6 +579,12 @@ class ResidentPool(_PoolBase):
         temp = _prefill_temp(self.params, jnp.asarray(row), self.cfg,
                              self.kv_quant)
         self.caches = _paste_row(self.caches, temp, jnp.int32(i))
+        if self.draft_params is not None:
+            # The draft's resident cache mirrors the target's frontier:
+            # prefill it once at admission too.
+            dtemp = _prefill_temp(self.draft_params, jnp.asarray(row),
+                                  self.draft_cfg, self.kv_quant)
+            self.dcaches = _paste_row(self.dcaches, dtemp, jnp.int32(i))
         self.stats["prefill_tokens"] += len(r.tokens)
         # frontier = the LAST prompt token's position: the first decode
         # step re-feeds that token (idempotent rewrite of its own KV)
@@ -488,13 +601,15 @@ class ResidentPool(_PoolBase):
         active = [s for s in self.slots if s is not None]
         if not active:
             return {}
-        chunk = _bucket_down(min(s.remaining for s in active))
         last = jnp.asarray(
             [s.history[-1] if s is not None else 0 for s in self.slots],
             jnp.int32)
         pos = jnp.asarray(
             [len(s.history) - 1 if s is not None else 0 for s in self.slots],
             jnp.int32)
+        if self.draft_params is not None:
+            return self._spec_round(active, last, pos)
+        chunk = _bucket_down(min(s.remaining for s in active))
         sample_kw = {}
         if self.temperature > 0:
             sample_kw = {
@@ -523,6 +638,37 @@ class ResidentPool(_PoolBase):
         self.stats["slot_steps"] += self.batch_size * chunk
         self.stats["active_slot_steps"] += len(active) * chunk
         return self._emit_events(out, chunk)
+
+    def _spec_round(self, active, last, pos) -> dict:
+        """One per-row verify-commit round: each active row commits its
+        OWN accepted count (1..gamma+1) and its frontier diverges
+        accordingly — the event fold caps the kept tokens at the row's
+        remaining budget (the cache overshoot beyond a retiring row's
+        budget is garbage its successor overwrites)."""
+        # Highest slot a spec round writes: frontier + gamma (the
+        # draft's hole-filling extra step and the verify chunk both top
+        # out there), needing maxhist + gamma columns.
+        lb = min(_bucket_up(int(max(len(s.history) for s in active))
+                            + self.gamma),
+                 self.cfg.max_seq_len)
+        greedy, counts, self.caches, self.dcaches, _, _ = (
+            _resident_spec_round(self.params, self.caches, self.dcaches,
+                                 self.draft_params, last, pos, self.cfg,
+                                 self.draft_cfg, self.gamma, lb))
+        greedy = np.asarray(greedy)
+        counts = np.asarray(counts)
+        self.stats["rounds"] += 1
+        self.stats["verify_rounds"] += 1
+        self.stats["draft_steps"] += self.gamma + 1
+        # Kept = accepted, clamped to each row's budget (the cache
+        # overshoot beyond a retiring row's budget is garbage its slot's
+        # next occupant overwrites).
+        kept = [min(int(counts[i]), s.remaining) if s is not None else 0
+                for i, s in enumerate(self.slots)]
+        self.stats["committed_tokens"] += sum(kept)
+        self.stats["slot_steps"] += sum(kept)
+        self.stats["active_slot_steps"] += sum(kept)
+        return self._emit_events(greedy, 0, counts=kept)
 
 
 def serve(params: Params, cfg: ModelConfig, requests: list,
@@ -558,15 +704,14 @@ def serve(params: Params, cfg: ModelConfig, requests: list,
     if resident:
         # resident=True swaps the replay pool for the resident-cache
         # engine: no per-round history replay, per-row frontiers.
-        # Sampling composes (same per-request key streams); the
-        # speculative verify-commit loop stays replay-only.
-        if draft_params is not None:
-            raise ValueError(
-                "resident serving does not take a speculative draft (the "
-                "verify-commit loop runs on the replay pool)")
+        # Sampling composes (same per-request key streams), and so does
+        # the speculative draft — with PER-ROW commits instead of the
+        # replay pool's lockstep min.
         pool = ResidentPool(params, cfg, batch_size, kv_quant=kv_quant,
                             eos_id=eos_id, temperature=temperature,
-                            top_k=top_k, top_p=top_p, key=key)
+                            top_k=top_k, top_p=top_p, key=key,
+                            draft_params=draft_params, draft_cfg=draft_cfg,
+                            gamma=gamma)
     else:
         pool = SlotPool(params, cfg, batch_size, kv_quant=kv_quant,
                         eos_id=eos_id, temperature=temperature, top_k=top_k,

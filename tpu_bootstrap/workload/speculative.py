@@ -81,16 +81,27 @@ def _verify_chunk(params: Params, tokens: jax.Array, pos, caches: list,
     per-row left-pad widths for RAGGED batches — pad columns stay
     excluded from every mask and rotary phases run at slot - pad per
     row (cache slots stay uniform across rows, exactly as in
-    decode.decode_step's ragged path)."""
+    decode.decode_step's ragged path).
+
+    pos as a (B,) VECTOR (pad must be None) is the PER-ROW FRONTIER
+    mode (resident-cache serving, same contract as decode_step's):
+    row b's chunk occupies slots [pos[b], pos[b]+C) of its own cache
+    row via batched scatter, masks and rotary phases per row."""
     b, c = tokens.shape
     max_len = caches[0]["k"].shape[1]
-    slots = pos + jnp.arange(c)
-    if pad is None:
+    if pad is None and getattr(pos, "ndim", 0) == 1:
+        positions = pos[:, None] + jnp.arange(c)[None, :]  # (B, C)
+        cols = jnp.arange(max_len)
+        valid = cols[None, None, :] <= positions[:, :, None]  # (B, C, L)
+        slot = pos  # vector -> per-row scatter in _block_step
+    elif pad is None:
+        slots = pos + jnp.arange(c)
         positions = slots
         # Chunk row i may see cache columns 0..pos+i.
         valid = jnp.arange(max_len)[None, :] <= slots[:, None]
         slot = None
     else:
+        slots = pos + jnp.arange(c)
         positions = slots[None, :] - pad[:, None]  # (B, C) rotary phases
         cols = jnp.arange(max_len)
         # (B, C, L): col visible iff real (>= pad_b) and causal.
