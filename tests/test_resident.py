@@ -241,3 +241,39 @@ def test_resident_over_sharded_params_matches_single_device():
     want = serve(PARAMS, CFG, reqs, batch_size=3, resident=True)
     got = serve(sharded, CFG, reqs, batch_size=3, resident=True)
     assert got == want
+
+
+def test_spec_resident_ingress_rejects_gamma_overflow_at_front_door():
+    """The front door validates with the POOL'S OWN rules: a request
+    that fits the base context check but lacks the speculative pool's
+    gamma headroom answers 400 — it must not reach the engine loop,
+    where its admission failure would fail every in-flight client."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from tpu_bootstrap.workload.ingress import IngressServer
+    from tpu_bootstrap.workload.quant import quantize_params
+
+    srv = IngressServer(PARAMS, CFG, port=0, batch_size=2, resident=True,
+                        draft_params=quantize_params(PARAMS), draft_cfg=CFG,
+                        gamma=4, host="127.0.0.1").start()
+    try:
+        body = json.dumps({"tokens": [1] * 8,
+                           "max_new": CFG.max_seq_len - 9}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/generate", data=body)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=60)
+        assert e.value.code == 400
+        assert "gamma" in json.loads(e.value.read())["error"]
+        # The engine survived untouched: a well-sized request serves.
+        ok = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/generate",
+            data=json.dumps({"tokens": [5, 6], "max_new": 4,
+                             "stream": False}).encode())
+        with urllib.request.urlopen(ok, timeout=300) as r:
+            out = json.loads(r.read())
+        assert out["done"] and out["tokens"] == _solo([5, 6], 4)
+    finally:
+        srv.stop()
