@@ -1434,8 +1434,199 @@ def webhook_path_bench(k: int = 30):
             fake.stop()
 
 
+# Small CPU workload run for the merged trace: a few real train steps and
+# a decode under tpu_bootstrap.telemetry spans, rooted in the trace id the
+# admission webhook stamped on the CR (passed via TPUBC_TRACE_ID exactly
+# as the JobSet would inject it). Runs in a subprocess so the forced-CPU
+# JAX config never leaks into the caller.
+TRACE_WORKLOAD_SCRIPT = r"""
+import os, sys
+sys.path.insert(0, os.environ["TPUBC_REPO"])
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from tpu_bootstrap import telemetry
+from tpu_bootstrap.workload.model import ModelConfig, init_params
+from tpu_bootstrap.workload.train import TrainConfig, train_loop
+from tpu_bootstrap.workload.decode import generate
+
+cfg = TrainConfig(model=ModelConfig(vocab_size=256, num_layers=2, num_heads=2,
+                                    head_dim=8, embed_dim=16, mlp_dim=32,
+                                    max_seq_len=32))
+with telemetry.span("workload.train", steps=3):
+    train_loop(cfg, 3, log_every=0)
+params = init_params(cfg.model, jax.random.PRNGKey(0))
+prompt = jnp.zeros((2, 4), jnp.int32)
+generate(params, prompt, cfg.model, 4)
+telemetry.tracer().dump(os.environ["TPUBC_TRACE_FILE"])
+print(len(telemetry.tracer().spans()))
+"""
+
+
+def trace_capture(out_path: str):
+    """--trace-out: drive ONE UserBootstrap through the deployed write
+    path (TLS webhook -> fake API server -> controller -> JobSet) with
+    TPUBC_TRACE_FILE set on both daemons, run a small CPU workload under
+    the same trace id, and merge all three Chrome traces into out_path.
+    Prints one JSON summary line."""
+    import base64
+    import ssl
+    import tempfile
+    import urllib.error
+
+    from tpu_bootstrap import telemetry
+    from tpu_bootstrap.fakeapi import FakeKube
+
+    tmp = Path(tempfile.mkdtemp())
+    adm_trace, ctrl_trace, wl_trace = (tmp / "admission.json", tmp / "controller.json",
+                                       tmp / "workload.json")
+    cert, keyf = tmp / "adm.crt", tmp / "adm.key"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(keyf), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=trace-admission"],
+        check=True, capture_output=True)
+
+    fake = FakeKube().start()
+    procs = []
+    try:
+        aport, cport = free_port(), free_port()
+        procs.append(subprocess.Popen(
+            [str(REPO / "native" / "build" / "tpubc-admission")],
+            env={**os.environ, "CONF_LISTEN_ADDR": "127.0.0.1",
+                 "CONF_LISTEN_PORT": str(aport), "CONF_CERT_PATH": str(cert),
+                 "CONF_KEY_PATH": str(keyf),
+                 "CONF_AUTHORIZED_GROUP_NAMES": "tpu,admin",
+                 "TPUBC_TRACE_FILE": str(adm_trace), "TPUBC_LOG": "error"},
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE))
+        procs.append(subprocess.Popen(
+            [str(REPO / "native" / "build" / "tpubc-controller")],
+            env={**os.environ, "CONF_KUBE_API_URL": fake.url,
+                 "CONF_LISTEN_ADDR": "127.0.0.1",
+                 "CONF_LISTEN_PORT": str(cport),
+                 "TPUBC_TRACE_FILE": str(ctrl_trace), "TPUBC_LOG": "error"},
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE))
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        deadline = time.time() + 15
+        while True:
+            try:
+                urllib.request.urlopen(f"https://127.0.0.1:{aport}/health",
+                                       timeout=1, context=ctx)
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise TimeoutError("admission TLS health timeout")
+                time.sleep(0.05)
+        wait_health(cport, procs[1])
+
+        def post(path, body, headers=None):
+            req = urllib.request.Request(
+                fake.url + path, data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json", **(headers or {})},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=15) as r:
+                return json.loads(r.read())
+
+        post("/apis/admissionregistration.k8s.io/v1/mutatingwebhookconfigurations", {
+            "apiVersion": "admissionregistration.k8s.io/v1",
+            "kind": "MutatingWebhookConfiguration",
+            "metadata": {"name": "tpubc-trace"},
+            "webhooks": [{
+                "name": "mutate.tpu.bacchus.io",
+                "clientConfig": {
+                    "url": f"https://127.0.0.1:{aport}/mutate",
+                    "caBundle": base64.b64encode(cert.read_bytes()).decode(),
+                },
+                "rules": [{"apiGroups": ["tpu.bacchus.io"],
+                           "apiVersions": ["v1"],
+                           "resources": ["userbootstraps"],
+                           "operations": ["CREATE", "UPDATE", "DELETE"]}],
+                "failurePolicy": "Fail", "timeoutSeconds": 10,
+            }],
+        })
+        name = "traced"
+        post("/apis/tpu.bacchus.io/v1/userbootstraps",
+             {"apiVersion": "tpu.bacchus.io/v1", "kind": "UserBootstrap",
+              "metadata": {"name": name},
+              "spec": {"tpu": {"accelerator": "tpu-v5-lite-podslice",
+                               "topology": "2x2"}}},
+             headers={"Impersonate-User": f"oidc:{name}",
+                      "Impersonate-Group": "tpu"})
+        req = urllib.request.Request(
+            fake.url + f"/apis/tpu.bacchus.io/v1/userbootstraps/{name}/status",
+            data=json.dumps({"status": {"synchronized_with_sheet": True}}).encode(),
+            headers={"Content-Type": "application/merge-patch+json"},
+            method="PATCH")
+        urllib.request.urlopen(req, timeout=15)
+        t0 = time.time()
+        while True:
+            with fake.store.lock:
+                js = fake.store.objects.get(KEY_JS(name), {}).get(f"{name}-slice")
+            if js:
+                break
+            if time.time() - t0 > 30:
+                raise TimeoutError("traced CR never produced a JobSet")
+            time.sleep(0.01)
+        trace_id = js["metadata"]["annotations"].get(telemetry.TRACE_ANNOTATION, "")
+
+        # Workload leg, rooted in the SAME trace id (the TPUBC_TRACE_ID
+        # contract the JobSet env carries).
+        wl = subprocess.run(
+            [sys.executable, "-c", TRACE_WORKLOAD_SCRIPT],
+            env={**os.environ, "TPUBC_REPO": str(REPO),
+                 "JAX_PLATFORMS": "cpu",
+                 "TPUBC_TRACE_ID": trace_id or "",
+                 "TPUBC_TRACE_FILE": str(wl_trace)},
+            capture_output=True, timeout=300)
+        if wl.returncode != 0:
+            raise RuntimeError("trace workload failed: "
+                               + wl.stderr.decode()[-400:])
+    finally:
+        # SIGTERM -> graceful shutdown writes each daemon's trace file.
+        for proc in procs:
+            proc.send_signal(signal.SIGTERM)
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        fake.stop()
+
+    merged = telemetry.merge_chrome_traces(
+        out_path, [str(adm_trace), str(ctrl_trace), str(wl_trace)])
+    events = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    processes = sorted({e.get("cat", "?") for e in events})
+    in_trace = [e for e in events
+                if trace_id and e.get("args", {}).get("trace_id") == trace_id]
+    bad = [e for e in events if e.get("dur", 0) < 0 or e.get("ts", 0) <= 0]
+    summary = {
+        "trace_out": str(out_path),
+        "trace_id": trace_id,
+        "span_count": len(events),
+        "processes": processes,
+        "spans_in_propagated_trace": len(in_trace),
+        "negative_or_zero_timestamps": len(bad),
+    }
+    print(json.dumps(summary))
+    return summary
+
+
 def main():
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--trace-out", metavar="PATH",
+                        help="capture one webhook->controller->workload "
+                             "lifecycle and write a merged Chrome trace to "
+                             "PATH instead of running the full bench")
+    args = parser.parse_args()
+
     nativelib.build_native()
+    if args.trace_out:
+        trace_capture(args.trace_out)
+        return
 
     # Workload first (VERDICT r1): the TPU half must not depend on anything
     # the control-plane bench does to the process.

@@ -16,12 +16,14 @@
 #include "tpubc/json.h"
 #include "tpubc/log.h"
 #include "tpubc/runtime.h"
+#include "tpubc/trace.h"
 #include "tpubc/util.h"
 
 using namespace tpubc;
 
 int main() {
   log_init("tpubc-admission");
+  Tracer::instance().set_process_name("tpubc-admission");
   install_signal_handlers();
 
   EnvConfig env;
@@ -62,6 +64,12 @@ int main() {
     if (req.path == "/metrics.json") {
       resp.status = 200;
       resp.body = Metrics::instance().to_json().dump();
+      return resp;
+    }
+    if (req.path == "/traces.json") {
+      resp.status = 200;
+      resp.headers["Content-Type"] = "application/json";
+      resp.body = Tracer::instance().to_json().dump();
       return resp;
     }
     if (req.path == "/mutate" && req.method == "POST") {
@@ -126,6 +134,7 @@ int main() {
   log_info("signal received, starting graceful shutdown");
   server.stop();
   if (reloader.joinable()) reloader.join();
+  Tracer::instance().dump_to_env_file();
   log_info("admission gracefully shut down");
   return 0;
 }

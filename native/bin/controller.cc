@@ -34,6 +34,7 @@
 #include "tpubc/log.h"
 #include "tpubc/reconcile_core.h"
 #include "tpubc/runtime.h"
+#include "tpubc/trace.h"
 #include "tpubc/util.h"
 
 using namespace tpubc;
@@ -351,6 +352,15 @@ bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::s
     return false;
   }
 
+  // The pass's trace span. If admission stamped a trace id onto the CR
+  // the reconcile joins that trace (webhook -> reconcile -> JobSet on
+  // one timeline); otherwise the pass roots a trace of its own. Every
+  // kube.* API-write span below parents under it via the thread-local
+  // span stack (the apply waves pass the ids across threads explicitly).
+  Span pass_span("controller.reconcile",
+                 ub.get("metadata").get("annotations").get_string(kTraceAnnotation));
+  pass_span.attr("name", name);
+
   log_info("reconciling", {{"name", name}});
   const std::string ns = target_namespace(ub);
   std::vector<Json> children = desired_children(ub, cfg.core);
@@ -385,6 +395,8 @@ bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::s
   auto apply_wave = [&](const std::vector<const Json*>& wave) {
     if (wave.size() == 1) {  // no point paying a thread spawn for one call
       try {
+        Span s("controller.apply", pass_span.trace_id(), pass_span.span_id());
+        s.attr("kind", wave[0]->get("kind").as_string());
         Json resp = client.apply(*wave[0], kFieldManager, /*force=*/true);
         Metrics::instance().inc("applies_total");
         if (wave[0]->get("kind").as_string() == "JobSet") {
@@ -402,6 +414,11 @@ bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::s
     std::mutex jobset_mu;
     auto apply_one = [&](size_t i) {
       try {
+        // Wave appliers run on their own threads: no TLS parent there, so
+        // the pass span's ids ride in explicitly and the wave keeps the
+        // one trace.
+        Span s("controller.apply", pass_span.trace_id(), pass_span.span_id());
+        s.attr("kind", wave[i]->get("kind").as_string());
         Json resp = client.apply(*wave[i], kFieldManager, /*force=*/true);
         Metrics::instance().inc("applies_total");
         if (wave[i]->get("kind").as_string() == "JobSet") {
@@ -649,6 +666,7 @@ bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::s
 
 int main() {
   log_init("tpubc-controller");
+  Tracer::instance().set_process_name("tpubc-controller");
   install_signal_handlers();
 
   ControllerConfig cfg = load_config();
@@ -678,6 +696,13 @@ int main() {
     } else if (req.path == "/metrics.json") {
       resp.status = 200;
       resp.body = Metrics::instance().to_json().dump();
+    } else if (req.path == "/traces.json") {
+      // Recent spans with parent links (the Dapper-style view of the
+      // reconcile pipeline), next to /metrics like the tracing and
+      // metrics lineages sit side by side.
+      resp.status = 200;
+      resp.headers["Content-Type"] = "application/json";
+      resp.body = Tracer::instance().to_json().dump();
     } else {
       resp.status = 404;
       resp.body = "not found";
@@ -875,6 +900,9 @@ int main() {
   events.stop();
   if (elector && !lost_leadership) elector->release();
   health.stop();
+  // Chrome-trace dump for offline analysis (and bench.py --trace-out's
+  // merged timeline): best-effort, gated on TPUBC_TRACE_FILE.
+  Tracer::instance().dump_to_env_file();
   // Exit nonzero on leadership loss so the kubelet restarts the pod into
   // standby mode rather than leaving a half-dead replica.
   log_info("controller gracefully shut down");

@@ -31,6 +31,7 @@
 #include "tpubc/reconcile_core.h"
 #include "tpubc/runtime.h"
 #include "tpubc/sheet_core.h"
+#include "tpubc/trace.h"
 #include "tpubc/util.h"
 
 using namespace tpubc;
@@ -135,6 +136,9 @@ bool write_status(KubeClient& client, const std::string& name, const std::string
 
 void run_sync_once(KubeClient& client, const Json& sync_config, SheetSource& sheet,
                    const InventorySource& inventory) {
+  // One span per sync tick; the status/quota API writes inside parent
+  // under it via the thread-local span stack.
+  Span tick_span("synchronizer.sync");
   log_info("starting synchronization");
   std::string csv = sheet.fetch();
   log_info("downloaded csv file", {{"bytes", std::to_string(csv.size())}});
@@ -225,12 +229,16 @@ void run_sync_once(KubeClient& client, const Json& sync_config, SheetSource& she
   }
   Metrics::instance().inc("syncs_total");
   Metrics::instance().set("pool_chips_allocated", plan.get_int("total_chips", 0));
+  tick_span.attr("actions", plan.get("actions").size());
+  tick_span.attr("revocations", plan.get("revocations").size());
+  tick_span.attr("chips", plan.get_int("total_chips", 0));
 }
 
 }  // namespace
 
 int main() {
   log_init("tpubc-synchronizer");
+  Tracer::instance().set_process_name("tpubc-synchronizer");
   install_signal_handlers();
 
   EnvConfig env;
@@ -291,6 +299,10 @@ int main() {
     } else if (req.path == "/metrics.json") {
       resp.status = 200;
       resp.body = Metrics::instance().to_json().dump();
+    } else if (req.path == "/traces.json") {
+      resp.status = 200;
+      resp.headers["Content-Type"] = "application/json";
+      resp.body = Tracer::instance().to_json().dump();
     } else {
       resp.status = 404;
       resp.body = "not found";
@@ -345,6 +357,7 @@ int main() {
   if (holder.joinable()) holder.join();
   if (elector && !lost_leadership) elector->release();
   health.stop();
+  Tracer::instance().dump_to_env_file();
   log_info("synchronizer gracefully shut down");
   return lost_leadership ? 1 : 0;
 }

@@ -70,6 +70,12 @@ class HttpClient {
 
   const Url& base() const { return base_; }
 
+  // Retries taken by THIS thread's most recent request() call (0 or 1 —
+  // the stale-pooled-connection replay). Thread-local so concurrent
+  // callers read their own count; the kube client stamps it onto the
+  // request's trace span.
+  static int last_request_retries();
+
   // Process-level cancel: while *cancel is true, requests waiting on a
   // response fail within ~1s (the DeadlineStream read tick) instead of
   // running out their full deadline — keeps shutdown joins prompt.

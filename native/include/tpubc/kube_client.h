@@ -105,6 +105,12 @@ class KubeClient {
 
  private:
   Json check(const HttpResponse& resp);
+  // All non-streaming verbs funnel through here: one "kube.<verb>" trace
+  // span per API round-trip (method/path/status/retries attributes),
+  // parented under whatever span the calling thread has live (the
+  // reconcile pass, the sheet sync tick, ...).
+  HttpResponse traced(const std::string& method, const std::string& path,
+                      const std::string& body = "", const std::string& content_type = "");
   KubeConfig config_;
   std::unique_ptr<HttpClient> http_;
 };

@@ -2,9 +2,20 @@
 //
 // The reference uses tracing_subscriber's fmt layer with a RUST_LOG env
 // filter (/root/reference/src/controller.rs:217, deployment.yaml:40-41).
-// Same contract here: TPUBC_LOG (or RUST_LOG) selects the max level
-// (error|warn|info|debug|trace, default info); output is one line per
-// event: RFC3339 timestamp, level, target, message, then key=value fields.
+// Same contract here, including env_logger-style per-target directives:
+// TPUBC_LOG (or RUST_LOG) is a comma-separated list of `level` or
+// `target=level` entries — e.g. `info,kube=debug` (daemon at info, the
+// Kubernetes client chatty), `off` (silence). Levels:
+// error|warn|info|debug|trace|off; bare level sets the default.
+// Targets match by prefix, longest directive wins (`kube` covers
+// `kube.watch`).
+//
+// Output is one line per event. Default format: RFC3339 timestamp,
+// level, target, message, then key=value fields. TPUBC_LOG_FORMAT=json
+// switches to one JSON object per line ({"ts","level","target","msg",
+// fields..., "trace_id","span_id" when a span is live}) — the shape log
+// aggregators ingest without a parse rule, correlated with /traces.json
+// by trace_id.
 #pragma once
 
 #include <initializer_list>
@@ -18,10 +29,23 @@ enum class LogLevel { Error = 0, Warn, Info, Debug, Trace };
 void log_init(const std::string& target);  // call once per daemon main()
 LogLevel log_level();
 
+// Effective max level for a target under a directive spec — the pure
+// core of the env filter, exposed for tests (and capi). Returns one of
+// "error"|"warn"|"info"|"debug"|"trace"|"off".
+std::string log_level_for(const std::string& spec, const std::string& target);
+
+// Would an event at this level for this target be emitted? Empty target
+// means the daemon's own (log_init) target.
+bool log_enabled(LogLevel level, const std::string& target = "");
+
 using LogField = std::pair<std::string, std::string>;
 
 void log_event(LogLevel level, const std::string& message,
                std::initializer_list<LogField> fields = {});
+// Same, under an explicit sub-target (e.g. "kube" for the API client) so
+// per-target directives can tune it independently of the daemon default.
+void log_event(LogLevel level, const std::string& target, const std::string& message,
+               std::initializer_list<LogField> fields);
 
 inline void log_error(const std::string& m, std::initializer_list<LogField> f = {}) {
   log_event(LogLevel::Error, m, f);

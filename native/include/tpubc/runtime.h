@@ -9,7 +9,7 @@
 #include <cstdint>
 #include <mutex>
 #include <string>
-#include <utility>
+#include <unordered_map>
 #include <vector>
 
 #include "tpubc/json.h"
@@ -43,9 +43,16 @@ class Metrics {
   void observe(const std::string& name, double value);
   // Quantile estimate from the histogram buckets (linear interpolation
   // within the containing bucket). Returns -1 when the histogram is empty.
+  // A quantile landing in the +Inf overflow bucket is CLAMPED to the last
+  // finite bound — the buckets genuinely don't know how far past it the
+  // observations went, and reporting 2x the bound (the old behavior)
+  // manufactured a precise-looking 20s out of anything >10s. Overflow is
+  // surfaced instead: to_json() adds <name>_overflow when it is nonzero.
   double quantile(const std::string& name, double q) const;
   Json to_json() const;
   std::string to_prometheus() const;
+  // Drop all recorded values (test isolation; the instance is process-global).
+  void reset();
 
  private:
   struct Histogram {
@@ -55,9 +62,13 @@ class Metrics {
   };
   double quantile_locked(const Histogram& h, double q) const;
 
+  // Hash maps, not vectors: inc/set/observe ride every reconcile pass
+  // under one global mutex, and the old linear scans made each hot-path
+  // touch O(#metrics). Render order stays deterministic by sorting the
+  // names at to_json()/to_prometheus() time (scrapes are rare).
   mutable std::mutex mutex_;
-  std::vector<std::pair<std::string, int64_t>> counters_;
-  std::vector<std::pair<std::string, Histogram>> histograms_;
+  std::unordered_map<std::string, int64_t> counters_;
+  std::unordered_map<std::string, Histogram> histograms_;
 };
 
 }  // namespace tpubc

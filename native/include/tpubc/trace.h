@@ -1,0 +1,125 @@
+// In-process span tracer — the Dapper-style request-tracing layer the
+// daemons lack (PAPERS.md): every UserBootstrap's journey through
+// webhook mutation, reconcile passes, and individual API writes becomes
+// a tree of timed spans sharing one trace id, exported three ways:
+//
+//  * GET /traces.json on every daemon (next to /metrics) — recent spans
+//    with parent links, for tests and live debugging;
+//  * TPUBC_TRACE_FILE=<path> — Chrome trace-event JSON written at
+//    graceful shutdown, loadable by Perfetto / chrome://tracing and
+//    merged with the JAX workload's spans by bench.py --trace-out;
+//  * trace_id/span_id fields on TPUBC_LOG_FORMAT=json log lines.
+//
+// Context propagation: the admission webhook stamps kTraceAnnotation
+// onto the mutated CR; the controller picks it up so its reconcile
+// spans (and the JobSet it emits) join the same trace.
+//
+// Cost model: a span is two steady_clock reads plus one mutex'd ring
+// slot on destruction — cheap enough for the reconcile hot path. The
+// buffer is bounded (kDefaultCapacity spans, TPUBC_TRACE_BUFFER
+// overrides); overflow evicts the oldest and counts.
+#pragma once
+
+#include <cstdint>
+#include <mutex>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "tpubc/json.h"
+
+namespace tpubc {
+
+// Annotation carrying the trace id from admission to the controller and
+// onto the emitted JobSet (one id correlates webhook -> reconcile ->
+// slice).
+inline constexpr const char* kTraceAnnotation = "tpu.bacchus.io/trace-id";
+
+struct TraceSpan {
+  std::string trace_id;
+  std::string span_id;
+  std::string parent_id;  // empty = root
+  std::string name;
+  int64_t start_us = 0;  // wall-aligned monotonic microseconds (epoch)
+  int64_t dur_us = 0;
+  std::vector<std::pair<std::string, std::string>> attrs;
+};
+
+// 64-bit random hex ids (Dapper's id width).
+std::string new_trace_id();
+std::string new_span_id();
+
+// Wall-aligned monotonic microseconds: a per-process wall-clock base
+// captured once plus a steady_clock delta. Monotonic within a process
+// (durations never go negative) yet comparable across processes, which
+// is what lets bench.py merge daemon and workload spans on one timeline.
+int64_t trace_now_us();
+
+class Tracer {
+ public:
+  static constexpr size_t kDefaultCapacity = 4096;
+
+  static Tracer& instance();
+
+  void set_process_name(const std::string& name);
+
+  void record(TraceSpan span);
+
+  // {"process": ..., "dropped": N, "spans": [...]} — newest-last.
+  Json to_json() const;
+
+  // Chrome trace-event JSON: {"traceEvents": [...]} of "ph":"X"
+  // complete events plus a process_name metadata record.
+  Json to_chrome() const;
+
+  void reset();
+
+  // Write to_chrome() to TPUBC_TRACE_FILE if set (called by the daemons
+  // at graceful shutdown). Returns false when unset or the write fails.
+  bool dump_to_env_file() const;
+
+ private:
+  Tracer();
+
+  mutable std::mutex mutex_;
+  std::vector<TraceSpan> ring_;
+  size_t capacity_;
+  size_t next_ = 0;     // ring write cursor
+  size_t count_ = 0;    // spans currently buffered (<= capacity_)
+  size_t dropped_ = 0;  // evicted by overflow
+  std::string process_ = "tpubc";
+};
+
+// RAII span guard. Parenting is implicit via a thread-local span stack:
+// a Span constructed while another is live on the same thread becomes
+// its child and shares its trace id. Cross-thread fan-out (the
+// controller's apply waves) passes (trace_id, parent_span_id)
+// explicitly.
+class Span {
+ public:
+  explicit Span(std::string name);
+  // Join an existing trace (empty trace_id = behave like Span(name)).
+  Span(std::string name, std::string trace_id, std::string parent_id = "");
+  ~Span();
+  Span(const Span&) = delete;
+  Span& operator=(const Span&) = delete;
+
+  void attr(const std::string& key, const std::string& value);
+  void attr(const std::string& key, int64_t value);
+
+  const std::string& trace_id() const { return span_.trace_id; }
+  const std::string& span_id() const { return span_.span_id; }
+
+ private:
+  void init(std::string name, std::string trace_id, std::string parent_id);
+
+  TraceSpan span_;
+  int64_t start_steady_us_ = 0;
+  Span* prev_ = nullptr;  // enclosing span on this thread
+};
+
+// Innermost live span on this thread (nullptr if none) — log.cc stamps
+// trace_id/span_id from here onto JSON log lines.
+Span* current_span();
+
+}  // namespace tpubc

@@ -2,6 +2,7 @@
 
 #include "tpubc/crd.h"
 #include "tpubc/topology.h"
+#include "tpubc/trace.h"
 #include "tpubc/util.h"
 
 namespace tpubc {
@@ -93,6 +94,10 @@ Json default_admission_config() {
       {"authorized_group_names", Json::array({Json("tpu"), Json("admin")})},
       {"default_accelerator", "tpu-v5-lite-podslice"},
       {"max_chips_per_user", 0},
+      // Stamp kTraceAnnotation onto mutated CRs so the controller's
+      // reconcile spans join the admission span's trace (Dapper-style
+      // context propagation; set false to opt out).
+      {"trace_propagation", true},
   });
 }
 
@@ -159,6 +164,28 @@ Json mutate(const Json& request, const Json& config) {
   }
 
   Json patches = Json::array();
+
+  // Trace-context propagation (patched FIRST so it rides along even when
+  // later sections add nothing): unless the CR already carries a trace
+  // id, stamp the live admission span's — the controller reads it back
+  // and its reconcile spans join this request's trace.
+  if (config.get_bool("trace_propagation", true)) {
+    const Json& anns = obj.get("metadata").get("annotations");
+    const std::string existing =
+        anns.is_object() ? anns.get_string(kTraceAnnotation) : "";
+    if (existing.empty()) {
+      Span* live = current_span();
+      const std::string tid = live ? live->trace_id() : new_trace_id();
+      if (anns.is_object()) {
+        patches.push_back(patch_op(
+            "add", "/metadata/annotations/" + Json::pointer_escape(kTraceAnnotation),
+            Json(tid)));
+      } else {
+        patches.push_back(patch_op("add", "/metadata/annotations",
+                                   Json::object({{kTraceAnnotation, tid}})));
+      }
+    }
+  }
 
   if (!username.is_admin) {
     // Normal users get their identity stamped in (admission.rs:352-357).
@@ -321,6 +348,10 @@ Json mutate(const Json& request, const Json& config) {
 }
 
 Json mutate_review(const Json& review, const Json& config) {
+  // The webhook-side half of the trace: mutate() injects this span's
+  // trace id into the CR, so this span IS the trace root the
+  // controller's reconcile spans hang off.
+  Span span("admission.mutate");
   Json response;
   const Json& request = review.get("request");
   if (!request.is_object() || request.get_string("uid").empty()) {
@@ -330,12 +361,16 @@ Json mutate_review(const Json& review, const Json& config) {
         {"status", Json::object({{"code", 400}, {"message", "invalid AdmissionReview: no request"}})},
     });
   } else {
+    span.attr("operation", request.get_string("operation"));
+    span.attr("user", request.get("userInfo").get_string("username"));
+    span.attr("object", request.get("object").get("metadata").get_string("name"));
     try {
       response = mutate(request, config);
     } catch (const std::exception& e) {
       response = invalid(request, std::string("admission error: ") + e.what());
     }
   }
+  span.attr("allowed", response.get_bool("allowed", false) ? "true" : "false");
   return Json::object({
       {"apiVersion", "admission.k8s.io/v1"},
       {"kind", "AdmissionReview"},

@@ -13,9 +13,12 @@
 #include "tpubc/crd.h"
 #include "tpubc/google_auth.h"
 #include "tpubc/json.h"
+#include "tpubc/log.h"
 #include "tpubc/reconcile_core.h"
+#include "tpubc/runtime.h"
 #include "tpubc/sheet_core.h"
 #include "tpubc/topology.h"
+#include "tpubc/trace.h"
 #include "tpubc/util.h"
 #include "tpubc/yaml.h"
 
@@ -203,6 +206,75 @@ char* tpubc_sha256_hex(const char* data) {
 
 char* tpubc_base64_encode(const char* data) {
   return guarded([&] { return tpubc::base64_encode(data); });
+}
+
+// ---- telemetry read-back (tracing / metrics / log filtering) --------------
+// The pytest suite drives the SAME tracer/metrics instances the cores
+// above record into: call tpubc_mutate_review, read the span back here.
+
+char* tpubc_trace_dump() {
+  return guarded([] { return tpubc::Tracer::instance().to_json().dump(); });
+}
+
+char* tpubc_trace_chrome() {
+  return guarded([] { return tpubc::Tracer::instance().to_chrome().dump(); });
+}
+
+char* tpubc_trace_reset() {
+  return guarded([] {
+    tpubc::Tracer::instance().reset();
+    return std::string("{}");
+  });
+}
+
+// Record one complete span (test fixture: exercises ring-buffer bounds
+// and parent links without touching a policy core).
+char* tpubc_trace_test_span(const char* name, const char* trace_id, const char* parent_id) {
+  return guarded([&] {
+    tpubc::Span s(name, trace_id, parent_id);
+    return tpubc::Json::object({{"trace_id", s.trace_id()}, {"span_id", s.span_id()}}).dump();
+  });
+}
+
+char* tpubc_metrics_inc(const char* name, const char* delta) {
+  return guarded([&] {
+    tpubc::Metrics::instance().inc(name, std::stoll(delta));
+    return std::string("{}");
+  });
+}
+
+char* tpubc_metrics_observe(const char* name, const char* value) {
+  return guarded([&] {
+    tpubc::Metrics::instance().observe(name, std::stod(value));
+    return std::string("{}");
+  });
+}
+
+char* tpubc_metrics_quantile(const char* name, const char* q) {
+  return guarded([&] {
+    return tpubc::Json(tpubc::Metrics::instance().quantile(name, std::stod(q))).dump();
+  });
+}
+
+char* tpubc_metrics_json() {
+  return guarded([] { return tpubc::Metrics::instance().to_json().dump(); });
+}
+
+char* tpubc_metrics_prometheus() {
+  return guarded([] { return tpubc::Metrics::instance().to_prometheus(); });
+}
+
+char* tpubc_metrics_reset() {
+  return guarded([] {
+    tpubc::Metrics::instance().reset();
+    return std::string("{}");
+  });
+}
+
+// Effective level for a target under a TPUBC_LOG directive spec
+// ("info,kube=debug") — the pure core of the env filter.
+char* tpubc_log_level_for(const char* spec, const char* target) {
+  return guarded([&] { return tpubc::log_level_for(spec, target); });
 }
 
 char* tpubc_base64_decode(const char* data) {

@@ -344,6 +344,12 @@ class DeadlineStream : public Stream {
 
 }  // namespace
 
+namespace {
+thread_local int g_last_retries = 0;
+}  // namespace
+
+int HttpClient::last_request_retries() { return g_last_retries; }
+
 HttpResponse HttpClient::request(const std::string& method, const std::string& path,
                                  const std::string& body, const std::string& content_type,
                                  const std::map<std::string, std::string>& extra_headers,
@@ -355,6 +361,7 @@ HttpResponse HttpClient::request(const std::string& method, const std::string& p
   const auto deadline = std::chrono::steady_clock::now() + std::chrono::seconds(timeout_secs);
 
   for (int attempt = 0;; ++attempt) {
+    g_last_retries = attempt;
     auto conn = attempt == 0 ? take_pooled() : nullptr;
     const bool pooled = conn != nullptr;
     if (!conn) {

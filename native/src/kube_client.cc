@@ -8,6 +8,7 @@
 #include "tpubc/log.h"
 #include "tpubc/reconcile_core.h"
 #include "tpubc/runtime.h"
+#include "tpubc/trace.h"
 #include "tpubc/util.h"
 
 namespace tpubc {
@@ -104,6 +105,24 @@ KubeClient::KubeClient(KubeConfig config) : config_(std::move(config)) {
 
 void KubeClient::set_cancel(std::atomic<bool>* cancel) { http_->set_cancel(cancel); }
 
+HttpResponse KubeClient::traced(const std::string& method, const std::string& path,
+                                const std::string& body, const std::string& content_type) {
+  Span span("kube." + to_lower(method));
+  span.attr("method", method);
+  span.attr("path", path);
+  try {
+    HttpResponse resp =
+        http_->request(method, path, body, content_type, {}, config_.request_timeout_secs);
+    span.attr("status", static_cast<int64_t>(resp.status));
+    span.attr("retries", static_cast<int64_t>(HttpClient::last_request_retries()));
+    return resp;
+  } catch (const std::exception& e) {
+    span.attr("status", "error");
+    span.attr("error", e.what());
+    throw;
+  }
+}
+
 Json KubeClient::check(const HttpResponse& resp) {
   if (!resp.ok()) {
     std::string message = resp.body;
@@ -140,13 +159,12 @@ Json KubeClient::list(const std::string& api_version, const std::string& kind,
     }
     path += "?labelSelector=" + enc;
   }
-  return check(http_->request("GET", path, "", "", {}, config_.request_timeout_secs));
+  return check(traced("GET", path));
 }
 
 Json KubeClient::get(const std::string& api_version, const std::string& kind,
                      const std::string& ns, const std::string& name) {
-  return check(http_->request("GET", resource_path(api_version, kind, ns, name), "", "", {},
-                              config_.request_timeout_secs));
+  return check(traced("GET", resource_path(api_version, kind, ns, name)));
 }
 
 Json KubeClient::apply(const Json& obj, const std::string& field_manager, bool force) {
@@ -158,8 +176,7 @@ Json KubeClient::apply(const Json& obj, const std::string& field_manager, bool f
   std::string path = resource_path(api_version, kind, ns, name);
   path += "?fieldManager=" + field_manager;
   if (force) path += "&force=true";
-  return check(http_->request("PATCH", path, obj.dump(), "application/apply-patch+yaml", {},
-                              config_.request_timeout_secs));
+  return check(traced("PATCH", path, obj.dump(), "application/apply-patch+yaml"));
 }
 
 Json KubeClient::create(const Json& obj) {
@@ -171,8 +188,8 @@ Json KubeClient::create(const Json& obj) {
   // (a real apiserver rejects the cluster-wide POST, fakes may not).
   if (ns.empty() && kind_info(api_version, kind).namespaced)
     throw std::runtime_error("create: " + kind + " object has no metadata.namespace");
-  return check(http_->request("POST", resource_path(api_version, kind, ns, ""), obj.dump(),
-                              "application/json", {}, config_.request_timeout_secs));
+  return check(traced("POST", resource_path(api_version, kind, ns, ""), obj.dump(),
+                      "application/json"));
 }
 
 Json KubeClient::replace(const Json& obj) {
@@ -180,35 +197,33 @@ Json KubeClient::replace(const Json& obj) {
   const std::string kind = obj.get_string("kind");
   const std::string name = obj.get("metadata").get_string("name");
   const std::string ns = obj.get("metadata").get_string("namespace");
-  return check(http_->request("PUT", resource_path(api_version, kind, ns, name), obj.dump(),
-                              "application/json", {}, config_.request_timeout_secs));
+  return check(traced("PUT", resource_path(api_version, kind, ns, name), obj.dump(),
+                      "application/json"));
 }
 
 Json KubeClient::json_patch(const std::string& api_version, const std::string& kind,
                             const std::string& ns, const std::string& name, const Json& patch) {
-  return check(http_->request("PATCH", resource_path(api_version, kind, ns, name), patch.dump(),
-                              "application/json-patch+json", {}, config_.request_timeout_secs));
+  return check(traced("PATCH", resource_path(api_version, kind, ns, name), patch.dump(),
+                      "application/json-patch+json"));
 }
 
 Json KubeClient::replace_status(const std::string& api_version, const std::string& kind,
                                 const std::string& ns, const std::string& name, const Json& obj) {
-  return check(http_->request("PUT", resource_path(api_version, kind, ns, name) + "/status",
-                              obj.dump(), "application/json", {}, config_.request_timeout_secs));
+  return check(traced("PUT", resource_path(api_version, kind, ns, name) + "/status",
+                      obj.dump(), "application/json"));
 }
 
 Json KubeClient::merge_status(const std::string& api_version, const std::string& kind,
                               const std::string& ns, const std::string& name,
                               const Json& status_patch) {
   Json body = Json::object({{"status", status_patch}});
-  return check(http_->request("PATCH", resource_path(api_version, kind, ns, name) + "/status",
-                              body.dump(), "application/merge-patch+json", {},
-                              config_.request_timeout_secs));
+  return check(traced("PATCH", resource_path(api_version, kind, ns, name) + "/status",
+                      body.dump(), "application/merge-patch+json"));
 }
 
 void KubeClient::remove(const std::string& api_version, const std::string& kind,
                         const std::string& ns, const std::string& name) {
-  check(http_->request("DELETE", resource_path(api_version, kind, ns, name), "", "", {},
-                        config_.request_timeout_secs));
+  check(traced("DELETE", resource_path(api_version, kind, ns, name)));
 }
 
 std::string KubeClient::watch(const std::string& api_version, const std::string& kind,
@@ -231,7 +246,7 @@ std::string KubeClient::watch(const std::string& api_version, const std::string&
         } catch (const JsonError& e) {
           // Could be a non-JSON HTTP error body; keep it for diagnostics.
           error_body = line;
-          log_warn("unparseable watch line", {{"error", e.what()}});
+          log_event(LogLevel::Warn, "kube", "unparseable watch line", {{"error", e.what()}});
           return true;
         }
         if (event.get_string("kind") == "Status") {
@@ -246,7 +261,8 @@ std::string KubeClient::watch(const std::string& api_version, const std::string&
             gone = true;  // history expired: caller must re-list
             return false;
           }
-          log_warn("watch error event", {{"message", obj.get_string("message")}});
+          log_event(LogLevel::Warn, "kube", "watch error event",
+                    {{"message", obj.get_string("message")}});
           return true;
         }
         const std::string rv = obj.get("metadata").get_string("resourceVersion");

@@ -3,15 +3,30 @@
 #include <cstdio>
 #include <cstdlib>
 #include <mutex>
+#include <vector>
 
+#include "tpubc/json.h"
+#include "tpubc/trace.h"
 #include "tpubc/util.h"
 
 namespace tpubc {
 
 namespace {
 
+// Levels as ints: -1 = off, 0..4 = Error..Trace.
+constexpr int kOff = -1;
+
+struct Directive {
+  std::string target;  // empty = default
+  int level;
+};
+
 std::string g_target = "tpubc";
-LogLevel g_level = LogLevel::Info;
+// Parsed directive set; g_default is the bare-level entry. Written once
+// at log_init (before threads start), read afterwards.
+int g_default = static_cast<int>(LogLevel::Info);
+std::vector<Directive> g_directives;
+bool g_json = false;
 std::mutex g_mutex;
 
 const char* level_name(LogLevel l) {
@@ -30,13 +45,59 @@ const char* level_name(LogLevel l) {
   return "?";
 }
 
-LogLevel parse_level(const std::string& s) {
-  std::string l = to_lower(s);
-  if (l == "error") return LogLevel::Error;
-  if (l == "warn") return LogLevel::Warn;
-  if (l == "debug") return LogLevel::Debug;
-  if (l == "trace") return LogLevel::Trace;
-  return LogLevel::Info;
+const char* level_word(int l) {
+  switch (l) {
+    case kOff:
+      return "off";
+    case 0:
+      return "error";
+    case 1:
+      return "warn";
+    case 3:
+      return "debug";
+    case 4:
+      return "trace";
+    default:
+      return "info";
+  }
+}
+
+int parse_level(const std::string& s) {
+  std::string l = to_lower(trim(s));
+  if (l == "off" || l == "none") return kOff;
+  if (l == "error") return 0;
+  if (l == "warn") return 1;
+  if (l == "debug") return 3;
+  if (l == "trace") return 4;
+  return 2;  // info (and anything unrecognized)
+}
+
+// Parse `info,kube=debug,http=off` into (default, per-target directives).
+void parse_directives(const std::string& spec, int* dflt, std::vector<Directive>* out) {
+  for (const std::string& raw : split(spec, ',')) {
+    std::string entry = trim(raw);
+    if (entry.empty()) continue;
+    size_t eq = entry.find('=');
+    if (eq == std::string::npos) {
+      *dflt = parse_level(entry);
+    } else {
+      out->push_back({trim(entry.substr(0, eq)), parse_level(entry.substr(eq + 1))});
+    }
+  }
+}
+
+// Longest-prefix-match directive for a target; falls back to default.
+int effective_level(int dflt, const std::vector<Directive>& dirs,
+                    const std::string& target) {
+  int best = dflt;
+  size_t best_len = 0;
+  for (const auto& d : dirs) {
+    if (d.target.size() >= best_len && starts_with(target, d.target)) {
+      best = d.level;
+      best_len = d.target.size();
+    }
+  }
+  return best;
 }
 
 }  // namespace
@@ -45,31 +106,86 @@ void log_init(const std::string& target) {
   g_target = target;
   const char* env = std::getenv("TPUBC_LOG");
   if (!env) env = std::getenv("RUST_LOG");  // honour the reference's knob
-  if (env) g_level = parse_level(env);
+  g_default = static_cast<int>(LogLevel::Info);
+  g_directives.clear();
+  if (env) parse_directives(env, &g_default, &g_directives);
+  const char* fmt = std::getenv("TPUBC_LOG_FORMAT");
+  g_json = fmt && to_lower(fmt) == "json";
 }
 
-LogLevel log_level() { return g_level; }
+LogLevel log_level() {
+  // The coarse global view: the default directive, floored at Error so
+  // the enum stays representable ("off" still suppresses via
+  // log_enabled, which compares against the raw -1).
+  return static_cast<LogLevel>(g_default < 0 ? 0 : g_default);
+}
 
-void log_event(LogLevel level, const std::string& message,
-               std::initializer_list<LogField> fields) {
-  if (static_cast<int>(level) > static_cast<int>(g_level)) return;
-  std::string line = now_rfc3339();
-  line += " ";
-  line += level_name(level);
-  line += " ";
-  line += g_target;
-  line += ": ";
-  line += message;
-  for (const auto& f : fields) {
+std::string log_level_for(const std::string& spec, const std::string& target) {
+  int dflt = static_cast<int>(LogLevel::Info);
+  std::vector<Directive> dirs;
+  parse_directives(spec, &dflt, &dirs);
+  return level_word(effective_level(dflt, dirs, target));
+}
+
+bool log_enabled(LogLevel level, const std::string& target) {
+  int max = effective_level(g_default, g_directives,
+                            target.empty() ? g_target : target);
+  return static_cast<int>(level) <= max;
+}
+
+namespace {
+
+void emit(LogLevel level, const std::string& target, const std::string& message,
+          std::initializer_list<LogField> fields) {
+  std::string line;
+  if (g_json) {
+    Json obj = Json::object({
+        {"ts", now_rfc3339()},
+        {"level", level_word(static_cast<int>(level))},
+        {"target", target},
+        {"msg", message},
+    });
+    for (const auto& f : fields) obj.set(f.first, f.second);
+    // Correlate with /traces.json: a live span stamps its ids.
+    if (Span* s = current_span()) {
+      obj.set("trace_id", s->trace_id());
+      obj.set("span_id", s->span_id());
+    }
+    line = obj.dump();
+    line += "\n";
+  } else {
+    line = now_rfc3339();
     line += " ";
-    line += f.first;
-    line += "=";
-    line += f.second;
+    line += level_name(level);
+    line += " ";
+    line += target;
+    line += ": ";
+    line += message;
+    for (const auto& f : fields) {
+      line += " ";
+      line += f.first;
+      line += "=";
+      line += f.second;
+    }
+    line += "\n";
   }
-  line += "\n";
   std::lock_guard<std::mutex> lock(g_mutex);
   std::fwrite(line.data(), 1, line.size(), stderr);
   std::fflush(stderr);
+}
+
+}  // namespace
+
+void log_event(LogLevel level, const std::string& message,
+               std::initializer_list<LogField> fields) {
+  if (!log_enabled(level)) return;
+  emit(level, g_target, message, fields);
+}
+
+void log_event(LogLevel level, const std::string& target, const std::string& message,
+               std::initializer_list<LogField> fields) {
+  if (!log_enabled(level, target)) return;
+  emit(level, target, message, fields);
 }
 
 }  // namespace tpubc

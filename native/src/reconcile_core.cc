@@ -4,6 +4,7 @@
 
 #include "tpubc/crd.h"
 #include "tpubc/topology.h"
+#include "tpubc/trace.h"
 #include "tpubc/util.h"
 
 namespace tpubc {
@@ -105,6 +106,16 @@ Json build_jobset(const Json& ub, const Json& config) {
       Json::object({{"name", "TPUBC_NUM_HOSTS"}, {"value", std::to_string(geom.hosts)}}),
       Json::object({{"name", "TPUBC_JOBSET_NAME"}, {"value", name}}),
   });
+  // Trace-context propagation, leg 3: the id admission stamped on the CR
+  // rides into the workload's environment, so tpu_bootstrap.telemetry
+  // roots its train/decode/serve spans in the SAME trace as the webhook
+  // and reconcile spans (TPUBC_* is a reserved prefix — users can't
+  // collide with it).
+  const std::string trace_id =
+      ub.get("metadata").get("annotations").get_string(kTraceAnnotation);
+  if (!trace_id.empty()) {
+    env.push_back(Json::object({{"name", "TPUBC_TRACE_ID"}, {"value", trace_id}}));
+  }
   if (slices > 1) {
     // Multislice: the global process space is slices x hosts. Each child
     // Job is one slice; JobSet stamps its index on every pod as the
@@ -255,8 +266,12 @@ Json build_jobset(const Json& ub, const Json& config) {
          // All child jobs of one replicated job land on one ICI-connected
          // slice: JobSet's exclusive-topology annotation pins the gang to a
          // single node pool, the TPU analogue of NCCL clique placement.
-         m.set("annotations", Json::object({{"alpha.jobset.sigs.k8s.io/exclusive-topology",
-                                             "cloud.google.com/gke-nodepool"}}));
+         Json anns = Json::object({{"alpha.jobset.sigs.k8s.io/exclusive-topology",
+                                    "cloud.google.com/gke-nodepool"}});
+         // Carry the CR's trace id onto the emitted JobSet: one id now
+         // correlates webhook -> reconcile -> the materialized slice.
+         if (!trace_id.empty()) anns.set(kTraceAnnotation, trace_id);
+         m.set("annotations", std::move(anns));
          // Stamp the CR spec generation that produced this JobSet.
          // slice_status reads it back so status.slice.observed_generation
          // records which spec an observed outcome belongs to — without the

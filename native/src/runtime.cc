@@ -54,24 +54,12 @@ Metrics& Metrics::instance() {
 
 void Metrics::inc(const std::string& name, int64_t delta) {
   std::lock_guard<std::mutex> lock(mutex_);
-  for (auto& kv : counters_) {
-    if (kv.first == name) {
-      kv.second += delta;
-      return;
-    }
-  }
-  counters_.emplace_back(name, delta);
+  counters_[name] += delta;
 }
 
 void Metrics::set(const std::string& name, int64_t value) {
   std::lock_guard<std::mutex> lock(mutex_);
-  for (auto& kv : counters_) {
-    if (kv.first == name) {
-      kv.second = value;
-      return;
-    }
-  }
-  counters_.emplace_back(name, value);
+  counters_[name] = value;
 }
 
 namespace {
@@ -89,15 +77,8 @@ std::string fmt_double(double v) {
 
 void Metrics::observe(const std::string& name, double value) {
   std::lock_guard<std::mutex> lock(mutex_);
-  Histogram* h = nullptr;
-  for (auto& kv : histograms_) {
-    if (kv.first == name) h = &kv.second;
-  }
-  if (!h) {
-    histograms_.emplace_back(name, Histogram{});
-    h = &histograms_.back().second;
-    h->bucket_counts.assign(kNumBuckets + 1, 0);
-  }
+  Histogram* h = &histograms_[name];
+  if (h->bucket_counts.empty()) h->bucket_counts.assign(kNumBuckets + 1, 0);
   size_t i = 0;
   while (i < kNumBuckets && value > kBuckets[i]) ++i;
   h->bucket_counts[i] += 1;
@@ -113,8 +94,12 @@ double Metrics::quantile_locked(const Histogram& h, double q) const {
   for (size_t i = 0; i <= kNumBuckets; ++i) {
     int64_t in_bucket = h.bucket_counts[i];
     if (seen + in_bucket > rank) {
+      // Overflow bucket: the histogram only knows "past the last bound".
+      // Clamp to that bound instead of inventing 2x it — a p99 of "10s
+      // (clamped)" is honest, "20s" was fiction that hid real blowups.
+      if (i == kNumBuckets) return kBuckets[kNumBuckets - 1];
       double lo = i == 0 ? 0 : kBuckets[i - 1];
-      double hi = i == kNumBuckets ? kBuckets[kNumBuckets - 1] * 2 : kBuckets[i];
+      double hi = kBuckets[i];
       if (in_bucket == 0) return hi;
       double frac = static_cast<double>(rank - seen + 1) / static_cast<double>(in_bucket);
       return lo + (hi - lo) * frac;
@@ -126,21 +111,39 @@ double Metrics::quantile_locked(const Histogram& h, double q) const {
 
 double Metrics::quantile(const std::string& name, double q) const {
   std::lock_guard<std::mutex> lock(mutex_);
-  for (const auto& kv : histograms_) {
-    if (kv.first == name) return quantile_locked(kv.second, q);
-  }
-  return -1;
+  auto it = histograms_.find(name);
+  if (it == histograms_.end()) return -1;
+  return quantile_locked(it->second, q);
 }
+
+namespace {
+// Deterministic render order over the unordered storage: scrapes and
+// tests see sorted names regardless of hash-map iteration order.
+template <typename Map>
+std::vector<const typename Map::value_type*> sorted_entries(const Map& m) {
+  std::vector<const typename Map::value_type*> out;
+  out.reserve(m.size());
+  for (const auto& kv : m) out.push_back(&kv);
+  std::sort(out.begin(), out.end(),
+            [](const auto* a, const auto* b) { return a->first < b->first; });
+  return out;
+}
+}  // namespace
 
 Json Metrics::to_json() const {
   std::lock_guard<std::mutex> lock(mutex_);
   Json out = Json::object();
-  for (const auto& kv : counters_) out.set(kv.first, kv.second);
-  for (const auto& kv : histograms_) {
-    out.set(kv.first + "_count", kv.second.count);
-    out.set(kv.first + "_sum", kv.second.sum);
-    out.set(kv.first + "_p50", quantile_locked(kv.second, 0.50));
-    out.set(kv.first + "_p99", quantile_locked(kv.second, 0.99));
+  for (const auto* kv : sorted_entries(counters_)) out.set(kv->first, kv->second);
+  for (const auto* kv : sorted_entries(histograms_)) {
+    const Histogram& h = kv->second;
+    out.set(kv->first + "_count", h.count);
+    out.set(kv->first + "_sum", h.sum);
+    out.set(kv->first + "_p50", quantile_locked(h, 0.50));
+    out.set(kv->first + "_p99", quantile_locked(h, 0.99));
+    // Observations past the last finite bound: the quantiles above are
+    // clamped whenever this is nonzero, so surface the evidence.
+    const int64_t overflow = h.bucket_counts.empty() ? 0 : h.bucket_counts[kNumBuckets];
+    if (overflow > 0) out.set(kv->first + "_overflow", overflow);
   }
   return out;
 }
@@ -148,29 +151,35 @@ Json Metrics::to_json() const {
 std::string Metrics::to_prometheus() const {
   std::lock_guard<std::mutex> lock(mutex_);
   std::string out;
-  for (const auto& kv : counters_) {
-    const bool counter = kv.first.size() > 6 &&
-                         kv.first.compare(kv.first.size() - 6, 6, "_total") == 0;
+  for (const auto* kv : sorted_entries(counters_)) {
+    const bool counter = kv->first.size() > 6 &&
+                         kv->first.compare(kv->first.size() - 6, 6, "_total") == 0;
     // Prometheus counter metric names are exposed WITH the _total suffix;
     // the TYPE line names the metric family (suffix stripped).
-    std::string family = counter ? kv.first.substr(0, kv.first.size() - 6) : kv.first;
+    std::string family = counter ? kv->first.substr(0, kv->first.size() - 6) : kv->first;
     out += "# TYPE " + family + (counter ? " counter\n" : " gauge\n");
-    out += kv.first + " " + std::to_string(kv.second) + "\n";
+    out += kv->first + " " + std::to_string(kv->second) + "\n";
   }
-  for (const auto& kv : histograms_) {
-    const Histogram& h = kv.second;
-    out += "# TYPE " + kv.first + " histogram\n";
+  for (const auto* kv : sorted_entries(histograms_)) {
+    const Histogram& h = kv->second;
+    out += "# TYPE " + kv->first + " histogram\n";
     int64_t cum = 0;
     for (size_t i = 0; i < kNumBuckets; ++i) {
       cum += h.bucket_counts[i];
-      out += kv.first + "_bucket{le=\"" + fmt_double(kBuckets[i]) + "\"} " +
+      out += kv->first + "_bucket{le=\"" + fmt_double(kBuckets[i]) + "\"} " +
              std::to_string(cum) + "\n";
     }
-    out += kv.first + "_bucket{le=\"+Inf\"} " + std::to_string(h.count) + "\n";
-    out += kv.first + "_sum " + fmt_double(h.sum) + "\n";
-    out += kv.first + "_count " + std::to_string(h.count) + "\n";
+    out += kv->first + "_bucket{le=\"+Inf\"} " + std::to_string(h.count) + "\n";
+    out += kv->first + "_sum " + fmt_double(h.sum) + "\n";
+    out += kv->first + "_count " + std::to_string(h.count) + "\n";
   }
   return out;
+}
+
+void Metrics::reset() {
+  std::lock_guard<std::mutex> lock(mutex_);
+  counters_.clear();
+  histograms_.clear();
 }
 
 }  // namespace tpubc

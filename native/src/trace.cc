@@ -1,0 +1,218 @@
+#include "tpubc/trace.h"
+
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <functional>
+#include <random>
+#include <thread>
+
+#include "tpubc/runtime.h"
+
+namespace tpubc {
+
+namespace {
+
+// Wall base captured once per process; spans advance it with steady_clock
+// deltas so in-process durations are monotonic while cross-process
+// timestamps still line up on one Chrome-trace timeline.
+struct TimeBase {
+  int64_t wall_us;
+  std::chrono::steady_clock::time_point steady;
+  TimeBase()
+      : wall_us(std::chrono::duration_cast<std::chrono::microseconds>(
+                    std::chrono::system_clock::now().time_since_epoch())
+                    .count()),
+        steady(std::chrono::steady_clock::now()) {}
+};
+
+const TimeBase& time_base() {
+  static TimeBase base;
+  return base;
+}
+
+int64_t steady_us() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now() - time_base().steady)
+      .count();
+}
+
+std::string random_hex64() {
+  // Thread-local generator: id creation sits on the reconcile/admission
+  // hot paths, so no shared lock; seeded per-thread from random_device.
+  thread_local std::mt19937_64 rng(
+      std::random_device{}() ^
+      (std::hash<std::thread::id>{}(std::this_thread::get_id()) << 1));
+  uint64_t v = rng();
+  char buf[17];
+  std::snprintf(buf, sizeof(buf), "%016llx", static_cast<unsigned long long>(v));
+  return buf;
+}
+
+thread_local Span* g_current_span = nullptr;
+
+// Chrome trace tids must be integers; derive a stable one from the trace
+// id so a request's spans share one row even when recorded from several
+// threads.
+int64_t chrome_tid(const std::string& trace_id) {
+  if (trace_id.empty()) return 0;
+  return static_cast<int64_t>(std::hash<std::string>{}(trace_id) & 0x7fffffff);
+}
+
+}  // namespace
+
+std::string new_trace_id() { return random_hex64(); }
+std::string new_span_id() { return random_hex64(); }
+
+int64_t trace_now_us() { return time_base().wall_us + steady_us(); }
+
+Tracer::Tracer() : capacity_(kDefaultCapacity) {
+  if (const char* env = std::getenv("TPUBC_TRACE_BUFFER")) {
+    char* end = nullptr;
+    long v = std::strtol(env, &end, 10);
+    if (end && *end == '\0' && v > 0) capacity_ = static_cast<size_t>(v);
+  }
+  ring_.resize(capacity_);
+}
+
+Tracer& Tracer::instance() {
+  static Tracer t;
+  return t;
+}
+
+void Tracer::set_process_name(const std::string& name) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  process_ = name;
+}
+
+void Tracer::record(TraceSpan span) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  if (count_ == capacity_) ++dropped_;  // cursor slot held the oldest span
+  ring_[next_] = std::move(span);
+  next_ = (next_ + 1) % capacity_;
+  if (count_ < capacity_) ++count_;
+}
+
+Json Tracer::to_json() const {
+  std::lock_guard<std::mutex> lock(mutex_);
+  Json spans = Json::array();
+  // Oldest-first: start at the cursor when the ring has wrapped.
+  size_t start = count_ == capacity_ ? next_ : 0;
+  for (size_t i = 0; i < count_; ++i) {
+    const TraceSpan& s = ring_[(start + i) % capacity_];
+    Json attrs = Json::object();
+    for (const auto& kv : s.attrs) attrs.set(kv.first, kv.second);
+    spans.push_back(Json::object({
+        {"trace_id", s.trace_id},
+        {"span_id", s.span_id},
+        {"parent_id", s.parent_id},
+        {"name", s.name},
+        {"start_us", s.start_us},
+        {"dur_us", s.dur_us},
+        {"attrs", std::move(attrs)},
+    }));
+  }
+  return Json::object({
+      {"process", process_},
+      {"dropped", static_cast<int64_t>(dropped_)},
+      {"spans", std::move(spans)},
+  });
+}
+
+Json Tracer::to_chrome() const {
+  std::lock_guard<std::mutex> lock(mutex_);
+  const int64_t pid = static_cast<int64_t>(getpid());
+  Json events = Json::array();
+  events.push_back(Json::object({
+      {"name", "process_name"},
+      {"ph", "M"},
+      {"pid", pid},
+      {"tid", 0},
+      {"args", Json::object({{"name", process_}})},
+  }));
+  size_t start = count_ == capacity_ ? next_ : 0;
+  for (size_t i = 0; i < count_; ++i) {
+    const TraceSpan& s = ring_[(start + i) % capacity_];
+    Json args = Json::object({
+        {"trace_id", s.trace_id},
+        {"span_id", s.span_id},
+        {"parent_id", s.parent_id},
+    });
+    for (const auto& kv : s.attrs) args.set(kv.first, kv.second);
+    events.push_back(Json::object({
+        {"name", s.name},
+        {"cat", process_},
+        {"ph", "X"},
+        {"ts", s.start_us},
+        {"dur", s.dur_us},
+        {"pid", pid},
+        // One Chrome row per trace keeps a request's spans visually
+        // nested even though they were recorded from several threads.
+        {"tid", chrome_tid(s.trace_id)},
+        {"args", std::move(args)},
+    }));
+  }
+  return Json::object({{"traceEvents", std::move(events)},
+                       {"displayTimeUnit", "ms"}});
+}
+
+void Tracer::reset() {
+  std::lock_guard<std::mutex> lock(mutex_);
+  next_ = count_ = dropped_ = 0;
+}
+
+bool Tracer::dump_to_env_file() const {
+  const char* path = std::getenv("TPUBC_TRACE_FILE");
+  if (!path || !*path) return false;
+  std::string body = to_chrome().dump();
+  std::FILE* f = std::fopen(path, "w");
+  if (!f) return false;
+  size_t n = std::fwrite(body.data(), 1, body.size(), f);
+  std::fclose(f);
+  return n == body.size();
+}
+
+Span::Span(std::string name) { init(std::move(name), "", ""); }
+
+Span::Span(std::string name, std::string trace_id, std::string parent_id) {
+  init(std::move(name), std::move(trace_id), std::move(parent_id));
+}
+
+void Span::init(std::string name, std::string trace_id, std::string parent_id) {
+  span_.name = std::move(name);
+  span_.span_id = new_span_id();
+  if (!trace_id.empty()) {
+    span_.trace_id = std::move(trace_id);
+    span_.parent_id = std::move(parent_id);
+  } else if (g_current_span) {
+    span_.trace_id = g_current_span->trace_id();
+    span_.parent_id = g_current_span->span_id();
+  } else {
+    span_.trace_id = new_trace_id();
+  }
+  start_steady_us_ = steady_us();
+  span_.start_us = time_base().wall_us + start_steady_us_;
+  prev_ = g_current_span;
+  g_current_span = this;
+}
+
+Span::~Span() {
+  span_.dur_us = steady_us() - start_steady_us_;
+  g_current_span = prev_;
+  Tracer::instance().record(std::move(span_));
+  Metrics::instance().inc("trace_spans_total");
+}
+
+void Span::attr(const std::string& key, const std::string& value) {
+  span_.attrs.emplace_back(key, value);
+}
+
+void Span::attr(const std::string& key, int64_t value) {
+  span_.attrs.emplace_back(key, std::to_string(value));
+}
+
+Span* current_span() { return g_current_span; }
+
+}  // namespace tpubc

@@ -18,6 +18,10 @@ from tpu_bootstrap.workload.model import ModelConfig, forward, init_params, loss
 from tpu_bootstrap.workload.ring_attention import make_ring_attention, reference_attention
 from tpu_bootstrap.workload.sharding import MeshConfig, batch_shardings, build_mesh
 from tpu_bootstrap.workload.train import TrainConfig, init_train_state, make_train_step
+# Heavy multi-device composition suite: excluded from the tier-1 budget run
+# (-m 'not slow'); CI's unfiltered pytest run still covers it.
+pytestmark = pytest.mark.slow
+
 
 GQA = ModelConfig(vocab_size=64, num_layers=2, num_heads=4, head_dim=8,
                   embed_dim=32, mlp_dim=64, max_seq_len=16, num_kv_heads=2)

@@ -23,6 +23,10 @@ from pathlib import Path
 import pytest
 
 from tpu_bootstrap.workload.sharding import MeshConfig
+# Heavy multi-device composition suite: excluded from the tier-1 budget run
+# (-m 'not slow'); CI's unfiltered pytest run still covers it.
+pytestmark = pytest.mark.slow
+
 
 REPO = Path(__file__).resolve().parent.parent
 

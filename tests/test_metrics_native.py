@@ -1,0 +1,120 @@
+"""Metrics core unit tests (ISSUE 1 satellites): quantile interpolation,
+the +Inf overflow clamp (the old 2x-last-bound estimate silently read
+20s when observations exceeded 10s), and Prometheus text rendering
+(cumulative buckets, _total counter family naming, deterministic sorted
+order over the hash-map storage)."""
+
+from __future__ import annotations
+
+import re
+
+import pytest
+
+
+@pytest.fixture()
+def metrics(lib):
+    lib.metrics_reset()
+    yield lib
+    lib.metrics_reset()
+
+
+# -- quantiles --------------------------------------------------------------
+
+
+def test_quantile_empty_histogram(metrics):
+    assert metrics.metrics_quantile("nope_ms", 0.5) == -1
+
+
+def test_quantile_interpolates_within_bucket(metrics):
+    # 100 observations all landing in the (10, 25] bucket: quantiles stay
+    # inside it and move with q (linear interpolation).
+    for _ in range(100):
+        metrics.metrics_observe("h_ms", 20)
+    p10 = metrics.metrics_quantile("h_ms", 0.10)
+    p50 = metrics.metrics_quantile("h_ms", 0.50)
+    p99 = metrics.metrics_quantile("h_ms", 0.99)
+    assert 10 < p10 < p50 < p99 <= 25
+
+
+def test_quantile_across_buckets(metrics):
+    # Half in (0,1], half in (100, 250]: the median straddles, p99 lands
+    # in the upper bucket.
+    for _ in range(50):
+        metrics.metrics_observe("h_ms", 0.5)
+    for _ in range(50):
+        metrics.metrics_observe("h_ms", 200)
+    assert metrics.metrics_quantile("h_ms", 0.25) <= 1
+    assert 100 < metrics.metrics_quantile("h_ms", 0.99) <= 250
+
+
+def test_quantile_overflow_clamps_to_last_bound(metrics):
+    # All observations beyond the last bound (10s): p99 must clamp to
+    # 10000, not fabricate 20000.
+    for _ in range(10):
+        metrics.metrics_observe("h_ms", 60000)
+    assert metrics.metrics_quantile("h_ms", 0.99) == 10000
+    assert metrics.metrics_quantile("h_ms", 0.50) == 10000
+    # ...and the overflow is surfaced in the JSON surface.
+    j = metrics.metrics_json()
+    assert j["h_ms_overflow"] == 10
+    assert j["h_ms_p99"] == 10000
+
+
+def test_quantile_mixed_overflow(metrics):
+    # 90% fast, 10% in overflow: p50 interpolates normally, p99 clamps.
+    for _ in range(90):
+        metrics.metrics_observe("h_ms", 3)
+    for _ in range(10):
+        metrics.metrics_observe("h_ms", 99999)
+    assert metrics.metrics_quantile("h_ms", 0.50) <= 5
+    assert metrics.metrics_quantile("h_ms", 0.99) == 10000
+
+
+def test_no_overflow_key_when_none(metrics):
+    metrics.metrics_observe("h_ms", 5)
+    assert "h_ms_overflow" not in metrics.metrics_json()
+
+
+# -- Prometheus text exposition ---------------------------------------------
+
+
+def test_prometheus_counter_family_naming(metrics):
+    metrics.metrics_inc("reconciles_total", 3)
+    metrics.metrics_inc("queue_depth")  # no _total suffix -> gauge
+    text = metrics.metrics_prometheus()
+    assert "# TYPE reconciles counter\nreconciles_total 3\n" in text
+    assert "# TYPE queue_depth gauge\nqueue_depth 1\n" in text
+
+
+def test_prometheus_histogram_cumulative_buckets(metrics):
+    for v in (0.5, 3, 3, 30):
+        metrics.metrics_observe("lat_ms", v)
+    text = metrics.metrics_prometheus()
+    assert "# TYPE lat_ms histogram" in text
+    buckets = dict(re.findall(r'lat_ms_bucket\{le="([^"]+)"\} (\d+)', text))
+    assert buckets["1"] == "1"
+    assert buckets["5"] == "3"      # cumulative: 1 + 2
+    assert buckets["50"] == "4"
+    assert buckets["+Inf"] == "4"   # == count
+    assert "lat_ms_count 4" in text
+    assert "lat_ms_sum 36.5" in text
+
+
+def test_render_order_is_sorted(metrics):
+    # Insertion order scrambled on purpose: the unordered_map storage must
+    # not leak into the exposition (scrape diffs, test determinism).
+    for name in ("zzz_total", "aaa_total", "mmm_total"):
+        metrics.metrics_inc(name)
+    text = metrics.metrics_prometheus()
+    assert text.index("aaa_total") < text.index("mmm_total") < text.index("zzz_total")
+    j = metrics.metrics_json()
+    keys = [k for k in j if k.endswith("_total")]
+    assert keys == sorted(keys)
+
+
+def test_inc_set_roundtrip(metrics):
+    metrics.metrics_inc("c_total", 5)
+    metrics.metrics_inc("c_total", 2)
+    metrics.metrics_inc("g", 9)
+    j = metrics.metrics_json()
+    assert j["c_total"] == 7 and j["g"] == 9

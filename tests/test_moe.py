@@ -23,6 +23,10 @@ from tpu_bootstrap.workload.model import (
 from tpu_bootstrap.workload.moe import expert_capacity, moe_mlp
 from tpu_bootstrap.workload.sharding import MeshConfig, batch_shardings, build_mesh
 from tpu_bootstrap.workload.train import TrainConfig, init_train_state, make_train_step
+# Heavy multi-device composition suite: excluded from the tier-1 budget run
+# (-m 'not slow'); CI's unfiltered pytest run still covers it.
+pytestmark = pytest.mark.slow
+
 
 
 def moe_cfg(**kw):

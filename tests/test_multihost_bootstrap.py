@@ -12,6 +12,7 @@ Two layers:
    job, not ours).
 """
 
+import pytest
 import os
 import socket
 import subprocess
@@ -21,6 +22,10 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 
 from tpu_bootstrap.workload.train import bootstrap_from_env
+
+# Heavy multi-device composition suite: excluded from the tier-1 budget run
+# (-m 'not slow'); CI's unfiltered pytest run still covers it.
+pytestmark = pytest.mark.slow
 
 
 def ub(name="alice", spec=None, status=None):

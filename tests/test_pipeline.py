@@ -20,6 +20,10 @@ from tpu_bootstrap.workload.pipeline import (
 )
 from tpu_bootstrap.workload.sharding import MeshConfig, batch_shardings, build_mesh
 from tpu_bootstrap.workload.train import TrainConfig, init_train_state, make_train_step
+# Heavy multi-device composition suite: excluded from the tier-1 budget run
+# (-m 'not slow'); CI's unfiltered pytest run still covers it.
+pytestmark = pytest.mark.slow
+
 
 MODEL = ModelConfig(vocab_size=64, num_layers=4, num_heads=2, head_dim=8,
                     embed_dim=32, mlp_dim=64, max_seq_len=16)
@@ -697,6 +701,11 @@ def test_1f1b_uses_less_activation_memory_than_gpipe():
         f"{gpipe/1e6:.1f} MB")
 
 
+@pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="old-jax experimental shard_map mis-specs the MoE aux's scalar "
+           "cotangent in AD transpose (fixed by the jax.shard_map rewrite); "
+           "the 1f1b MoE tests cover the composition there")
 @pytest.mark.parametrize("mesh_cfg", [
     MeshConfig(pipe=2, data=2, expert=2),    # pp x dp x ep
     MeshConfig(pipe=2, expert=2, tensor=2),  # pp x ep x tp
@@ -782,6 +791,11 @@ def test_pipeline_moe_aux_matches_per_shard_oracle():
     assert got == pytest.approx(want, rel=2e-5)
 
 
+@pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="old-jax experimental shard_map mis-specs the MoE aux's scalar "
+           "cotangent in AD transpose (fixed by the jax.shard_map rewrite); "
+           "the 1f1b MoE tests cover the composition there")
 def test_pipeline_moe_aux_grads_match_oracle():
     """Gradients THROUGH the aux path (aux_coef > 0): the pipelined loss
     and the same microbatched estimator written as one differentiable

@@ -28,6 +28,10 @@ from tpu_bootstrap.workload.train import (
     make_train_step,
     synthetic_batch,
 )
+# Heavy multi-device composition suite: excluded from the tier-1 budget run
+# (-m 'not slow'); CI's unfiltered pytest run still covers it.
+pytestmark = pytest.mark.slow
+
 
 P = 2  # pipeline stages (mesh pipe axis)
 

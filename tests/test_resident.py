@@ -22,6 +22,10 @@ from tpu_bootstrap.workload.serving import (
     serve,
     static_schedule_slot_steps,
 )
+# Heavy multi-device composition suite: excluded from the tier-1 budget run
+# (-m 'not slow'); CI's unfiltered pytest run still covers it.
+pytestmark = pytest.mark.slow
+
 
 CFG = ModelConfig(vocab_size=128, num_layers=2, num_heads=4, head_dim=16,
                   embed_dim=64, mlp_dim=128, max_seq_len=64)

@@ -17,6 +17,10 @@ from tpu_bootstrap.workload.ring_attention import (
     make_ring_attention,
     reference_attention,
 )
+# Heavy multi-device composition suite: excluded from the tier-1 budget run
+# (-m 'not slow'); CI's unfiltered pytest run still covers it.
+pytestmark = pytest.mark.slow
+
 
 
 def _qkv(key, batch=2, seq=32, heads=4, head_dim=8, dtype=jnp.float32):

@@ -11,6 +11,10 @@ import pytest
 from tpu_bootstrap.workload.model import ModelConfig
 from tpu_bootstrap.workload.sharding import MeshConfig, batch_shardings, build_mesh
 from tpu_bootstrap.workload.train import TrainConfig, init_train_state, make_train_step
+# Heavy multi-device composition suite: excluded from the tier-1 budget run
+# (-m 'not slow'); CI's unfiltered pytest run still covers it.
+pytestmark = pytest.mark.slow
+
 
 MODEL = ModelConfig(vocab_size=64, num_layers=2, num_heads=4, head_dim=8,
                     embed_dim=32, mlp_dim=64, max_seq_len=33)

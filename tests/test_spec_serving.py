@@ -8,6 +8,7 @@ must bit-match its solo greedy `generate` output (greedy speculative is
 bit-identical to the target's own greedy path, so the pool mode cannot
 change any request's tokens)."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -21,6 +22,10 @@ from tpu_bootstrap.workload.serving import (
     static_schedule_slot_steps,
 )
 from tpu_bootstrap.workload.speculative import speculative_generate
+# Heavy multi-device composition suite: excluded from the tier-1 budget run
+# (-m 'not slow'); CI's unfiltered pytest run still covers it.
+pytestmark = pytest.mark.slow
+
 
 CFG = ModelConfig(vocab_size=128, num_layers=2, num_heads=4, head_dim=16,
                   embed_dim=64, mlp_dim=128, max_seq_len=64)

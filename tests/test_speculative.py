@@ -11,6 +11,10 @@ import pytest
 from tpu_bootstrap.workload.decode import generate
 from tpu_bootstrap.workload.model import ModelConfig, init_params
 from tpu_bootstrap.workload.speculative import speculative_generate
+# Heavy multi-device composition suite: excluded from the tier-1 budget run
+# (-m 'not slow'); CI's unfiltered pytest run still covers it.
+pytestmark = pytest.mark.slow
+
 
 TARGET = ModelConfig(vocab_size=64, num_layers=2, num_heads=4, head_dim=8,
                      embed_dim=32, mlp_dim=64, max_seq_len=128)

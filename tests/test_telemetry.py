@@ -1,0 +1,344 @@
+"""End-to-end tracing layer (ISSUE 1 tentpole).
+
+Covers the three legs of a trace and both exporters:
+* the Python workload tracer (tpu_bootstrap/telemetry.py): nesting,
+  parent links, bounded buffer, Chrome export, merge helper;
+* the native tracer through capi: admission spans, the injected
+  trace-id annotation, ring bounds;
+* the deployed daemons: trace-id annotation surviving
+  admission -> controller -> JobSet on the fake API server,
+  /traces.json scrapes, TPUBC_TRACE_FILE Chrome dumps, and
+  TPUBC_LOG_FORMAT=json structured log lines.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import signal
+import subprocess
+import urllib.request
+
+import pytest
+
+from tpu_bootstrap import telemetry
+from tpu_bootstrap.fakeapi import FakeKube, apply_json_patch
+from tests.test_integration_daemons import (
+    KEY_JS,
+    Daemon,
+    controller_env,
+    free_port,
+    wait_for,
+)
+
+TRACE_ANN = telemetry.TRACE_ANNOTATION
+
+
+# -- Python tracer ----------------------------------------------------------
+
+
+def test_span_nesting_and_parent_links():
+    t = telemetry.Tracer(capacity=16)
+    old = telemetry._tracer
+    telemetry._tracer = t
+    try:
+        with telemetry.span("outer", foo="bar") as outer:
+            with telemetry.span("inner") as inner:
+                assert inner.trace_id == outer.trace_id
+                assert inner.parent_id == outer.span_id
+    finally:
+        telemetry._tracer = old
+    spans = t.spans()
+    assert [s.name for s in spans] == ["inner", "outer"]  # close order
+    assert spans[1].attrs == {"foo": "bar"}
+    assert spans[0].dur_us >= 0 and spans[1].dur_us >= spans[0].dur_us
+    assert spans[1].parent_id == ""
+
+
+def test_tracer_ring_is_bounded():
+    t = telemetry.Tracer(capacity=4)
+    for i in range(10):
+        t.add_span(f"s{i}", telemetry.now_us(), 1)
+    assert len(t.spans()) == 4
+    assert t.dropped == 6
+    assert [s.name for s in t.spans()] == ["s6", "s7", "s8", "s9"]
+
+
+def test_chrome_export_shape(tmp_path):
+    t = telemetry.Tracer(capacity=8)
+    t.add_span("a", telemetry.now_us(), 5, trace_id="t1", x=1)
+    doc = t.to_chrome()
+    events = doc["traceEvents"]
+    assert events[0]["ph"] == "M" and events[0]["args"]["name"] == t.process
+    (ev,) = [e for e in events if e["ph"] == "X"]
+    assert ev["name"] == "a" and ev["dur"] == 5 and ev["ts"] > 0
+    assert ev["args"]["trace_id"] == "t1" and ev["args"]["x"] == "1"
+    # dump round-trips through json.load
+    out = tmp_path / "trace.json"
+    t.dump(str(out))
+    assert json.load(open(out)) == doc
+
+
+def test_merge_chrome_traces(tmp_path):
+    a, b = telemetry.Tracer(capacity=4), telemetry.Tracer(capacity=4)
+    a.add_span("a", telemetry.now_us(), 1)
+    b.add_span("b", telemetry.now_us(), 2)
+    pa, pb = tmp_path / "a.json", tmp_path / "b.json"
+    a.dump(str(pa))
+    b.dump(str(pb))
+    out = tmp_path / "merged.json"
+    merged = telemetry.merge_chrome_traces(
+        str(out), [str(pa), str(pb), str(tmp_path / "missing.json")])
+    names = {e["name"] for e in merged["traceEvents"] if e["ph"] == "X"}
+    assert names == {"a", "b"}
+    assert json.load(open(out))["traceEvents"] == merged["traceEvents"]
+
+
+def test_workload_spans_join_propagated_trace(monkeypatch):
+    monkeypatch.setenv(telemetry.TRACE_ID_ENV, "cafe0123cafe0123")
+    monkeypatch.setattr(telemetry, "_root_id", None)
+    t = telemetry.Tracer(capacity=4)
+    old = telemetry._tracer
+    telemetry._tracer = t
+    try:
+        with telemetry.span("workload.step"):
+            pass
+    finally:
+        telemetry._tracer = old
+        telemetry._root_id = None  # don't leak the pinned id to other tests
+    assert t.spans()[0].trace_id == "cafe0123cafe0123"
+
+
+# -- native tracer via capi -------------------------------------------------
+
+
+def admission_review(name="alice"):
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {
+            "uid": "t-1",
+            "operation": "CREATE",
+            "userInfo": {"username": f"oidc:{name}", "groups": ["tpu"]},
+            "object": {
+                "apiVersion": "tpu.bacchus.io/v1",
+                "kind": "UserBootstrap",
+                "metadata": {"name": name},
+                "spec": {"tpu": {"accelerator": "tpu-v5-lite-podslice",
+                                 "topology": "2x2"}},
+            },
+        },
+    }
+
+
+def test_native_admission_span_and_annotation(lib):
+    lib.trace_reset()
+    out = lib.mutate_review(admission_review(), lib.default_admission_config())
+    patch = json.loads(base64.b64decode(out["response"]["patch"]))
+    (ann,) = [p for p in patch if p["path"].startswith("/metadata/annotations")]
+    injected = ann["value"][TRACE_ANN]
+    dump = lib.trace_dump()
+    (span,) = [s for s in dump["spans"] if s["name"] == "admission.mutate"]
+    # The injected annotation IS the admission span's trace id.
+    assert span["trace_id"] == injected
+    assert span["attrs"]["allowed"] == "true"
+    assert span["attrs"]["user"] == "oidc:alice"
+    assert span["dur_us"] >= 0 and span["start_us"] > 0
+    # Chrome exporter emits the same span, json-clean.
+    chrome = lib.trace_chrome()
+    names = [e["name"] for e in chrome["traceEvents"]]
+    assert "process_name" in names and "admission.mutate" in names
+
+
+def test_native_trace_respects_existing_annotation(lib):
+    lib.trace_reset()
+    review = admission_review()
+    review["request"]["object"]["metadata"]["annotations"] = {TRACE_ANN: "feed"}
+    out = lib.mutate_review(review, lib.default_admission_config())
+    patch = json.loads(base64.b64decode(out["response"]["patch"]))
+    assert not [p for p in patch if TRACE_ANN in str(p.get("path", ""))
+                or (isinstance(p.get("value"), dict) and TRACE_ANN in p["value"])]
+
+
+def test_native_trace_propagation_can_be_disabled(lib):
+    cfg = lib.default_admission_config()
+    cfg["trace_propagation"] = False
+    out = lib.mutate_review(admission_review(), cfg)
+    patch = json.loads(base64.b64decode(out["response"]["patch"]))
+    assert not [p for p in patch if "annotations" in p["path"]]
+
+
+def test_native_parent_links_and_reset(lib):
+    lib.trace_reset()
+    root = lib.trace_test_span("root")
+    child = lib.trace_test_span("child", root["trace_id"], root["span_id"])
+    assert child["trace_id"] == root["trace_id"]
+    dump = lib.trace_dump()
+    by_name = {s["name"]: s for s in dump["spans"]}
+    assert by_name["child"]["parent_id"] == by_name["root"]["span_id"]
+    lib.trace_reset()
+    assert lib.trace_dump()["spans"] == []
+
+
+def test_native_ring_bounded(lib):
+    lib.trace_reset()
+    for i in range(4200):  # default capacity 4096
+        lib.trace_test_span(f"s{i}")
+    dump = lib.trace_dump()
+    assert len(dump["spans"]) == 4096
+    assert dump["dropped"] >= 104
+    assert dump["spans"][-1]["name"] == "s4199"  # newest kept
+    lib.trace_reset()
+
+
+# -- log directives ---------------------------------------------------------
+
+
+@pytest.mark.parametrize("spec,target,want", [
+    ("info,kube=debug", "kube", "debug"),
+    ("info,kube=debug", "tpubc-controller", "info"),
+    ("warn", "anything", "warn"),
+    ("off", "anything", "off"),
+    ("error,kube=off", "kube", "off"),
+    ("debug,kube=warn", "kube.watch", "warn"),  # prefix match
+    ("", "x", "info"),  # default
+    ("bogus", "x", "info"),  # unrecognized level falls back to info
+])
+def test_log_directive_levels(lib, spec, target, want):
+    assert lib.log_level_for(spec, target) == want
+
+
+# -- daemons: propagation + endpoints + dumps + json logs -------------------
+
+
+@pytest.fixture()
+def fake():
+    server = FakeKube().start()
+    yield server
+    server.stop()
+
+
+def test_trace_id_survives_admission_to_jobset(fake, tmp_path):
+    """The acceptance path: one trace id from the webhook response through
+    the controller's reconcile to the emitted JobSet's annotation, visible
+    in both daemons' /traces.json."""
+    trace_file = tmp_path / "controller-trace.json"
+    ctl_port, adm_port = free_port(), free_port()
+    ctl = Daemon("tpubc-controller",
+                 {**controller_env(fake, ctl_port),
+                  "TPUBC_TRACE_FILE": str(trace_file)}, ctl_port)
+    adm = Daemon("tpubc-admission",
+                 {"CONF_LISTEN_ADDR": "127.0.0.1",
+                  "CONF_LISTEN_PORT": str(adm_port),
+                  "CONF_TLS_DISABLED": "1",
+                  "CONF_AUTHORIZED_GROUP_NAMES": "tpu,admin"}, adm_port)
+    for d in (ctl, adm):
+        d.wait_healthy()
+    try:
+        review = admission_review("tracey")
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{adm_port}/mutate",
+            data=json.dumps(review).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=5) as r:
+            out = json.loads(r.read())
+        patch = json.loads(base64.b64decode(out["response"]["patch"]))
+        obj = review["request"]["object"]
+        apply_json_patch(obj, patch)
+        trace_id = obj["metadata"]["annotations"][TRACE_ANN]
+        assert trace_id
+        obj.setdefault("status", {})["synchronized_with_sheet"] = True
+        fake.store.upsert(fake.KEY_UB, "tracey", obj)
+
+        js = wait_for(lambda: fake.get(KEY_JS("tracey"), "tracey-slice"),
+                      desc="jobset")
+        # Leg 1 -> 3: the JobSet carries the same id...
+        assert js["metadata"]["annotations"][TRACE_ANN] == trace_id
+        # ...and the worker env gets it (telemetry.py's root id).
+        env = {e["name"]: e.get("value") for e in
+               js["spec"]["replicatedJobs"][0]["template"]["spec"]["template"]
+               ["spec"]["containers"][0]["env"]}
+        assert env["TPUBC_TRACE_ID"] == trace_id
+
+        # /traces.json on both daemons shows the one trace.
+        def scrape(port):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/traces.json", timeout=5) as r:
+                assert r.headers["Content-Type"].startswith("application/json")
+                return json.loads(r.read())
+
+        adm_doc = scrape(adm_port)
+        (aspan,) = [s for s in adm_doc["spans"]
+                    if s["name"] == "admission.mutate"
+                    and s["trace_id"] == trace_id]
+        assert aspan["attrs"]["object"] == "tracey"
+
+        ctl_doc = wait_for(
+            lambda: (lambda d: d if any(
+                s["name"] == "controller.reconcile" and s["trace_id"] == trace_id
+                for s in d["spans"]) else None)(scrape(ctl_port)),
+            desc="reconcile span in /traces.json")
+        spans = ctl_doc["spans"]
+        ids = {s["span_id"] for s in spans}
+        in_trace = [s for s in spans if s["trace_id"] == trace_id]
+        # Every reconcile pass for the CR joined the trace, and the API
+        # writes are parent-linked under them.
+        recs = [s for s in in_trace if s["name"] == "controller.reconcile"]
+        assert recs and all(s["attrs"]["name"] == "tracey" for s in recs)
+        kube = [s for s in in_trace if s["name"].startswith("kube.")]
+        assert kube, "API writes must join the CR's trace"
+        for s in kube:
+            # Spans record on close, so a scrape can see a child whose
+            # enclosing pass is still open — every kube span must carry a
+            # parent, and the settled majority must link to recorded ones.
+            assert s["parent_id"]
+            assert s["attrs"]["status"].isdigit()
+            assert "retries" in s["attrs"]
+        assert any(s["parent_id"] in ids for s in kube)
+        for s in spans:
+            assert s["dur_us"] >= 0 and s["start_us"] > 0
+    finally:
+        for d in (ctl, adm):
+            code, err = d.stop()
+            assert code == 0, err
+
+    # TPUBC_TRACE_FILE: graceful shutdown dumped a Chrome trace that
+    # round-trips through json.load with sane timing.
+    doc = json.load(open(trace_file))
+    events = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert events
+    assert any(e["args"].get("trace_id") == trace_id for e in events)
+    assert all(e["dur"] >= 0 and e["ts"] > 0 for e in events)
+    assert any(e["ph"] == "M" and e["args"]["name"] == "tpubc-controller"
+               for e in doc["traceEvents"])
+
+
+def test_json_log_format(fake):
+    """TPUBC_LOG_FORMAT=json: every stderr line is one JSON object with
+    ts/level/target/msg."""
+    port = free_port()
+    ctl = Daemon("tpubc-controller",
+                 {**controller_env(fake, port), "TPUBC_LOG": "info",
+                  "TPUBC_LOG_FORMAT": "json"}, port)
+    ctl.wait_healthy()
+    code, err = ctl.stop()
+    assert code == 0
+    lines = [ln for ln in err.splitlines() if ln.strip()]
+    assert lines
+    for ln in lines:
+        obj = json.loads(ln)
+        assert {"ts", "level", "target", "msg"} <= set(obj)
+        assert obj["target"] == "tpubc-controller" or obj["target"] == "kube"
+
+
+def test_per_target_directive_silences_daemon(fake):
+    """TPUBC_LOG=off silences everything (level filtering through the
+    directive parser, observed end to end)."""
+    port = free_port()
+    ctl = Daemon("tpubc-controller",
+                 {**controller_env(fake, port), "TPUBC_LOG": "off"}, port)
+    ctl.wait_healthy()
+    code, err = ctl.stop()
+    assert code == 0
+    assert err.strip() == ""

@@ -10,6 +10,7 @@ from __future__ import annotations
 import ctypes
 import json
 import os
+import shutil
 import subprocess
 from pathlib import Path
 from typing import Any
@@ -19,8 +20,60 @@ NATIVE_DIR = REPO_ROOT / "native"
 BUILD_DIR = NATIVE_DIR / "build"
 LIB_PATH = BUILD_DIR / "libtpubc_capi.so"
 
+DAEMONS = ("crdgen", "controller", "admission", "synchronizer")
+
+
+def _libssl_flags() -> list:
+    """Link whichever OpenSSL runtime the image ships (the declared ABI in
+    tls.h is stable since 1.1)."""
+    if Path("/usr/lib/x86_64-linux-gnu/libssl.so.3").exists():
+        return ["-l:libssl.so.3", "-l:libcrypto.so.3"]
+    return ["-l:libssl.so.1.1", "-l:libcrypto.so.1.1"]
+
+
+def _build_fallback(force: bool = False) -> None:
+    """Direct g++ build for images without cmake/ninja (mirrors
+    CMakeLists.txt: one core objects set -> capi .so + four daemons).
+    Object files are cached by mtime against their source and the newest
+    header, so incremental edits recompile only what changed."""
+    obj_dir = BUILD_DIR / "obj"
+    obj_dir.mkdir(parents=True, exist_ok=True)
+    include = NATIVE_DIR / "include"
+    newest_header = max(p.stat().st_mtime for p in include.rglob("*.h"))
+    cxx = ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", "-Wextra", f"-I{include}"]
+
+    def compile_one(src: Path) -> Path:
+        obj = obj_dir / (src.stem + ".o")
+        if (force or not obj.exists()
+                or obj.stat().st_mtime < max(src.stat().st_mtime, newest_header)):
+            subprocess.run(cxx + ["-c", str(src), "-o", str(obj)],
+                           check=True, capture_output=True)
+        return obj
+
+    core = [compile_one(src) for src in sorted((NATIVE_DIR / "src").glob("*.cc"))
+            if src.name != "capi.cc"]
+    capi = compile_one(NATIVE_DIR / "src" / "capi.cc")
+    link = _libssl_flags() + ["-lpthread"]
+
+    def link_if_stale(out: Path, objs: list, extra: list) -> None:
+        if (not force and out.exists()
+                and out.stat().st_mtime >= max(o.stat().st_mtime for o in objs)):
+            return
+        subprocess.run(["g++"] + extra + [str(o) for o in objs] + ["-o", str(out)] + link,
+                       check=True, capture_output=True)
+
+    link_if_stale(LIB_PATH, [capi] + core, ["-shared"])
+    for daemon in DAEMONS:
+        bin_obj = compile_one(NATIVE_DIR / "bin" / f"{daemon}.cc")
+        link_if_stale(BUILD_DIR / f"tpubc-{daemon}", [bin_obj] + core, [])
+
+
 def build_native(force: bool = False) -> None:
-    """Configure + build the native tree (cached; ninja makes this a no-op)."""
+    """Configure + build the native tree (cached; ninja makes this a no-op).
+    Falls back to a direct g++ build when cmake/ninja are not installed."""
+    if shutil.which("cmake") is None or shutil.which("ninja") is None:
+        _build_fallback(force)
+        return
     if LIB_PATH.exists() and not force:
         # ninja is fast; always re-run so edited C++ is picked up in dev.
         pass
@@ -166,6 +219,43 @@ class NativeLib:
         return self._call_json(
             "tpubc_plan_sync", ub_list, rows, config or self.default_synchronizer_config()
         )
+
+    # -- telemetry (tracing / metrics / log filtering) ----------------------
+    def trace_dump(self) -> dict:
+        """{"process", "dropped", "spans": [...]} from the in-process tracer."""
+        return self._call_json("tpubc_trace_dump")
+
+    def trace_chrome(self) -> dict:
+        """Chrome trace-event JSON ({"traceEvents": [...]})."""
+        return self._call_json("tpubc_trace_chrome")
+
+    def trace_reset(self) -> None:
+        self._call_json("tpubc_trace_reset")
+
+    def trace_test_span(self, name: str, trace_id: str = "", parent_id: str = "") -> dict:
+        return self._call_json("tpubc_trace_test_span", name, trace_id, parent_id)
+
+    def metrics_inc(self, name: str, delta: int = 1) -> None:
+        self._call_json("tpubc_metrics_inc", name, str(delta))
+
+    def metrics_observe(self, name: str, value: float) -> None:
+        self._call_json("tpubc_metrics_observe", name, str(value))
+
+    def metrics_quantile(self, name: str, q: float) -> float:
+        return float(self._call("tpubc_metrics_quantile", name, str(q)))
+
+    def metrics_json(self) -> dict:
+        return self._call_json("tpubc_metrics_json")
+
+    def metrics_prometheus(self) -> str:
+        return self._call("tpubc_metrics_prometheus")
+
+    def metrics_reset(self) -> None:
+        self._call_json("tpubc_metrics_reset")
+
+    def log_level_for(self, spec: str, target: str) -> str:
+        """Effective level for a target under a TPUBC_LOG directive spec."""
+        return self._call("tpubc_log_level_for", spec, target)
 
 
 _shared: NativeLib | None = None

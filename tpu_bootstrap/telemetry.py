@@ -1,0 +1,262 @@
+"""Span tracer for the JAX workload, wire-compatible with the native one.
+
+The daemons' tracer (native/src/trace.cc) and this module speak the same
+two formats — the span-list JSON served at /traces.json and the Chrome
+trace-event JSON written to TPUBC_TRACE_FILE — so bench.py --trace-out
+can merge controller, admission, and workload spans onto ONE
+Perfetto-loadable timeline. Timestamps are wall-aligned monotonic
+microseconds on both sides: a per-process wall base captured once plus
+monotonic deltas, which keeps in-process durations non-negative while
+cross-process events still line up.
+
+Trace-context propagation: a slice worker inherits its trace id from the
+TPUBC_TRACE_ID env var the controller injects into the JobSet (which in
+turn carries the id the admission webhook stamped on the CR) — so a
+train step's span and the reconcile pass that scheduled it share a
+trace.
+
+Usage:
+
+    from tpu_bootstrap import telemetry
+
+    with telemetry.span("train.step", step=i):
+        ...
+
+    telemetry.tracer().dump(path)          # Chrome trace JSON
+    telemetry.merge_chrome_traces(out, [path1, path2, ...])
+
+Spans cost two clock reads and a deque append; the buffer is bounded
+(TPUBC_TRACE_BUFFER spans, default 4096) and overflow evicts oldest.
+If TPUBC_TRACE_FILE is set, the buffer is dumped there at interpreter
+exit (the JobSet-worker path: the trace survives pod termination in the
+pod log volume / mounted dir without any workload code changes).
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import secrets
+import threading
+import time
+import zlib
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+TRACE_ANNOTATION = "tpu.bacchus.io/trace-id"
+TRACE_ID_ENV = "TPUBC_TRACE_ID"
+
+_WALL_BASE_US = int(time.time() * 1e6)
+_MONO_BASE_NS = time.monotonic_ns()
+
+
+def now_us() -> int:
+    """Wall-aligned monotonic microseconds (see module docstring)."""
+    return _WALL_BASE_US + (time.monotonic_ns() - _MONO_BASE_NS) // 1000
+
+
+def new_trace_id() -> str:
+    return secrets.token_hex(8)
+
+
+def new_span_id() -> str:
+    return secrets.token_hex(8)
+
+
+@dataclass
+class Span:
+    trace_id: str
+    span_id: str
+    parent_id: str
+    name: str
+    start_us: int
+    dur_us: int = 0
+    attrs: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "start_us": self.start_us,
+            "dur_us": self.dur_us,
+            "attrs": dict(self.attrs),
+        }
+
+
+class Tracer:
+    """Bounded in-process span buffer (thread-safe)."""
+
+    def __init__(self, process: str = "tpu-bootstrap-workload",
+                 capacity: int | None = None):
+        if capacity is None:
+            try:
+                capacity = int(os.environ.get("TPUBC_TRACE_BUFFER", "4096"))
+            except ValueError:
+                capacity = 4096
+        self.process = process
+        self.capacity = max(capacity, 1)
+        self._spans: deque = deque(maxlen=self.capacity)
+        self.dropped = 0
+        self._lock = threading.Lock()
+
+    def record(self, span: Span) -> None:
+        with self._lock:
+            if len(self._spans) == self.capacity:
+                self.dropped += 1
+            self._spans.append(span)
+
+    def add_span(self, name: str, start_us: int, dur_us: int, *,
+                 trace_id: str = "", parent_id: str = "", **attrs) -> Span:
+        """Record a span retroactively (e.g. a serving request timed by
+        the scheduler: admission time is only known to be a span start
+        once the request finishes)."""
+        span = Span(trace_id or root_trace_id(), new_span_id(), parent_id,
+                    name, start_us, max(int(dur_us), 0),
+                    {k: str(v) for k, v in attrs.items()})
+        self.record(span)
+        return span
+
+    def spans(self) -> list:
+        with self._lock:
+            return list(self._spans)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self.dropped = 0
+
+    def to_json(self) -> dict:
+        """Same shape as the daemons' /traces.json."""
+        return {
+            "process": self.process,
+            "dropped": self.dropped,
+            "spans": [s.to_dict() for s in self.spans()],
+        }
+
+    def to_chrome(self) -> dict:
+        """Chrome trace-event JSON, matching native Tracer::to_chrome()."""
+        pid = os.getpid()
+        events = [{
+            "name": "process_name",
+            "ph": "M",
+            "pid": pid,
+            "tid": 0,
+            "args": {"name": self.process},
+        }]
+        for s in self.spans():
+            args = {"trace_id": s.trace_id, "span_id": s.span_id,
+                    "parent_id": s.parent_id}
+            args.update(s.attrs)
+            events.append({
+                "name": s.name,
+                "cat": self.process,
+                "ph": "X",
+                "ts": s.start_us,
+                "dur": s.dur_us,
+                "pid": pid,
+                # Same row-per-trace grouping rule as the native side.
+                "tid": _chrome_tid(s.trace_id),
+                "args": args,
+            })
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def dump(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_chrome(), f)
+
+
+def _chrome_tid(trace_id: str) -> int:
+    if not trace_id:
+        return 0
+    # Stable across processes (Python's str hash is salted per process)
+    # and total over arbitrary ids, not just hex ones.
+    return zlib.crc32(trace_id.encode()) & 0x7FFFFFFF
+
+
+_tracer = Tracer()
+_tls = threading.local()
+
+
+def tracer() -> Tracer:
+    return _tracer
+
+
+_root_id: str | None = None
+
+
+def root_trace_id() -> str:
+    """The trace id workload spans root under: the controller-injected
+    TPUBC_TRACE_ID when running as a slice worker, else a per-process
+    random id."""
+    global _root_id
+    if _root_id is None:
+        _root_id = os.environ.get(TRACE_ID_ENV, "") or new_trace_id()
+    return _root_id
+
+
+def current() -> Span | None:
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
+@contextmanager
+def span(name: str, trace_id: str | None = None, **attrs):
+    """Context-managed span. Nested spans parent implicitly (per-thread
+    stack) and share the enclosing trace id; a root span joins
+    ``trace_id`` (default: root_trace_id(), i.e. the propagated one)."""
+    parent = current()
+    if parent is not None:
+        tid, pid = parent.trace_id, parent.span_id
+    else:
+        tid, pid = trace_id or root_trace_id(), ""
+    s = Span(tid, new_span_id(), pid, name, now_us(),
+             attrs={k: str(v) for k, v in attrs.items()})
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    stack.append(s)
+    t0 = time.monotonic_ns()
+    try:
+        yield s
+    finally:
+        s.dur_us = (time.monotonic_ns() - t0) // 1000
+        stack.pop()
+        _tracer.record(s)
+
+
+def merge_chrome_traces(out_path: str, sources: list) -> dict:
+    """Merge Chrome trace files (or already-parsed dicts) into one
+    timeline at ``out_path``. Sources that are missing or unparseable are
+    skipped (a daemon that never got SIGTERM'd simply contributes no
+    spans). Returns the merged document."""
+    events = []
+    for src in sources:
+        if isinstance(src, dict):
+            doc = src
+        else:
+            try:
+                with open(src) as f:
+                    doc = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue
+        events.extend(doc.get("traceEvents", doc if isinstance(doc, list) else []))
+    merged = {"traceEvents": events, "displayTimeUnit": "ms"}
+    with open(out_path, "w") as f:
+        json.dump(merged, f)
+    return merged
+
+
+def _dump_at_exit() -> None:
+    path = os.environ.get("TPUBC_TRACE_FILE", "")
+    if path and _tracer.spans():
+        try:
+            _tracer.dump(path)
+        except OSError:
+            pass
+
+
+atexit.register(_dump_at_exit)

@@ -43,6 +43,7 @@ from jax import lax
 
 import math
 
+from tpu_bootstrap import telemetry
 from tpu_bootstrap.workload import decode_attention, quant
 from tpu_bootstrap.workload.flash_attention import flash_attention
 from tpu_bootstrap.workload.model import (
@@ -488,12 +489,27 @@ def generate(params: Params, prompt: jax.Array, cfg: ModelConfig, steps: int,
         kv_kernel = _multi_device(params) is False
     # Statics must go by keyword: jax.jit's static_argnames does not
     # match positionally-passed arguments.
-    return _generate(params, prompt, cfg=cfg, steps=steps,
-                     temperature=temperature, key=key, top_k=top_k,
-                     top_p=top_p, kv_quant=kv_quant, kv_kernel=kv_kernel,
-                     prefill_flash=prefill_flash,
-                     prompt_lengths=prompt_lengths, row_keys=row_keys,
-                     row_key_offsets=row_key_offsets)
+    if isinstance(prompt, jax.core.Tracer):
+        # Inside an outer jit: a telemetry span would time the trace, not
+        # the device — skip it (the outer caller owns the timing).
+        return _generate(params, prompt, cfg=cfg, steps=steps,
+                         temperature=temperature, key=key, top_k=top_k,
+                         top_p=top_p, kv_quant=kv_quant, kv_kernel=kv_kernel,
+                         prefill_flash=prefill_flash,
+                         prompt_lengths=prompt_lengths, row_keys=row_keys,
+                         row_key_offsets=row_key_offsets)
+    # Span covers dispatch through device completion (block_until_ready):
+    # the decode-step timeline bench.py --trace-out merges with the
+    # daemons' spans must carry real durations, not async-dispatch time.
+    with telemetry.span("decode.generate", steps=steps,
+                        batch=int(prompt.shape[0]), kv_quant=int(kv_quant)):
+        out = _generate(params, prompt, cfg=cfg, steps=steps,
+                        temperature=temperature, key=key, top_k=top_k,
+                        top_p=top_p, kv_quant=kv_quant, kv_kernel=kv_kernel,
+                        prefill_flash=prefill_flash,
+                        prompt_lengths=prompt_lengths, row_keys=row_keys,
+                        row_key_offsets=row_key_offsets)
+        return jax.block_until_ready(out)
 
 
 @partial(jax.jit, static_argnames=("cfg", "steps", "temperature", "top_k", "top_p",

@@ -49,6 +49,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# JAX renamed TPUCompilerParams -> CompilerParams across 0.4.x/0.5.x;
+# accept either so the kernels import on both.
+if not hasattr(pltpu, "CompilerParams"):  # pragma: no cover - version shim
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
+
 # One definition of backend detection (incl. the axon tunneled-PJRT
 # case) — a backend added to one kernel's allowlist but not another's
 # would silently run that kernel in interpret mode on real hardware.

@@ -44,6 +44,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# JAX renamed TPUCompilerParams -> CompilerParams across 0.4.x/0.5.x;
+# accept either so the kernels import on both.
+if not hasattr(pltpu, "CompilerParams"):  # pragma: no cover - version shim
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
+
 
 def _interpret_default() -> bool:
     try:

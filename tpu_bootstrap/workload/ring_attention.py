@@ -40,7 +40,17 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-shard_map = jax.shard_map
+try:
+    shard_map = jax.shard_map  # jax >= 0.5
+except AttributeError:  # pragma: no cover - version shim
+    # Older JAX: shard_map lives in experimental and spells the
+    # replication-check kwarg check_rep instead of check_vma.
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def shard_map(f, /, *args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _exp_shard_map(f, *args, **kwargs)
 
 _NEG = -1e30  # finite "minus infinity": keeps exp() arithmetic NaN-free
 

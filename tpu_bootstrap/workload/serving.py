@@ -699,6 +699,8 @@ def serve(params: Params, cfg: ModelConfig, requests: list,
     count decode work only; replayed_tokens counts the history-replay
     prefills that are the (O(length), flash-kernel-served) price of
     admission."""
+    from tpu_bootstrap import telemetry
+
     if len({r.rid for r in requests}) != len(requests):
         raise ValueError("duplicate request rids (results key by rid)")
     if resident:
@@ -721,13 +723,28 @@ def serve(params: Params, cfg: ModelConfig, requests: list,
         pool.validate(r, cfg)  # ALL requests fail loudly before any compute
     queue = list(requests)
     done: dict = {}
-    while queue or pool.has_active():
-        # Admission: free slots take queued requests (FIFO).
-        while queue and pool.free_slots() > 0:
-            pool.admit(queue.pop(0))
-        for rid, ev in pool.step_round().items():
-            if ev["done"]:
-                done[rid] = ev["generated"]
+    admitted_us: dict = {}
+    # One span per batch plus one per request (admission -> retirement):
+    # the serving-side leg of the merged timeline. Request spans are
+    # recorded retroactively at retirement — the scheduler, not a with-
+    # block, owns a request's lifetime.
+    with telemetry.span("serve.batch", requests=len(requests),
+                        batch_size=batch_size) as batch_span:
+        while queue or pool.has_active():
+            # Admission: free slots take queued requests (FIFO).
+            while queue and pool.free_slots() > 0:
+                r = queue.pop(0)
+                admitted_us[r.rid] = telemetry.now_us()
+                pool.admit(r)
+            for rid, ev in pool.step_round().items():
+                if ev["done"]:
+                    done[rid] = ev["generated"]
+                    telemetry.tracer().add_span(
+                        "serve.request", admitted_us[rid],
+                        telemetry.now_us() - admitted_us[rid],
+                        trace_id=batch_span.trace_id,
+                        parent_id=batch_span.span_id,
+                        rid=rid, tokens=len(ev["generated"]))
     if stats is not None:
         stats.update(pool.stats)
     return done
@@ -775,8 +792,13 @@ def serve_demo_from_env() -> None:
             # is an array dict needing no structure, and the optimizer
             # state is dead weight here anyway.
             import jax.numpy as jnp
+            import orbax.checkpoint as ocp
 
-            out = mgr.restore(step)
+            # Targetless StandardRestore spelled explicitly: plain
+            # mgr.restore(step) works on newer orbax but older releases
+            # refuse to infer the handler for the saved composite.
+            out = mgr.restore(step, args=ocp.args.Composite(
+                **{ck.STATE_KEY: ocp.args.StandardRestore()}))
             params = jax.tree.map(jnp.asarray, out[ck.STATE_KEY]["params"])
             print(f"serve: restored checkpoint step {step} from {ckpt}")
 

@@ -17,6 +17,8 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from tpu_bootstrap.workload.ring_attention import shard_map
+from tpu_bootstrap import telemetry
 from tpu_bootstrap.workload.model import ModelConfig, init_params, loss_from_inputs
 from tpu_bootstrap.workload.sharding import (
     BATCH_AXES,
@@ -177,7 +179,7 @@ def make_train_step(cfg: TrainConfig, mesh, p_shardings):
         attn = make_flash_attn_fn(block_size=cfg.attention_block)
         if not degenerate_mesh(mesh):
             spec = P(BATCH_AXES, None, "tensor", None)
-            attn = jax.shard_map(
+            attn = shard_map(
                 attn,
                 mesh=mesh,
                 in_specs=(spec, spec, spec),
@@ -252,11 +254,17 @@ def make_train_step(cfg: TrainConfig, mesh, p_shardings):
         # no-op partition-wise, but ~40x slower to dispatch through
         # tunneled single-chip backends like axon).
         return jax.jit(step, donate_argnums=(0, 1))
+    # Old JAX (<0.5, no jax.shard_map) resolves the opt_state's None
+    # out_sharding to an auto layout that can differ from the donated
+    # input's, and the aliasing check then dies at run time — keep the
+    # param donation (explicit matching shardings) and skip the
+    # opt_state's there.
+    donate = (0, 1) if hasattr(jax, "shard_map") else (0,)
     return jax.jit(
         step,
         in_shardings=(p_shardings, None, batch_shardings(mesh)),
         out_shardings=(p_shardings, None, replicated(mesh)),
-        donate_argnums=(0, 1),
+        donate_argnums=donate,
     )
 
 
@@ -357,8 +365,14 @@ def train_loop(cfg: TrainConfig, steps: int, *, checkpoint_dir: str | None = Non
                 profiling = True
             elif profiling and i == start + 4:
                 _close_trace()
-        params, opt_state, loss_value = step_fn(params, opt_state, tokens)
-        losses.append(float(loss_value))
+        # Telemetry span per step (tpu_bootstrap.telemetry, distinct from
+        # the XLA profiler above): the float() loss readback inside the
+        # span synchronizes with the device, so the duration is the real
+        # step wall time — and the span joins the controller's trace via
+        # the TPUBC_TRACE_ID the JobSet injected.
+        with telemetry.span("train.step", step=i):
+            params, opt_state, loss_value = step_fn(params, opt_state, tokens)
+            losses.append(float(loss_value))
         if log_every > 0 and (i + 1) % log_every == 0:
             now = _time.time()
             tps = tokens_per_step * (i + 1 - last_logged) / max(now - t_log, 1e-9)
@@ -670,10 +684,15 @@ def worker_main() -> None:
         pipeline_schedule=os.environ.get("WORKLOAD_SCHEDULE", "gpipe"),
         num_microbatches=int(os.environ.get("WORKLOAD_MICROBATCHES", "0")),
     )
-    losses = train_loop(cfg, steps, checkpoint_dir=ckpt_dir,
-                        save_every=save_every, seed=seed,
-                        profile_dir=os.environ.get("WORKLOAD_PROFILE_DIR") or None,
-                        log_every=int(os.environ.get("WORKLOAD_LOG_EVERY", "10")))
+    # Root workload span: joins the controller's trace via the injected
+    # TPUBC_TRACE_ID; per-step spans nest under it. TPUBC_TRACE_FILE (if
+    # set) gets the Chrome-trace dump at interpreter exit.
+    with telemetry.span("workload.train", steps=steps,
+                        mode=os.environ.get("WORKLOAD_ATTENTION", "dense")):
+        losses = train_loop(cfg, steps, checkpoint_dir=ckpt_dir,
+                            save_every=save_every, seed=seed,
+                            profile_dir=os.environ.get("WORKLOAD_PROFILE_DIR") or None,
+                            log_every=int(os.environ.get("WORKLOAD_LOG_EVERY", "10")))
     if losses:
         print(f"train_loop done: ran {len(losses)} steps, "
               f"first={losses[0]:.4f} last={losses[-1]:.4f}")
