@@ -1613,6 +1613,195 @@ def trace_capture(out_path: str):
     return summary
 
 
+def slo_report(out_path: str, n_crs: int = 30):
+    """--slo-report: the operator-facing SLO summary for one bench
+    trajectory. Two legs share one process:
+
+    1. Serve leg: a small model behind the real ingress engine answers a
+       handful of live HTTP generate calls — filling the workload
+       registry's TTFT/latency histograms and qps/tokens-per-sec gauges.
+    2. Control-plane leg: the registry is then exposed on a local
+       metrics server standing in for worker 0, and the real controller
+       (CONF_WORKLOAD_SCRAPE=1 pointed at it) converges n_crs CRs whose
+       JobSets a simulator marks ready — driving phase to Running, the
+       time-to-Running histogram, and the status.slice.workload merge.
+
+    The emitted JSON answers: how fast do slices reach Running (p50/p99),
+    how often do reconciles fail, what latency does serving deliver
+    (TTFT, tokens/s), and does /statusz join it all by trace id.
+    """
+    import threading
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    from tpu_bootstrap import telemetry
+    from tpu_bootstrap.fakeapi import FakeKube
+    from tpu_bootstrap.workload.ingress import IngressServer
+    from tpu_bootstrap.workload.model import ModelConfig, init_params
+
+    # ---- serve leg --------------------------------------------------------
+    cfg = ModelConfig(vocab_size=128, num_layers=2, num_heads=2, head_dim=8,
+                      embed_dim=16, mlp_dim=32, max_seq_len=64)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    ingress = IngressServer(params, cfg, port=0, batch_size=4).start()
+
+    def generate_once(tokens, max_new):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{ingress.port}/v1/generate",
+            data=json.dumps({"tokens": tokens, "max_new": max_new,
+                             "stream": False}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=300) as r:
+            return json.loads(r.read())
+
+    n_serve = 8
+    for i in range(n_serve):
+        out = generate_once([1 + i, 2, 3], 4 + (i % 3) * 4)
+        assert out["done"] and len(out["tokens"]) >= 4
+    ingress.stop()
+
+    # Worker-0 stand-in: the SAME registry the serve leg just filled,
+    # behind the same /metrics.json route a slice worker serves.
+    worker_metrics = telemetry.start_metrics_server(0, host="127.0.0.1")
+    worker_port = worker_metrics.server_address[1]
+
+    # ---- control-plane leg ------------------------------------------------
+    fake = FakeKube().start()
+    port = free_port()
+    proc = subprocess.Popen(
+        [str(REPO / "native" / "build" / "tpubc-controller")],
+        env={**os.environ,
+             "CONF_KUBE_API_URL": fake.url,
+             "CONF_LISTEN_ADDR": "127.0.0.1",
+             "CONF_LISTEN_PORT": str(port),
+             "CONF_WORKLOAD_SCRAPE": "1",
+             "CONF_WORKLOAD_SCRAPE_ADDR": f"127.0.0.1:{worker_port}",
+             "CONF_WORKLOAD_SCRAPE_INTERVAL_SECS": "1",
+             "TPUBC_LOG": "error"},
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+    try:
+        wait_health(port, proc)
+
+        # JobSet-readiness simulator: the moment a JobSet exists, mark its
+        # gang ready (what the JobSet controller does on a real cluster) —
+        # the controller's child watch then drives phase to Running.
+        stop_sim = threading.Event()
+
+        def simulate_ready():
+            while not stop_sim.is_set():
+                with fake.store.lock:
+                    pending = [
+                        (f"slo-{i:03d}", dict(js))
+                        for i in range(n_crs)
+                        for js in [fake.store.objects.get(
+                            KEY_JS(f"slo-{i:03d}"), {}).get(f"slo-{i:03d}-slice")]
+                        if js and not js.get("status")
+                    ]
+                for ns, js in pending:
+                    js["status"] = {"replicatedJobsStatus": [
+                        {"name": "workers", "ready": 1}]}
+                    fake.store.upsert(KEY_JS(ns), f"{ns}-slice", js,
+                                      preserve_status=False)
+                time.sleep(0.01)
+
+        sim = threading.Thread(target=simulate_ready, daemon=True)
+        sim.start()
+
+        t0 = time.time()
+        for i in range(n_crs):
+            fake.create_ub(f"slo-{i:03d}", spec=cr_spec(), status=dict(SYNCED))
+
+        def phase(name):
+            ub = fake.get(fake.KEY_UB, name) or {}
+            return ub.get("status", {}).get("slice", {}).get("phase")
+
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if all(phase(f"slo-{i:03d}") == "Running" for i in range(n_crs)):
+                break
+            time.sleep(0.02)
+        else:
+            raise TimeoutError("SLO CRs never all reached Running")
+        running_elapsed = time.time() - t0
+
+        # The scrape loop (1s interval) must merge the worker summary.
+        sample = None
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            ub = fake.get(fake.KEY_UB, "slo-000") or {}
+            sample = ub.get("status", {}).get("slice", {}).get("workload")
+            if sample:
+                break
+            time.sleep(0.05)
+        if not sample:
+            raise TimeoutError("status.slice.workload never merged")
+        stop_sim.set()
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics.json", timeout=5) as r:
+            m = json.loads(r.read())
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/statusz?name=slo-000",
+                timeout=5) as r:
+            statusz = json.loads(r.read())
+        outcomes = statusz["objects"]["slo-000"]
+        reconcile_outcomes = [o for o in outcomes if o["op"] == "reconcile"]
+        serve_json = telemetry.metrics().to_json()
+
+        reconciles = m.get("reconciles_total", 0)
+        errors = m.get("reconcile_errors_total", 0)
+        report = {
+            "slo_report_version": 1,
+            "bench_commit": _git_fingerprint(),
+            "fakeapi_version": FAKEAPI_VERSION,
+            "n_crs": n_crs,
+            "all_running_elapsed_s": round(running_elapsed, 3),
+            # Provisioning SLO: the controller's own first-seen->Running
+            # condition-transition histogram.
+            "time_to_running_p50_ms": m.get("tpubc_time_to_running_ms_p50"),
+            "time_to_running_p99_ms": m.get("tpubc_time_to_running_ms_p99"),
+            "time_to_running_count": m.get("tpubc_time_to_running_ms_count"),
+            "reconciles_total": reconciles,
+            "reconcile_errors_total": errors,
+            "reconcile_error_rate": round(errors / max(reconciles, 1), 4),
+            "reconcile_p50_ms": m.get("tpubc_reconcile_duration_ms_p50"),
+            "workqueue_depth": m.get("workqueue_depth"),
+            "watch_last_event_age_seconds": m.get("watch_last_event_age_seconds"),
+            "workload_scrapes_total": m.get("workload_scrapes_total"),
+            # Serving SLO, from the serve leg's registry.
+            "serve_requests": serve_json.get("serve_requests_total"),
+            "serve_ttft_p50_ms": serve_json.get("serve_ttft_ms_p50"),
+            "serve_ttft_p99_ms": serve_json.get("serve_ttft_ms_p99"),
+            "serve_request_p50_ms": serve_json.get("serve_request_ms_p50"),
+            "serve_tokens_per_sec": serve_json.get("serve_tokens_per_sec"),
+            "serve_qps": serve_json.get("serve_qps"),
+            # Aggregation + introspection evidence: the merged status
+            # block and the CR's latest reconcile outcome with its trace
+            # id (joinable against /traces.json and JSON logs).
+            "status_slice_workload": sample,
+            "statusz_last_reconcile": reconcile_outcomes[-1]
+                                      if reconcile_outcomes else None,
+            "statusz_outcomes": len(outcomes),
+            "statusz_trace_ids_present": all(
+                o.get("trace_id") for o in reconcile_outcomes),
+        }
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        fake.stop()
+        worker_metrics.shutdown()
+
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps(report))
+    return report
+
+
 def main():
     import argparse
 
@@ -1621,11 +1810,19 @@ def main():
                         help="capture one webhook->controller->workload "
                              "lifecycle and write a merged Chrome trace to "
                              "PATH instead of running the full bench")
+    parser.add_argument("--slo-report", metavar="PATH",
+                        help="drive a serve run + CR trajectory and write a "
+                             "JSON SLO summary (time-to-Running p50/p99, "
+                             "reconcile error rate, serve TTFT/tokens-per-"
+                             "sec) to PATH instead of running the full bench")
     args = parser.parse_args()
 
     nativelib.build_native()
     if args.trace_out:
         trace_capture(args.trace_out)
+        return
+    if args.slo_report:
+        slo_report(args.slo_report)
         return
 
     # Workload first (VERDICT r1): the TPU half must not depend on anything

@@ -9,6 +9,7 @@
 // defaulting happen in the shared policy core (admission_core.cc).
 // CONF_TLS_DISABLED=1 serves plain HTTP for tests/sidecar-TLS setups.
 #include <thread>
+#include <utility>
 
 #include "tpubc/admission_core.h"
 #include "tpubc/config.h"
@@ -16,6 +17,7 @@
 #include "tpubc/json.h"
 #include "tpubc/log.h"
 #include "tpubc/runtime.h"
+#include "tpubc/statusz.h"
 #include "tpubc/trace.h"
 #include "tpubc/util.h"
 
@@ -24,6 +26,7 @@ using namespace tpubc;
 int main() {
   log_init("tpubc-admission");
   Tracer::instance().set_process_name("tpubc-admission");
+  Statusz::instance().set_process_name("tpubc-admission");
   install_signal_handlers();
 
   EnvConfig env;
@@ -72,6 +75,17 @@ int main() {
       resp.body = Tracer::instance().to_json().dump();
       return resp;
     }
+    if (req.path == "/statusz" || starts_with(req.path, "/statusz?")) {
+      // Per-CR mutate outcomes (decision, duration, trace id);
+      // ?name=<cr> filters to one CR.
+      std::string filter;
+      const size_t q = req.path.find("?name=");
+      if (q != std::string::npos) filter = req.path.substr(q + 6);
+      resp.status = 200;
+      resp.headers["Content-Type"] = "application/json";
+      resp.body = Statusz::instance().to_json(filter).dump();
+      return resp;
+    }
     if (req.path == "/mutate" && req.method == "POST") {
       Metrics::instance().inc("admission_requests_total");
       Json review;
@@ -82,9 +96,30 @@ int main() {
         resp.body = Json::object({{"error", std::string("bad AdmissionReview: ") + e.what()}}).dump();
         return resp;
       }
+      // The outer request span: mutate_review's admission.mutate span
+      // nests under it, so its trace id IS the id the webhook stamps on
+      // the CR — the statusz entry joins the same trace the controller's
+      // reconcile entries will.
+      const int64_t t0 = monotonic_ms();
+      Span req_span("admission.request");
       Json out = mutate_review(review, config);
-      if (!out.get("response").get_bool("allowed", false))
-        Metrics::instance().inc("admission_denials_total");
+      const Json& response = out.get("response");
+      const bool allowed = response.get_bool("allowed", false);
+      if (!allowed) Metrics::instance().inc("admission_denials_total");
+      const Json& request = review.get("request");
+      std::string cr_name = request.get("object").get("metadata").get_string("name");
+      if (cr_name.empty()) cr_name = request.get_string("name");
+      if (!cr_name.empty()) {
+        StatuszEntry entry;
+        entry.op = "mutate";
+        entry.duration_ms = static_cast<double>(monotonic_ms() - t0);
+        entry.trace_id = req_span.trace_id();
+        entry.detail = std::string(request.get_string("operation")) +
+                       (allowed ? " allowed" : " denied");
+        if (!allowed)
+          entry.error = response.get("status").get_string("message");
+        Statusz::instance().record(cr_name, std::move(entry));
+      }
       resp.status = 200;
       resp.body = out.dump();
       return resp;

@@ -34,6 +34,7 @@
 #include "tpubc/log.h"
 #include "tpubc/reconcile_core.h"
 #include "tpubc/runtime.h"
+#include "tpubc/statusz.h"
 #include "tpubc/trace.h"
 #include "tpubc/util.h"
 
@@ -50,6 +51,13 @@ struct ControllerConfig {
   int64_t workers;
   bool leader_elect;
   LeaderConfig leader;
+  // Workload health aggregation (opt-in, CONF_WORKLOAD_SCRAPE=1): probe
+  // worker 0's /metrics.json for Running slices and merge the summary
+  // into status.slice.workload. scrape_addr overrides the derived
+  // headless-service DNS address (tests, port-forward setups).
+  bool workload_scrape;
+  std::string scrape_addr;
+  int64_t scrape_interval_secs;
   Json core;  // config passed to the pure planner
 };
 
@@ -68,6 +76,9 @@ ControllerConfig load_config() {
   // deadline, so genuine CR events at delay 0 are never held back).
   c.child_requeue_ms = env.get_int("child_requeue_ms", 1000);
   c.workers = env.get_int("reconcile_workers", 4);
+  c.workload_scrape = env.get("workload_scrape", "0") == "1";
+  c.scrape_addr = env.get("workload_scrape_addr", "");
+  c.scrape_interval_secs = env.get_int("workload_scrape_interval_secs", 15);
   c.leader_elect = env.get("leader_elect", "0") == "1";
   if (c.leader_elect) c.leader = leader_config_from_env("tpu-bootstrap-controller");
   c.core = default_controller_config();
@@ -87,7 +98,18 @@ class WorkQueue {
     int64_t due = monotonic_ms() + delay_ms;
     auto it = due_.find(name);
     if (it == due_.end() || due < it->second) due_[name] = due;
+    // workqueue_depth: pending + in-flight. A growing depth under load
+    // is the first sign the workers can't keep up — previously visible
+    // only by correlating logs.
+    Metrics::instance().set("workqueue_depth",
+                            static_cast<int64_t>(due_.size() + active_.size()));
     cv_.notify_one();
+  }
+
+  // Pending + in-flight items (the /statusz live-state view).
+  int64_t depth() {
+    std::lock_guard<std::mutex> lock(mutex_);
+    return static_cast<int64_t>(due_.size() + active_.size());
   }
 
   // Pop the next due item; blocks until one is due or stop. Returns false
@@ -123,6 +145,8 @@ class WorkQueue {
   void done(const std::string& name) {
     std::lock_guard<std::mutex> lock(mutex_);
     active_.erase(name);
+    Metrics::instance().set("workqueue_depth",
+                            static_cast<int64_t>(due_.size() + active_.size()));
     cv_.notify_one();
   }
 
@@ -181,9 +205,46 @@ class ObjectCache {
     return true;
   }
 
+  std::vector<std::string> names() const {
+    std::lock_guard<std::mutex> lock(mutex_);
+    std::vector<std::string> out;
+    out.reserve(objects_.size());
+    for (const auto& kv : objects_) out.push_back(kv.first);
+    return out;
+  }
+
  private:
   mutable std::mutex mutex_;
   std::map<std::string, Json> objects_;
+};
+
+// When THIS process first saw each CR (monotonic ms) — the start point of
+// the time-to-Running histogram: first-seen -> slice phase Running is the
+// user-facing provisioning SLO (for a CR created while the controller
+// runs it is apply->Running; after a restart it is recovery->Running,
+// which is the number an operator watching a failover cares about).
+class FirstSeen {
+ public:
+  void note(const std::string& name) {
+    std::lock_guard<std::mutex> lock(mutex_);
+    seen_.emplace(name, monotonic_ms());  // no-op if already recorded
+  }
+
+  int64_t get(const std::string& name) {
+    std::lock_guard<std::mutex> lock(mutex_);
+    auto it = seen_.find(name);
+    if (it == seen_.end()) it = seen_.emplace(name, monotonic_ms()).first;
+    return it->second;
+  }
+
+  void erase(const std::string& name) {
+    std::lock_guard<std::mutex> lock(mutex_);
+    seen_.erase(name);
+  }
+
+ private:
+  std::mutex mutex_;
+  std::map<std::string, int64_t> seen_;
 };
 
 // Process-lifetime record of CRs whose RoleBinding is known absent. The
@@ -328,7 +389,7 @@ class EventSink {
 // gone (callers must not requeue it).
 bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::string& name,
                    EventSink& events, const ObjectCache& cache, KnownAbsent& rb_absent,
-                   KnownAbsent& svc_absent, EmittedPhases& emitted) {
+                   KnownAbsent& svc_absent, EmittedPhases& emitted, FirstSeen& first_seen) {
   // Whole-pass latency histogram: the in-daemon half of the BASELINE
   // metric surface, scrapeable at /metrics and read back by bench.py.
   struct PassTimer {
@@ -349,6 +410,7 @@ bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::s
     emitted.erase(name);  // CR deleted: drop the per-CR emission record
     rb_absent.erase(name);
     svc_absent.erase(name);
+    first_seen.erase(name);
     return false;
   }
 
@@ -361,9 +423,44 @@ bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::s
                  ub.get("metadata").get("annotations").get_string(kTraceAnnotation));
   pass_span.attr("name", name);
 
+  // Flight-recorder entry for this pass: filled in along the way and
+  // recorded on every exit (success or throw) so `/statusz?name=<cr>`
+  // shows the last N outcomes — timestamp, duration, error, the trace id
+  // joining /traces.json, and what the pass applied.
+  struct PassRecord {
+    const std::string& cr;
+    StatuszEntry entry;
+    int64_t t0 = monotonic_ms();
+    explicit PassRecord(const std::string& n, const std::string& trace_id)
+        : cr(n) {
+      entry.op = "reconcile";
+      entry.trace_id = trace_id;
+    }
+    ~PassRecord() {
+      entry.duration_ms = static_cast<double>(monotonic_ms() - t0);
+      if (entry.error.empty() && std::uncaught_exceptions() > 0)
+        entry.error = "reconcile threw (non-std exception)";
+      Statusz::instance().record(cr, std::move(entry));
+    }
+  } pass_record(name, pass_span.trace_id());
+
+  // The pass body runs in a lambda so the catch below can stamp the real
+  // error message into the flight-recorder entry before the worker's
+  // requeue logic sees the exception.
+  auto body = [&]() -> bool {
   log_info("reconciling", {{"name", name}});
   const std::string ns = target_namespace(ub);
   std::vector<Json> children = desired_children(ub, cfg.core);
+  {
+    // What this pass intends to apply — the "applied kinds" the per-CR
+    // statusz page shows next to each outcome.
+    std::string kinds;
+    for (const Json& child : children) {
+      if (!kinds.empty()) kinds += ",";
+      kinds += child.get("kind").as_string();
+    }
+    pass_record.entry.detail = "apply=" + kinds;
+  }
   // Whether THIS pass applies a serve Service — the single source of
   // truth for the prune below: any exit that stops the emission
   // (revoked, spec.tpu removed, serve mode off, one-shot slice
@@ -618,6 +715,12 @@ bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::s
       }
     }
     Json desired_slice = slice_status(ub, observed);
+    // The scrape loop owns status.slice.workload: carry the cached block
+    // forward so this merge neither nulls it out nor fights the scraper
+    // every pass.
+    if (cached_slice.is_object() && cached_slice.get("workload").is_object())
+      desired_slice.set("workload", cached_slice.get("workload"));
+    pass_record.entry.detail += " phase=" + desired_slice.get_string("phase");
     // Merge-patch is RFC 7386 (recursive): keys that should disappear
     // (e.g. jobset after a prune) must be explicitly nulled or they
     // linger in status and re-trigger this write — and the prune above —
@@ -655,11 +758,81 @@ bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::s
         old_phase = ub.get("status").get("slice").get_string("phase");
       Json event = slice_event(ub, old_phase, desired_slice, now_rfc3339());
       if (event.is_object()) events.enqueue(std::move(event));
+      // The user-facing provisioning SLO: first-seen -> Running, as a
+      // histogram (p50/p99 at /metrics) — the condition-transition
+      // latency bench.py --slo-report reads back.
+      if (desired_slice.get_string("phase") == "Running" &&
+          old_phase != "Running") {
+        Metrics::instance().observe(
+            "tpubc_time_to_running_ms",
+            static_cast<double>(monotonic_ms() - first_seen.get(name)));
+      }
       emitted.set(name, uid, desired_slice.get_string("phase"));
     }
   }
   Metrics::instance().inc("reconciles_total");
   return true;
+  };  // body
+  try {
+    return body();
+  } catch (const std::exception& e) {
+    pass_record.entry.error = e.what();
+    throw;
+  }
+}
+
+// One scrape pass over every Running slice: GET worker 0's /metrics.json
+// and merge the workload summary into status.slice.workload — `kubectl
+// get tub -o yaml` then answers "is it training/serving, at what rate"
+// without port-forwarding. Address: the worker's stable hostname under
+// the JobSet's headless service (the same wiring
+// TPUBC_COORDINATOR_ADDRESS rides), or CONF_WORKLOAD_SCRAPE_ADDR when an
+// operator (or the fake-API test harness) fronts the pod differently.
+void scrape_workloads(KubeClient& client, const ControllerConfig& cfg,
+                      const ObjectCache& cache) {
+  for (const std::string& name : cache.names()) {
+    if (stop_requested().load()) return;
+    Json ub;
+    if (!cache.get(name, &ub)) continue;
+    if (ub.get("status").get("slice").get_string("phase") != "Running") continue;
+    std::string addr = cfg.scrape_addr;
+    if (addr.empty()) {
+      const int64_t port = workload_metrics_port(ub);
+      if (port == 0) continue;  // nothing scrapeable for this CR
+      const std::string ns = target_namespace(ub);
+      const std::string js = ns + "-slice";
+      addr = js + "-workers-0-0." + js + "." + ns + ".svc:" + std::to_string(port);
+    }
+    const int64_t t0 = monotonic_ms();
+    StatuszEntry entry;
+    entry.op = "scrape";
+    try {
+      Span span("controller.scrape");
+      span.attr("name", name);
+      entry.trace_id = span.trace_id();
+      HttpClient http("http://" + addr);
+      HttpResponse resp = http.request("GET", "/metrics.json", "", "", {}, 5);
+      if (!resp.ok())
+        throw std::runtime_error("scrape HTTP " + std::to_string(resp.status));
+      Json summary = workload_summary(Json::parse(resp.body), now_rfc3339());
+      Metrics::instance().inc("workload_scrapes_total");
+      if (summary.is_object()) {
+        client.merge_status(
+            kApiVersion, kKind, "", name,
+            Json::object({{"slice", Json::object({{"workload", summary}})}}));
+        entry.detail = summary.dump();
+      } else {
+        entry.detail = "scrape carried no workload metrics";
+      }
+    } catch (const std::exception& e) {
+      Metrics::instance().inc("workload_scrape_errors_total");
+      entry.error = e.what();
+      log_warn("workload scrape failed",
+               {{"name", name}, {"addr", addr}, {"error", e.what()}});
+    }
+    entry.duration_ms = static_cast<double>(monotonic_ms() - t0);
+    Statusz::instance().record(name, std::move(entry));
+  }
 }
 
 }  // namespace
@@ -667,6 +840,7 @@ bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::s
 int main() {
   log_init("tpubc-controller");
   Tracer::instance().set_process_name("tpubc-controller");
+  Statusz::instance().set_process_name("tpubc-controller");
   install_signal_handlers();
 
   ControllerConfig cfg = load_config();
@@ -681,8 +855,21 @@ int main() {
 
   WorkQueue queue;
 
+  // Live daemon state for the metrics/statusz surfaces, refreshed at
+  // render time (ages must be current at scrape, not at last event).
+  std::atomic<int64_t> last_cr_event_ms{monotonic_ms()};
+  std::atomic<int64_t> last_child_event_ms{monotonic_ms()};
+  std::atomic<bool> is_leader{!cfg.leader_elect};  // no election => always leads
+  auto refresh_state_gauges = [&] {
+    Metrics::instance().set("workqueue_depth", queue.depth());
+    Metrics::instance().set(
+        "watch_last_event_age_seconds",
+        (monotonic_ms() - last_cr_event_ms.load()) / 1000);
+    Metrics::instance().set("leader_is_leader", is_leader.load() ? 1 : 0);
+  };
+
   // Health + metrics server (reference: axum /health returning "pong").
-  HttpServer health(cfg.listen_addr, cfg.listen_port, [](const HttpRequest& req) {
+  HttpServer health(cfg.listen_addr, cfg.listen_port, [&](const HttpRequest& req) {
     HttpResponse resp;
     if (req.path == "/health") {
       resp.status = 200;
@@ -690,12 +877,31 @@ int main() {
       resp.body = "pong";
     } else if (req.path == "/metrics") {
       // Prometheus text exposition format (scrapeable in-cluster).
+      refresh_state_gauges();
       resp.status = 200;
       resp.headers["Content-Type"] = "text/plain; version=0.0.4";
       resp.body = Metrics::instance().to_prometheus();
     } else if (req.path == "/metrics.json") {
+      refresh_state_gauges();
       resp.status = 200;
       resp.body = Metrics::instance().to_json().dump();
+    } else if (req.path == "/statusz" || starts_with(req.path, "/statusz?")) {
+      // Per-CR flight recorder: recent reconcile/scrape outcomes with
+      // trace ids, plus live daemon state. ?name=<cr> filters to one CR.
+      std::string filter;
+      const size_t q = req.path.find("?name=");
+      if (q != std::string::npos) filter = req.path.substr(q + 6);
+      Statusz::instance().set_state("workqueue_depth", queue.depth());
+      Statusz::instance().set_state(
+          "watch_last_event_age_seconds",
+          (monotonic_ms() - last_cr_event_ms.load()) / 1000);
+      Statusz::instance().set_state(
+          "child_watch_last_event_age_seconds",
+          (monotonic_ms() - last_child_event_ms.load()) / 1000);
+      Statusz::instance().set_state("leader", is_leader.load());
+      resp.status = 200;
+      resp.headers["Content-Type"] = "application/json";
+      resp.body = Statusz::instance().to_json(filter).dump();
     } else if (req.path == "/traces.json") {
       // Recent spans with parent links (the Dapper-style view of the
       // reconcile pipeline), next to /metrics like the tracing and
@@ -723,6 +929,7 @@ int main() {
       log_info("stopped before acquiring leadership");
       return 0;
     }
+    is_leader.store(true);
   }
 
   EventSink events(client);
@@ -730,6 +937,7 @@ int main() {
   KnownAbsent rb_absent;
   KnownAbsent svc_absent;
   EmittedPhases emitted_phases;
+  FirstSeen first_seen;
 
   // Reconcile workers.
   std::vector<std::thread> workers;
@@ -750,7 +958,7 @@ int main() {
         }
         try {
           bool exists = reconcile_one(client, cfg, name, events, cache, rb_absent,
-                                      svc_absent, emitted_phases);
+                                      svc_absent, emitted_phases, first_seen);
           queue.done(name);
           if (exists) queue.add(name, cfg.requeue_secs * 1000);  // controller.rs:154
         } catch (const std::exception& e) {
@@ -842,7 +1050,10 @@ int main() {
             for (const auto& item : list.get("items").items())
               requeue_owner(item, /*count_event=*/false);
           },
-          [&](const std::string&, const Json& obj) { requeue_owner(obj, /*count_event=*/true); });
+          [&](const std::string&, const Json& obj) {
+            last_child_event_ms.store(monotonic_ms());
+            requeue_owner(obj, /*count_event=*/true);
+          });
     });
   }
 
@@ -855,34 +1066,59 @@ int main() {
           // Full replace, not merge: a relist after watch-history expiry
           // must drop objects deleted during the gap.
           cache.reset(list);
-          for (const auto& item : list.get("items").items())
-            queue.add(item.get("metadata").get_string("name"), 0);
+          for (const auto& item : list.get("items").items()) {
+            const std::string name = item.get("metadata").get_string("name");
+            first_seen.note(name);
+            queue.add(name, 0);
+          }
         },
         [&](const std::string& type, const Json& obj) {
           const std::string name = obj.get("metadata").get_string("name");
           if (name.empty()) return;
           Metrics::instance().inc("watch_events_total");
+          last_cr_event_ms.store(monotonic_ms());
           if (type == "DELETED") {
             cache.remove(name);
             queue.remove(name);  // GC handles children; stop requeueing
             rb_absent.erase(name);  // don't grow unbounded across CR churn
             svc_absent.erase(name);
+            first_seen.erase(name);
             // A recreated CR must re-emit its phase history; a stale
             // record would swallow its transitions forever.
             emitted_phases.erase(name);
             return;
           }
+          first_seen.note(name);
           cache.put(obj);
           queue.add(name, 0);
         });
   });
+
+  // Workload scraper (opt-in): probes Running slices' worker-0 metrics
+  // on its own thread — scrape latency must never ride the reconcile
+  // path — and merges summaries into status.slice.workload.
+  std::thread scraper;
+  if (cfg.workload_scrape) {
+    scraper = std::thread([&] {
+      // Short initial beat so startup reconciles can seed phases; then
+      // one pass per interval. The leadership gate mirrors the workers'.
+      if (stop_wait_ms(std::min<int64_t>(cfg.scrape_interval_secs, 2) * 1000))
+        return;
+      do {
+        if (!elector || elector->is_leader()) scrape_workloads(client, cfg, cache);
+      } while (!stop_wait_ms(cfg.scrape_interval_secs * 1000));
+    });
+  }
 
   // Block until a signal arrives (reference: tokio::try_join over tasks),
   // or — with leader election — until leadership is lost.
   bool lost_leadership = false;
   if (elector) {
     lost_leadership = !elector->hold(stop_requested());
-    if (lost_leadership) request_stop();  // wind everything down
+    if (lost_leadership) {
+      is_leader.store(false);
+      request_stop();  // wind everything down
+    }
   } else {
     while (!stop_wait_ms(60'000)) {
     }
@@ -894,6 +1130,7 @@ int main() {
   for (auto& t : workers) t.join();
   watcher.join();
   for (auto& t : child_watchers) t.join();
+  if (scraper.joinable()) scraper.join();
   // After the workers: nothing enqueues anymore. stop() discards any
   // backlog rather than draining it — the lease release below must not
   // wait behind event I/O against a possibly-dead API server.

@@ -19,6 +19,7 @@
 #include <map>
 #include <memory>
 #include <thread>
+#include <utility>
 
 #include "tpubc/config.h"
 #include "tpubc/crd.h"
@@ -31,6 +32,7 @@
 #include "tpubc/reconcile_core.h"
 #include "tpubc/runtime.h"
 #include "tpubc/sheet_core.h"
+#include "tpubc/statusz.h"
 #include "tpubc/trace.h"
 #include "tpubc/util.h"
 
@@ -166,10 +168,16 @@ void run_sync_once(KubeClient& client, const Json& sync_config, SheetSource& she
 
   for (const auto& action : plan.get("actions").items()) {
     const std::string name = action.get_string("name");
+    const int64_t t_action = monotonic_ms();
     // 1. status first (synchronizer.rs:302 before :324).
     log_info("updating status", {{"name", name}});
     if (!write_status(client, name, action.get_string("resource_version"),
                       action.get("status"))) {
+      StatuszEntry conflict;
+      conflict.op = "sync";
+      conflict.trace_id = tick_span.trace_id();
+      conflict.error = "status conflict (409); retrying next tick";
+      Statusz::instance().record(name, std::move(conflict));
       continue;
     }
     // Gate-opening event (best-effort): kubectl describe shows when the
@@ -195,6 +203,13 @@ void run_sync_once(KubeClient& client, const Json& sync_config, SheetSource& she
     client.json_patch(kApiVersion, kKind, "", name, action.get("patches"));
     Metrics::instance().inc("sync_actions_total");
     log_info("quota updated", {{"name", name}});
+    StatuszEntry applied;
+    applied.op = "sync";
+    applied.trace_id = tick_span.trace_id();
+    applied.duration_ms = static_cast<double>(monotonic_ms() - t_action);
+    applied.detail =
+        "quota synchronized (" + std::to_string(action.get_int("chips", 0)) + " chips)";
+    Statusz::instance().record(name, std::move(applied));
   }
   // Revocations (opt-in, CONF_REVOKE_ON_UNAUTHORIZED=1): close the gate
   // of previously synchronized CRs whose sheet approval was withdrawn;
@@ -217,6 +232,11 @@ void run_sync_once(KubeClient& client, const Json& sync_config, SheetSource& she
       continue;
     }
     Metrics::instance().inc("sync_revocations_total");
+    StatuszEntry revoked;
+    revoked.op = "sync";
+    revoked.trace_id = tick_span.trace_id();
+    revoked.detail = "sheet authorization revoked";
+    Statusz::instance().record(name, std::move(revoked));
     try {
       post_event(client,
                  build_event(prior[name], "QuotaRevoked",
@@ -239,6 +259,7 @@ void run_sync_once(KubeClient& client, const Json& sync_config, SheetSource& she
 int main() {
   log_init("tpubc-synchronizer");
   Tracer::instance().set_process_name("tpubc-synchronizer");
+  Statusz::instance().set_process_name("tpubc-synchronizer");
   install_signal_handlers();
 
   EnvConfig env;
@@ -286,19 +307,35 @@ int main() {
   // black-holed API server.
   client.set_cancel(&stop_requested());
 
-  HttpServer health(listen_addr, listen_port, [](const HttpRequest& req) {
+  std::atomic<bool> is_leader{env.get("leader_elect", "0") != "1"};
+  std::atomic<int64_t> last_tick_ms{monotonic_ms()};
+  HttpServer health(listen_addr, listen_port, [&](const HttpRequest& req) {
     HttpResponse resp;
     if (req.path == "/health") {
       resp.status = 200;
       resp.headers["Content-Type"] = "text/plain";
       resp.body = "pong";
     } else if (req.path == "/metrics") {
+      Metrics::instance().set("leader_is_leader", is_leader.load() ? 1 : 0);
       resp.status = 200;
       resp.headers["Content-Type"] = "text/plain; version=0.0.4";
       resp.body = Metrics::instance().to_prometheus();
     } else if (req.path == "/metrics.json") {
+      Metrics::instance().set("leader_is_leader", is_leader.load() ? 1 : 0);
       resp.status = 200;
       resp.body = Metrics::instance().to_json().dump();
+    } else if (req.path == "/statusz" || starts_with(req.path, "/statusz?")) {
+      // Per-CR sync outcomes (quota applied, revoked, conflicts) with
+      // the tick's trace id; ?name=<cr> filters to one CR.
+      std::string filter;
+      const size_t q = req.path.find("?name=");
+      if (q != std::string::npos) filter = req.path.substr(q + 6);
+      Statusz::instance().set_state("leader", is_leader.load());
+      Statusz::instance().set_state(
+          "last_tick_age_seconds", (monotonic_ms() - last_tick_ms.load()) / 1000);
+      resp.status = 200;
+      resp.headers["Content-Type"] = "application/json";
+      resp.body = Statusz::instance().to_json(filter).dump();
     } else if (req.path == "/traces.json") {
       resp.status = 200;
       resp.headers["Content-Type"] = "application/json";
@@ -329,11 +366,13 @@ int main() {
       log_info("stopped before acquiring leadership");
       return 0;
     }
+    is_leader = true;
     // The renew loop runs beside the tick loop; losing the lease stops
     // the process (exit 1 -> kubelet restarts it into standby mode).
     holder = std::thread([&] {
       if (!elector->hold(stop_requested())) {
         lost_leadership = true;
+        is_leader = false;
         request_stop();
       }
     });
@@ -344,11 +383,16 @@ int main() {
     // Per-tick leadership gate (wall-clock-deadline checked): a tick that
     // starts after lease validity lapsed must not write.
     if (elector && !elector->is_leader()) continue;
+    last_tick_ms.store(monotonic_ms());
     try {
       run_sync_once(client, sync_config, sheet, inventory);
     } catch (const std::exception& e) {
       log_error("synchronization failed", {{"error", e.what()}});
       Metrics::instance().inc("sync_errors_total");
+      StatuszEntry failed;
+      failed.op = "sync";
+      failed.error = e.what();
+      Statusz::instance().record("_tick", std::move(failed));
     }
   } while (!stop_wait_ms(interval_secs * 1000));
 

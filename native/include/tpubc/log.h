@@ -38,6 +38,19 @@ std::string log_level_for(const std::string& spec, const std::string& target);
 // means the daemon's own (log_init) target.
 bool log_enabled(LogLevel level, const std::string& target = "");
 
+// Hot-path Warning flood control: a per-(target, message) token bucket
+// (burst TPUBC_LOG_RATELIMIT_BURST, default 5; one token refilled every
+// TPUBC_LOG_RATELIMIT_SECS, default 10; TPUBC_LOG_RATELIMIT=off
+// disables). A flapping CR re-logging the same warning every error
+// requeue would otherwise flood TPUBC_LOG_FORMAT=json output; suppressed
+// lines increment the log_suppressed_total metric instead of printing.
+// Pure-core probe (explicit clock) exposed for tests and capi: returns
+// whether an event keyed (target, message) at now_ms passes the bucket.
+bool log_ratelimit_allow(const std::string& target, const std::string& message,
+                         int64_t now_ms);
+// Drop all bucket state (test isolation; the limiter is process-global).
+void log_ratelimit_reset();
+
 using LogField = std::pair<std::string, std::string>;
 
 void log_event(LogLevel level, const std::string& message,

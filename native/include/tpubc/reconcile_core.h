@@ -50,6 +50,12 @@ Json build_jobset(const Json& ub, const Json& config);
 // additionally emits build_service's ClusterIP Service for it.
 bool serve_mode(const Json& ub);
 
+// Port worker 0's workload metrics are reachable on for this CR (0 =
+// nothing scrapeable): an explicit WORKLOAD_METRICS_PORT in spec.tpu.env
+// wins (the train-mode metrics server); a serve-mode slice falls back to
+// its serving port (the ingress serves /metrics next to /v1/generate).
+int64_t workload_metrics_port(const Json& ub);
+
 // The ClusterIP Service routing to worker 0 of a serve-mode slice —
 // the consumable front door for a provisioned serving JobSet. Port 80
 // -> the worker's WORKLOAD_SERVE_PORT (defaulted by build_jobset when
@@ -89,6 +95,16 @@ bool jobset_spec_changed(const Json& ub, const Json& desired_jobset);
 
 // Desired status.slice block given the CR and the observed JobSet (or null).
 Json slice_status(const Json& ub, const Json& observed_jobset);
+
+// Summarize a worker's /metrics.json scrape into the
+// status.slice.workload block: {last_step, tokens_per_sec, serve_qps,
+// last_scrape}. The controller merge-patches it next to the phase so
+// `kubectl get tub -o yaml` answers "is it training/serving, at what
+// rate" without port-forwarding to the pod. Pure: the scrape payload and
+// timestamp are threaded in. Returns null when the payload carries none
+// of the workload keys (a scrape of a pod that exports nothing must not
+// write an empty block).
+Json workload_summary(const Json& metrics, const std::string& scraped_at);
 
 // A core/v1 Event attached to the CR (involvedObject), applied by the
 // daemons so `kubectl describe ub <name>` shows reconcile history. The

@@ -1,0 +1,88 @@
+// Per-object flight recorder — the "/statusz" introspection surface.
+//
+// /metrics answers "how is the daemon doing in aggregate"; /traces.json
+// answers "what did this request's call tree look like". Neither answers
+// the question an operator actually pages on: "what happened to CR X in
+// the last minute?" — that used to require replaying logs. /statusz
+// closes the gap: every daemon keeps a bounded ring of recent outcomes
+// PER OBJECT (reconcile passes, sync actions, admission mutations) with
+// timestamp, duration, error, and the trace id that joins the outcome to
+// /traces.json and the TPUBC_LOG_FORMAT=json log lines — plus a small
+// live-state map (leader state, workqueue depth, watch-stream ages) the
+// daemons refresh at render time.
+//
+// Bounds: kRingCapacity outcomes per object (TPUBC_STATUSZ_RING
+// overrides) and kMaxObjects tracked objects; when the object cap is
+// hit, the object with the OLDEST most-recent outcome is evicted — CR
+// churn cannot grow the recorder without bound.
+//
+// GET /statusz           -> every object's recent outcomes + live state
+// GET /statusz?name=foo  -> just foo's ring (the per-CR page)
+#pragma once
+
+#include <cstdint>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+
+#include "tpubc/json.h"
+
+namespace tpubc {
+
+struct StatuszEntry {
+  int64_t ts_ms = 0;       // wall-clock epoch milliseconds
+  std::string op;          // "reconcile" | "sync" | "mutate" | ...
+  double duration_ms = 0;
+  std::string error;       // empty = success
+  std::string trace_id;    // joins /traces.json and JSON log lines
+  std::string detail;      // e.g. applied kinds, slice phase, decision
+};
+
+class Statusz {
+ public:
+  static constexpr size_t kRingCapacity = 32;
+  static constexpr size_t kMaxObjects = 1024;
+
+  static Statusz& instance();
+
+  void set_process_name(const std::string& name);
+
+  // Append one outcome to the object's ring (oldest evicted at
+  // capacity). Thread-safe; intended for the reconcile/sync/mutate hot
+  // paths — one mutex'd deque append.
+  void record(const std::string& object, StatuszEntry entry);
+
+  // Live daemon state rendered alongside the rings (leader flag,
+  // workqueue depth, watch-stream last-event ages...). Daemons refresh
+  // these right before rendering so ages are current at scrape time.
+  void set_state(const std::string& key, const Json& value);
+
+  // {"process", "objects": {name: [outcomes oldest-first]}, "state":
+  // {...}}; a non-empty object_filter restricts to that object (absent
+  // objects render an empty ring rather than erroring — the CR may
+  // simply not have been touched yet).
+  Json to_json(const std::string& object_filter = "") const;
+
+  // Number of buffered outcomes for one object (tests).
+  size_t ring_size(const std::string& object) const;
+
+  void reset();
+
+ private:
+  Statusz();
+
+  Json entry_json(const StatuszEntry& e) const;
+
+  mutable std::mutex mutex_;
+  size_t capacity_;
+  std::string process_ = "tpubc";
+  std::unordered_map<std::string, std::deque<StatuszEntry>> rings_;
+  Json state_ = Json::object();
+  size_t evicted_objects_ = 0;
+};
+
+// Wall-clock epoch milliseconds (the recorder's timestamp base).
+int64_t statusz_now_ms();
+
+}  // namespace tpubc

@@ -8,6 +8,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <string>
+#include <utility>
 
 #include "tpubc/admission_core.h"
 #include "tpubc/crd.h"
@@ -17,6 +18,7 @@
 #include "tpubc/reconcile_core.h"
 #include "tpubc/runtime.h"
 #include "tpubc/sheet_core.h"
+#include "tpubc/statusz.h"
 #include "tpubc/topology.h"
 #include "tpubc/trace.h"
 #include "tpubc/util.h"
@@ -275,6 +277,72 @@ char* tpubc_metrics_reset() {
 // ("info,kube=debug") — the pure core of the env filter.
 char* tpubc_log_level_for(const char* spec, const char* target) {
   return guarded([&] { return tpubc::log_level_for(spec, target); });
+}
+
+// Warning-flood token bucket, driven with an EXPLICIT clock so tests pin
+// refill behavior deterministically (the daemons feed monotonic_ms).
+char* tpubc_log_ratelimit_allow(const char* target, const char* message,
+                                const char* now_ms) {
+  return guarded([&] {
+    return tpubc::Json(
+               tpubc::log_ratelimit_allow(target, message, std::stoll(now_ms)))
+        .dump();
+  });
+}
+
+char* tpubc_log_ratelimit_reset() {
+  return guarded([] {
+    tpubc::log_ratelimit_reset();
+    return std::string("{}");
+  });
+}
+
+// ---- statusz flight recorder ----------------------------------------------
+// The pytest suite drives the SAME recorder instance the daemons write:
+// ring bounds, error capture, and trace-id join are tested here without a
+// cluster.
+
+char* tpubc_statusz_record(const char* object, const char* entry_json) {
+  return guarded([&] {
+    tpubc::Json e = tpubc::Json::parse(entry_json);
+    tpubc::StatuszEntry entry;
+    entry.ts_ms = e.get_int("ts_ms", 0);
+    entry.op = e.get_string("op");
+    entry.duration_ms = e.get("duration_ms").is_number()
+                            ? e.get("duration_ms").as_double()
+                            : 0.0;
+    entry.error = e.get_string("error");
+    entry.trace_id = e.get_string("trace_id");
+    entry.detail = e.get_string("detail");
+    tpubc::Statusz::instance().record(object, std::move(entry));
+    return std::string("{}");
+  });
+}
+
+char* tpubc_statusz_set_state(const char* key, const char* value_json) {
+  return guarded([&] {
+    tpubc::Statusz::instance().set_state(key, tpubc::Json::parse(value_json));
+    return std::string("{}");
+  });
+}
+
+char* tpubc_statusz_json(const char* object_filter) {
+  return guarded(
+      [&] { return tpubc::Statusz::instance().to_json(object_filter).dump(); });
+}
+
+char* tpubc_statusz_reset() {
+  return guarded([] {
+    tpubc::Statusz::instance().reset();
+    return std::string("{}");
+  });
+}
+
+char* tpubc_workload_summary(const char* metrics, const char* scraped_at) {
+  return guarded([&] {
+    return tpubc::workload_summary(tpubc::Json::parse(metrics), scraped_at)
+        .dump();
+  });
 }
 
 char* tpubc_base64_decode(const char* data) {

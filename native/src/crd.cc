@@ -315,6 +315,36 @@ Json status_schema() {
                           "controller compares it against the desired "
                           "JobSet's hash to decide delete-then-recreate "
                           "(JobSet pod templates are immutable).")},
+                     {"workload",
+                      Json::object({
+                          {"description",
+                           "Workload health summary scraped from worker "
+                           "0's /metrics.json (opt-in via "
+                           "CONF_WORKLOAD_SCRAPE on the controller): is "
+                           "the slice training/serving and at what rate, "
+                           "without port-forwarding to the pod."},
+                          {"nullable", true},
+                          {"type", "object"},
+                          {"properties",
+                           Json::object({
+                               {"last_step",
+                                int_schema("Last completed train step.")},
+                               {"tokens_per_sec",
+                                Json::object({{"description",
+                                               "Recent training (or serving) "
+                                               "token throughput."},
+                                              {"type", "number"}})},
+                               {"serve_qps",
+                                Json::object({{"description",
+                                               "Recent serving completions "
+                                               "per second."},
+                                              {"type", "number"}})},
+                               {"last_scrape",
+                                nullable_string_schema(
+                                    "RFC3339 timestamp of the scrape this "
+                                    "summary came from.")},
+                           })},
+                      })},
                      {"conditions",
                       Json::object({
                           {"description", "Slice-provisioning conditions "
@@ -378,20 +408,26 @@ Json crd_definition() {
            {"scope", "Cluster"},
            {"versions",
             Json::array({Json::object({
+                // `kubectl get tub` shows the lifecycle at a glance:
+                // PHASE (the slice ladder), the requested hardware
+                // (ACCELERATOR, CHIPS), the sheet gate (SYNCED), and AGE.
                 {"additionalPrinterColumns",
                  Json::array({
+                     Json::object({{"jsonPath", ".status.slice.phase"},
+                                   {"name", "Phase"},
+                                   {"type", "string"}}),
                      Json::object({{"jsonPath", ".spec.tpu.accelerator"},
                                    {"name", "Accelerator"},
                                    {"type", "string"}}),
-                     Json::object({{"jsonPath", ".spec.tpu.topology"},
-                                   {"name", "Topology"},
-                                   {"type", "string"}}),
+                     Json::object({{"jsonPath", ".status.slice.chips"},
+                                   {"name", "Chips"},
+                                   {"type", "integer"}}),
                      Json::object({{"jsonPath", ".status.synchronized_with_sheet"},
                                    {"name", "Synced"},
                                    {"type", "boolean"}}),
-                     Json::object({{"jsonPath", ".status.slice.phase"},
-                                   {"name", "Slice"},
-                                   {"type", "string"}}),
+                     Json::object({{"jsonPath", ".metadata.creationTimestamp"},
+                                   {"name", "Age"},
+                                   {"type", "date"}}),
                  })},
                 {"name", kVersion},
                 {"schema", Json::object({{"openAPIV3Schema", schema}})},

@@ -1,11 +1,14 @@
 #include "tpubc/log.h"
 
+#include <algorithm>
 #include <cstdio>
 #include <cstdlib>
 #include <mutex>
+#include <unordered_map>
 #include <vector>
 
 #include "tpubc/json.h"
+#include "tpubc/runtime.h"
 #include "tpubc/trace.h"
 #include "tpubc/util.h"
 
@@ -135,6 +138,81 @@ bool log_enabled(LogLevel level, const std::string& target) {
 
 namespace {
 
+// Per-(target, message) token buckets for Warning flood control. One
+// mutex'd map lookup per Warning — off the Info/Debug fast path
+// entirely. Bounded: a pathological key cardinality (e.g. messages
+// carrying unique ids) clears the whole map rather than growing without
+// bound; the cost is a one-time burst re-grant per key.
+struct TokenBucket {
+  double tokens;
+  int64_t last_ms;
+};
+
+constexpr size_t kMaxRatelimitKeys = 4096;
+std::mutex g_rl_mutex;
+std::unordered_map<std::string, TokenBucket> g_rl_buckets;
+
+double rl_burst() {
+  static double v = [] {
+    const char* env = std::getenv("TPUBC_LOG_RATELIMIT_BURST");
+    double b = env ? std::atof(env) : 5.0;
+    return b > 0 ? b : 5.0;
+  }();
+  return v;
+}
+
+double rl_refill_secs() {
+  static double v = [] {
+    const char* env = std::getenv("TPUBC_LOG_RATELIMIT_SECS");
+    double s = env ? std::atof(env) : 10.0;
+    return s > 0 ? s : 10.0;
+  }();
+  return v;
+}
+
+bool rl_disabled() {
+  static bool v = [] {
+    const char* env = std::getenv("TPUBC_LOG_RATELIMIT");
+    return env && to_lower(env) == "off";
+  }();
+  return v;
+}
+
+}  // namespace
+
+bool log_ratelimit_allow(const std::string& target, const std::string& message,
+                         int64_t now_ms) {
+  if (rl_disabled()) return true;
+  const std::string key = target + "\x1f" + message;
+  std::lock_guard<std::mutex> lock(g_rl_mutex);
+  if (g_rl_buckets.size() >= kMaxRatelimitKeys && !g_rl_buckets.count(key))
+    g_rl_buckets.clear();
+  auto it = g_rl_buckets.find(key);
+  if (it == g_rl_buckets.end()) {
+    g_rl_buckets[key] = {rl_burst() - 1.0, now_ms};
+    return true;
+  }
+  TokenBucket& b = it->second;
+  const double refill =
+      static_cast<double>(now_ms - b.last_ms) / 1000.0 / rl_refill_secs();
+  if (refill > 0) {
+    b.tokens = std::min(rl_burst(), b.tokens + refill);
+    b.last_ms = now_ms;
+  }
+  if (b.tokens >= 1.0) {
+    b.tokens -= 1.0;
+    return true;
+  }
+  return false;
+}
+
+void log_ratelimit_reset() {
+  std::lock_guard<std::mutex> lock(g_rl_mutex);
+  g_rl_buckets.clear();
+}
+
+namespace {
+
 void emit(LogLevel level, const std::string& target, const std::string& message,
           std::initializer_list<LogField> fields) {
   std::string line;
@@ -176,15 +254,33 @@ void emit(LogLevel level, const std::string& target, const std::string& message,
 
 }  // namespace
 
+namespace {
+
+// Warnings ride error-requeue loops: a flapping CR re-logs the same
+// (target, message) every few seconds forever. The bucket keys on the
+// constant message text — fields (which carry the per-occurrence error
+// detail) stay out of the key, so one flapping CAUSE maps to one bucket.
+bool suppress_warning(LogLevel level, const std::string& target,
+                      const std::string& message) {
+  if (level != LogLevel::Warn) return false;
+  if (log_ratelimit_allow(target, message, monotonic_ms())) return false;
+  Metrics::instance().inc("log_suppressed_total");
+  return true;
+}
+
+}  // namespace
+
 void log_event(LogLevel level, const std::string& message,
                std::initializer_list<LogField> fields) {
   if (!log_enabled(level)) return;
+  if (suppress_warning(level, g_target, message)) return;
   emit(level, g_target, message, fields);
 }
 
 void log_event(LogLevel level, const std::string& target, const std::string& message,
                std::initializer_list<LogField> fields) {
   if (!log_enabled(level, target)) return;
+  if (suppress_warning(level, target, message)) return;
   emit(level, target, message, fields);
 }
 

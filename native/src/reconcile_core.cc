@@ -314,6 +314,20 @@ bool serve_mode(const Json& ub) {
   return env.is_object() && env.get_string("WORKLOAD_MODE") == "serve";
 }
 
+int64_t workload_metrics_port(const Json& ub) {
+  const Json& tpu = ub.get("spec").get("tpu");
+  if (!tpu.is_object()) return 0;
+  const Json& env = tpu.get("env");
+  if (env.is_object()) {
+    int64_t v = 0;
+    if (parse_port(env.get_string("WORKLOAD_METRICS_PORT"), &v)) return v;
+  }
+  // A serve-mode slice's ingress serves /metrics + /metrics.json next to
+  // /v1/generate, so its serving port doubles as the scrape port.
+  if (serve_mode(ub)) return serve_port(tpu);
+  return 0;
+}
+
 Json build_service(const Json& ub) {
   const Json& tpu = ub.get("spec").get("tpu");
   if (!tpu.is_object()) throw JsonError("build_service: spec.tpu is absent");
@@ -597,6 +611,29 @@ Json slice_status(const Json& ub, const Json& observed_jobset) {
              }),
          }));
   return st;
+}
+
+Json workload_summary(const Json& metrics, const std::string& scraped_at) {
+  if (!metrics.is_object()) return Json();
+  Json out = Json::object();
+  const Json& step = metrics.get("workload_last_step");
+  if (step.is_number()) out.set("last_step", step.as_int());
+  // Training and serving export different rate gauges; whichever the
+  // worker runs wins (a serve-mode slice has no train loop and vice
+  // versa — both present would mean a custom workload, where the train
+  // rate is the more conservative report).
+  const Json& train_tps = metrics.get("workload_tokens_per_sec");
+  const Json& serve_tps = metrics.get("serve_tokens_per_sec");
+  if (train_tps.is_number() && train_tps.as_double() > 0) {
+    out.set("tokens_per_sec", train_tps.as_double());
+  } else if (serve_tps.is_number()) {
+    out.set("tokens_per_sec", serve_tps.as_double());
+  }
+  const Json& qps = metrics.get("serve_qps");
+  if (qps.is_number()) out.set("serve_qps", qps.as_double());
+  if (out.size() == 0) return Json();
+  out.set("last_scrape", scraped_at);
+  return out;
 }
 
 std::string event_namespace() {

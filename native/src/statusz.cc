@@ -1,0 +1,137 @@
+#include "tpubc/statusz.h"
+
+#include <algorithm>
+#include <chrono>
+#include <climits>
+#include <cstdlib>
+#include <utility>
+#include <vector>
+
+namespace tpubc {
+
+int64_t statusz_now_ms() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+
+Statusz::Statusz() : capacity_(kRingCapacity) {
+  if (const char* env = std::getenv("TPUBC_STATUSZ_RING")) {
+    char* end = nullptr;
+    long v = std::strtol(env, &end, 10);
+    if (end != env && v > 0) capacity_ = static_cast<size_t>(v);
+  }
+}
+
+Statusz& Statusz::instance() {
+  static Statusz s;
+  return s;
+}
+
+void Statusz::set_process_name(const std::string& name) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  process_ = name;
+}
+
+void Statusz::record(const std::string& object, StatuszEntry entry) {
+  if (entry.ts_ms == 0) entry.ts_ms = statusz_now_ms();
+  std::lock_guard<std::mutex> lock(mutex_);
+  auto it = rings_.find(object);
+  if (it == rings_.end()) {
+    if (rings_.size() >= kMaxObjects) {
+      // Evict the object with the OLDEST most-recent outcome: CR churn
+      // (create/delete storms) must not grow the recorder unboundedly,
+      // and the least-recently-touched ring is the least likely page an
+      // operator is about to ask for.
+      auto oldest = rings_.begin();
+      int64_t oldest_ts = INT64_MAX;
+      for (auto r = rings_.begin(); r != rings_.end(); ++r) {
+        const int64_t last = r->second.empty() ? 0 : r->second.back().ts_ms;
+        if (last < oldest_ts) {
+          oldest_ts = last;
+          oldest = r;
+        }
+      }
+      rings_.erase(oldest);
+      ++evicted_objects_;
+    }
+    it = rings_.emplace(object, std::deque<StatuszEntry>()).first;
+  }
+  std::deque<StatuszEntry>& ring = it->second;
+  if (ring.size() >= capacity_) ring.pop_front();
+  ring.push_back(std::move(entry));
+}
+
+void Statusz::set_state(const std::string& key, const Json& value) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  state_.set(key, value);
+}
+
+Json Statusz::entry_json(const StatuszEntry& e) const {
+  Json out = Json::object({
+      {"ts_ms", e.ts_ms},
+      {"op", e.op},
+      {"duration_ms", e.duration_ms},
+      {"ok", e.error.empty()},
+  });
+  if (!e.error.empty()) out.set("error", e.error);
+  if (!e.trace_id.empty()) out.set("trace_id", e.trace_id);
+  if (!e.detail.empty()) out.set("detail", e.detail);
+  return out;
+}
+
+Json Statusz::to_json(const std::string& object_filter) const {
+  std::lock_guard<std::mutex> lock(mutex_);
+  Json objects = Json::object();
+  auto render_ring = [&](const std::string& name,
+                         const std::deque<StatuszEntry>& ring) {
+    Json arr = Json::array();
+    for (const auto& e : ring) arr.push_back(entry_json(e));
+    objects.set(name, std::move(arr));
+  };
+  if (!object_filter.empty()) {
+    auto it = rings_.find(object_filter);
+    if (it != rings_.end()) {
+      render_ring(it->first, it->second);
+    } else {
+      // An unknown object renders an empty ring, not an error: "no
+      // recorded outcomes" is a real answer for a CR the daemon has not
+      // touched (or whose ring was evicted).
+      objects.set(object_filter, Json::array());
+    }
+  } else {
+    // Deterministic render order over the unordered storage.
+    std::vector<const std::pair<const std::string, std::deque<StatuszEntry>>*> sorted;
+    sorted.reserve(rings_.size());
+    for (const auto& kv : rings_) sorted.push_back(&kv);
+    std::sort(sorted.begin(), sorted.end(),
+              [](const auto* a, const auto* b) { return a->first < b->first; });
+    for (const auto* kv : sorted) render_ring(kv->first, kv->second);
+  }
+  Json out = Json::object({
+      {"process", process_},
+      {"generated_at_ms", statusz_now_ms()},
+      {"ring_capacity", static_cast<int64_t>(capacity_)},
+      {"tracked_objects", static_cast<int64_t>(rings_.size())},
+      {"state", state_},
+      {"objects", std::move(objects)},
+  });
+  if (evicted_objects_ > 0)
+    out.set("evicted_objects", static_cast<int64_t>(evicted_objects_));
+  return out;
+}
+
+size_t Statusz::ring_size(const std::string& object) const {
+  std::lock_guard<std::mutex> lock(mutex_);
+  auto it = rings_.find(object);
+  return it == rings_.end() ? 0 : it->second.size();
+}
+
+void Statusz::reset() {
+  std::lock_guard<std::mutex> lock(mutex_);
+  rings_.clear();
+  state_ = Json::object();
+  evicted_objects_ = 0;
+}
+
+}  // namespace tpubc

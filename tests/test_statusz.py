@@ -1,0 +1,287 @@
+"""Flight-recorder (/statusz) tests: the per-object ring core via capi
+(ring bounds, error capture, trace-id join), the Warning-flood token
+bucket, and the deployed surface — all three daemons answering /statusz
+with per-CR outcomes whose trace ids join /traces.json."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import pytest
+
+from tests.test_integration_daemons import (
+    KEY_JS,
+    SYNCED,
+    Daemon,
+    controller_env,
+    fake,  # noqa: F401 - fixture
+    free_port,
+    full_spec,
+    wait_for,
+)
+
+
+@pytest.fixture()
+def recorder(lib):
+    lib.statusz_reset()
+    yield lib
+    lib.statusz_reset()
+
+
+# ---------------------------------------------------------------------------
+# pure core (capi)
+# ---------------------------------------------------------------------------
+
+
+def test_ring_bounds_per_object(recorder):
+    """The per-object ring holds the LAST capacity outcomes — oldest
+    evicted, other objects untouched."""
+    doc = recorder.statusz()
+    cap = doc["ring_capacity"]
+    for i in range(cap + 10):
+        recorder.statusz_record("alice", {"op": "reconcile", "duration_ms": i})
+    recorder.statusz_record("bob", {"op": "reconcile", "duration_ms": 1})
+    doc = recorder.statusz()
+    ring = doc["objects"]["alice"]
+    assert len(ring) == cap
+    # Oldest-first: the first 10 outcomes were evicted.
+    assert ring[0]["duration_ms"] == 10
+    assert ring[-1]["duration_ms"] == cap + 9
+    assert len(doc["objects"]["bob"]) == 1
+
+
+def test_error_capture_and_ok_flag(recorder):
+    recorder.statusz_record("alice", {"op": "reconcile", "duration_ms": 3.5})
+    recorder.statusz_record(
+        "alice", {"op": "reconcile", "error": "apply failed: HTTP 500"})
+    ring = recorder.statusz("alice")["objects"]["alice"]
+    assert ring[0]["ok"] is True and "error" not in ring[0]
+    assert ring[1]["ok"] is False
+    assert ring[1]["error"] == "apply failed: HTTP 500"
+
+
+def test_trace_id_join(recorder):
+    """A recorded outcome's trace_id must be the join key against the
+    span buffer: record a real span, then a statusz entry carrying its
+    trace id, and match them."""
+    recorder.trace_reset()
+    span = recorder.trace_test_span("controller.reconcile")
+    recorder.statusz_record(
+        "alice", {"op": "reconcile", "trace_id": span["trace_id"]})
+    entry = recorder.statusz("alice")["objects"]["alice"][0]
+    trace_ids = {s["trace_id"] for s in recorder.trace_dump()["spans"]}
+    assert entry["trace_id"] in trace_ids
+    recorder.trace_reset()
+
+
+def test_filter_and_unknown_object(recorder):
+    recorder.statusz_record("alice", {"op": "sync"})
+    recorder.statusz_record("bob", {"op": "sync"})
+    filtered = recorder.statusz("alice")["objects"]
+    assert set(filtered) == {"alice"}
+    # Unknown object: an empty ring, not an error ("never touched" is a
+    # real answer).
+    assert recorder.statusz("nobody")["objects"]["nobody"] == []
+
+
+def test_live_state_rendered(recorder):
+    recorder.statusz_set_state("leader", True)
+    recorder.statusz_set_state("workqueue_depth", 7)
+    state = recorder.statusz()["state"]
+    assert state["leader"] is True
+    assert state["workqueue_depth"] == 7
+
+
+def test_timestamps_default_to_now(recorder):
+    recorder.statusz_record("alice", {"op": "mutate"})
+    entry = recorder.statusz("alice")["objects"]["alice"][0]
+    assert entry["ts_ms"] > 1_500_000_000_000  # epoch ms, not zero
+
+
+# ---------------------------------------------------------------------------
+# warning rate limiter (pure core, explicit clock)
+# ---------------------------------------------------------------------------
+
+
+def test_log_ratelimit_burst_then_refill(lib):
+    lib.log_ratelimit_reset()
+    t0 = 1_000_000
+    # Default burst 5: the first five pass, the sixth is suppressed.
+    decisions = [lib.log_ratelimit_allow("tpubc", "apply failed", t0)
+                 for _ in range(6)]
+    assert decisions == [True] * 5 + [False]
+    # One token refills every 10s (default): at +10s exactly one more
+    # line passes, the next is suppressed again.
+    assert lib.log_ratelimit_allow("tpubc", "apply failed", t0 + 10_000)
+    assert not lib.log_ratelimit_allow("tpubc", "apply failed", t0 + 10_000)
+    lib.log_ratelimit_reset()
+
+
+def test_log_ratelimit_keys_are_per_target_and_message(lib):
+    lib.log_ratelimit_reset()
+    t0 = 2_000_000
+    for _ in range(5):
+        assert lib.log_ratelimit_allow("tpubc", "watch failed", t0)
+    assert not lib.log_ratelimit_allow("tpubc", "watch failed", t0)
+    # A different message — and the same message under a different
+    # target — have their own buckets.
+    assert lib.log_ratelimit_allow("tpubc", "sync failed", t0)
+    assert lib.log_ratelimit_allow("kube", "watch failed", t0)
+    lib.log_ratelimit_reset()
+
+
+def test_suppressed_warnings_surface_as_metric(lib):
+    """A flapping daemon's suppressed Warning lines must be countable:
+    log_suppressed_total is the dedup counter the satellite asks for.
+    (The counter increments in log_event's Warn path; here we pin the
+    capi-visible contract that the metric exists and counts.)"""
+    lib.metrics_reset()
+    lib.metrics_inc("log_suppressed_total", 3)
+    assert lib.metrics_json()["log_suppressed_total"] == 3
+    text = lib.metrics_prometheus()
+    assert "# TYPE log_suppressed counter" in text
+    lib.metrics_reset()
+
+
+# ---------------------------------------------------------------------------
+# deployed surface: the daemons answer /statusz
+# ---------------------------------------------------------------------------
+
+
+def statusz_of(port: int, name: str = "") -> dict:
+    url = f"http://127.0.0.1:{port}/statusz"
+    if name:
+        url += f"?name={name}"
+    with urllib.request.urlopen(url, timeout=5) as r:
+        assert r.headers["Content-Type"].startswith("application/json")
+        return json.loads(r.read())
+
+
+def test_controller_statusz_records_reconciles_with_trace_ids(fake):  # noqa: F811
+    fake.create_ub("alice", spec=full_spec(), status=dict(SYNCED))
+    port = free_port()
+    d = Daemon("tpubc-controller", controller_env(fake, port), port).wait_healthy()
+    try:
+        wait_for(lambda: fake.get(KEY_JS("alice"), "alice-slice"), desc="jobset")
+        doc = wait_for(
+            lambda: (lambda s: s if s["objects"].get("alice") else None)(
+                statusz_of(port, "alice")),
+            desc="statusz outcomes for alice",
+        )
+        assert doc["process"] == "tpubc-controller"
+        ring = doc["objects"]["alice"]
+        last = [o for o in ring if o["op"] == "reconcile"][-1]
+        assert last["ok"] is True
+        assert last["trace_id"], "reconcile outcome must join a trace"
+        assert "JobSet" in last["detail"]
+        assert "phase=" in last["detail"]
+        # Live state next to the rings.
+        assert "workqueue_depth" in doc["state"]
+        assert "watch_last_event_age_seconds" in doc["state"]
+        assert doc["state"]["leader"] is True
+        # The outcome's trace id joins /traces.json (the Dapper-side view
+        # of the same pass).
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/traces.json", timeout=5) as r:
+            spans = json.loads(r.read())["spans"]
+        assert last["trace_id"] in {s["trace_id"] for s in spans}
+        # ...and the new daemon gauges are scrapeable.
+        m = d.metrics()
+        assert "workqueue_depth" in m
+        assert "watch_last_event_age_seconds" in m
+        assert m["leader_is_leader"] == 1
+    finally:
+        code, err = d.stop()
+        assert code == 0, err
+
+
+def test_controller_statusz_records_errors(fake):  # noqa: F811
+    """A reconcile that throws must land in the CR's ring WITH the error
+    message — the "what happened to CR X" answer that used to require
+    log replay."""
+    fake.create_ub("erin", spec=full_spec(), status=dict(SYNCED))
+    # Fail every write for a while: reconciles error out.
+    fake.httpd.error_rate = 1.0
+    port = free_port()
+    d = Daemon("tpubc-controller",
+               controller_env(fake, port, conf_error_requeue_secs=1),
+               port).wait_healthy()
+    try:
+        doc = wait_for(
+            lambda: (lambda s: s if any(
+                not o["ok"] for o in s["objects"].get("erin", [])) else None)(
+                statusz_of(port, "erin")),
+            timeout=15,
+            desc="errored outcome recorded",
+        )
+        bad = [o for o in doc["objects"]["erin"] if not o["ok"]][-1]
+        assert bad["error"]
+        assert bad["trace_id"]
+        # Recovery: outcomes flip back to ok once writes heal.
+        fake.httpd.error_rate = 0.0
+        wait_for(
+            lambda: any(o["ok"] for o in
+                        statusz_of(port, "erin")["objects"]["erin"]),
+            timeout=15, desc="healthy outcome after recovery",
+        )
+    finally:
+        code, err = d.stop()
+        assert code == 0, err
+
+
+def test_admission_statusz_records_mutations():
+    from tests.test_integration_daemons import admission_review, post_json
+
+    port = free_port()
+    d = Daemon(
+        "tpubc-admission",
+        {"CONF_LISTEN_ADDR": "127.0.0.1", "CONF_LISTEN_PORT": str(port),
+         "CONF_TLS_DISABLED": "1",
+         "CONF_AUTHORIZED_GROUP_NAMES": "tpu,admin"},
+        port,
+    ).wait_healthy()
+    try:
+        post_json(f"http://127.0.0.1:{port}/mutate", admission_review())
+        post_json(f"http://127.0.0.1:{port}/mutate",
+                  admission_review(name="mallory", groups=("students",)))
+        doc = statusz_of(port)
+        allowed = doc["objects"]["alice"][-1]
+        assert allowed["op"] == "mutate" and allowed["ok"] is True
+        assert "allowed" in allowed["detail"]
+        assert allowed["trace_id"]
+        denied = doc["objects"]["mallory"][-1]
+        assert denied["ok"] is False and denied["error"]
+        assert "denied" in denied["detail"]
+    finally:
+        code, err = d.stop()
+        assert code == 0, err
+
+
+def test_synchronizer_statusz_records_sync_outcomes(fake, tmp_path):  # noqa: F811
+    from tests.test_integration_daemons import CSV_HEADER
+
+    sheet = tmp_path / "sheet.csv"
+    sheet.write_text(CSV_HEADER + "앨리스,CSE,alice,tpu-serv,16,8,32,100,o\n")
+    fake.create_ub("alice", spec={"kube_username": "alice"})
+    port = free_port()
+    d = Daemon(
+        "tpubc-synchronizer",
+        {"CONF_KUBE_API_URL": fake.url, "CONF_LISTEN_ADDR": "127.0.0.1",
+         "CONF_LISTEN_PORT": str(port), "CONF_SHEET_PATH": str(sheet),
+         "CONF_SYNC_INTERVAL_SECS": "1", "CONF_SERVER_NAME": "tpu-serv"},
+        port,
+    ).wait_healthy()
+    try:
+        doc = wait_for(
+            lambda: (lambda s: s if s["objects"].get("alice") else None)(
+                statusz_of(port, "alice")),
+            desc="sync outcome for alice",
+        )
+        entry = doc["objects"]["alice"][-1]
+        assert entry["op"] == "sync" and entry["ok"] is True
+        assert "16 chips" in entry["detail"]
+        assert entry["trace_id"]
+    finally:
+        code, err = d.stop()
+        assert code == 0, err

@@ -257,6 +257,34 @@ class NativeLib:
         """Effective level for a target under a TPUBC_LOG directive spec."""
         return self._call("tpubc_log_level_for", spec, target)
 
+    def log_ratelimit_allow(self, target: str, message: str, now_ms: int) -> bool:
+        """Warning-flood token bucket probe at an explicit clock."""
+        return self._call_json("tpubc_log_ratelimit_allow", target, message,
+                               str(now_ms))
+
+    def log_ratelimit_reset(self) -> None:
+        self._call_json("tpubc_log_ratelimit_reset")
+
+    # -- statusz flight recorder --------------------------------------------
+    def statusz_record(self, obj: str, entry: dict) -> None:
+        """Append one outcome to an object's /statusz ring. Entry keys:
+        ts_ms, op, duration_ms, error, trace_id, detail (all optional)."""
+        self._call_json("tpubc_statusz_record", obj, entry)
+
+    def statusz_set_state(self, key: str, value: Any) -> None:
+        self._call_json("tpubc_statusz_set_state", key, json.dumps(value))
+
+    def statusz(self, object_filter: str = "") -> dict:
+        """The /statusz document (optionally filtered to one object)."""
+        return self._call_json("tpubc_statusz_json", object_filter)
+
+    def statusz_reset(self) -> None:
+        self._call_json("tpubc_statusz_reset")
+
+    def workload_summary(self, metrics: Any, scraped_at: str) -> dict | None:
+        """status.slice.workload block from a worker /metrics.json scrape."""
+        return self._call_json("tpubc_workload_summary", metrics, scraped_at)
+
 
 _shared: NativeLib | None = None
 

@@ -260,3 +260,225 @@ def _dump_at_exit() -> None:
 
 
 atexit.register(_dump_at_exit)
+
+
+# ---------------------------------------------------------------------------
+# Workload metrics: a small Prometheus registry mirroring the native
+# Metrics surface (native/src/runtime.cc) — same two expositions
+# (/metrics text, /metrics.json with self-computed _p50/_p99), same
+# histogram semantics (fixed buckets, quantiles landing in the +Inf
+# overflow bucket CLAMPED to the last finite bound and surfaced as
+# <name>_overflow instead of being extrapolated). The controller scrapes
+# worker 0's /metrics.json and merges {last_step, tokens_per_sec,
+# serve_qps} into status.slice.workload, so the names here are a wire
+# contract with native workload_summary().
+# ---------------------------------------------------------------------------
+
+# Control-plane/serving latency bounds in ms (native kBuckets parity);
+# the implicit +Inf overflow bucket is the last slot of counts.
+DEFAULT_BUCKETS = (1, 2, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000,
+                   10000)
+
+
+class _Histogram:
+    __slots__ = ("bounds", "counts", "sum", "count")
+
+    def __init__(self, bounds):
+        self.bounds = tuple(bounds)
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        i = 0
+        while i < len(self.bounds) and value > self.bounds[i]:
+            i += 1
+        self.counts[i] += 1
+        self.sum += value
+        self.count += 1
+
+    def quantile(self, q: float) -> float:
+        """Linear interpolation within the containing bucket; overflow
+        clamps to the last finite bound (native quantile_locked parity —
+        a p99 of "10s (clamped)" is honest, extrapolating is fiction)."""
+        if self.count == 0:
+            return -1.0
+        rank = min(int(q * self.count), self.count - 1)
+        seen = 0
+        for i, in_bucket in enumerate(self.counts):
+            if seen + in_bucket > rank:
+                if i == len(self.bounds):
+                    return float(self.bounds[-1])
+                lo = 0.0 if i == 0 else float(self.bounds[i - 1])
+                hi = float(self.bounds[i])
+                if in_bucket == 0:
+                    return hi
+                return lo + (hi - lo) * (rank - seen + 1) / in_bucket
+            seen += in_bucket
+        return float(self.bounds[-1])
+
+    @property
+    def overflow(self) -> int:
+        return self.counts[-1]
+
+
+class MetricsRegistry:
+    """Named counters/gauges + fixed-bucket histograms (thread-safe)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._values: dict = {}      # counters and gauges share one map
+        self._histograms: dict = {}
+
+    def inc(self, name: str, delta=1) -> None:
+        with self._lock:
+            self._values[name] = self._values.get(name, 0) + delta
+
+    def set_gauge(self, name: str, value) -> None:
+        with self._lock:
+            self._values[name] = value
+
+    def observe(self, name: str, value: float, buckets=None) -> None:
+        """Record one observation; ``buckets`` fixes the bounds on the
+        histogram's FIRST observation (later calls reuse them)."""
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = _Histogram(
+                    buckets or DEFAULT_BUCKETS)
+            h.observe(value)
+
+    def quantile(self, name: str, q: float) -> float:
+        with self._lock:
+            h = self._histograms.get(name)
+            return -1.0 if h is None else h.quantile(q)
+
+    def to_json(self) -> dict:
+        """The bench/test/scrape surface (native to_json parity):
+        histograms appear as _count/_sum/_p50/_p99 (+ _overflow when
+        nonzero) so harnesses don't re-implement bucket math."""
+        with self._lock:
+            out = {}
+            for name in sorted(self._values):
+                out[name] = self._values[name]
+            for name in sorted(self._histograms):
+                h = self._histograms[name]
+                out[name + "_count"] = h.count
+                out[name + "_sum"] = h.sum
+                out[name + "_p50"] = h.quantile(0.50)
+                out[name + "_p99"] = h.quantile(0.99)
+                if h.overflow > 0:
+                    out[name + "_overflow"] = h.overflow
+            return out
+
+    def to_prometheus(self) -> str:
+        """Text exposition format: *_total render as counters, everything
+        else as gauges; histograms get cumulative _bucket{le=...} series
+        (native to_prometheus parity)."""
+        with self._lock:
+            lines = []
+            for name in sorted(self._values):
+                counter = name.endswith("_total")
+                family = name[:-6] if counter else name
+                lines.append(f"# TYPE {family} "
+                             f"{'counter' if counter else 'gauge'}")
+                v = self._values[name]
+                lines.append(f"{name} {v:g}" if isinstance(v, float)
+                             else f"{name} {v}")
+            for name in sorted(self._histograms):
+                h = self._histograms[name]
+                lines.append(f"# TYPE {name} histogram")
+                cum = 0
+                for bound, c in zip(h.bounds, h.counts):
+                    cum += c
+                    lines.append(f'{name}_bucket{{le="{bound:g}"}} {cum}')
+                lines.append(f'{name}_bucket{{le="+Inf"}} {h.count}')
+                lines.append(f"{name}_sum {h.sum:g}")
+                lines.append(f"{name}_count {h.count}")
+            return "\n".join(lines) + ("\n" if lines else "")
+
+    def reset(self) -> None:
+        with self._lock:
+            self._values.clear()
+            self._histograms.clear()
+
+
+_metrics = MetricsRegistry()
+
+
+def metrics() -> MetricsRegistry:
+    """The process-wide workload metrics registry."""
+    return _metrics
+
+
+class RateWindow:
+    """Rolling event-rate gauge feed (serve_qps, serve_tokens_per_sec):
+    count events with add(), read events-per-second over the trailing
+    window. Memory is bounded by the event timestamps in the window."""
+
+    def __init__(self, window_secs: float = 60.0):
+        self.window = window_secs
+        self._events = deque()  # (t, weight)
+        self._lock = threading.Lock()
+
+    def add(self, weight: float = 1.0, t: float | None = None) -> None:
+        t = time.monotonic() if t is None else t
+        with self._lock:
+            self._events.append((t, weight))
+            self._trim(t)
+
+    def per_sec(self, t: float | None = None) -> float:
+        t = time.monotonic() if t is None else t
+        with self._lock:
+            self._trim(t)
+            return sum(w for _, w in self._events) / self.window
+
+    def _trim(self, t: float) -> None:
+        cutoff = t - self.window
+        while self._events and self._events[0][0] < cutoff:
+            self._events.popleft()
+
+
+def start_metrics_server(port: int, host: str = "0.0.0.0"):
+    """Serve the registry at /metrics (text) + /metrics.json next to a
+    /healthz, on a daemon thread. The train-mode counterpart of the
+    ingress routes: a WORKLOAD_METRICS_PORT-configured train worker
+    exposes step-time/tokens-per-sec/goodput for the controller's
+    status.slice.workload scrape. Returns the HTTPServer (its .server_
+    address[1] reports the bound port; port 0 = ephemeral)."""
+    import json as _json
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if self.path == "/metrics":
+                body = _metrics.to_prometheus().encode()
+                ctype = "text/plain; version=0.0.4"
+            elif self.path == "/metrics.json":
+                body = _json.dumps(_metrics.to_json()).encode()
+                ctype = "application/json"
+            elif self.path in ("/healthz", "/health"):
+                body = b'{"ok": true}'
+                ctype = "application/json"
+            else:
+                body = b'{"error": "not found"}'
+                self.send_response(404)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd

@@ -51,6 +51,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from tpu_bootstrap import telemetry
 from tpu_bootstrap.workload.model import ModelConfig, Params
 from tpu_bootstrap.workload.serving import Request, ResidentPool, SlotPool
 
@@ -106,6 +107,13 @@ class IngressServer:
         self._ttft_ms = collections.deque(maxlen=256)
         self._total_ms = collections.deque(maxlen=256)
         self._served = 0
+        # The /metrics half of the same numbers (telemetry.metrics()):
+        # TTFT/inter-token/total-latency histograms plus rolling
+        # qps/tokens-per-sec gauges — the scrape surface the controller
+        # folds into status.slice.workload.
+        self._last_ev_t: dict = {}  # rid -> last event time (inter-token)
+        self._qps_window = telemetry.RateWindow()
+        self._tps_window = telemetry.RateWindow()
 
         outer = self
 
@@ -117,6 +125,20 @@ class IngressServer:
                 pass
 
             def do_GET(self):
+                if self.path == "/metrics":
+                    # Prometheus text exposition, same routes a daemon
+                    # serves — worker 0 of a serve slice is scrapeable
+                    # like the control plane is.
+                    body = telemetry.metrics().to_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if self.path == "/metrics.json":
+                    return self._json(200, telemetry.metrics().to_json())
                 if self.path not in ("/healthz", "/health"):
                     return self._json(404, {"error": f"unknown path {self.path}"})
                 with outer._lock:
@@ -225,6 +247,8 @@ class IngressServer:
             self._next_rid += 1
             self._pending.append((req, out_q))
             self._submit_t[req.rid] = (time.monotonic(), None)
+            telemetry.metrics().set_gauge("serve_queue_depth",
+                                          len(self._pending))
             self._work.notify()
         return out_q
 
@@ -275,21 +299,58 @@ class IngressServer:
                                "generated": generated.get(rid, [])})
                     self._streams.clear()
                     self._submit_t.clear()
+                    self._last_ev_t.clear()
                     self.pool.reset()
                 continue
             now = time.monotonic()
+            reg = telemetry.metrics()
             with self._work:
                 for rid, ev in events.items():
                     self._streams[rid].put(ev)
                     t_submit, t_first = self._submit_t.get(rid, (now, None))
+                    if ev["new"]:
+                        self._tps_window.add(len(ev["new"]), t=now)
+                        reg.inc("serve_tokens_total", len(ev["new"]))
+                        last = self._last_ev_t.get(rid)
+                        if last is not None:
+                            # Inter-token latency: this round's wall time
+                            # amortized over the tokens it delivered —
+                            # the streaming cadence a client sees.
+                            reg.observe("serve_inter_token_ms",
+                                        (now - last) * 1e3 / len(ev["new"]))
+                        self._last_ev_t[rid] = now
                     if t_first is None and ev["new"]:
                         self._submit_t[rid] = (t_submit, now)
                         self._ttft_ms.append((now - t_submit) * 1e3)
+                        reg.observe("serve_ttft_ms", (now - t_submit) * 1e3)
                     if ev["done"]:
                         del self._streams[rid]
                         self._submit_t.pop(rid, None)
+                        self._last_ev_t.pop(rid, None)
                         self._total_ms.append((now - t_submit) * 1e3)
                         self._served += 1
+                        reg.inc("serve_requests_total")
+                        reg.observe("serve_request_ms",
+                                    (now - t_submit) * 1e3)
+                        self._qps_window.add(t=now)
+                # Round-granularity gauges: occupancy, queue, the rolling
+                # qps/token-rate the status.slice.workload summary reads,
+                # and cumulative slot utilization from the pool's own
+                # schedule accounting.
+                reg.set_gauge("serve_active_slots",
+                              sum(1 for s in self.pool.slots
+                                  if s is not None))
+                reg.set_gauge("serve_queue_depth", len(self._pending))
+                reg.set_gauge("serve_qps",
+                              round(self._qps_window.per_sec(t=now), 3))
+                reg.set_gauge("serve_tokens_per_sec",
+                              round(self._tps_window.per_sec(t=now), 1))
+                stats = self.pool.stats
+                if stats.get("slot_steps"):
+                    reg.set_gauge(
+                        "serve_slot_utilization",
+                        round(stats["active_slot_steps"]
+                              / stats["slot_steps"], 3))
 
     # ---- lifecycle -------------------------------------------------------
 

@@ -80,6 +80,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from tpu_bootstrap import telemetry
 from tpu_bootstrap.workload.decode import decode_step, generate, init_cache, prefill
 from tpu_bootstrap.workload.model import ModelConfig, Params
 
@@ -176,6 +177,9 @@ class _PoolBase:
                 got = s.generated[len(s.generated) - len(got):cut]
                 s.generated = s.generated[:cut]
                 s.remaining = 0
+                # Early retirement is the lever slot recycling pays off
+                # on; its rate is an operator-facing serving metric.
+                telemetry.metrics().inc("serve_eos_retired_total")
             done = s.remaining == 0
             events[s.rid] = {"new": got, "done": done,
                              "generated": s.generated}
@@ -295,6 +299,14 @@ class SlotPool(_PoolBase):
         # gamma+1 draft steps per verify round (the +1 keeps the draft
         # cache gapless — speculative.py's draft-cache-hole note).
         self.stats["draft_steps"] += rounds * (self.gamma + 1)
+        # Committed-tokens-per-verify-round, per row: the speculative
+        # payoff per target weight stream (1.0 = no better than plain
+        # decode, gamma+1 = full acceptance). The lockstep loop commits
+        # uniformly across rows, so chunk/rounds IS the per-row value.
+        if rounds > 0:
+            telemetry.metrics().observe(
+                "serve_spec_committed_per_round", chunk / rounds,
+                buckets=tuple(range(1, self.gamma + 2)))
         return out
 
     def step_round(self) -> dict:
@@ -665,6 +677,11 @@ class ResidentPool(_PoolBase):
         # next occupant overwrites).
         kept = [min(int(counts[i]), s.remaining) if s is not None else 0
                 for i, s in enumerate(self.slots)]
+        # Per-row committed-per-round average for this verify round (the
+        # resident engine's rows diverge, so the mean is the summary).
+        telemetry.metrics().observe(
+            "serve_spec_committed_per_round", sum(kept) / max(len(active), 1),
+            buckets=tuple(range(1, self.gamma + 2)))
         self.stats["committed_tokens"] += sum(kept)
         self.stats["slot_steps"] += sum(kept)
         self.stats["active_slot_steps"] += sum(kept)

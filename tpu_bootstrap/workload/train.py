@@ -324,6 +324,12 @@ def train_loop(cfg: TrainConfig, steps: int, *, checkpoint_dir: str | None = Non
 
     start = 0
     if latest is not None:
+        # Restart recovery: this process is resuming a prior run (the
+        # JobSet gang-restart path). Count it and time the restore — the
+        # recovery cost the goodput gauge below charges against.
+        telemetry.metrics().inc("workload_restarts_total")
+        telemetry.metrics().set_gauge("workload_resumed_from_step", latest)
+        t_restore = _time.monotonic()
         # Resume: never materialize the fresh random init just to throw it
         # away — build the abstract (shape/dtype/sharding) state and let
         # orbax place the restored shards directly onto the mesh. The
@@ -342,6 +348,9 @@ def train_loop(cfg: TrainConfig, steps: int, *, checkpoint_dir: str | None = Non
             jax.eval_shape(opt.init, params_sds), opt_shardings,
         )
         params, opt_state = ckpt.restore(mgr, latest, params_abs, opt_abs)
+        telemetry.metrics().observe(
+            "workload_checkpoint_restore_ms",
+            (_time.monotonic() - t_restore) * 1e3)
         start = latest
     else:
         params, opt_state, p_shardings = init_train_state(cfg, mesh, jax.random.PRNGKey(seed))
@@ -354,9 +363,14 @@ def train_loop(cfg: TrainConfig, steps: int, *, checkpoint_dir: str | None = Non
     last_logged = start  # count ACTUAL steps per interval: a resume from
     # a step that is not a log_every multiple makes the first interval
     # shorter, and multiplying by log_every would inflate tokens/s.
+    # Goodput accounting: productive (in-step) time over total loop wall
+    # time. A restart pays restore + recompile before its first step, so
+    # the gauge is exactly the restart-recovery cost made visible.
+    t_loop = _time.monotonic()
+    busy_s = 0.0
 
     def run_step(i, tokens):
-        nonlocal params, opt_state, profiling, t_log, last_logged
+        nonlocal params, opt_state, profiling, t_log, last_logged, busy_s
         # Trace steps start+1..start+3: step start is compile+warm, and a
         # bounded window keeps the trace small enough to actually open.
         if profile_dir is not None:
@@ -370,9 +384,23 @@ def train_loop(cfg: TrainConfig, steps: int, *, checkpoint_dir: str | None = Non
         # span synchronizes with the device, so the duration is the real
         # step wall time — and the span joins the controller's trace via
         # the TPUBC_TRACE_ID the JobSet injected.
-        with telemetry.span("train.step", step=i):
+        with telemetry.span("train.step", step=i) as step_span:
             params, opt_state, loss_value = step_fn(params, opt_state, tokens)
             losses.append(float(loss_value))
+        # The /metrics half of the same observation: the step-time
+        # histogram and the {last_step, tokens_per_sec, loss, goodput}
+        # gauges the controller's status.slice.workload scrape reads.
+        step_ms = step_span.dur_us / 1e3
+        busy_s += step_ms / 1e3
+        reg = telemetry.metrics()
+        reg.observe("workload_train_step_ms", step_ms)
+        reg.inc("workload_train_steps_total")
+        reg.set_gauge("workload_last_step", i + 1)
+        reg.set_gauge("workload_train_loss", losses[-1])
+        reg.set_gauge("workload_tokens_per_sec",
+                      round(tokens_per_step / max(step_ms / 1e3, 1e-9), 1))
+        reg.set_gauge("workload_goodput_frac",
+                      round(busy_s / max(_time.monotonic() - t_loop, 1e-9), 4))
         if log_every > 0 and (i + 1) % log_every == 0:
             now = _time.time()
             tps = tokens_per_step * (i + 1 - last_logged) / max(now - t_log, 1e-9)
@@ -380,7 +408,11 @@ def train_loop(cfg: TrainConfig, steps: int, *, checkpoint_dir: str | None = Non
             print(f"step {i + 1}/{steps}: loss {losses[-1]:.4f}, "
                   f"{tps:,.0f} tokens/s", flush=True)
         if mgr is not None and ((i + 1) % save_every == 0 or i + 1 == steps):
+            t_save = _time.monotonic()
             ckpt.save(mgr, i + 1, params, opt_state)
+            telemetry.metrics().observe(
+                "workload_checkpoint_save_ms",
+                (_time.monotonic() - t_save) * 1e3)
 
     def _close_trace():
         nonlocal profiling
@@ -637,6 +669,17 @@ def worker_main() -> None:
         # run (plain Indexed Job on GKE): fall back to auto-discovery so
         # each host doesn't silently train as an independent process.
         jax.distributed.initialize()
+
+    # Worker-0 metrics endpoint (WORKLOAD_METRICS_PORT, settable per CR
+    # through spec.tpu.env): /metrics + /metrics.json for the
+    # controller's status.slice.workload scrape and any in-cluster
+    # Prometheus. Worker 0 only — it is the host the headless-service
+    # DNS pins, and one exposition per slice is the scrape contract.
+    # (Serve mode's ingress serves the same routes on the serve port.)
+    metrics_port = int(os.environ.get("WORKLOAD_METRICS_PORT", "0"))
+    if metrics_port > 0 and int(os.environ.get("JOB_COMPLETION_INDEX", "0")) == 0:
+        httpd = telemetry.start_metrics_server(metrics_port)
+        print(f"workload: metrics on :{httpd.server_address[1]}", flush=True)
 
     # WORKLOAD_MODE=serve: the slice runs the continuous-batching
     # serving demo instead of the training loop (same WORKLOAD_MODEL /
