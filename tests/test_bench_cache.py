@@ -186,3 +186,49 @@ def test_finish_workload_judges_against_prior_cache(tmp_path, monkeypatch):
     assert "workload_regressions" not in cache["results"]
     assert "workload_regression_count" not in cache["results"]
     assert cache["results"]["decode_tokens_per_sec"] == 50.0
+
+
+def test_check_gates_roofline_regressions(tmp_path, monkeypatch, capsys):
+    """bench.py --check: a roofline-fraction (or achieved-GB/s) key
+    regressing >15% vs the last-good cache FAILS (exit 1); other
+    regressions are loudly flagged but pass; improvements, chip-down
+    runs with no live keys, and a missing cache all pass."""
+    monkeypatch.setattr(bench, "WORKLOAD_CACHE", tmp_path / "cache.json")
+    bench._cache_workload({"chip_alive": True,
+                           "decode_int8_hbm_roofline_frac": 0.40,
+                           "kernel_int8_up_achieved_gbps": 400.0,
+                           "decode_tokens_per_sec": 100.0})
+
+    # Roofline key down 45%: hard failure.
+    rc = bench.check_results({"decode_int8_hbm_roofline_frac": 0.22,
+                              "kernel_int8_up_achieved_gbps": 410.0,
+                              "decode_tokens_per_sec": 101.0})
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 1
+    assert "decode_int8_hbm_roofline_frac" in out["check_hard_failures"]
+
+    # Throughput-only regression: flagged, not fatal.
+    rc = bench.check_results({"decode_int8_hbm_roofline_frac": 0.41,
+                              "kernel_int8_up_achieved_gbps": 405.0,
+                              "decode_tokens_per_sec": 60.0})
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    assert "decode_tokens_per_sec" in out["check_regressions"]
+    assert out["check_failed"] == 0
+
+    # Everything improved: clean pass.
+    assert bench.check_results({"decode_int8_hbm_roofline_frac": 0.46,
+                                "kernel_int8_up_achieved_gbps": 500.0,
+                                "decode_tokens_per_sec": 140.0}) == 0
+    capsys.readouterr()
+
+    # Chip down: only cached_*/error keys -> nothing judged, pass + note.
+    rc = bench.check_results({"workload_bench_error": "tunnel down",
+                              "cached_decode_int8_hbm_roofline_frac": 0.40})
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and out["check_keys_judged"] == 0
+    assert "check_note" in out
+
+    # No cache at all: nothing to gate against.
+    monkeypatch.setattr(bench, "WORKLOAD_CACHE", tmp_path / "none.json")
+    assert bench.check_results({"decode_int8_hbm_roofline_frac": 0.1}) == 0

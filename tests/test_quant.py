@@ -8,6 +8,8 @@ close to the float model — identical argmax tokens on a well-scaled
 model is the acceptance bar for weight-only int8.
 """
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -199,8 +201,8 @@ def test_int4_rejections():
                                               quantize_weight4)
 
     w = jax.random.normal(jax.random.PRNGKey(0), (66, 8), jnp.float32)
-    with pytest.raises(ValueError, match="divisible"):
-        quantize_weight4(w, group=64)  # 66 % 64 != 0
+    with pytest.raises(ValueError, match="even"):
+        quantize_weight4(w, group=63)  # odd group: nibble pairs break
     ok = quantize_weight4(jax.random.normal(jax.random.PRNGKey(0), (64, 8)),
                           group=32)
     with pytest.raises(ValueError, match="contraction"):
@@ -214,6 +216,153 @@ def test_int4_rejections():
                                          max_seq_len=8),
                              jax.random.PRNGKey(0))
         quantize_params4(params, group=16, head="int2")
+
+
+# ---- odd shapes under K-blocking (tail-guard oracle suite) -----------------
+
+
+@pytest.mark.parametrize("t,k,n", [(1, 100, 96), (8, 300, 200), (5, 64, 130),
+                                   (1, 32, 512), (3, 1024, 72)])
+def test_int8_kernel_odd_shapes(t, k, n):
+    """Non-128-multiple K and N, and batch-of-1 decode rows: the
+    K-blocked kernel's zero padding must be exact (padded activation
+    columns are zero, so padded weight rows never contribute) — silent
+    tile-pad corruption would show here as a mismatch vs the oracle."""
+    x = jax.random.normal(jax.random.PRNGKey(1), (t, k), jnp.float32)
+    qw = quantize_weight(jax.random.normal(jax.random.PRNGKey(2), (k, n)))
+    want = reference_int8_matmul(x, qw)
+    for block_k in (None, 128):  # autotune-default path AND forced K tiles
+        got = int8_matmul(x, qw, block_n=128, block_k=block_k)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-2, atol=2e-2)
+
+
+def test_int8_k_blocking_matches_whole_k():
+    """Forcing many K tiles changes only the accumulation order: the f32
+    accumulator carried across K tiles must agree with the single-tile
+    launch to f32 round-off."""
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 1024), jnp.float32)
+    qw = quantize_weight(jax.random.normal(jax.random.PRNGKey(2), (1024, 256)))
+    whole = int8_matmul(x, qw, block_n=128, block_k=1024)
+    blocked = int8_matmul(x, qw, block_n=128, block_k=128)
+    np.testing.assert_allclose(np.asarray(blocked), np.asarray(whole),
+                               rtol=1e-5, atol=1e-4)
+
+
+@pytest.mark.parametrize("t,k,n,group", [(1, 80, 96, 32), (4, 100, 130, 32),
+                                         (8, 300, 200, 64), (1, 30, 72, 64)])
+def test_int4_kernel_group_tails_and_odd_shapes(t, k, n, group):
+    """int4 K % group != 0 (and K < group, K odd, batch-of-1): storage
+    pads to whole groups with zero-encoded rows and zero scales, kdim
+    records the true extent, and the kernel matches the dequant oracle
+    at the LOGICAL shape for any K tiling."""
+    from tpu_bootstrap.workload.quant import (dequantize_weight4, int4_matmul,
+                                              quantize_weight4)
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (t, k), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(2), (k, n), jnp.float32)
+    qw = quantize_weight4(w, group=group)
+    assert qw.kdim == k and qw.q.shape[0] == -(-k // group) * group // 2
+    back = dequantize_weight4(qw)
+    assert back.shape == (k, n)  # storage pad rows sliced off
+    # roundtrip error bound on the REAL rows (pad rows are exact zeros)
+    kp = -(-k // group) * group
+    wp = np.zeros((kp, n), np.float32)
+    wp[:k] = np.asarray(w)
+    step = np.repeat(np.abs(wp.reshape(-1, group, n)).max(axis=1),
+                     group, axis=0)[:k] / 7.0
+    assert np.all(np.abs(np.asarray(back) - np.asarray(w)) <= step / 2 + 1e-6)
+    want = jnp.dot(x.astype(jnp.bfloat16), back.astype(jnp.bfloat16),
+                   preferred_element_type=jnp.float32).astype(x.dtype)
+    for block_k in (None, 128):
+        got = int4_matmul(x, qw, block_n=128, block_k=block_k)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-2, atol=2e-2)
+
+
+def test_expert_kernels_odd_shapes():
+    """Expert-stacked launches share the same pad conventions: odd K/N
+    and an int4 group tail through the (E, N, K) grid."""
+    from tpu_bootstrap.workload.quant import (dequantize_weight4,
+                                              int4_expert_matmul,
+                                              int8_expert_matmul,
+                                              quantize_expert_weight,
+                                              quantize_expert_weight4)
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (3, 100, 130), jnp.float32)
+    qw = quantize_expert_weight(w)
+    x = jax.random.normal(jax.random.PRNGKey(1), (3, 5, 100), jnp.float32)
+    got = int8_expert_matmul(x, qw, block_n=128, block_k=128)
+    # Oracle mirrors the kernel's arithmetic order (bf16 operands, f32
+    # accumulation, per-channel scale applied AFTER the matmul) so the
+    # diff is purely accumulation-order noise.
+    want = jnp.einsum("etk,ekn->etn", x.astype(jnp.bfloat16),
+                      qw.q.astype(jnp.bfloat16),
+                      preferred_element_type=jnp.float32) * qw.s
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-2, atol=2e-2)
+
+    w4 = jax.random.normal(jax.random.PRNGKey(2), (4, 80, 96), jnp.float32)
+    qw4 = quantize_expert_weight4(w4, group=32)
+    assert qw4.kdim == 80 and qw4.q.shape == (4, 48, 96)
+    x4 = jax.random.normal(jax.random.PRNGKey(3), (4, 5, 80), jnp.float32)
+    got4 = int4_expert_matmul(x4, qw4, block_n=128, block_k=128)
+    want4 = jnp.einsum("etk,ekn->etn", x4.astype(jnp.bfloat16),
+                       dequantize_weight4(qw4).astype(jnp.bfloat16),
+                       preferred_element_type=jnp.float32)
+    np.testing.assert_allclose(np.asarray(got4), np.asarray(want4),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_gated_mlp_quantized_fusion():
+    """ModelConfig.mlp_gated: gelu(gate) * up with the quantized tree
+    carrying a fused w_gateup copy — one launch, one activation read,
+    same logits as the float model to weight-only-int8 tolerance."""
+    from tpu_bootstrap.workload.decode import init_cache, prefill
+    from tpu_bootstrap.workload.quant import quantize_params4
+
+    gcfg = ModelConfig(vocab_size=64, num_layers=2, num_heads=4, head_dim=8,
+                       embed_dim=32, mlp_dim=64, max_seq_len=32,
+                       mlp_gated=True)
+    params = init_params(gcfg, jax.random.PRNGKey(0))
+    assert "w_gate" in params["blocks"][0]
+    qp = quantize_params(params)
+    blk = qp["blocks"][0]
+    assert is_quantized(blk["w_gateup"])
+    assert blk["w_gateup"].q.shape == (32, 128)  # gate|up along N
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, 64)
+    want, _ = prefill(params, tokens, init_cache(gcfg, 2, 8), gcfg)
+    got, _ = prefill(qp, tokens, init_cache(gcfg, 2, 8), gcfg)
+    assert float(jnp.max(jnp.abs(got - want))) < 0.4
+    np.testing.assert_array_equal(np.asarray(jnp.argmax(got, -1)),
+                                  np.asarray(jnp.argmax(want, -1)))
+    # int4 trees fuse the pair too, and MoE + gating is rejected loudly.
+    q4 = quantize_params4(params, group=16, head=False)
+    assert hasattr(q4["blocks"][0]["w_gateup"], "group")
+    with pytest.raises(ValueError, match="dense"):
+        init_params(dataclasses.replace(gcfg, num_experts=2),
+                    jax.random.PRNGKey(0))
+
+
+def test_int4_fused_qkv_matches_separate():
+    """quantize_block4 now stores the fused wqkv (satellite: the int4
+    self-draft rides the same fused seam as int8): the single launch
+    over concatenated output channels is EXACT vs three separate
+    launches — N-concat never mixes scales."""
+    from tpu_bootstrap.workload.quant import int4_matmul, quantize_block4
+
+    cfg = ModelConfig(vocab_size=64, num_layers=1, num_heads=4, head_dim=8,
+                      embed_dim=32, mlp_dim=64, max_seq_len=16,
+                      num_kv_heads=2)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    blk4 = quantize_block4(params["blocks"][0], group=16)
+    assert hasattr(blk4["wqkv"], "group") and blk4["wqkv"].kdim == 32
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 32), jnp.float32)
+    fused = int4_matmul(x, blk4["wqkv"])
+    parts = [int4_matmul(x, blk4[nm]) for nm in ("wq", "wk", "wv")]
+    np.testing.assert_allclose(np.asarray(fused),
+                               np.asarray(jnp.concatenate(parts, axis=1)),
+                               rtol=1e-5, atol=1e-5)
 
 
 def test_int4_expert_stacks():

@@ -203,3 +203,31 @@ def test_sharded_target_matches_single_device(models):
     got = speculative_generate(sharded, draft, prompt, TARGET, DRAFT, 12,
                                gamma=3)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_self_draft_rides_fused_quantized_seam(models):
+    """Satellite: the self-draft's decode steps reuse the fused
+    quantized kernels. Both quantized formats now carry the fused wqkv
+    copy, the fused and unfused drafts commit IDENTICAL tokens with
+    IDENTICAL committed-per-round telemetry (fusion is a launch-count
+    optimization, never a numerics change), and the draft tree the
+    serving path passes really does hold the fused entries."""
+    from tpu_bootstrap.workload.quant import quantize_params, quantize_params4
+
+    target, _, prompt = models
+    for q in (quantize_params(target), quantize_params4(target, group=16)):
+        assert all("wqkv" in b for b in q["blocks"])
+        unfused = {**q, "blocks": [
+            {k: v for k, v in b.items() if k != "wqkv"} for b in q["blocks"]]}
+        got_f, stats_f = speculative_generate(
+            target, q, prompt, TARGET, TARGET, 16, gamma=3, with_stats=True)
+        got_u, stats_u = speculative_generate(
+            target, unfused, prompt, TARGET, TARGET, 16, gamma=3,
+            with_stats=True)
+        np.testing.assert_array_equal(np.asarray(got_f), np.asarray(got_u))
+        assert int(stats_f["verify_rounds"]) == int(stats_u["verify_rounds"])
+        assert float(stats_f["mean_committed"]) == pytest.approx(
+            float(stats_u["mean_committed"]))
+        # And exactness vs the target's own greedy path, as always.
+        np.testing.assert_array_equal(
+            np.asarray(got_f), np.asarray(generate(target, prompt, TARGET, 16)))

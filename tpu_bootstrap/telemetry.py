@@ -411,6 +411,38 @@ def metrics() -> MetricsRegistry:
     return _metrics
 
 
+# v5e HBM peak, GB/s — the denominator of every roofline fraction this
+# process reports. Override with TPUBC_HBM_GBPS when the slice runs on a
+# different part (v5p ~2765, v4 ~1228).
+HBM_PEAK_ENV = "TPUBC_HBM_GBPS"
+DEFAULT_HBM_PEAK_GBPS = 819.0
+
+
+def hbm_peak_gbps() -> float:
+    try:
+        return float(os.environ.get(HBM_PEAK_ENV, DEFAULT_HBM_PEAK_GBPS))
+    except ValueError:
+        return DEFAULT_HBM_PEAK_GBPS
+
+
+def record_kernel_bandwidth(kernel: str, bytes_moved: int, seconds: float,
+                            peak_gbps: float | None = None) -> None:
+    """Set the per-kernel achieved-bandwidth gauges from one measured
+    execution: ``quant_<kernel>_achieved_gbps`` and
+    ``quant_<kernel>_hbm_roofline_frac``. The quantized-matmul launch
+    seam (workload/quant.py autotuner) and bench.py both feed this, so
+    the workload scrape, /metrics.json, and --slo-report surfaces carry
+    the roofline fraction per kernel."""
+    if seconds <= 0 or bytes_moved <= 0:
+        return
+    if peak_gbps is None:
+        peak_gbps = hbm_peak_gbps()
+    gbps = bytes_moved / seconds / 1e9
+    _metrics.set_gauge(f"quant_{kernel}_achieved_gbps", round(gbps, 2))
+    _metrics.set_gauge(f"quant_{kernel}_hbm_roofline_frac",
+                       round(gbps / peak_gbps, 4))
+
+
 class RateWindow:
     """Rolling event-rate gauge feed (serve_qps, serve_tokens_per_sec):
     count events with add(), read events-per-second over the trailing

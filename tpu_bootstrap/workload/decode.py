@@ -56,15 +56,18 @@ from tpu_bootstrap.workload.model import (
 )
 
 
-def _linear(x: jax.Array, w, contract_rank: int, dtype) -> jax.Array:
+def _linear(x: jax.Array, w, contract_rank: int, dtype,
+            tag: str = "") -> jax.Array:
     """Projection of x's trailing dims against w's leading dims, for
     float weights or quantized ones (int8/int4, workload/quant.py) —
     the one seam through which weight-only quantization reaches every
-    block projection."""
+    block projection. ``tag`` labels the quantized launch's byte
+    counters (e.g. "qkv", "gateup", "head") so per-kernel bandwidth
+    accounting can tell the fused decode reads apart."""
     k = math.prod(w.shape[:contract_rank])
     x2 = x.reshape(-1, k).astype(dtype)
     if quant.is_quantized(w):
-        y = quant.quantized_matmul(x2, w)
+        y = quant.quantized_matmul(x2, w, tag=tag)
     else:
         y = x2 @ w.astype(dtype).reshape(k, -1)
     return y.reshape(*x.shape[: x.ndim - contract_rank], *w.shape[contract_rank:])
@@ -184,9 +187,12 @@ def _block_step(block: Params, x: jax.Array, cache: dict, positions: jax.Array,
     h = _rms_norm(x, block["attn_norm"])
     wqkv = block.get("wqkv")
     if wqkv is not None and quant.is_quantized(wqkv):
-        # Fused int8 QKV (quant.quantize_block): one kernel launch for all
-        # three projections — decode at small batch is launch-bound.
-        fused = _linear(h, wqkv, 1, dtype)
+        # Fused quantized QKV (quant.quantize_block / quantize_block4):
+        # one kernel launch for all three projections — decode at small
+        # batch is launch-bound — and ONE activation read instead of
+        # three (the byte-accounting contract the interpret-mode tests
+        # pin under the "qkv" tag).
+        fused = _linear(h, wqkv, 1, dtype, tag="qkv")
         nq = cfg.num_heads * cfg.head_dim
         nk = cfg.kv_heads * cfg.head_dim
         q = fused[..., :nq].reshape(*h.shape[:-1], cfg.num_heads, cfg.head_dim)
@@ -285,7 +291,7 @@ def _logits(params: Params, x: jax.Array) -> jax.Array:
         # matmul is the single biggest weight read of a decode step —
         # vocab x embed bytes — so it streams at 1 byte/element, through
         # the same _linear seam as every block projection.
-        return _linear(x, head, 1, jnp.float32)
+        return _linear(x, head, 1, jnp.float32, tag="head")
     return jnp.einsum("bse,ve->bsv", x.astype(jnp.float32), params["embed"])
 
 

@@ -142,6 +142,7 @@ def apply_lora(params: Params, lora: Params, lcfg: LoraConfig) -> Params:
     for block, adapters in zip(params["blocks"], lora["blocks"]):
         eff = dict(block)
         eff.pop("wqkv", None)
+        eff.pop("w_gateup", None)  # same staleness rule as wqkv
         for name in _FORWARD_LEAVES:
             if name in adapters:
                 eff[name] = _effective(adapters[name], block[name], lcfg.scale)
@@ -185,11 +186,12 @@ def make_lora_train_step(cfg, mesh, base_params: Params, lcfg: LoraConfig,
     # resident and the ~0.5x-of-bf16 residency claim would be
     # overstated. (Callers who keep their own qbase reference still pay
     # for it; drop it or quantize fresh for fine-tuning.)
-    if "lm_head" in base_params or any("wqkv" in b
+    if "lm_head" in base_params or any("wqkv" in b or "w_gateup" in b
                                        for b in base_params["blocks"]):
         base_params = {
             **{k: v for k, v in base_params.items() if k != "lm_head"},
-            "blocks": [{k: v for k, v in b.items() if k != "wqkv"}
+            "blocks": [{k: v for k, v in b.items()
+                        if k not in ("wqkv", "w_gateup")}
                        for b in base_params["blocks"]],
         }
     opt = make_optimizer(cfg)

@@ -60,6 +60,13 @@ class ModelConfig:
     # Honored by loss_from_inputs AND both pipeline schedules' loss heads
     # (pipeline._head_nll); forward/generate still produce real logits.
     vocab_chunk: int = 0
+    # Gated FFN (SwiGLU-style, gelu variant): gelu(x @ w_gate) * (x @ w_up)
+    # instead of gelu(x @ w_up). Serving-relevant because the gate/up pair
+    # shares one input activation — quantize_block fuses the two reads
+    # into a single "w_gateup" launch, the MLP analogue of the fused QKV
+    # copy. Dense blocks only (MoE experts keep the ungated two-matmul
+    # FFN).
+    mlp_gated: bool = False
 
     @property
     def qkv_dim(self) -> int:
@@ -76,7 +83,13 @@ class ModelConfig:
 
 def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
     """Initialize float32 params as a nested pytree."""
-    keys = iter(jax.random.split(key, 4 + 8 * cfg.num_layers))
+    if cfg.mlp_gated and cfg.num_experts > 0:
+        raise ValueError("mlp_gated applies to the dense FFN only "
+                         "(MoE experts keep the ungated two-matmul FFN)")
+    # Ungated configs keep the exact historical split count so their
+    # params are bit-identical to pre-gating builds.
+    extra = cfg.num_layers if cfg.mlp_gated else 0
+    keys = iter(jax.random.split(key, 4 + 8 * cfg.num_layers + extra))
 
     def dense(key, shape, scale=None):
         fan_in = shape[0] if scale is None else scale
@@ -107,6 +120,9 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
             block["w_down"] = dense(
                 next(keys), (cfg.num_experts, cfg.mlp_dim, cfg.embed_dim), cfg.mlp_dim)
         else:
+            if cfg.mlp_gated:
+                block["w_gate"] = dense(
+                    next(keys), (cfg.embed_dim, cfg.mlp_dim), cfg.embed_dim)
             block["w_up"] = dense(next(keys), (cfg.embed_dim, cfg.mlp_dim), cfg.embed_dim)
             block["w_down"] = dense(next(keys), (cfg.mlp_dim, cfg.embed_dim), cfg.mlp_dim)
         params["blocks"].append(block)
@@ -193,9 +209,11 @@ def _attention(block: Params, x: jax.Array, cfg: ModelConfig, attn_fn=None,
     return jnp.einsum("bshd,hde->bse", out, block["wo"].astype(dtype))
 
 
-def _default_linear(x: jax.Array, w: jax.Array, contract_rank: int, dtype) -> jax.Array:
+def _default_linear(x: jax.Array, w: jax.Array, contract_rank: int, dtype,
+                    tag: str = "") -> jax.Array:
     """Plain matmul projection of x's trailing dims against w's leading
-    dims (the float counterpart of decode._linear's quantized path)."""
+    dims (the float counterpart of decode._linear's quantized path).
+    ``tag`` labels quantized-kernel accounting and is ignored here."""
     k = 1
     for d in w.shape[:contract_rank]:
         k *= d
@@ -206,10 +224,25 @@ def _default_linear(x: jax.Array, w: jax.Array, contract_rank: int, dtype) -> ja
 def _mlp(block: Params, x: jax.Array, cfg: ModelConfig, linear=_default_linear) -> jax.Array:
     """Dense FFN. ``linear(x, w, contract_rank, dtype)`` overrides the
     projection — the seam decode uses to route through int8-quantized
-    weights — so the norm/gelu structure has exactly one definition."""
+    weights — so the norm/gelu/gating structure has exactly one
+    definition. Gated blocks ("w_gate" present) compute
+    gelu(gate) * up; a quantized tree's fused "w_gateup" copy covers
+    both projections in ONE launch (one activation read — the MLP
+    analogue of the fused QKV decode read)."""
     dtype = cfg.compute_dtype
     h = _rms_norm(x, block["mlp_norm"])
-    h = jax.nn.gelu(linear(h, block["w_up"], 1, dtype))
+    if "w_gate" in block:
+        fused = block.get("w_gateup")
+        if fused is not None:
+            gu = linear(h, fused, 1, dtype, tag="gateup")
+            f = gu.shape[-1] // 2
+            g, u = gu[..., :f], gu[..., f:]
+        else:
+            g = linear(h, block["w_gate"], 1, dtype)
+            u = linear(h, block["w_up"], 1, dtype)
+        h = jax.nn.gelu(g) * u
+    else:
+        h = jax.nn.gelu(linear(h, block["w_up"], 1, dtype))
     return linear(h, block["w_down"], 1, dtype)
 
 

@@ -56,16 +56,20 @@ def expert_capacity(seq: int, num_experts: int, top_k: int,
     return max(1, math.ceil(seq * top_k / num_experts * capacity_factor))
 
 
-def _expert_linear(x, w, dtype):
+def _expert_linear(x, w, dtype, tag: str = ""):
     """Per-expert batched projection x (E, B, C, K) @ w (E, K, N), for
-    float expert stacks or int8-quantized ones (workload/quant.py) — the
-    seam through which weight-only quantization reaches the expert FFN
-    on the serving path."""
+    float expert stacks or int8/int4-quantized ones (workload/quant.py)
+    — the seam through which weight-only quantization reaches the
+    expert FFN on the serving path. Quantized stacks launch through the
+    unified K-blocked kernel seam (grid (E, N tiles, K tiles), f32
+    accumulator, double-buffered weight stream); ``tag`` labels the
+    launch's byte-accounting counters."""
     from tpu_bootstrap.workload import quant
 
     if quant.is_quantized(w):
         e, b, c, k = x.shape
-        y = quant.quantized_expert_matmul(x.reshape(e, b * c, k).astype(dtype), w)
+        y = quant.quantized_expert_matmul(
+            x.reshape(e, b * c, k).astype(dtype), w, tag=tag)
         return y.reshape(e, b, c, -1)
     return jnp.einsum("ebck,ekn->ebcn", x, w.astype(dtype))
 
@@ -145,8 +149,10 @@ def moe_mlp_manual(block, h, cfg, axis_name: str = "expert", n_expert: int = 1):
         # experts' slots for every member's rows.
         expert_in = lax.all_to_all(expert_in, axis_name, split_axis=0,
                                    concat_axis=1, tiled=True)
-    hidden = jax.nn.gelu(_expert_linear(expert_in, block["w_up"], dtype))
-    expert_out = _expert_linear(hidden, block["w_down"], dtype)
+    hidden = jax.nn.gelu(_expert_linear(expert_in, block["w_up"], dtype,
+                                        tag="moe_up"))
+    expert_out = _expert_linear(hidden, block["w_down"], dtype,
+                                tag="moe_down")
     if n_expert > 1:
         # Inverse: (E/n, b*n, C, M) -> (E, b, C, M), rows home again.
         expert_out = lax.all_to_all(expert_out, axis_name, split_axis=1,

@@ -1,5 +1,6 @@
-"""Weight-only int8 quantization for serving — halve the HBM bytes the
-decode loop streams.
+"""Weight-only int8/int4 quantization for serving — halve (or quarter)
+the HBM bytes the decode loop streams, and stream them as fast as the
+chip allows.
 
 Why weight-only, and why for decode: autoregressive decoding is
 bandwidth-bound — every step reads every weight once to produce one
@@ -13,21 +14,58 @@ path) — so no calibration data is needed.
 The compute path is a Pallas kernel fusing dequantization into the
 matmul: the int8 tile is cast to bfloat16 in VMEM (never materialized in
 HBM), fed to the MXU with float32 accumulation, and scaled per output
-channel on the way out. Grid over N tiles; the K axis rides whole —
-right for the few-thousand-wide projections decode runs. Symmetric
-per-output-channel scales (scale = absmax/127 over the contraction
-axis) keep the kernel a pure multiply — no zero points.
+channel on the way out. The grid runs over (N tiles, K tiles) — the
+contraction dimension is BLOCKED, not ridden whole: whole-K panels were
+the structural reason the round-5 bench measured int8 decode at 25% of
+the HBM roofline against bf16's 47% (a whole-K weight panel plus the
+activation panel must fit VMEM at once, so Mosaic cannot pipeline the
+weight stream). With K tiled, a float32 accumulator in VMEM scratch is
+carried across the K steps of each N tile, and Pallas's grid pipeline
+DOUBLE-BUFFERS the input streams: while the MXU consumes K tile i, the
+DMA engines prefetch tile i+1's weight block from HBM — dequantize+MXU
+overlap the next tile's fetch, which is what lets the 1-byte stream
+approach the bf16 path's efficiency. Symmetric per-output-channel scales
+(scale = absmax/127 over the contraction axis) keep the kernel a pure
+multiply — no zero points.
 
-Scope: the transformer block projections (wq/wk/wv/wo, w_up/w_down),
-plus — by default — a separate int8 copy of the logits head
-(``lm_head``, the embedding transposed into matmul layout). The head
-matmul reads vocab x embed bytes EVERY step (a quarter of this model
-family's weight traffic); the gather-table use of the embedding reads
-only batch rows, so the float embedding stays for gathers and the int8
-copy serves the head. MoE blocks quantize their attention projections
-and (E, K, N) expert stacks — per (expert, output channel) scales, a
-grid axis over experts in the kernel — while the router (tiny,
-routing-critical) stays float.
+One launch seam: every variant — int8/int4, dense/expert-stacked —
+launches through ``_quant_matmul``, which owns the tile-alignment
+convention, the K-blocking, the accumulator scratch, a tiny block-size
+autotuner (first eager call per shape measures 2-3 (block_n, block_k)
+candidates on the chip and caches the winner process-wide;
+``TPUBC_QUANT_BLOCKS="bn,bk"`` pins globally, ``TPUBC_QUANT_AUTOTUNE=0``
+disables), and per-kernel byte accounting: every launch increments
+``quant_<kernel>_{calls,weight_bytes,activation_bytes,bytes}_total``
+counters in telemetry.metrics() (trace-time accounting: under ``jit``
+the counters tick once per traced launch site, not per executed step —
+analytic per-launch bytes, exactly what the interpret-mode tests and
+the bench's roofline math consume), and on-chip autotune measurements
+set ``quant_<kernel>_achieved_gbps`` / ``_hbm_roofline_frac`` gauges
+(peak overridable via ``TPUBC_HBM_GBPS``; default v5e's ~819 GB/s).
+
+Fused decode reads: the three QKV projections share one input
+activation, so quantize_block (int8) and quantize_block4 (int4) both
+store a fused ``wqkv`` copy — one grid over the concatenated output
+channels, ONE activation read instead of three (exact: scales are per
+output channel, so concatenating along N changes nothing). Gated-MLP
+models (ModelConfig.mlp_gated: gelu(gate) * up) get the same treatment
+as ``w_gateup``. decode._block_step / model._mlp prefer the fused
+entries; the per-projection copies stay for any per-projection reader.
+
+Scope: the transformer block projections (wq/wk/wv/wo, w_up/w_down, and
+w_gate on gated models), plus — by default — a separate int8 copy of
+the logits head (``lm_head``, the embedding transposed into matmul
+layout). The head matmul reads vocab x embed bytes EVERY step (a
+quarter of this model family's weight traffic); the gather-table use of
+the embedding reads only batch rows, so the float embedding stays for
+gathers and the int8 copy serves the head. MoE blocks quantize their
+attention projections and (E, K, N) expert stacks — per (expert, output
+channel) scales, a grid axis over experts in the kernel — while the
+router (tiny, routing-critical) stays float.
+
+Mosaic lowering rules (round-5 hardware bisection): no uint8->float
+lowering, and uint8->int8 intermediates crash the compile helper —
+the int4 nibble unpack widens uint8->int32 BEFORE any arithmetic.
 
 Reference parity note: the reference (bacchus-gpu-controller) has no
 compute path (SURVEY.md §2); this module extends the serving half of
@@ -38,16 +76,33 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import math
+import os
+import time
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tpu_bootstrap import telemetry
+
 # JAX renamed TPUCompilerParams -> CompilerParams across 0.4.x/0.5.x;
 # accept either so the kernels import on both.
 if not hasattr(pltpu, "CompilerParams"):  # pragma: no cover - version shim
     pltpu.CompilerParams = pltpu.TPUCompilerParams
+
+
+DEFAULT_BLOCK_N = 512
+DEFAULT_BLOCK_K = 512
+# Autotune candidates, clamped per shape before launch: the default
+# square tile, a K-deep tile (small N, long contraction — decode's
+# w_down), and an N-wide tile (wide outputs — the lm_head).
+_CANDIDATE_BLOCKS = ((512, 512), (256, 1024), (1024, 256))
+_TUNED: dict = {}  # (fmt, expert, t_pad, k_store, n, group) -> (bn, bk)
+
+BLOCKS_ENV = "TPUBC_QUANT_BLOCKS"
+AUTOTUNE_ENV = "TPUBC_QUANT_AUTOTUNE"
 
 
 def _interpret_default() -> bool:
@@ -85,66 +140,68 @@ def dequantize_weight(qw: QuantizedWeight) -> jax.Array:
     return qw.q.astype(jnp.float32) * qw.s
 
 
-def _tile_pads(t: int, n: int, block_n: int):
-    """The ONE tile-alignment convention for every quantized matmul:
-    T pads to the f32 sublane (8), N to a lane-aligned block that
-    divides the padded extent. int8, expert, and int4 kernels all align
-    through here so the convention cannot diverge."""
-    t_pad = -(-t // 8) * 8
-    bn = min(block_n, -(-n // 128) * 128)
-    n_pad = -(-n // bn) * bn
-    return t_pad, bn, n_pad
+@dataclasses.dataclass
+class Quantized4Weight:
+    """int4 values nibble-packed two-per-byte along the contraction
+    axis, with GROUP-wise scales (per (K-group, output channel) — int4's
+    dynamic range is too coarse for whole-column scales). Storage is
+    padded up to a whole number of groups; ``kdim`` records the TRUE
+    contraction extent (0 = storage extent, for pre-tail-support trees)
+    and ``shape`` the original logical shape — both static pytree
+    metadata."""
+
+    q: jax.Array  # uint8 (Ks/2, N): low nibble = even k, high = odd k
+    s: jax.Array  # f32 (Ks/group, N)
+    group: int    # static K-group size
+    shape: tuple  # original logical shape, static
+    kdim: int = 0  # true contraction K (storage Ks >= kdim, group-aligned)
 
 
-def _matmul_kernel(x_ref, q_ref, s_ref, o_ref):
-    # Dequant fused into the matmul: int8 -> bf16 happens in VMEM, the
-    # MXU accumulates f32, per-channel scales apply on the way out.
-    w = q_ref[:].astype(jnp.bfloat16)
-    acc = jax.lax.dot_general(
-        x_ref[:].astype(jnp.bfloat16), w,
-        (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    o_ref[:] = (acc * s_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+jax.tree_util.register_dataclass(
+    Quantized4Weight, data_fields=["q", "s"],
+    meta_fields=["group", "shape", "kdim"])
 
 
-def int8_matmul(x: jax.Array, qw: QuantizedWeight, *, block_n: int = 512,
-                interpret: bool | None = None) -> jax.Array:
-    """x (T, K) @ dequant(qw) (K, N) -> (T, N) in x.dtype.
+def _k4(qw: Quantized4Weight) -> int:
+    """Logical contraction extent of an int4 weight (kdim, falling back
+    to the storage extent for trees quantized before tail support)."""
+    return qw.kdim or 2 * qw.q.shape[-2]
 
-    Pads T up to the float32 sublane tile (8) and N up to a lane-aligned
-    block; K must match the stored weight. The weight never exists in HBM
-    at more than 1 byte/element."""
-    if interpret is None:
-        interpret = _interpret_default()
-    t, k = x.shape
-    kq, n = qw.q.shape
-    if k != kq:
-        raise ValueError(f"contraction mismatch: x has K={k}, weight has K={kq}")
 
-    t_pad, bn, n_pad = _tile_pads(t, n, block_n)
-    xp = jnp.pad(x, ((0, t_pad - t), (0, 0))) if t_pad != t else x
-    q = qw.q
-    s = qw.s
-    if n_pad != n:
-        q = jnp.pad(q, ((0, 0), (0, n_pad - n)))
-        s = jnp.pad(s, (0, n_pad - n))
-    s2 = s.reshape(1, n_pad)  # 2-D so the lane dim tiles
+def quantize_weight4(w: jax.Array, group: int = 64) -> Quantized4Weight:
+    """w: (K, N) float -> nibble-packed int4 with symmetric per-(group,
+    channel) scales. ``group`` must be even; K may be ANYTHING — a tail
+    group (K % group != 0) is zero-padded in storage (pad rows encode
+    exact 0 and never contribute; the matmul also zero-pads the
+    activation, so the tail is doubly inert) and ``kdim`` records the
+    true extent."""
+    k, n = w.shape
+    if group < 2 or group % 2 != 0:
+        raise ValueError(f"int4 group must be even and >= 2, got {group}")
+    kp = -(-k // group) * group
+    wf = jnp.pad(w.astype(jnp.float32), ((0, kp - k), (0, 0)))
+    wf = wf.reshape(kp // group, group, n)
+    absmax = jnp.max(jnp.abs(wf), axis=1, keepdims=True)  # (Kp/g, 1, N)
+    scale = jnp.where(absmax > 0, absmax / 7.0, 1.0)
+    q = jnp.clip(jnp.round(wf / scale), -7, 7).astype(jnp.int32).reshape(kp, n)
+    u = (q + 8).astype(jnp.uint8)  # nibbles in [1, 15]
+    packed = (u[0::2] | (u[1::2] << 4)).astype(jnp.uint8)  # (Kp/2, N)
+    return Quantized4Weight(q=packed, s=scale[:, 0], group=group,
+                            shape=tuple(w.shape), kdim=k)
 
-    out = pl.pallas_call(
-        _matmul_kernel,
-        grid=(n_pad // bn,),
-        in_specs=[
-            pl.BlockSpec((t_pad, k), lambda j: (0, 0)),
-            pl.BlockSpec((k, bn), lambda j: (0, j)),
-            pl.BlockSpec((1, bn), lambda j: (0, j)),
-        ],
-        out_specs=pl.BlockSpec((t_pad, bn), lambda j: (0, j)),
-        out_shape=jax.ShapeDtypeStruct((t_pad, n_pad), x.dtype),
-        compiler_params=pltpu.CompilerParams(dimension_semantics=("parallel",)),
-        interpret=interpret,
-    )(xp, q, s2)
-    return out[:t, :n]
+
+def dequantize_weight4(qw: Quantized4Weight) -> jax.Array:
+    """f32 reconstruction at the LOGICAL K (storage pad rows sliced off)
+    — the oracle the kernels are tested against and the fallback for
+    consumers that need a plain array. Handles both the dense (Ks/2, N)
+    and the expert-stacked (E, Ks/2, N) layouts."""
+    lo = (qw.q & 0xF).astype(jnp.int32) - 8
+    hi = (qw.q >> 4).astype(jnp.int32) - 8
+    k2, n = qw.q.shape[-2:]
+    lead = qw.q.shape[:-2]
+    w = jnp.stack([lo, hi], axis=-2).reshape(*lead, 2 * k2, n).astype(jnp.float32)
+    w = w.reshape(*lead, -1, qw.group, n) * qw.s[..., :, None, :]
+    return w.reshape(*lead, 2 * k2, n)[..., : _k4(qw), :]
 
 
 def quantize_expert_weight(w: jax.Array) -> QuantizedWeight:
@@ -157,224 +214,496 @@ def quantize_expert_weight(w: jax.Array) -> QuantizedWeight:
     return QuantizedWeight(q=q, s=scale, shape=tuple(w.shape))
 
 
-def int8_expert_matmul(x: jax.Array, qw: QuantizedWeight, *, block_n: int = 512,
-                       interpret: bool | None = None) -> jax.Array:
-    """Per-expert batched matmul: x (E, T, K) @ dequant(qw) (E, K, N) ->
-    (E, T, N) in x.dtype. Grid (E, N tiles); the leading None block dims
-    squeeze away, so the kernel body is the same 2-D fused-dequant matmul
-    as int8_matmul's."""
-    if interpret is None:
-        interpret = _interpret_default()
-    e, t, k = x.shape
-    eq, kq, n = qw.q.shape
-    if (e, k) != (eq, kq):
-        raise ValueError(f"expert/contraction mismatch: x {x.shape}, weight {qw.q.shape}")
-
-    t_pad, bn, n_pad = _tile_pads(t, n, block_n)
-    xp = jnp.pad(x, ((0, 0), (0, t_pad - t), (0, 0))) if t_pad != t else x
-    q, s = qw.q, qw.s
-    if n_pad != n:
-        q = jnp.pad(q, ((0, 0), (0, 0), (0, n_pad - n)))
-        s = jnp.pad(s, ((0, 0), (0, 0), (0, n_pad - n)))
-
-    out = pl.pallas_call(
-        _matmul_kernel,
-        grid=(e, n_pad // bn),
-        in_specs=[
-            pl.BlockSpec((None, t_pad, k), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, k, bn), lambda i, j: (i, 0, j)),
-            pl.BlockSpec((None, 1, bn), lambda i, j: (i, 0, j)),
-        ],
-        out_specs=pl.BlockSpec((None, t_pad, bn), lambda i, j: (i, 0, j)),
-        out_shape=jax.ShapeDtypeStruct((e, t_pad, n_pad), x.dtype),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel")),
-        interpret=interpret,
-    )(xp, q, s)
-    return out[:, :t, :n]
-
-
-@dataclasses.dataclass
-class Quantized4Weight:
-    """int4 values nibble-packed two-per-byte along the contraction
-    axis, with GROUP-wise scales (per (K-group, output channel) — int4's
-    dynamic range is too coarse for whole-column scales). ``shape`` is
-    the original logical shape, static pytree metadata."""
-
-    q: jax.Array  # uint8 (K/2, N): low nibble = even k, high = odd k
-    s: jax.Array  # f32 (K/group, N)
-    group: int    # static K-group size
-    shape: tuple  # original logical shape, static
-
-
-jax.tree_util.register_dataclass(
-    Quantized4Weight, data_fields=["q", "s"], meta_fields=["group", "shape"])
-
-
-def quantize_weight4(w: jax.Array, group: int = 64) -> Quantized4Weight:
-    """w: (K, N) float -> nibble-packed int4 with symmetric per-(group,
-    channel) scales. K must be even and divisible by `group`."""
-    k, n = w.shape
-    if k % 2 != 0 or group % 2 != 0 or k % group != 0:
-        raise ValueError(
-            f"int4 packing needs K ({k}) even and divisible by an even "
-            f"group ({group})")
-    wf = w.astype(jnp.float32).reshape(k // group, group, n)
-    absmax = jnp.max(jnp.abs(wf), axis=1, keepdims=True)  # (K/g, 1, N)
+def quantize_expert_weight4(w: jax.Array, group: int = 64) -> Quantized4Weight:
+    """Expert stack (E, K, N) float -> nibble-packed int4 with
+    per-(expert, K-group, output channel) scales — the same group-wise
+    scaling (and K-tail padding) as the dense int4 format, one more
+    leading axis."""
+    e, k, n = w.shape
+    if group < 2 or group % 2 != 0:
+        raise ValueError(f"int4 group must be even and >= 2, got {group}")
+    kp = -(-k // group) * group
+    wf = jnp.pad(w.astype(jnp.float32), ((0, 0), (0, kp - k), (0, 0)))
+    wf = wf.reshape(e, kp // group, group, n)
+    absmax = jnp.max(jnp.abs(wf), axis=2, keepdims=True)  # (E, Kp/g, 1, N)
     scale = jnp.where(absmax > 0, absmax / 7.0, 1.0)
-    q = jnp.clip(jnp.round(wf / scale), -7, 7).astype(jnp.int32).reshape(k, n)
-    u = (q + 8).astype(jnp.uint8)  # nibbles in [1, 15]
-    packed = (u[0::2] | (u[1::2] << 4)).astype(jnp.uint8)  # (K/2, N)
-    return Quantized4Weight(q=packed, s=scale[:, 0], group=group,
-                            shape=tuple(w.shape))
+    q = jnp.clip(jnp.round(wf / scale), -7, 7).astype(jnp.int32).reshape(e, kp, n)
+    u = (q + 8).astype(jnp.uint8)
+    packed = (u[:, 0::2] | (u[:, 1::2] << 4)).astype(jnp.uint8)  # (E, Kp/2, N)
+    return Quantized4Weight(q=packed, s=scale[:, :, 0], group=group,
+                            shape=tuple(w.shape), kdim=k)
 
 
-def dequantize_weight4(qw: Quantized4Weight) -> jax.Array:
-    """f32 reconstruction — the oracle the kernels are tested against
-    and the fallback for consumers that need a plain array. Handles both
-    the dense (K/2, N) and the expert-stacked (E, K/2, N) layouts."""
-    lo = (qw.q & 0xF).astype(jnp.int32) - 8
-    hi = (qw.q >> 4).astype(jnp.int32) - 8
-    k2, n = qw.q.shape[-2:]
-    lead = qw.q.shape[:-2]
-    w = jnp.stack([lo, hi], axis=-2).reshape(*lead, 2 * k2, n).astype(jnp.float32)
-    w = w.reshape(*lead, -1, qw.group, n) * qw.s[..., :, None, :]
-    return w.reshape(*lead, 2 * k2, n)
+# ---------------------------------------------------------------------------
+# Kernels: K-blocked fused-dequant matmuls with an f32 VMEM accumulator.
+# The K grid axis is innermost and "arbitrary" (sequential), so the
+# accumulator scratch persists across the K steps of each output tile
+# while Pallas's grid pipeline prefetches the NEXT K tile's weight block
+# during the current tile's dequant+MXU work (the double buffering).
+# ---------------------------------------------------------------------------
 
 
-def _matmul4_kernel(x_ref, q_ref, s_ref, o_ref, *, group):
+def _matmul_kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, k_axis, nk):
+    # Dequant fused into the matmul: int8 -> bf16 happens in VMEM, the
+    # MXU accumulates f32 across K tiles, per-channel scales apply once
+    # on the way out (scales are K-independent, so scaling the final
+    # accumulator is exact).
+    kk = pl.program_id(k_axis)
+
+    @pl.when(kk == 0)
+    def _():
+        acc_ref[:] = jnp.zeros(acc_ref.shape, acc_ref.dtype)
+
+    acc_ref[:] += jax.lax.dot_general(
+        x_ref[:].astype(jnp.bfloat16), q_ref[:].astype(jnp.bfloat16),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(kk == nk - 1)
+    def _():
+        o_ref[:] = (acc_ref[:] * s_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def _matmul4_kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, group, k_axis, nk):
     # Unpack nibbles in VMEM: the weight never exists in HBM at more
     # than half a byte per element. Even k rides the low nibble.
     # Widen uint8 -> int32 BEFORE any arithmetic: Mosaic has no
     # uint8->float lowering, and the int8-intermediate variant crashes
-    # its compile helper outright (hardware-bisected this round;
+    # its compile helper outright (hardware-bisected round 5;
     # interpret-mode tests cannot see either failure). int32 bit ops and
     # the int32->f32 cast are supported, and the unpack is VMEM-local
-    # arithmetic off the critical MXU path.
+    # arithmetic off the critical MXU path. Group scales are K-local, so
+    # they apply to each K tile's weights BEFORE accumulation (unlike
+    # the int8 kernel's output-side scaling).
+    kk = pl.program_id(k_axis)
+
+    @pl.when(kk == 0)
+    def _():
+        acc_ref[:] = jnp.zeros(acc_ref.shape, acc_ref.dtype)
+
     q = q_ref[:].astype(jnp.int32)
     lo = (q & 0xF) - 8
     hi = (q >> 4) - 8
     k2, bn = q.shape
     w = jnp.stack([lo, hi], axis=1).reshape(2 * k2, bn).astype(jnp.float32)
     w = (w.reshape(-1, group, bn) * s_ref[:][:, None, :]).reshape(2 * k2, bn)
-    acc = jax.lax.dot_general(
+    acc_ref[:] += jax.lax.dot_general(
         x_ref[:].astype(jnp.bfloat16), w.astype(jnp.bfloat16),
         (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    o_ref[:] = acc.astype(o_ref.dtype)
+
+    @pl.when(kk == nk - 1)
+    def _():
+        o_ref[:] = acc_ref[:].astype(o_ref.dtype)
 
 
-def int4_matmul(x: jax.Array, qw: Quantized4Weight, *, block_n: int = 512,
-                interpret: bool | None = None) -> jax.Array:
-    """x (T, K) @ dequant(qw) (K, N) -> (T, N) in x.dtype, streaming the
-    weight at 0.5 bytes/element + the small group scales."""
+# ---------------------------------------------------------------------------
+# The unified launch seam.
+# ---------------------------------------------------------------------------
+
+
+def _tile_pads(t: int, n: int, block_n: int):
+    """The ONE output-tile alignment convention for every quantized
+    matmul: T pads to the f32 sublane (8), N to a lane-aligned block
+    that divides the padded extent. int8, expert, and int4 kernels all
+    align through here so the convention cannot diverge."""
+    t_pad = -(-t // 8) * 8
+    bn = min(max(-(-block_n // 128) * 128, 128), -(-n // 128) * 128)
+    n_pad = -(-n // bn) * bn
+    return t_pad, bn, n_pad
+
+
+def _k_blocking(k: int, block_k: int, align: int):
+    """Contraction tiling: bk is a multiple of ``align`` (the activation
+    tile's lane alignment, lcm'd with the int4 group so scale tiles stay
+    whole groups), clamped to the aligned extent; K pads up to a
+    multiple of bk. Zero padding is exact: padded activation columns are
+    zero, so padded weight rows never contribute."""
+    bk = min(max(block_k // align, 1) * align, -(-k // align) * align)
+    k_pad = -(-k // bk) * bk
+    return bk, k_pad
+
+
+def _account(name: str, weight_bytes: int, act_bytes: int, out_bytes: int):
+    m = telemetry.metrics()
+    m.inc(f"quant_{name}_calls_total")
+    m.inc(f"quant_{name}_weight_bytes_total", int(weight_bytes))
+    m.inc(f"quant_{name}_activation_bytes_total", int(act_bytes))
+    m.inc(f"quant_{name}_bytes_total",
+          int(weight_bytes + act_bytes + out_bytes))
+
+
+def _choose_blocks(key, runner, bytes_moved: int, interpret: bool,
+                   tracing: bool, name: str):
+    """First eager on-chip call per shape: measure the candidate block
+    sizes on the live operands, cache the winner process-wide, and feed
+    the winning measurement to the telemetry bandwidth gauges. Pinned /
+    disabled / interpret / tracing calls fall through to the defaults
+    (a jitted consumer still picks up winners tuned eagerly before its
+    trace — the cache is keyed by shape, not by array identity)."""
+    pinned = os.environ.get(BLOCKS_ENV, "")
+    if pinned:
+        try:
+            bn, bk = (int(v) for v in pinned.split(","))
+            return bn, bk
+        except ValueError:
+            pass  # malformed pin: fall through to tuning/defaults
+    hit = _TUNED.get(key)
+    if hit is not None:
+        return hit
+    if (interpret or tracing
+            or os.environ.get(AUTOTUNE_ENV, "1") == "0"):
+        return DEFAULT_BLOCK_N, DEFAULT_BLOCK_K
+    best, best_t = None, float("inf")
+    for bn, bk in _CANDIDATE_BLOCKS:
+        try:
+            jax.block_until_ready(runner(bn, bk))  # compile + warm
+            t0 = time.perf_counter()
+            jax.block_until_ready(runner(bn, bk))
+            dt = time.perf_counter() - t0
+        except Exception:  # noqa: BLE001 - a candidate Mosaic rejects
+            continue
+        if dt < best_t:
+            best, best_t = (bn, bk), dt
+    if best is None:
+        return DEFAULT_BLOCK_N, DEFAULT_BLOCK_K
+    _TUNED[key] = best
+    telemetry.record_kernel_bandwidth(name, bytes_moved, best_t)
+    return best
+
+
+def tuned_blocks() -> dict:
+    """The autotuner's process-wide winners, keyed by shape — the bench
+    echoes this so on-chip runs record what actually launched."""
+    return {"/".join(str(p) for p in k): f"{bn}x{bk}"
+            for k, (bn, bk) in sorted(_TUNED.items(), key=str)}
+
+
+def _quant_matmul(x: jax.Array, qw, *, block_n: int | None,
+                  block_k: int | None, interpret: bool | None, tag: str):
+    """THE launch seam: dense (x 2-D) or expert-stacked (x 3-D), int8 or
+    int4, one code path. Owns validation, padding, K-blocking, the
+    autotuner, accounting, and the pallas_call."""
     if interpret is None:
         interpret = _interpret_default()
-    t, k2 = x.shape[0], qw.q.shape[0]
-    k = 2 * k2
-    if x.shape[1] != k:
-        raise ValueError(f"contraction mismatch: x has K={x.shape[1]}, "
-                         f"weight has K={k}")
-    n = qw.q.shape[1]
-    t_pad, bn, n_pad = _tile_pads(t, n, block_n)
-    xp = jnp.pad(x, ((0, t_pad - t), (0, 0))) if t_pad != t else x
+    fmt4 = isinstance(qw, Quantized4Weight)
+    expert = x.ndim == 3
     q, s = qw.q, qw.s
-    if n_pad != n:
-        q = jnp.pad(q, ((0, 0), (0, n_pad - n)))
-        s = jnp.pad(s, ((0, 0), (0, n_pad - n)))
+    group = qw.group if fmt4 else None
+    n = q.shape[-1]
+    k_store = 2 * q.shape[-2] if fmt4 else q.shape[-2]
+    k_logical = _k4(qw) if fmt4 else k_store
 
-    out = pl.pallas_call(
-        functools.partial(_matmul4_kernel, group=qw.group),
-        grid=(n_pad // bn,),
-        in_specs=[
-            pl.BlockSpec((t_pad, k), lambda j: (0, 0)),
-            pl.BlockSpec((k2, bn), lambda j: (0, j)),
-            pl.BlockSpec((k // qw.group, bn), lambda j: (0, j)),
-        ],
-        out_specs=pl.BlockSpec((t_pad, bn), lambda j: (0, j)),
-        out_shape=jax.ShapeDtypeStruct((t_pad, n_pad), x.dtype),
-        compiler_params=pltpu.CompilerParams(dimension_semantics=("parallel",)),
-        interpret=interpret,
-    )(xp, q, s)
-    return out[:t, :n]
+    if expert:
+        e, t, k = x.shape
+        if (e, k) != (q.shape[0], k_logical):
+            raise ValueError(
+                f"expert/contraction mismatch: x {x.shape}, weight "
+                f"{q.shape}" + (f" (K = {k_logical})" if fmt4 else ""))
+    else:
+        e = None
+        t, k = x.shape
+        if k != k_logical:
+            raise ValueError(
+                f"contraction mismatch: x has K={k}, weight has "
+                f"K={k_logical}")
+
+    name = (("int4" if fmt4 else "int8")
+            + ("_expert" if expert else "") + "_matmul"
+            + (f"_{tag}" if tag else ""))
+    elt = x.dtype.itemsize
+    weight_bytes = q.size * q.dtype.itemsize + s.size * s.dtype.itemsize
+    act_bytes = x.size * elt
+    out_bytes = (e or 1) * t * n * elt
+    _account(name, weight_bytes, act_bytes, out_bytes)
+
+    align = (128 * group) // math.gcd(128, group) if fmt4 else 128
+
+    def run(bn_req, bk_req):
+        t_pad, bn, n_pad = _tile_pads(t, n, bn_req)
+        bk, k_pad = _k_blocking(k_store, bk_req, align)
+        nk = k_pad // bk
+        lead = ((0, 0),) if expert else ()
+        xp = x
+        if (t_pad, k_pad) != (t, k):
+            xp = jnp.pad(x, (*lead, (0, t_pad - t), (0, k_pad - k)))
+        qp, sp = q, s
+        if fmt4:
+            qrows, srows = (k_pad - k_store) // 2, k_pad // group - s.shape[-2]
+            if qrows or n_pad != n:
+                qp = jnp.pad(q, (*lead, (0, qrows), (0, n_pad - n)))
+            if srows or n_pad != n:
+                # Zero scales for padded groups: pad nibbles decode to -8,
+                # times a zero scale is zero (and the activation pad is
+                # zero anyway — doubly inert).
+                sp = jnp.pad(s, (*lead, (0, srows), (0, n_pad - n)))
+            s_block, s_index = (bk // group, bn), lambda j, kk: (kk, j)
+            q_block, q_index = (bk // 2, bn), lambda j, kk: (kk, j)
+            kernel = functools.partial(_matmul4_kernel, group=group,
+                                       k_axis=2 if expert else 1, nk=nk)
+        else:
+            if k_pad != k_store or n_pad != n:
+                qp = jnp.pad(q, (*lead, (0, k_pad - k_store), (0, n_pad - n)))
+            if expert:
+                if n_pad != n:
+                    sp = jnp.pad(s, ((0, 0), (0, 0), (0, n_pad - n)))
+            else:
+                sp = (jnp.pad(s, (0, n_pad - n)) if n_pad != n
+                      else s).reshape(1, n_pad)
+            s_block, s_index = (1, bn), lambda j, kk: (0, j)
+            q_block, q_index = (bk, bn), lambda j, kk: (kk, j)
+            kernel = functools.partial(_matmul_kernel,
+                                       k_axis=2 if expert else 1, nk=nk)
+
+        if expert:
+            grid = (e, n_pad // bn, nk)
+            in_specs = [
+                pl.BlockSpec((None, t_pad, bk), lambda i, j, kk: (i, 0, kk)),
+                pl.BlockSpec((None, *q_block),
+                             lambda i, j, kk, f=q_index: (i, *f(j, kk))),
+                pl.BlockSpec((None, *s_block),
+                             lambda i, j, kk, f=s_index: (i, *f(j, kk))),
+            ]
+            out_specs = pl.BlockSpec((None, t_pad, bn),
+                                     lambda i, j, kk: (i, 0, j))
+            out_shape = jax.ShapeDtypeStruct((e, t_pad, n_pad), x.dtype)
+            semantics = ("parallel", "parallel", "arbitrary")
+        else:
+            grid = (n_pad // bn, nk)
+            in_specs = [
+                pl.BlockSpec((t_pad, bk), lambda j, kk: (0, kk)),
+                pl.BlockSpec(q_block, q_index),
+                pl.BlockSpec(s_block, s_index),
+            ]
+            out_specs = pl.BlockSpec((t_pad, bn), lambda j, kk: (0, j))
+            out_shape = jax.ShapeDtypeStruct((t_pad, n_pad), x.dtype)
+            semantics = ("parallel", "arbitrary")
+
+        out = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shape,
+            scratch_shapes=[pltpu.VMEM((t_pad, bn), jnp.float32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=semantics),
+            interpret=interpret,
+        )(xp, qp, sp)
+        return out[:, :t, :n] if expert else out[:t, :n]
+
+    if block_n is not None or block_k is not None:
+        # Explicit blocks bypass the autotuner (tests pin exact tilings).
+        return run(block_n or DEFAULT_BLOCK_N, block_k or DEFAULT_BLOCK_K)
+    bn_c, bk_c = _choose_blocks(
+        ("int4" if fmt4 else "int8", expert, -(-t // 8) * 8, k_store, n, group),
+        run, weight_bytes + act_bytes + out_bytes, interpret,
+        isinstance(x, jax.core.Tracer), name)
+    return run(bn_c, bk_c)
 
 
-def quantize_expert_weight4(w: jax.Array, group: int = 64) -> Quantized4Weight:
-    """Expert stack (E, K, N) float -> nibble-packed int4 with
-    per-(expert, K-group, output channel) scales — the same group-wise
-    scaling as the dense int4 format, one more leading axis."""
-    e, k, n = w.shape
-    if k % 2 != 0 or group % 2 != 0 or k % group != 0:
-        raise ValueError(
-            f"int4 packing needs K ({k}) even and divisible by an even "
-            f"group ({group})")
-    wf = w.astype(jnp.float32).reshape(e, k // group, group, n)
-    absmax = jnp.max(jnp.abs(wf), axis=2, keepdims=True)  # (E, K/g, 1, N)
-    scale = jnp.where(absmax > 0, absmax / 7.0, 1.0)
-    q = jnp.clip(jnp.round(wf / scale), -7, 7).astype(jnp.int32).reshape(e, k, n)
-    u = (q + 8).astype(jnp.uint8)
-    packed = (u[:, 0::2] | (u[:, 1::2] << 4)).astype(jnp.uint8)  # (E, K/2, N)
-    return Quantized4Weight(q=packed, s=scale[:, :, 0], group=group,
-                            shape=tuple(w.shape))
+def int8_matmul(x: jax.Array, qw: QuantizedWeight, *,
+                block_n: int | None = None, block_k: int | None = None,
+                interpret: bool | None = None, tag: str = "") -> jax.Array:
+    """x (T, K) @ dequant(qw) (K, N) -> (T, N) in x.dtype.
+
+    Pads T up to the float32 sublane tile (8), N up to a lane-aligned
+    block, and K up to a 128-aligned block multiple (zero pad — exact).
+    The weight never exists in HBM at more than 1 byte/element. Omitted
+    block sizes go through the autotuner; explicit ones pin the tiling."""
+    return _quant_matmul(x, qw, block_n=block_n, block_k=block_k,
+                         interpret=interpret, tag=tag)
+
+
+def int8_expert_matmul(x: jax.Array, qw: QuantizedWeight, *,
+                       block_n: int | None = None,
+                       block_k: int | None = None,
+                       interpret: bool | None = None,
+                       tag: str = "") -> jax.Array:
+    """Per-expert batched matmul: x (E, T, K) @ dequant(qw) (E, K, N) ->
+    (E, T, N) in x.dtype. Grid (E, N tiles, K tiles); the leading None
+    block dims squeeze away, so the kernel body is the same K-blocked
+    fused-dequant matmul as int8_matmul's."""
+    return _quant_matmul(x, qw, block_n=block_n, block_k=block_k,
+                         interpret=interpret, tag=tag)
+
+
+def int4_matmul(x: jax.Array, qw: Quantized4Weight, *,
+                block_n: int | None = None, block_k: int | None = None,
+                interpret: bool | None = None, tag: str = "") -> jax.Array:
+    """x (T, K) @ dequant(qw) (K, N) -> (T, N) in x.dtype, streaming the
+    weight at 0.5 bytes/element + the small group scales. K-blocked like
+    the int8 kernel (block_k aligned to whole scale groups)."""
+    return _quant_matmul(x, qw, block_n=block_n, block_k=block_k,
+                         interpret=interpret, tag=tag)
 
 
 def int4_expert_matmul(x: jax.Array, qw: Quantized4Weight, *,
-                       block_n: int = 512,
-                       interpret: bool | None = None) -> jax.Array:
+                       block_n: int | None = None,
+                       block_k: int | None = None,
+                       interpret: bool | None = None,
+                       tag: str = "") -> jax.Array:
     """Per-expert batched matmul: x (E, T, K) @ dequant(qw) (E, K, N) ->
-    (E, T, N) in x.dtype, streaming the stacks at 0.5 bytes/element.
-    Grid (E, N tiles); the leading None block dims squeeze away, so the
-    kernel body is the same unpack-in-VMEM matmul as int4_matmul's."""
-    if interpret is None:
-        interpret = _interpret_default()
-    e, t, k = x.shape
-    eq, k2, n = qw.q.shape
-    if (e, k) != (eq, 2 * k2):
-        raise ValueError(f"expert/contraction mismatch: x {x.shape}, "
-                         f"weight {qw.q.shape} (K = 2x{k2})")
-    t_pad, bn, n_pad = _tile_pads(t, n, block_n)
-    xp = jnp.pad(x, ((0, 0), (0, t_pad - t), (0, 0))) if t_pad != t else x
-    q, s = qw.q, qw.s
-    if n_pad != n:
-        q = jnp.pad(q, ((0, 0), (0, 0), (0, n_pad - n)))
-        s = jnp.pad(s, ((0, 0), (0, 0), (0, n_pad - n)))
+    (E, T, N) in x.dtype, streaming the stacks at 0.5 bytes/element."""
+    return _quant_matmul(x, qw, block_n=block_n, block_k=block_k,
+                         interpret=interpret, tag=tag)
 
-    out = pl.pallas_call(
-        functools.partial(_matmul4_kernel, group=qw.group),
-        grid=(e, n_pad // bn),
-        in_specs=[
-            pl.BlockSpec((None, t_pad, k), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, k2, bn), lambda i, j: (i, 0, j)),
-            pl.BlockSpec((None, k // qw.group, bn), lambda i, j: (i, 0, j)),
-        ],
-        out_specs=pl.BlockSpec((None, t_pad, bn), lambda i, j: (i, 0, j)),
-        out_shape=jax.ShapeDtypeStruct((e, t_pad, n_pad), x.dtype),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel")),
-        interpret=interpret,
-    )(xp, q, s)
-    return out[:, :t, :n]
+
+# ---------------------------------------------------------------------------
+# Stream-bytes accounting helpers (the analytic side of the roofline:
+# the bench's bytes-per-token math and the interpret-mode byte tests
+# both read these, so the claim regresses in tier-1 without a chip).
+# ---------------------------------------------------------------------------
+
+
+def weight_stream_bytes(w) -> int:
+    """Bytes ONE launch streams for the weight side: packed values plus
+    scales for quantized weights (1 byte/elem int8 + f32/channel; 0.5
+    byte/elem int4 + f32/group/channel), plain nbytes for float."""
+    if is_quantized(w):
+        return int(w.q.size * w.q.dtype.itemsize + w.s.size * w.s.dtype.itemsize)
+    return int(w.size * w.dtype.itemsize)
+
+
+def decode_stream_bytes(params: dict) -> int:
+    """Bytes a decode step actually STREAMS, not the tree's total:
+    quantized trees keep the f32 embedding for batch-row gathers
+    (negligible reads) while the int8/int4 lm_head copy serves the head
+    matmul, the fused wqkv copy replaces the three separate projections
+    decode then never reads, and w_gateup likewise replaces w_gate/w_up
+    on gated models. Summing every leaf would overstate the quantized
+    variants ~2x and skew the exact roofline this exists to localize."""
+    total = 0
+    for b in params["blocks"]:
+        leaves = dict(b)
+        if "wqkv" in leaves:
+            for n2 in ("wq", "wk", "wv"):
+                leaves.pop(n2, None)
+        if "w_gateup" in leaves:
+            for n2 in ("w_gate", "w_up"):
+                leaves.pop(n2, None)
+        total += sum(x.nbytes for x in jax.tree.leaves(leaves))
+    head = params.get("lm_head")
+    if head is not None:
+        total += sum(x.nbytes for x in jax.tree.leaves(head))
+    else:
+        total += params["embed"].nbytes  # head matmul reads the embed
+    total += params["final_norm"].nbytes
+    return int(total)
+
+
+# ---------------------------------------------------------------------------
+# Params-tree quantization.
+# ---------------------------------------------------------------------------
+
+
+def _q2d(w, contract_rank, quantize=None):
+    """Flatten a projection to 2-D matmul layout (contraction axes first)
+    and quantize; the original logical shape rides in the wrapper. The
+    ONE definition of the flattening convention — `quantize` selects the
+    format (default int8 per-channel; int4 passes quantize_weight4) so
+    the int8/int4 layouts cannot diverge."""
+    k = 1
+    for d in w.shape[:contract_rank]:
+        k *= d
+    qw = (quantize or quantize_weight)(w.reshape(k, -1))
+    return dataclasses.replace(qw, shape=tuple(w.shape))
+
+
+def _fuse_n(parts, shape):
+    """Concatenate quantized weights along the OUTPUT-channel axis into
+    one launch (exact for both formats: int8 scales are per channel,
+    int4 scales per (group, channel) — N-concat never mixes scales).
+    All parts must share the contraction layout (and group, for int4)."""
+    first = parts[0]
+    if isinstance(first, Quantized4Weight):
+        if any(p.group != first.group or _k4(p) != _k4(first)
+               for p in parts[1:]):
+            raise ValueError("fused int4 parts must share K and group")
+        return Quantized4Weight(
+            q=jnp.concatenate([p.q for p in parts], axis=-1),
+            s=jnp.concatenate([p.s for p in parts], axis=-1),
+            group=first.group, shape=shape, kdim=_k4(first))
+    return QuantizedWeight(
+        q=jnp.concatenate([p.q for p in parts], axis=-1),
+        s=jnp.concatenate([p.s for p in parts], axis=-1),
+        shape=shape)
+
+
+_DENSE_PROJECTIONS = (("wq", 1), ("wk", 1), ("wv", 1), ("wo", 2),
+                      ("w_up", 1), ("w_down", 1))
+
+
+def _quantize_block_common(block: dict, q2d, expert_quantize) -> dict:
+    """Shared block-quantization skeleton for int8 and int4: projections
+    through _q2d, expert stacks through their format's expert quantizer
+    (router stays float), and the fused decode-critical copies — wqkv
+    (one activation read for the three QKV projections) and, on gated
+    models, w_gateup (one read for the gate/up pair)."""
+    if "router" in block:
+        out = dict(block)
+        for name in ("wq", "wk", "wv"):
+            out[name] = q2d(block[name], 1)
+        out["wo"] = q2d(block["wo"], 2)
+        out["w_up"] = expert_quantize(block["w_up"])
+        out["w_down"] = expert_quantize(block["w_down"])
+        return out
+    out = dict(block)
+    for name, contract_rank in _DENSE_PROJECTIONS:
+        out[name] = q2d(block[name], contract_rank)
+    if "w_gate" in block:
+        out["w_gate"] = q2d(block["w_gate"], 1)
+    # Fused QKV: the three projections share the input activation, so one
+    # kernel launch covers all three — decode at small batch is kernel-
+    # launch-bound (6 launches per layer per token otherwise) and pays
+    # ONE activation read instead of three. Same for the gate/up pair on
+    # gated-MLP models. Per-projection copies stay for any per-projection
+    # reader (quantized storage is cheap next to the float master copy).
+    k = block["wq"].shape[0]
+    nq = sum(out[n2].q.shape[-1] for n2 in ("wq", "wk", "wv"))
+    out["wqkv"] = _fuse_n([out[n2] for n2 in ("wq", "wk", "wv")], (k, nq))
+    if "w_gate" in block:
+        f2 = out["w_gate"].q.shape[-1] + out["w_up"].q.shape[-1]
+        out["w_gateup"] = _fuse_n([out["w_gate"], out["w_up"]], (k, f2))
+    return out
+
+
+def quantize_block(block: dict) -> dict:
+    """Quantize one transformer block's projections, preserving the
+    pytree keys decode._block_step reads. Dense weights are stored 2-D in
+    matmul layout (contraction axis first) plus the fused wqkv (and
+    w_gateup) decode copies; MoE blocks quantize their attention
+    projections the same way plus the (E, K, N) expert stacks per
+    (expert, channel), while the router — a tiny, routing-critical
+    matmul — stays float."""
+    return _quantize_block_common(block, _q2d, quantize_expert_weight)
 
 
 def quantize_block4(block: dict, group: int = 64) -> dict:
-    """int4 counterpart of quantize_block. MoE blocks quantize their
-    attention projections and (E, K, N) expert stacks with per-(expert,
-    group, channel) scales; the router (tiny, routing-critical) stays
-    float, as in int8. No fused QKV: int4 is the extreme-bandwidth
-    option and keeps the minimal surface."""
+    """int4 counterpart of quantize_block — same structure, group-wise
+    scales, and (since the K-blocked kernel rework) the same fused
+    wqkv/w_gateup decode copies, so the int4 self-draft and serving
+    paths ride the identical launch seam as int8."""
     q4 = functools.partial(quantize_weight4, group=group)
-    out = dict(block)
-    if "router" in block:
-        for name in ("wq", "wk", "wv"):
-            out[name] = _q2d(block[name], 1, quantize=q4)
-        out["wo"] = _q2d(block["wo"], 2, quantize=q4)
-        out["w_up"] = quantize_expert_weight4(block["w_up"], group)
-        out["w_down"] = quantize_expert_weight4(block["w_down"], group)
-        return out
-    for name, contract_rank in (("wq", 1), ("wk", 1), ("wv", 1), ("wo", 2),
-                                ("w_up", 1), ("w_down", 1)):
-        out[name] = _q2d(block[name], contract_rank, quantize=q4)
+    return _quantize_block_common(
+        block, functools.partial(_q2d, quantize=q4),
+        functools.partial(quantize_expert_weight4, group=group))
+
+
+def quantize_params(params: dict, *, head: bool = True) -> dict:
+    """Params pytree -> the same tree with dense block projections
+    int8-quantized (decode.py detects the quantized leaves).
+
+    head=True additionally stores ``lm_head``: the embedding transposed
+    to (embed, vocab) matmul layout and int8-quantized. The float
+    embedding stays in the tree untouched (gathers read it by row);
+    decode's logits head streams the 1-byte copy instead of the full
+    float matrix."""
+    out = {**params, "blocks": [quantize_block(b) for b in params["blocks"]]}
+    if head:
+        out["lm_head"] = quantize_weight(params["embed"].T)
     return out
 
 
@@ -418,87 +747,26 @@ def reference_int8_matmul(x: jax.Array, qw: QuantizedWeight) -> jax.Array:
     return (acc * qw.s).astype(x.dtype)
 
 
-def _q2d(w, contract_rank, quantize=None):
-    """Flatten a projection to 2-D matmul layout (contraction axes first)
-    and quantize; the original logical shape rides in the wrapper. The
-    ONE definition of the flattening convention — `quantize` selects the
-    format (default int8 per-channel; int4 passes quantize_weight4) so
-    the int8/int4 layouts cannot diverge."""
-    k = 1
-    for d in w.shape[:contract_rank]:
-        k *= d
-    qw = (quantize or quantize_weight)(w.reshape(k, -1))
-    return dataclasses.replace(qw, shape=tuple(w.shape))
-
-
-def quantize_block(block: dict) -> dict:
-    """Quantize one transformer block's projections, preserving the
-    pytree keys decode._block_step reads. Dense weights are stored 2-D in
-    matmul layout (contraction axis first); MoE blocks quantize their
-    attention projections the same way plus the (E, K, N) expert stacks
-    per (expert, channel), while the router — a tiny, routing-critical
-    matmul — stays float."""
-    if "router" in block:
-        out = dict(block)
-        for name in ("wq", "wk", "wv"):
-            out[name] = _q2d(block[name], 1)
-        out["wo"] = _q2d(block["wo"], 2)
-        out["w_up"] = quantize_expert_weight(block["w_up"])
-        out["w_down"] = quantize_expert_weight(block["w_down"])
-        return out
-
-    out = dict(block)
-    for name, contract_rank in (("wq", 1), ("wk", 1), ("wv", 1), ("wo", 2),
-                                ("w_up", 1), ("w_down", 1)):
-        out[name] = _q2d(block[name], contract_rank)
-    # Fused QKV: the three projections share the input activation, so one
-    # kernel launch covers all three — decode at small batch is kernel-
-    # launch-bound (6 launches per layer per token otherwise). Scales are
-    # per-output-channel, so concatenating along N is exact. decode
-    # prefers this entry; wq/wk/wv stay for any per-projection reader
-    # (int8 storage is cheap next to the float master copy).
-    out["wqkv"] = QuantizedWeight(
-        q=jnp.concatenate([out[n].q for n in ("wq", "wk", "wv")], axis=1),
-        s=jnp.concatenate([out[n].s for n in ("wq", "wk", "wv")]),
-        shape=(out["wq"].q.shape[0],
-               out["wq"].q.shape[1] + out["wk"].q.shape[1] + out["wv"].q.shape[1]),
-    )
-    return out
-
-
-def quantize_params(params: dict, *, head: bool = True) -> dict:
-    """Params pytree -> the same tree with dense block projections
-    int8-quantized (decode.py detects the quantized leaves).
-
-    head=True additionally stores ``lm_head``: the embedding transposed
-    to (embed, vocab) matmul layout and int8-quantized. The float
-    embedding stays in the tree untouched (gathers read it by row);
-    decode's logits head streams the 1-byte copy instead of the full
-    float matrix."""
-    out = {**params, "blocks": [quantize_block(b) for b in params["blocks"]]}
-    if head:
-        out["lm_head"] = quantize_weight(params["embed"].T)
-    return out
-
-
 def is_quantized(w) -> bool:
     return isinstance(w, (QuantizedWeight, Quantized4Weight))
 
 
-def quantized_matmul(x2: jax.Array, w) -> jax.Array:
+def quantized_matmul(x2: jax.Array, w, tag: str = "") -> jax.Array:
     """Route a 2-D activation through whichever quantized kernel matches
-    the weight — the single dispatch the decode._linear seam calls."""
+    the weight — the single dispatch the decode._linear seam calls.
+    ``tag`` labels the launch's accounting counters (e.g. "qkv",
+    "head") without changing any numerics."""
     if isinstance(w, Quantized4Weight):
-        return int4_matmul(x2, w)
-    return int8_matmul(x2, w)
+        return int4_matmul(x2, w, tag=tag)
+    return int8_matmul(x2, w, tag=tag)
 
 
-def quantized_expert_matmul(x3: jax.Array, w) -> jax.Array:
+def quantized_expert_matmul(x3: jax.Array, w, tag: str = "") -> jax.Array:
     """Expert-stack counterpart of quantized_matmul — the dispatch the
     MoE FFN seam (moe._expert_linear) calls."""
     if isinstance(w, Quantized4Weight):
-        return int4_expert_matmul(x3, w)
-    return int8_expert_matmul(x3, w)
+        return int4_expert_matmul(x3, w, tag=tag)
+    return int8_expert_matmul(x3, w, tag=tag)
 
 
 def dequantize_any(w) -> jax.Array:
@@ -516,6 +784,7 @@ __all__ = [
     "quantize_expert_weight4",
     "quantized_expert_matmul",
     "QuantizedWeight",
+    "decode_stream_bytes",
     "dequantize_weight",
     "dequantize_any",
     "dequantize_weight4",
@@ -532,4 +801,6 @@ __all__ = [
     "quantize_weight4",
     "quantized_matmul",
     "reference_int8_matmul",
+    "tuned_blocks",
+    "weight_stream_bytes",
 ]

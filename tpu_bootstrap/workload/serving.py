@@ -141,6 +141,28 @@ class _PoolBase:
                 f"{_bucket_up(len(r.tokens) + r.max_new)} > the model's "
                 f"max_seq_len ({cfg.max_seq_len})")
 
+    def _record_stream_gauges(self) -> None:
+        """Export the analytic per-step weight-stream bytes of the
+        target (and the draft, when speculative) as registry gauges —
+        the serving-side denominator of the decode roofline, riding the
+        same scrape/-/metrics.json/--slo-report surfaces as the
+        per-kernel quant_* bandwidth counters. decode_stream_bytes
+        counts what a step actually streams (fused wqkv/w_gateup copies
+        replace their per-projection reads; the quantized head replaces
+        the float embedding)."""
+        from tpu_bootstrap.workload import quant
+
+        try:
+            telemetry.metrics().set_gauge(
+                "serve_target_stream_bytes",
+                quant.decode_stream_bytes(self.params))
+            if getattr(self, "draft_params", None) is not None:
+                telemetry.metrics().set_gauge(
+                    "serve_draft_stream_bytes",
+                    quant.decode_stream_bytes(self.draft_params))
+        except (KeyError, TypeError, AttributeError):
+            pass  # non-standard param trees (test doubles) skip the gauge
+
     def free_slots(self) -> int:
         return sum(1 for s in self.slots if s is None)
 
@@ -241,6 +263,7 @@ class SlotPool(_PoolBase):
         if draft_params is not None:
             self.stats.update({"verify_rounds": 0, "committed_tokens": 0,
                                "draft_steps": 0})
+        self._record_stream_gauges()
 
     def reset(self) -> None:
         """Abandon every in-flight row (the ingress engine's
@@ -552,6 +575,7 @@ class ResidentPool(_PoolBase):
         if draft_params is not None:
             self.stats.update({"verify_rounds": 0, "committed_tokens": 0,
                                "draft_steps": 0})
+        self._record_stream_gauges()
 
     def validate(self, r: Request, cfg: ModelConfig) -> None:
         _PoolBase.validate(r, cfg)

@@ -140,8 +140,11 @@ def param_shardings(mesh: Mesh, params: Params):
             return P("tensor", None, "fsdp")
         if path.endswith("router"):
             return P("fsdp", None)
-        if path.endswith("w_up"):
-            # 3-D: expert-stacked (E, embed, mlp) — E over the expert axis
+        if path.endswith(("w_up", "w_gate")):
+            # 3-D: expert-stacked (E, embed, mlp) — E over the expert axis.
+            # (w_gate is dense-only and shaped like w_up; the fused
+            # quantized "w_gateup" copy never reaches here — quantized
+            # leaves take the is_quantized branch below.)
             return P("expert", "fsdp", "tensor") if ndim == 3 else P("fsdp", "tensor")
         if path.endswith("w_down"):
             return P("expert", "tensor", "fsdp") if ndim == 3 else P("tensor", "fsdp")

@@ -296,7 +296,14 @@ def speculative_generate(target_params: Params, draft_params: Params,
     A cheap high-acceptance draft needs no second model: the target's
     own int8 copy (quant.quantize_params) rarely flips an argmax, so
     self-speculation accelerates the bf16 target with its quantized
-    shadow — and exactness holds regardless.
+    shadow — and exactness holds regardless. The draft's decode steps
+    ride the SAME fused quantized launch seam as plain decode
+    (decode._block_step prefers the fused wqkv — and, on gated models,
+    w_gateup — copies that both quantize_params and quantize_params4
+    now store), so each draft step costs one fused QKV read + the
+    K-blocked block projections, not six separate launches; the
+    committed-per-round telemetry is unchanged by the fusion (pinned by
+    test_speculative's fused-vs-unfused parity case).
 
     with_stats=True additionally returns {"verify_rounds",
     "mean_committed"} — committed tokens per verify round is the
