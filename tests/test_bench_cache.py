@@ -232,3 +232,41 @@ def test_check_gates_roofline_regressions(tmp_path, monkeypatch, capsys):
     # No cache at all: nothing to gate against.
     monkeypatch.setattr(bench, "WORKLOAD_CACHE", tmp_path / "none.json")
     assert bench.check_results({"decode_int8_hbm_roofline_frac": 0.1}) == 0
+
+
+def test_check_gates_paged_serving_slo_keys(tmp_path, monkeypatch, capsys):
+    """The paged serving SLO pair is hard-gated like the roofline keys:
+    throughput (higher-better, by suffix) and burst TTFT p99
+    (lower-better, by suffix) each fail --check on a >15% wrong-way
+    move; kv_blocks_peak_frac is judged lower-better but stays a soft
+    flag."""
+    monkeypatch.setattr(bench, "WORKLOAD_CACHE", tmp_path / "cache.json")
+    bench._cache_workload({"chip_alive": True,
+                           "serve_paged_tokens_per_sec": 9000.0,
+                           "serve_ttft_p99_ms": 120.0,
+                           "kv_blocks_peak_frac": 0.5})
+
+    # Paged throughput down 30%: hard failure.
+    rc = bench.check_results({"serve_paged_tokens_per_sec": 6300.0,
+                              "serve_ttft_p99_ms": 118.0,
+                              "kv_blocks_peak_frac": 0.5})
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 1
+    assert "serve_paged_tokens_per_sec" in out["check_hard_failures"]
+
+    # TTFT p99 up 2x: hard failure (lower-better direction).
+    rc = bench.check_results({"serve_paged_tokens_per_sec": 9100.0,
+                              "serve_ttft_p99_ms": 260.0,
+                              "kv_blocks_peak_frac": 0.49})
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 1
+    assert "serve_ttft_p99_ms" in out["check_hard_failures"]
+
+    # Peak block fraction ballooning is flagged but not fatal.
+    rc = bench.check_results({"serve_paged_tokens_per_sec": 9100.0,
+                              "serve_ttft_p99_ms": 110.0,
+                              "kv_blocks_peak_frac": 0.9})
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    assert "kv_blocks_peak_frac" in out["check_regressions"]
+    assert out["check_failed"] == 0

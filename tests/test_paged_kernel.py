@@ -1,0 +1,144 @@
+"""Paged Pallas decode-attention kernel
+(workload/decode_attention.paged_decode_attention_int8), interpret mode:
+correctness against the gather-then-attend oracle over scattered block
+tables, per-row frontier masking, invariance to garbage in blocks a row
+does not own, and the PagedPool routing."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_bootstrap.workload.decode import _quantize_kv
+from tpu_bootstrap.workload.decode_attention import (
+    paged_decode_attention_int8,
+    paged_supports,
+)
+
+B, H, HK, D, BS, NBLK, NB = 3, 8, 2, 16, 8, 12, 3
+
+
+def _case(key=0):
+    ks = jax.random.split(jax.random.PRNGKey(key), 3)
+    q = jax.random.normal(ks[0], (B, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (NBLK, BS, HK, D), jnp.float32)
+    v = jax.random.normal(ks[2], (NBLK, BS, HK, D), jnp.float32)
+    kq, kscale = _quantize_kv(k)
+    vq, vscale = _quantize_kv(v)
+    # Scattered, out-of-order physical placement — the whole point of
+    # the block table (row 2 uses a single block; its pad entries are
+    # never dereferenced).
+    bt = jnp.asarray([[3, 7, 1], [5, 2, 0], [9, 0, 0]], jnp.int32)
+    lengths = jnp.asarray([20, 11, 5], jnp.int32)
+    return q, kq, kscale, vq, vscale, bt, lengths
+
+
+def _oracle(q, kq, kscale, vq, vscale, bt, lengths):
+    kd = (kq.astype(jnp.float32) * kscale[..., None])[bt]
+    vd = (vq.astype(jnp.float32) * vscale[..., None])[bt]
+    kd = kd.reshape(B, NB * BS, HK, D)
+    vd = vd.reshape(B, NB * BS, HK, D)
+    qg = q.reshape(B, HK, H // HK, D)
+    s = jnp.einsum("bkgd,blkd->bkgl", qg, kd) * D ** -0.5
+    mask = (jnp.arange(NB * BS)[None, :] < lengths[:, None])[:, None, None]
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bkgl,blkd->bkgd", p, vd).reshape(B, H, D)
+
+
+def test_paged_kernel_matches_gather_oracle():
+    q, kq, kscale, vq, vscale, bt, lengths = _case()
+    got = paged_decode_attention_int8(q, kq, kscale, vq, vscale, bt, lengths)
+    want = _oracle(q, kq, kscale, vq, vscale, bt, lengths)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_paged_kernel_ignores_unowned_and_masked_blocks():
+    """Garbage anywhere a row's table/length does not reach — other
+    rows' blocks, the null block, the row's own slots past its frontier
+    — must not change its output (the isolation the allocator's unique-
+    ownership invariant plus the per-row mask together guarantee)."""
+    q, kq, kscale, vq, vscale, bt, lengths = _case(key=1)
+    base = paged_decode_attention_int8(q, kq, kscale, vq, vscale, bt, lengths)
+    # Null block (0), a block no table references (11), and row 1's
+    # slots past its length-11 frontier (block 2 offsets 3..).
+    kq2 = kq.at[0].set(127).at[11].set(-128).at[2, 3:].set(127)
+    vq2 = vq.at[0].set(127).at[11].set(-128).at[2, 3:].set(127)
+    got = paged_decode_attention_int8(q, kq2, kscale, vq2, vscale, bt,
+                                      lengths)
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(got))
+
+
+def test_paged_kernel_single_query_head():
+    """MQA folding: Hk=1 with the group padded to the sublane tile."""
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = jax.random.normal(ks[0], (2, 4, D), jnp.float32)
+    k = jax.random.normal(ks[1], (6, BS, 1, D), jnp.float32)
+    v = jax.random.normal(ks[2], (6, BS, 1, D), jnp.float32)
+    kq, kscale = _quantize_kv(k)
+    vq, vscale = _quantize_kv(v)
+    bt = jnp.asarray([[2, 4], [1, 3]], jnp.int32)
+    lengths = jnp.asarray([13, 16], jnp.int32)
+    got = paged_decode_attention_int8(q, kq, kscale, vq, vscale, bt, lengths)
+    kd = (kq.astype(jnp.float32) * kscale[..., None])[bt].reshape(2, 16, 1, D)
+    vd = (vq.astype(jnp.float32) * vscale[..., None])[bt].reshape(2, 16, 1, D)
+    s = jnp.einsum("bhd,bld->bhl", q, kd[:, :, 0]) * D ** -0.5
+    s = jnp.where((jnp.arange(16)[None] < lengths[:, None])[:, None], s, -1e30)
+    want = jnp.einsum("bhl,bld->bhd", jax.nn.softmax(s, -1), vd[:, :, 0])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_paged_supports_gating():
+    assert paged_supports(64, 4, 64) and paged_supports(8, 2, 16)
+    assert not paged_supports(12, 4, 64)  # not an 8-multiple
+    assert not paged_supports(512, 512, 128)  # VMEM tile budget
+    q, kq, kscale, vq, vscale, bt, lengths = _case()
+    with pytest.raises(ValueError, match="paged_supports"):
+        paged_decode_attention_int8(q, kq[:, :4], kscale[:, :4],
+                                    vq[:, :4], vscale[:, :4], bt, lengths)
+
+
+def test_paged_pool_routes_through_kernel(monkeypatch):
+    """PagedPool(kv_quant=True) auto-selects the kernel path on a
+    tileable block size, every decode chunk streams through it, and the
+    token output equals the gather/einsum path (paged_kernel=False —
+    the documented sharded-serving escape)."""
+    from tpu_bootstrap.workload import decode_attention as da
+    from tpu_bootstrap.workload.model import ModelConfig, init_params
+    from tpu_bootstrap.workload.serving import PagedPool, Request
+
+    cfg = ModelConfig(vocab_size=64, num_layers=2, num_heads=4, head_dim=8,
+                      embed_dim=32, mlp_dim=64, max_seq_len=64)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    reqs = [Request(rid=0, tokens=[3, 1, 4, 1, 5], max_new=6),
+            Request(rid=1, tokens=[2, 7], max_new=4)]
+
+    calls = {"n": 0}
+    real = da.paged_decode_attention_int8
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return real(*a, **kw)
+
+    monkeypatch.setattr(da, "paged_decode_attention_int8", counting)
+
+    def run(**kw):
+        pool = PagedPool(params, cfg, 2, kv_quant=True, block_size=8, **kw)
+        assert pool.paged_kernel == (not kw)
+        for r in reqs:
+            pool.admit(r)
+        got = {}
+        while pool.has_active():
+            for rid, ev in pool.step_round().items():
+                if ev["done"]:
+                    got[rid] = ev["generated"]
+        return got
+
+    with_kernel = run()
+    assert calls["n"] > 0, "paged kernel path never taken"
+    calls["n"] = 0
+    without = run(paged_kernel=False)
+    assert calls["n"] == 0, "paged_kernel=False still took the kernel path"
+    assert with_kernel == without

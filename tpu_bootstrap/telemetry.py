@@ -443,6 +443,30 @@ def record_kernel_bandwidth(kernel: str, bytes_moved: int, seconds: float,
                        round(gbps / peak_gbps, 4))
 
 
+def record_kv_block_pool(total: int, used: int, free: int,
+                         capacity_tokens: int, live_tokens: int,
+                         peak_used: int, compactness: float) -> None:
+    """Block-pool gauges for the paged serving engine (serving.PagedPool
+    feeds this after every admission / retirement / round): absolute
+    block counts, the peak fraction the workload ever reserved
+    (kv_blocks_peak_frac — the bench's capacity-headroom key), internal
+    fragmentation (reserved-but-unwritten token slots over reserved
+    capacity; bounded by per-row budget remainders + one partial block
+    per row), and address-space compactness (1.0 = live blocks are a
+    dense prefix; defrag() restores it)."""
+    reg = _metrics
+    reg.set_gauge("kv_blocks_total", total)
+    reg.set_gauge("kv_blocks_used", used)
+    reg.set_gauge("kv_blocks_free", free)
+    if total > 0:
+        reg.set_gauge("kv_blocks_used_frac", round(used / total, 4))
+        reg.set_gauge("kv_blocks_peak_frac", round(peak_used / total, 4))
+    if capacity_tokens > 0:
+        reg.set_gauge("kv_block_internal_frag",
+                      round(1.0 - live_tokens / capacity_tokens, 4))
+    reg.set_gauge("kv_blocks_compactness", round(compactness, 4))
+
+
 class RateWindow:
     """Rolling event-rate gauge feed (serve_qps, serve_tokens_per_sec):
     count events with add(), read events-per-second over the trailing

@@ -97,6 +97,97 @@ def init_cache(cfg: ModelConfig, batch: int, max_len: int, quantized: bool = Fal
     ]
 
 
+def init_paged_cache(cfg: ModelConfig, num_blocks: int, block_size: int,
+                     quantized: bool = False):
+    """One physical KV block pool pair per layer: ``(N, bs, Hk, D)``
+    arrays whose first axis is the PHYSICAL BLOCK ID — rows of a paged
+    serving pool own scattered sets of blocks through per-row block
+    tables (serving.BlockAllocator) instead of a contiguous
+    ``max_seq_len`` region. Same dtype/scale conventions as
+    `init_cache`; ``num_blocks`` counts every physical block the caller
+    wants, including any sentinel block it reserves (serving keeps id 0
+    as a never-read null block that pads short block tables)."""
+    shape = (num_blocks, block_size, cfg.kv_heads, cfg.head_dim)
+    if quantized:
+        sshape = shape[:-1]
+        return [
+            {"k": jnp.zeros(shape, jnp.int8),
+             "k_scale": jnp.zeros(sshape, jnp.float32),
+             "v": jnp.zeros(shape, jnp.int8),
+             "v_scale": jnp.zeros(sshape, jnp.float32)}
+            for _ in range(cfg.num_layers)
+        ]
+    return [
+        {"k": jnp.zeros(shape, cfg.compute_dtype),
+         "v": jnp.zeros(shape, cfg.compute_dtype)}
+        for _ in range(cfg.num_layers)
+    ]
+
+
+def paged_decode_step(params: Params, token: jax.Array, pos: jax.Array,
+                      pools: list, block_tables: jax.Array, cfg: ModelConfig):
+    """One token (B,) against a BLOCK-PAGED int8 cache at per-row
+    frontiers ``pos`` (B,): row b's new KV lands at block
+    ``block_tables[b, pos[b]//bs]`` offset ``pos[b]%bs`` of the physical
+    pool, and attention streams the row's own blocks through the paged
+    Pallas kernel (decode_attention.paged_decode_attention_int8) — no
+    gathered window ever exists in HBM. Quantized pools only (the
+    kernel is the point; float pools take the serving gather path).
+    Returns (next-token logits (B, vocab), updated pools)."""
+    bs = pools[0]["k"].shape[1]
+    dtype = cfg.compute_dtype
+    # Clamp the logical block index to the table width: rows run PAST
+    # their budget under the majority-chunk scheduler (their overshoot
+    # tokens are discarded by the event fold), and an unclamped
+    # out-of-range gather would return take_along_axis's fill value
+    # instead of a real block id. Clamped, the overshoot write lands in
+    # the row's own last block (or its null pad) — garbage beyond every
+    # kept token's mask, overwritten by the slot's next occupant.
+    logical = jnp.minimum(pos // bs, block_tables.shape[1] - 1)
+    blk_idx = jnp.take_along_axis(
+        block_tables, logical[:, None], axis=1)[:, 0]  # (B,) physical
+    off = pos % bs
+    positions = pos[:, None]  # (B, 1) true per-row rotary phases
+    x = params["embed"].astype(dtype)[token[:, None]]
+    new_pools = []
+    for block, pool in zip(params["blocks"], pools):
+        h = _rms_norm(x, block["attn_norm"])
+        wqkv = block.get("wqkv")
+        if wqkv is not None and quant.is_quantized(wqkv):
+            fused = _linear(h, wqkv, 1, dtype, tag="qkv")
+            nq = cfg.num_heads * cfg.head_dim
+            nk = cfg.kv_heads * cfg.head_dim
+            q = fused[..., :nq].reshape(*h.shape[:-1], cfg.num_heads,
+                                        cfg.head_dim)
+            k = fused[..., nq:nq + nk].reshape(*h.shape[:-1], cfg.kv_heads,
+                                               cfg.head_dim)
+            v = fused[..., nq + nk:].reshape(*h.shape[:-1], cfg.kv_heads,
+                                             cfg.head_dim)
+            q, k = _rotary(q, positions), _rotary(k, positions)
+        else:
+            q = _rotary(_linear(h, block["wq"], 1, dtype), positions)
+            k, v = _project_kv(block, h, positions, cfg)
+        kq, ks = _quantize_kv(k)
+        vq, vs = _quantize_kv(v)
+        # Paged frontier write: a (blk, off) scatter per row — block
+        # ownership is unique by allocator construction, so rows never
+        # collide (pad entries of SHORT tables all alias the null
+        # block, whose content no mask ever admits).
+        pool = {
+            "k": pool["k"].at[blk_idx, off].set(kq[:, 0]),
+            "k_scale": pool["k_scale"].at[blk_idx, off].set(ks[:, 0]),
+            "v": pool["v"].at[blk_idx, off].set(vq[:, 0]),
+            "v_scale": pool["v_scale"].at[blk_idx, off].set(vs[:, 0]),
+        }
+        out = decode_attention.paged_decode_attention_int8(
+            q[:, 0], pool["k"], pool["k_scale"], pool["v"], pool["v_scale"],
+            block_tables, pos + 1)
+        x = x + _linear(out[:, None], block["wo"], 2, dtype)
+        x = _mlp_tail(block, x, cfg)
+        new_pools.append(pool)
+    return _logits(params, x)[:, 0], new_pools
+
+
 def _row_scatter(cache_arr: jax.Array, new: jax.Array, starts: jax.Array):
     """Per-row cache write: row b of ``new`` lands at ``starts[b]`` in
     row b of the cache — vmapped dynamic_update_slice, which XLA lowers
@@ -587,4 +678,5 @@ def _generate(params: Params, prompt: jax.Array, cfg: ModelConfig, steps: int,
     return jnp.concatenate([toks.swapaxes(0, 1), last[:, None]], axis=1)
 
 
-__all__ = ["init_cache", "prefill", "decode_step", "generate"]
+__all__ = ["init_cache", "init_paged_cache", "prefill", "decode_step",
+           "paged_decode_step", "generate"]

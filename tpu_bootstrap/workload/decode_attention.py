@@ -35,6 +35,16 @@ Layout/grid design (mirrors flash_attention.py's streamed formulation):
   runtime input, not a static python value, because the cache length a
   step may see grows every step under `lax.scan`.
 
+The PAGED variant (`paged_decode_attention_int8`) is the same streamed
+formulation over a block-paged cache (serving.PagedPool): the physical
+cache is a pool of fixed-size KV blocks, each row owns a scattered set
+of them through its block table, and the kernel's L axis walks the
+row's table via SCALAR-PREFETCHED indices (PrefetchScalarGridSpec) —
+the index map dereferences the table, so the only HBM traffic is the
+row's OWN blocks, and the mask comes from the row's own frontier length
+rather than a batch-max bias row. Tiles past a row's frontier clamp to
+its last used block (a DMA-free repeat) and skip compute entirely.
+
 Reference parity note: the reference (bacchus-gpu-controller) has no
 compute path (SURVEY.md §2); this module extends the serving half of the
 JAX workload its JobSets launch.
@@ -138,6 +148,150 @@ def supports(length: int, kv_heads: int, head_dim: int) -> bool:
     return _pick_block(length, kv_heads, head_dim) is not None
 
 
+def _paged_kernel(bt_ref, len_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref,
+                  o_ref, m_scr, l_scr, acc_scr, *, sm_scale, bs):
+    """Same online-softmax body as `_kernel`, but the L axis walks each
+    row's OWN block table: tile j is the row's j-th logical KV block,
+    fetched from wherever the allocator placed it, and the validity mask
+    comes from the row's true frontier length (len_ref) instead of a
+    shared bias row — per-row lengths, not the batch-max bucket."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    num_l = pl.num_programs(1)
+    hk, g_pad = q_ref.shape[0], q_ref.shape[1]
+    length = len_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Tiles past the row's frontier do no arithmetic at all (their DMA
+    # was already skipped by the clamped index map: same physical block
+    # as the previous grid step, so Mosaic reuses the buffer).
+    @pl.when(j * bs < length)
+    def _compute():
+        idx = jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1) + j * bs
+        bias = jnp.where(idx < length, 0.0, _NEG)
+        for i in range(hk):
+            q = q_ref[i].astype(jnp.float32) * sm_scale  # (g_pad, D)
+            k = k_ref[:, i, :].astype(jnp.float32) * ks_ref[:, i, :]
+            v = v_ref[:, i, :].astype(jnp.float32) * vs_ref[:, i, :]
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            s = s + bias
+            band = slice(i * g_pad, (i + 1) * g_pad)
+            m = m_scr[band]
+            m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new)
+            m_scr[band] = m_new
+            l_scr[band] = l_scr[band] * alpha + jnp.sum(p, axis=1,
+                                                        keepdims=True)
+            acc_scr[band] = acc_scr[band] * alpha + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+    @pl.when(j == num_l - 1)
+    def _finalize():
+        o_ref[:] = (acc_scr[:] / l_scr[:]).reshape(o_ref.shape).astype(
+            o_ref.dtype)
+
+
+def paged_supports(block_size: int, kv_heads: int, head_dim: int) -> bool:
+    """A KV block is the kernel's tile, so the paged launch is legal when
+    the block itself is: an 8-multiple token count (Mosaic sublane
+    tiling, same rule as `_pick_block`'s single-tile arm) inside the
+    shared VMEM tile budget."""
+    return (block_size % 8 == 0
+            and block_size * kv_heads * head_dim <= _TILE_BYTES_CEILING)
+
+
+def paged_decode_attention_int8(q: jax.Array, kq: jax.Array, ks: jax.Array,
+                                vq: jax.Array, vs: jax.Array,
+                                block_tables: jax.Array, lengths: jax.Array,
+                                *, interpret: bool | None = None) -> jax.Array:
+    """Single-position attention over a BLOCK-PAGED quantized cache.
+
+    q: (B, H, D) — the one decode-step query, any float dtype.
+    kq/vq: (N, bs, Hk, D) int8 physical block pool; ks/vs: (N, bs, Hk)
+    f32 per-vector scales (decode.init_paged_cache layout).
+    block_tables: (B, nb) int32 — row b's j-th logical block lives in
+    physical block block_tables[b, j]; entries past the row's used
+    count are never dereferenced (the index map clamps to the last
+    used block, so out-of-range tiles are DMA-free repeats).
+    lengths: (B,) int32 — row b attends exactly its own [0, lengths[b])
+    tokens: per-row frontiers, not a shared batch-max mask row.
+    Returns (B, H, D) in q.dtype.
+
+    Why this beats gather-then-attend: the gather path materializes a
+    (B, nb*bs) contiguous window in HBM (one full window write + read
+    per step) sized by the LONGEST row in the batch; here the only HBM
+    traffic is each row's own int8 blocks + scales, streamed directly
+    through the same double-buffered pipeline as the resident kernel.
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    b, h, d = q.shape
+    _, bs, kv_heads, _ = kq.shape
+    nb = block_tables.shape[1]
+    group = h // kv_heads
+    if not paged_supports(bs, kv_heads, d):
+        raise ValueError(
+            f"KV block (block_size={bs}, kv_heads={kv_heads}, head_dim={d}) "
+            f"is not a legal tile: block_size must be an 8-multiple and "
+            f"bs*Hk*D must fit the {_TILE_BYTES_CEILING}-byte VMEM tile "
+            "budget; gate direct calls on paged_supports(...) — the paged "
+            "pool does, falling back to its gather/einsum path")
+
+    g_pad = max(8, -(-group // 8) * 8)
+    q4 = q.reshape(b, kv_heads, group, d)
+    if g_pad != group:
+        q4 = jnp.pad(q4, ((0, 0), (0, 0), (0, g_pad - group), (0, 0)))
+    ks4 = ks.astype(jnp.float32)[..., None]  # (N, bs, Hk, 1)
+    vs4 = vs.astype(jnp.float32)[..., None]
+    hk = kv_heads
+
+    def cache_map(r, j, bt_ref, len_ref):
+        # Clamp to the row's last USED block: grid steps past the
+        # frontier re-address the same physical block, which Mosaic's
+        # pipeline recognizes (no refetch), and _compute skips them.
+        used = jnp.maximum((len_ref[r] + bs - 1) // bs, 1)
+        return (bt_ref[r, jnp.minimum(j, used - 1)], 0, 0, 0)
+
+    def q_map(r, j, bt_ref, len_ref):
+        return (r, 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, nb),
+        in_specs=[
+            pl.BlockSpec((None, hk, g_pad, d), q_map),
+            pl.BlockSpec((None, bs, hk, d), cache_map),
+            pl.BlockSpec((None, bs, hk, 1), cache_map),
+            pl.BlockSpec((None, bs, hk, d), cache_map),
+            pl.BlockSpec((None, bs, hk, 1), cache_map),
+        ],
+        out_specs=pl.BlockSpec((None, hk, g_pad, d), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((hk * g_pad, 1), jnp.float32),
+            pltpu.VMEM((hk * g_pad, 1), jnp.float32),
+            pltpu.VMEM((hk * g_pad, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_kernel, sm_scale=d ** -0.5, bs=bs),
+        grid_spec=grid_spec,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        out_shape=jax.ShapeDtypeStruct((b, kv_heads, g_pad, d), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32),
+      q4, kq, ks4, vq, vs4)
+    return out[:, :, :group].reshape(b, h, d)
+
+
 def decode_attention_int8(q: jax.Array, kq: jax.Array, ks: jax.Array,
                           vq: jax.Array, vs: jax.Array, valid: jax.Array,
                           *, interpret: bool | None = None) -> jax.Array:
@@ -201,4 +355,5 @@ def decode_attention_int8(q: jax.Array, kq: jax.Array, ks: jax.Array,
     return out[:, :, :group].reshape(b, h, d)
 
 
-__all__ = ["decode_attention_int8", "supports"]
+__all__ = ["decode_attention_int8", "paged_decode_attention_int8",
+           "paged_supports", "supports"]

@@ -11,9 +11,11 @@ reference exposes its admission daemon through a chart Service
 CR -> admission -> sheet gate -> JobSet + Service -> `curl` is then the
 full serving analogue of the reference's onboarding flow.
 
-Design: one ENGINE thread owns the SlotPool and steps it against live
-queues — admission at round boundaries, per-request output queues fed
-from each round's events. HTTP handler threads never touch JAX: they
+Design: one ENGINE thread owns the pool (SlotPool, ResidentPool, or
+the block-paged PagedPool — admission batches check both free slots
+AND, on the paged engine, the queued request's block footprint) and
+steps it against live queues — admission at round boundaries,
+per-request output queues fed from each round's events. HTTP handler threads never touch JAX: they
 validate, enqueue, and stream whatever the engine publishes. This keeps
 every JAX call on one thread (trace caches and device buffers are not
 handler-concurrency-safe) while the pool's fixed batch shape means the
@@ -53,7 +55,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from tpu_bootstrap import telemetry
 from tpu_bootstrap.workload.model import ModelConfig, Params
-from tpu_bootstrap.workload.serving import Request, ResidentPool, SlotPool
+from tpu_bootstrap.workload.serving import (
+    PagedPool,
+    Request,
+    ResidentPool,
+    SlotPool,
+)
 
 
 class IngressServer:
@@ -67,14 +74,34 @@ class IngressServer:
                  top_k: int = 0, top_p: float = 1.0, key=None,
                  draft_params: Params | None = None,
                  draft_cfg: ModelConfig | None = None, gamma: int = 4,
-                 resident: bool = False, host: str = "0.0.0.0"):
+                 resident: bool = False, paged: bool = False,
+                 kv_blocks: int | None = None, block_size: int | None = None,
+                 prefill_budget: int | None = None, host: str = "0.0.0.0"):
         self.cfg = cfg
+        if paged and resident:
+            # Same loud rejection as serve(): silently preferring one
+            # engine would leave the operator believing the other is on.
+            raise ValueError("paged and resident are distinct engines; "
+                             "pick one")
         # Sampling is a POOL property, not per request: temperature is a
         # static jit argument (one compiled program per value), and the
         # per-request PRNG streams (keyed by server-assigned rid) make a
         # request's draw sequence independent of scheduling — but the
         # temperature itself comes from the slice's env, like the model.
-        if resident:
+        if paged:
+            # Block-paged engine: admission reserves a request's block
+            # footprint only (no device work — prefill chunks ride the
+            # rounds), so a long arriving prompt no longer stalls every
+            # streaming client behind a full-pool prefill.
+            self.pool = PagedPool(params, cfg, batch_size,
+                                  kv_blocks=kv_blocks, block_size=block_size,
+                                  prefill_budget=prefill_budget,
+                                  kv_quant=kv_quant, eos_id=eos_id,
+                                  temperature=temperature, top_k=top_k,
+                                  top_p=top_p, key=key,
+                                  draft_params=draft_params,
+                                  draft_cfg=draft_cfg, gamma=gamma)
+        elif resident:
             # Resident-cache engine: no history replay, per-row
             # frontiers; sampling composes (same per-request streams),
             # and a speculative draft commits PER ROW instead of the
@@ -267,11 +294,15 @@ class IngressServer:
                 # it. Streams register before admit so the failure path
                 # below can always reach the client.
                 to_admit = []
+                planned_blocks = 0
                 while (self._pending
-                       and self.pool.free_slots() > len(to_admit)):
+                       and self.pool.admits(self._pending[0][0],
+                                            extra_slots=len(to_admit),
+                                            extra_blocks=planned_blocks)):
                     req, out_q = self._pending.pop(0)
                     self._streams[req.rid] = out_q
                     to_admit.append(req)
+                    planned_blocks += self.pool.blocks_needed(req)
             # Admission + the round share one failure domain: either
             # raises for the same reasons (backend error mid-program),
             # and the engine must survive both.
@@ -369,7 +400,8 @@ class IngressServer:
               f"(pool={self.pool.batch_size}, "
               f"speculative="
               f"{getattr(self.pool, 'draft_params', None) is not None}, "
-              f"resident={isinstance(self.pool, ResidentPool)})")
+              f"resident={isinstance(self.pool, ResidentPool)}, "
+              f"paged={isinstance(self.pool, PagedPool)})")
         self.httpd.serve_forever()
 
     def stop(self) -> None:
