@@ -2,12 +2,16 @@
 block bookkeeping. Property tests for the invariants corruption would
 hide behind — no double free, block reuse after retirement, loud
 exhaustion instead of over-allocation, and the fragmentation bound the
-full-footprint reservation scheme implies."""
+full-footprint reservation scheme implies. Refcount/content-hash
+behavior (prefix caching) is covered in tests/test_prefix_cache.py;
+here the accounting seams: live vs reclaimable-cached must stay
+distinguishable (used()/peak/compactness count live only; available()
+counts cached as claimable)."""
 
 import numpy as np
 import pytest
 
-from tpu_bootstrap.workload.serving import BlockAllocator
+from tpu_bootstrap.workload.serving import BlockAllocator, block_hash
 
 
 def test_alloc_free_roundtrip_and_reuse():
@@ -60,6 +64,27 @@ def test_compactness_tracks_address_spread():
     assert a.compactness() == 1.0
     a.free(x[:4])  # only id 5 remains -> 1 live block spread over 5 ids
     assert a.compactness() == pytest.approx(1 / 5)
+
+
+def test_live_vs_cached_accounting():
+    """The headroom metrics' contract: used()/peak_used/compactness()
+    see LIVE blocks only, while available() counts the reclaimable
+    cached set — a warm cache reads as capacity, never as pressure."""
+    a = BlockAllocator(8, block_size=8)
+    ids = a.alloc(4)
+    for j, b in enumerate(ids):
+        a.register(b, block_hash(b"", [j] * 8))
+    a.free(ids[:3])  # registered -> cached, content retained
+    assert a.used() == 1 and a.cached() == 3
+    assert a.available() == 4 + 3  # free heap + evictable cache
+    assert a.stats["peak_used"] == 4  # live peak, cached excluded
+    # Compactness judges the live set only: one live block at id 4.
+    assert a.compactness() == pytest.approx(1 / 4)
+    # An alloc larger than the heap succeeds by evicting cache...
+    got = a.alloc(6)
+    assert len(got) == 6 and a.cached() == 1
+    # ...and the evicted blocks' index entries are gone.
+    assert a.lookup(block_hash(b"", [0] * 8)) is None
 
 
 def test_random_schedule_invariants():

@@ -445,7 +445,8 @@ def record_kernel_bandwidth(kernel: str, bytes_moved: int, seconds: float,
 
 def record_kv_block_pool(total: int, used: int, free: int,
                          capacity_tokens: int, live_tokens: int,
-                         peak_used: int, compactness: float) -> None:
+                         peak_used: int, compactness: float,
+                         cached: int = 0) -> None:
     """Block-pool gauges for the paged serving engine (serving.PagedPool
     feeds this after every admission / retirement / round): absolute
     block counts, the peak fraction the workload ever reserved
@@ -453,11 +454,19 @@ def record_kv_block_pool(total: int, used: int, free: int,
     fragmentation (reserved-but-unwritten token slots over reserved
     capacity; bounded by per-row budget remainders + one partial block
     per row), and address-space compactness (1.0 = live blocks are a
-    dense prefix; defrag() restores it)."""
+    dense prefix; defrag() restores it).
+
+    With prefix caching, ``used``/``peak_used``/``compactness`` count
+    LIVE (refcounted) blocks only and ``cached`` counts the zero-ref
+    content-retained set (kv_blocks_cached) — evictable on demand, so
+    it rides in ``free`` (= allocator.available()) rather than
+    shrinking it: the peak-headroom key must read a warm cache as
+    reclaimable capacity, not as pressure."""
     reg = _metrics
     reg.set_gauge("kv_blocks_total", total)
     reg.set_gauge("kv_blocks_used", used)
     reg.set_gauge("kv_blocks_free", free)
+    reg.set_gauge("kv_blocks_cached", cached)
     if total > 0:
         reg.set_gauge("kv_blocks_used_frac", round(used / total, 4))
         reg.set_gauge("kv_blocks_peak_frac", round(peak_used / total, 4))

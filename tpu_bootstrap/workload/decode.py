@@ -133,6 +133,11 @@ def paged_decode_step(params: Params, token: jax.Array, pos: jax.Array,
     Pallas kernel (decode_attention.paged_decode_attention_int8) — no
     gathered window ever exists in HBM. Quantized pools only (the
     kernel is the point; float pools take the serving gather path).
+    Tables may alias blocks across rows (serving's prefix cache): safe,
+    because reads are pure and the ONE write this step performs targets
+    the row's frontier block, which serving guarantees is privately
+    owned (shared blocks sit strictly below every sharer's write
+    positions; mid-block extensions get a copy-on-write duplicate).
     Returns (next-token logits (B, vocab), updated pools)."""
     bs = pools[0]["k"].shape[1]
     dtype = cfg.compute_dtype

@@ -220,7 +220,13 @@ def paged_decode_attention_int8(q: jax.Array, kq: jax.Array, ks: jax.Array,
     block_tables: (B, nb) int32 — row b's j-th logical block lives in
     physical block block_tables[b, j]; entries past the row's used
     count are never dereferenced (the index map clamps to the last
-    used block, so out-of-range tiles are DMA-free repeats).
+    used block, so out-of-range tiles are DMA-free repeats). Tables
+    may ALIAS physical blocks across rows (serving's prefix cache maps
+    a shared prompt prefix into several rows): the kernel only READS
+    through the table — each grid step DMAs the block its row's index
+    map names, aliased or not — and every per-row softmax masks to its
+    own ``lengths[b]`` frontier, so sharing is invisible here (pinned
+    by the aliased-table parity test in tests/test_prefix_cache.py).
     lengths: (B,) int32 — row b attends exactly its own [0, lengths[b])
     tokens: per-row frontiers, not a shared batch-max mask row.
     Returns (B, H, D) in q.dtype.

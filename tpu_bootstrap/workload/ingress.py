@@ -30,7 +30,10 @@ philosophy):
   "stream": bool}``. stream=true (default) answers chunked
   JSON-lines, one ``{"tokens": [...]}`` object per scheduling round
   and a final ``{"tokens": [...], "done": true}``; stream=false
-  answers one ``{"tokens": [all], "done": true}``.
+  answers one ``{"tokens": [all], "done": true}``. On the paged
+  engine the final object also carries ``"cached_tokens": N`` — how
+  many prompt tokens the prefix cache served (prefill skipped); 0 on
+  a cold prompt or a non-paged pool.
 * ``GET /healthz`` -> ``{"ok": bool, "active": A, "queued": Q,
   "served": N, "p50_ttft_ms": ..., "p50_total_ms": ...,
   "last_error": ...}`` — the Service readiness probe surface. ``ok``
@@ -76,7 +79,8 @@ class IngressServer:
                  draft_cfg: ModelConfig | None = None, gamma: int = 4,
                  resident: bool = False, paged: bool = False,
                  kv_blocks: int | None = None, block_size: int | None = None,
-                 prefill_budget: int | None = None, host: str = "0.0.0.0"):
+                 prefill_budget: int | None = None,
+                 prefix_cache: bool | None = None, host: str = "0.0.0.0"):
         self.cfg = cfg
         if paged and resident:
             # Same loud rejection as serve(): silently preferring one
@@ -100,7 +104,8 @@ class IngressServer:
                                   temperature=temperature, top_k=top_k,
                                   top_p=top_p, key=key,
                                   draft_params=draft_params,
-                                  draft_cfg=draft_cfg, gamma=gamma)
+                                  draft_cfg=draft_cfg, gamma=gamma,
+                                  prefix_cache=prefix_cache)
         elif resident:
             # Resident-cache engine: no history replay, per-row
             # frontiers; sampling composes (same per-request streams),
@@ -139,6 +144,13 @@ class IngressServer:
         # qps/tokens-per-sec gauges — the scrape surface the controller
         # folds into status.slice.workload.
         self._last_ev_t: dict = {}  # rid -> last event time (inter-token)
+        # rid -> prompt tokens the paged engine served from its prefix
+        # cache at admission (0 on other engines): surfaced as
+        # ``cached_tokens`` on the request's final response object and
+        # used to split the TTFT histograms cached-vs-cold — the
+        # latency win prefix caching exists for must be attributable,
+        # not averaged away.
+        self._cached_toks: dict = {}
         self._qps_window = telemetry.RateWindow()
         self._tps_window = telemetry.RateWindow()
 
@@ -232,6 +244,8 @@ class IngressServer:
                             line = json.dumps(
                                 {"tokens": ev["new"],
                                  **({"done": True} if ev["done"] else {}),
+                                 **({"cached_tokens": ev["cached_tokens"]}
+                                    if "cached_tokens" in ev else {}),
                                  **({"error": ev["error"]}
                                     if ev.get("error") else {})}
                             ).encode() + b"\n"
@@ -248,6 +262,8 @@ class IngressServer:
                         ev = out_q.get()
                         if ev["done"]:
                             out = {"tokens": ev["generated"], "done": True}
+                            if "cached_tokens" in ev:
+                                out["cached_tokens"] = ev["cached_tokens"]
                             if ev.get("error"):
                                 out["error"] = ev["error"]
                             return self._json(200, out)
@@ -302,6 +318,11 @@ class IngressServer:
                     req, out_q = self._pending.pop(0)
                     self._streams[req.rid] = out_q
                     to_admit.append(req)
+                    # FULL footprint, deliberately ignoring prefix-cache
+                    # hits: a hit counted here could be evicted by an
+                    # earlier admission in this same batch before this
+                    # request's admit() runs, so the batched plan
+                    # over-reserves and each admit stays infallible.
                     planned_blocks += self.pool.blocks_needed(req)
             # Admission + the round share one failure domain: either
             # raises for the same reasons (backend error mid-program),
@@ -309,6 +330,11 @@ class IngressServer:
             try:
                 for req in to_admit:
                     self.pool.admit(req)
+                    # Paged engines report per-request prefix-cache hits
+                    # at admission; pop keeps the pool-side map bounded.
+                    self._cached_toks[req.rid] = getattr(
+                        self.pool, "request_cached_tokens", {}).pop(
+                            req.rid, 0)
                 events = self.pool.step_round()
             except Exception as e:  # noqa: BLE001
                 # The engine must SURVIVE a failed round (a transient
@@ -331,12 +357,18 @@ class IngressServer:
                     self._streams.clear()
                     self._submit_t.clear()
                     self._last_ev_t.clear()
+                    self._cached_toks.clear()
                     self.pool.reset()
                 continue
             now = time.monotonic()
             reg = telemetry.metrics()
             with self._work:
                 for rid, ev in events.items():
+                    if ev["done"]:
+                        # Surfaced on the final response object: how
+                        # many prompt tokens this request never paid
+                        # prefill for.
+                        ev["cached_tokens"] = self._cached_toks.get(rid, 0)
                     self._streams[rid].put(ev)
                     t_submit, t_first = self._submit_t.get(rid, (now, None))
                     if ev["new"]:
@@ -354,10 +386,19 @@ class IngressServer:
                         self._submit_t[rid] = (t_submit, now)
                         self._ttft_ms.append((now - t_submit) * 1e3)
                         reg.observe("serve_ttft_ms", (now - t_submit) * 1e3)
+                        # Cached-vs-cold split: the whole point of
+                        # prefix caching is the TTFT of requests whose
+                        # prompt prefix skipped prefill — one averaged
+                        # histogram would bury it.
+                        reg.observe("serve_cached_ttft_ms"
+                                    if self._cached_toks.get(rid, 0)
+                                    else "serve_cold_ttft_ms",
+                                    (now - t_submit) * 1e3)
                     if ev["done"]:
                         del self._streams[rid]
                         self._submit_t.pop(rid, None)
                         self._last_ev_t.pop(rid, None)
+                        self._cached_toks.pop(rid, None)
                         self._total_ms.append((now - t_submit) * 1e3)
                         self._served += 1
                         reg.inc("serve_requests_total")

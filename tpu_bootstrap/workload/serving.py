@@ -64,6 +64,18 @@ tokens across pending prompts before the decode chunk, so a new
 arrival's multi-second prefill no longer stalls every streaming client
 and TTFT becomes a scheduling knob.
 
+On top of the paged pool rides AUTOMATIC PREFIX CACHING (the
+SGLang/RadixAttention insight on the vLLM substrate): full KV blocks
+are content-addressed by a radix-chained hash of the tokens they
+cover, admission shares the longest cached chain into a new request's
+table (refcounts instead of unique ownership; prefill skipped for
+covered tokens; only the uncovered footprint freshly reserved), retire
+DECREFS, and zero-ref registered blocks park in an LRU cached set that
+``alloc()`` reclaims on demand. On shared-system-prompt traffic this
+turns most of the pool's prefill FLOPs and most of its capacity back
+into decode throughput — with byte-identical token streams, because a
+KV vector is a pure function of (token, position).
+
 Speculative composition (VERDICT r4 weak #4): constructed with
 ``draft_params``, the pool steps each round through
 ``speculative_generate``'s verify-commit loop instead of plain decode —
@@ -92,9 +104,11 @@ machinery into a request-serving loop.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import heapq
 import os
 import time
+from collections import OrderedDict
 from functools import partial
 
 import jax
@@ -845,6 +859,20 @@ class ResidentPool(_PoolBase):
         return events
 
 
+def block_hash(parent: bytes, tokens) -> bytes:
+    """Content key of one FULL KV block: a hash over the token ids the
+    block covers, CHAINED on the parent block's key (radix-style) so a
+    block's key commits to the entire prefix behind it — two requests
+    map to the same physical block only when every token from position
+    0 through the block's end matches. sha256 over the int64 token
+    bytes: keys are stable across processes and collision-proof enough
+    that a hash hit can be trusted as a content match (a collision
+    would serve another prompt's KV, so a salted/64-bit hash is not an
+    option here)."""
+    return hashlib.sha256(
+        parent + np.asarray(tokens, np.int64).tobytes()).digest()
+
+
 class BlockAllocator:
     """Bookkeeping for the shared pool of fixed-size KV blocks: ids
     1..num_blocks (id 0 is the caller's null/pad block, never owned),
@@ -852,7 +880,20 @@ class BlockAllocator:
     as compact as the workload allows), loud double-free / exhaustion
     errors, and the accounting the block-pool gauges read. Pure host
     state — device arrays never see it; only block TABLES built from it
-    do."""
+    do.
+
+    Blocks are REFCOUNTED and content-addressable (automatic prefix
+    caching, the vLLM/SGLang design): a block is in exactly one of
+    three states — FREE (on the min-heap, content meaningless), LIVE
+    (refcount >= 1; one reference per row table that maps it), or
+    CACHED (refcount 0 but registered in the content-hash index; its KV
+    is retained so a future request with the same prefix can revive it
+    without recomputing). ``free()`` is a DECREF: the last reference of
+    a registered block parks it in an LRU cached set instead of the
+    heap, and ``alloc()`` evicts oldest-cached blocks on demand when
+    the heap alone cannot cover a request — cached blocks never block
+    admission, they are reclaimable capacity (``available()`` counts
+    them)."""
 
     def __init__(self, num_blocks: int, block_size: int):
         if num_blocks < 1:
@@ -861,49 +902,158 @@ class BlockAllocator:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
         self.num_blocks, self.block_size = num_blocks, block_size
         self._free = list(range(1, num_blocks + 1))  # already a valid heap
-        self._used: set = set()
-        self.stats = {"allocs": 0, "frees": 0, "peak_used": 0}
+        self._ref: dict = {}           # live block id -> refcount (>= 1)
+        self._cached = OrderedDict()   # ref-0 registered blocks, LRU order
+        self._index: dict = {}         # content key -> block id (live|cached)
+        self._key_of: dict = {}        # registered block id -> content key
+        self.stats = {"allocs": 0, "frees": 0, "peak_used": 0,
+                      "evictions": 0, "hash_hits": 0}
+
+    # ---- accounting -------------------------------------------------------
 
     def available(self) -> int:
-        return len(self._free)
+        """Blocks an admission may claim: truly free plus reclaimable
+        cached (eviction is part of alloc — a warm cache must never
+        refuse a request cold capacity would have taken)."""
+        return len(self._free) + len(self._cached)
 
     def used(self) -> int:
-        return len(self._used)
+        """LIVE blocks only (refcount >= 1). Cached blocks are counted
+        by cached(), not here — the headroom metrics must not read
+        reclaimable cache as pressure."""
+        return len(self._ref)
+
+    def cached(self) -> int:
+        return len(self._cached)
+
+    def is_cached(self, bid: int) -> bool:
+        return bid in self._cached
+
+    def refcount(self, bid: int) -> int:
+        return self._ref.get(bid, 0)
+
+    # ---- alloc / refcount lifecycle ---------------------------------------
 
     def alloc(self, n: int) -> list:
         if n < 1:
             raise ValueError(f"alloc of {n} blocks")
-        if n > len(self._free):
+        if n > self.available():
             raise RuntimeError(
-                f"KV block pool exhausted: want {n}, free {len(self._free)} "
+                f"KV block pool exhausted: want {n}, free {self.available()} "
                 f"of {self.num_blocks} (admission must check admits/"
                 "available first — refusing is the contract, not "
                 "corrupting a live row's blocks)")
+        while len(self._free) < n:
+            # Reclaim oldest-cached first: LRU preserves the prefixes
+            # most recently shared/retired, the ones a shared-system-
+            # prompt workload will hit again next.
+            bid, key = self._cached.popitem(last=False)
+            del self._index[key]
+            del self._key_of[bid]
+            heapq.heappush(self._free, bid)
+            self.stats["evictions"] += 1
         ids = [heapq.heappop(self._free) for _ in range(n)]
-        self._used.update(ids)
+        for i in ids:
+            self._ref[i] = 1
         self.stats["allocs"] += n
         self.stats["peak_used"] = max(self.stats["peak_used"],
-                                      len(self._used))
+                                      len(self._ref))
         return ids
 
+    def incref(self, bid: int) -> None:
+        """Add a table reference to a live or cached block (a prefix
+        hit). Reviving a cached block removes it from the evictable
+        set; its registration survives so further requests keep
+        hitting it."""
+        if bid in self._cached:
+            del self._cached[bid]
+            self._ref[bid] = 1
+        elif bid in self._ref:
+            self._ref[bid] += 1
+        else:
+            raise ValueError(
+                f"incref of KV block {bid} which is neither live nor "
+                "cached — sharing a free block would alias its next "
+                "owner's KV")
+        self.stats["hash_hits"] += 1
+        self.stats["peak_used"] = max(self.stats["peak_used"],
+                                      len(self._ref))
+
     def free(self, ids: list) -> None:
+        """DECREF each id (retirement path — see MIGRATION.md: since
+        prefix caching, 'free' no longer implies the heap). The last
+        reference of a registered (content-addressable) block parks it
+        in the cached LRU set, KV retained for future prefix hits;
+        unregistered blocks (partial tails, duplicates) return to the
+        heap immediately."""
         for i in ids:
-            if i not in self._used:
+            if i not in self._ref:
                 raise ValueError(
                     f"double free of KV block {i} (not currently "
                     "allocated) — a table still referencing it would "
                     "read its next owner's KV")
-            self._used.remove(i)
-            heapq.heappush(self._free, i)
+            self._ref[i] -= 1
+            if self._ref[i] == 0:
+                del self._ref[i]
+                if i in self._key_of:
+                    self._cached[i] = self._key_of[i]  # MRU end
+                else:
+                    heapq.heappush(self._free, i)
         self.stats["frees"] += len(ids)
 
+    # ---- content-hash index -----------------------------------------------
+
+    def register(self, bid: int, key: bytes) -> bool:
+        """Enter a FULL live block into the content-hash index under
+        ``key`` (its chained token hash). Returns False when the key is
+        already indexed — another block holds identical content; the
+        existing entry keeps the key so every future hit lands on ONE
+        physical block, and the duplicate stays unregistered (it frees
+        to the heap at its last decref instead of being cached)."""
+        if bid not in self._ref:
+            raise ValueError(
+                f"register of KV block {bid} which is not live — only a "
+                "referenced block's content is known to be complete")
+        if key in self._index or bid in self._key_of:
+            # Second clause: a block carries ONE content key for life;
+            # re-keying would leave the old index entry dangling at a
+            # block whose content no longer matches it.
+            return False
+        self._index[key] = bid
+        self._key_of[bid] = key
+        return True
+
+    def lookup(self, key: bytes) -> int | None:
+        """Physical block holding the content ``key`` names, or None.
+        Read-only — callers incref on actual use."""
+        return self._index.get(key)
+
+    def remap(self, mapping: dict) -> None:
+        """Rewrite every block id through ``mapping`` (old -> new) after
+        the caller physically relocated the pool arrays (defrag): live
+        refcounts, the cached LRU set (order preserved), and the
+        content-hash index all follow, so prefix hits survive a
+        mid-flight defrag. Every live and cached block must appear in
+        the mapping; the heap is rebuilt from the complement."""
+        self._ref = {mapping[b]: c for b, c in self._ref.items()}
+        self._cached = OrderedDict(
+            (mapping[b], k) for b, k in self._cached.items())
+        self._key_of = {mapping[b]: k for b, k in self._key_of.items()}
+        self._index = {k: mapping[b] for k, b in self._index.items()}
+        taken = set(self._ref) | set(self._cached)
+        self._free = [i for i in range(1, self.num_blocks + 1)
+                      if i not in taken]
+        heapq.heapify(self._free)
+
     def compactness(self) -> float:
-        """1.0 = the used set is a perfect prefix of the id space; lower
+        """1.0 = the LIVE set is a perfect prefix of the id space; lower
         means churn has scattered live blocks toward high ids (the
-        address-space fragmentation defrag() repairs)."""
-        if not self._used:
+        address-space fragmentation defrag() repairs). Cached blocks
+        are excluded — they are reclaimable, and counting them would
+        let a full-but-evictable pool read as fragmented."""
+        if not self._ref:
             return 1.0
-        return len(self._used) / max(self._used)
+        return len(self._ref) / max(self._ref)
 
 
 @dataclasses.dataclass
@@ -913,6 +1063,15 @@ class _PagedSlot(_Slot):
     prefill_chunks: int = 0
     admit_round: int = 0
     blocks: list = dataclasses.field(default_factory=list)
+    # Prefix-cache bookkeeping: the first n_shared blocks are refcounted
+    # references to the content-hash index (this row never writes them);
+    # registered counts leading blocks whose chain key has been computed
+    # and entered into (or matched against) the index, and chain_key is
+    # that prefix's rolling hash — the parent for the next full block.
+    n_shared: int = 0
+    registered: int = 0
+    chain_key: bytes = b""
+    cached_tokens: int = 0   # prompt tokens served from cache (not prefilled)
 
 
 def _gather_windows(pools, bt):
@@ -929,10 +1088,17 @@ def _gather_windows(pools, bt):
 
 
 def _scatter_windows(pools, window, bt):
-    """Write per-row windows back through the block tables. Real blocks
-    are owned uniquely (allocator invariant), so rows never collide;
-    every row's null-pad segments all land on block 0, whose winner is
-    unspecified and whose content is never read."""
+    """Write per-row windows back through the block tables. With prefix
+    caching, tables may ALIAS blocks across rows (a shared prompt
+    prefix maps several rows to one physical block) — the scatter's
+    winner among duplicate indices is unspecified, and that is safe
+    because it cannot matter: a row only WRITES window columns at its
+    own frontier, which serving guarantees lies in a privately-owned
+    block (shared blocks sit strictly below every sharer's first write
+    position, COW copies are private), so every aliasing row scatters
+    back the identical bytes it gathered. Null-pad segments likewise
+    all land on block 0, whose winner is unspecified and whose content
+    is never read."""
     b, nb = bt.shape
 
     def put(a, w):
@@ -1028,6 +1194,17 @@ def _permute_pools(pools, perm):
     return [{n: a[perm] for n, a in layer.items()} for layer in pools]
 
 
+@partial(jax.jit, donate_argnums=(0,))
+def _copy_block(pools, src, dst):
+    """Copy-on-write: duplicate one physical block (every layer, K and
+    V and their scales) so a writer can EXTEND a shared or cached
+    prefix block — the new row's decode continues inside its private
+    copy while every other reader of ``src`` is untouched. ``src`` and
+    ``dst`` are traced, so one compiled program covers every copy."""
+    return [{n: a.at[dst].set(a[src]) for n, a in layer.items()}
+            for layer in pools]
+
+
 class PagedPool(_PoolBase):
     """Block-paged continuous batching: ONE shared physical pool of
     fixed-size KV blocks per layer, per-row block tables, and chunked
@@ -1053,13 +1230,37 @@ class PagedPool(_PoolBase):
     interleaves with live decode streams instead of stalling them, and
     TTFT is bounded by the budget knob (``TPUBC_PREFILL_BUDGET``).
 
+    Automatic prefix caching (``prefix_cache``, default on /
+    ``TPUBC_PREFIX_CACHE=0`` to disable): every FULL block a row fills
+    is registered in the allocator's content-hash index under the
+    rolling (radix-chained) hash of the tokens it covers, and admission
+    walks a new prompt's chain against the index — matched blocks are
+    refcount-shared into the new row's table, their prefill is SKIPPED
+    (chunked prefill starts at the first uncovered position), and only
+    the uncovered footprint is freshly reserved, so admission capacity
+    RISES on shared-prefix traffic. Retirement decrefs; the last
+    reference of a registered block parks it in an LRU cached set the
+    allocator reclaims inside alloc() on demand (cached blocks never
+    refuse an admission cold capacity would have taken). When the
+    matched chain reaches into the block a new row must WRITE (its
+    prompt ends mid-block), that one block is copy-on-write duplicated
+    instead of shared. The draft pool of a speculative serve shares the
+    target's cached prefixes for free: one block table drives both
+    pools, and prefill/decode write both, so a hit block's id holds
+    valid target AND draft KV.
+
     Exactness oracle unchanged: every request's tokens equal its solo
     greedy generate() (or its solo row-keyed sampled stream), and the
     speculative verify-commit loop composes with PER-ROW commits
-    exactly as on the resident engine. Quantized pools additionally get
-    the paged Pallas kernel path (``paged_kernel``): attention streams
-    each row's own blocks at its own frontier length instead of
-    gathering a batch-max window."""
+    exactly as on the resident engine — a KV vector is a pure function
+    of (token id, position), so cache-served KV is bit-identical to
+    recomputed KV and cached streams equal the cold-cache engine's.
+    Quantized pools additionally get the paged Pallas kernel path
+    (``paged_kernel``): attention streams each row's own blocks at its
+    own frontier length instead of gathering a batch-max window; block
+    tables may alias shared blocks across rows, which the kernel reads
+    purely (writes only ever target a row's privately-owned frontier
+    block)."""
 
     def __init__(self, params: Params, cfg: ModelConfig, batch_size: int, *,
                  kv_blocks: int | None = None, block_size: int | None = None,
@@ -1068,7 +1269,8 @@ class PagedPool(_PoolBase):
                  temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
                  key=None, draft_params: Params | None = None,
                  draft_cfg: ModelConfig | None = None, gamma: int = 4,
-                 paged_kernel: bool | None = None):
+                 paged_kernel: bool | None = None,
+                 prefix_cache: bool | None = None):
         self._check_pool_args(batch_size, temperature, key, draft_params,
                               draft_cfg, gamma)
         if block_size is None:
@@ -1121,6 +1323,14 @@ class PagedPool(_PoolBase):
                     f"D={cfg.head_dim}) — see decode_attention."
                     "paged_supports")
         self.paged_kernel = paged_kernel
+        if prefix_cache is None:
+            prefix_cache = os.environ.get(
+                "TPUBC_PREFIX_CACHE", "1").lower() not in ("0", "false")
+        self.prefix_cache = prefix_cache
+        # rid -> prompt tokens served from cache at admission; the
+        # ingress surfaces it per response (and pops it — bounded) and
+        # splits its TTFT histograms cached-vs-cold on it.
+        self.request_cached_tokens: dict = {}
         self._dummy_keys = (
             [jax.random.fold_in(jax.random.fold_in(key, 0), i)
              for i in range(batch_size)] if temperature > 0 else None)
@@ -1139,7 +1349,9 @@ class PagedPool(_PoolBase):
         self.stats = {"rounds": 0, "slot_steps": 0, "active_slot_steps": 0,
                       "prefill_tokens": 0, "prefill_chunks": 0,
                       "blocks_total": kv_blocks, "blocks_peak": 0,
-                      "defrags": 0}
+                      "defrags": 0, "prompt_tokens": 0,
+                      "prefix_hit_tokens": 0, "prefix_hit_requests": 0,
+                      "cow_copies": 0}
         if draft_params is not None:
             self.stats.update({"verify_rounds": 0, "committed_tokens": 0,
                                "draft_steps": 0})
@@ -1152,11 +1364,46 @@ class PagedPool(_PoolBase):
         over = self.gamma if self.draft_params is not None else 0
         return -(-(len(r.tokens) + r.max_new + over) // self.block_size)
 
+    def _prefix_plan(self, r: Request):
+        """Longest cached full-block chain covering ``r``'s prompt:
+        returns (shared block ids, cow source id or None, chain key of
+        the shared prefix). Shared blocks must sit strictly below the
+        row's first write position (the last prompt token, re-fed at
+        decode) — the one matched block that would contain it is
+        returned as the COW source instead, to be privately copied.
+        Read-only: refcounts move in admit()."""
+        if not self.prefix_cache:
+            return [], None, b""
+        bs = self.block_size
+        prompt_len = len(r.tokens)
+        key = b""
+        hits = []  # (block id, chain key through this block)
+        for j in range(prompt_len // bs):
+            key = block_hash(key, r.tokens[j * bs:(j + 1) * bs])
+            bid = self.allocator.lookup(key)
+            if bid is None:
+                break
+            hits.append((bid, key))
+        n_sh = min(len(hits), (prompt_len - 1) // bs)
+        cow = hits[n_sh][0] if len(hits) > n_sh else None
+        chain = hits[n_sh - 1][1] if n_sh else b""
+        return [b for b, _ in hits[:n_sh]], cow, chain
+
     def admits(self, r: Request, *, extra_slots: int = 0,
                extra_blocks: int = 0) -> bool:
-        return (self.free_slots() > extra_slots
-                and self.allocator.available() - extra_blocks
-                >= self.blocks_needed(r))
+        if self.free_slots() <= extra_slots:
+            return False
+        shared, cow, _ = self._prefix_plan(r)
+        # Cache-aware capacity math: shared blocks cost nothing fresh,
+        # but a hit on a CACHED block revives it out of the reclaimable
+        # set, so it must be debited from available() alongside the
+        # fresh allocation (the COW source is pinned across the copy —
+        # same debit, conservatively).
+        pinned = sum(1 for b in shared if self.allocator.is_cached(b))
+        if cow is not None and self.allocator.is_cached(cow):
+            pinned += 1
+        return (self.allocator.available() - extra_blocks - pinned
+                >= self.blocks_needed(r) - len(shared))
 
     def validate(self, r: Request, cfg: ModelConfig) -> None:
         _PoolBase.validate(r, cfg)
@@ -1177,8 +1424,11 @@ class PagedPool(_PoolBase):
     def reset(self) -> None:
         """Abandon every in-flight row AND rebuild pools + allocator:
         the round jits donate the pools, so after a failed round the
-        only copy may be consumed (the ingress failed-round path)."""
+        only copy may be consumed (the ingress failed-round path). The
+        prefix cache resets with the allocator: its index describes
+        content the rebuilt (zeroed) arrays no longer hold."""
         self.slots = [None] * self.batch_size
+        self.request_cached_tokens.clear()
         self.allocator = BlockAllocator(self.allocator.num_blocks,
                                         self.block_size)
         self.pools = init_paged_cache(self.cfg,
@@ -1192,7 +1442,41 @@ class PagedPool(_PoolBase):
                                            quantized=self.kv_quant)
         self._record_block_gauges()
 
+    def _register_full(self, s) -> None:
+        """Enter ``s``'s newly-FULL blocks into the content-hash index.
+        A block is registerable once every position it covers holds
+        committed KV: through ``prefilled`` while the prompt is still
+        chunking in, through ``len(history) - 1`` once decoding (the
+        final token's KV is never written — it would be re-fed). Keys
+        chain off the row's running prefix hash, so a registered
+        block's key commits to its whole prefix; duplicates (another
+        block already holds identical content) simply advance the chain
+        without indexing."""
+        written = (s.prefilled if self._prefilling(s)
+                   else len(s.history) - 1)
+        nfull = min(written // self.block_size, len(s.blocks))
+        while s.registered < nfull:
+            j = s.registered
+            s.chain_key = block_hash(
+                s.chain_key,
+                s.history[j * self.block_size:(j + 1) * self.block_size])
+            self.allocator.register(s.blocks[j], s.chain_key)
+            s.registered += 1
+
+    def _register_phase(self) -> None:
+        if not self.prefix_cache:
+            return
+        for s in self.slots:
+            if s is not None:
+                self._register_full(s)
+
     def _on_retire(self, i: int, s) -> None:
+        # Register the trailing full blocks first (a retired request is
+        # the main cache producer), then DECREF — not hard-free — every
+        # table reference: registered blocks with no other sharer park
+        # in the cached LRU set, unregistered tails return to the heap.
+        if self.prefix_cache:
+            self._register_full(s)
         self.allocator.free(s.blocks)
         s.blocks = []
         self._record_block_gauges()
@@ -1205,18 +1489,30 @@ class PagedPool(_PoolBase):
             total=self.allocator.num_blocks,
             used=self.allocator.used(),
             free=self.allocator.available(),
+            cached=self.allocator.cached(),
             capacity_tokens=self.allocator.used() * self.block_size,
             live_tokens=live,
             peak_used=self.allocator.stats["peak_used"],
             compactness=self.allocator.compactness())
+        if self.stats["prompt_tokens"]:
+            telemetry.metrics().set_gauge(
+                "serve_prefix_hit_rate",
+                round(self.stats["prefix_hit_tokens"]
+                      / self.stats["prompt_tokens"], 4))
         self.stats["blocks_peak"] = self.allocator.stats["peak_used"]
 
     # ---- admission --------------------------------------------------------
 
     def admit(self, r: Request) -> None:
-        """Reserve the request's whole block footprint and enqueue its
-        prompt — NO device work happens here (prefill is chunked into
-        the coming rounds), so admission never stalls live streams."""
+        """Reserve the request's block footprint and enqueue its prompt.
+        With prefix caching, the longest cached chain over the prompt is
+        refcount-shared into the new table first: covered tokens skip
+        prefill entirely (``prefilled`` starts past them) and only the
+        UNCOVERED footprint is freshly allocated — the capacity win on
+        shared-prefix traffic. The only device work here is the
+        occasional copy-on-write block duplicate (one block copy; the
+        chunked prefill itself still rides the coming rounds), so
+        admission still never stalls live streams."""
         self.validate(r, self.cfg)
         i = self._free_index()
         if not self.admits(r):
@@ -1224,15 +1520,44 @@ class PagedPool(_PoolBase):
                 f"request {r.rid}: pool has a free slot but not "
                 f"{self.blocks_needed(r)} free KV blocks (callers check "
                 "admits() before admit — refusal, not corruption)")
-        blocks = self.allocator.alloc(self.blocks_needed(r))
+        shared, cow, chain = self._prefix_plan(r)
+        for b in shared:
+            self.allocator.incref(b)
+        if cow is not None:
+            # Pin the COW source across the fresh alloc below — it may
+            # be sitting in the cached LRU set, and the alloc's eviction
+            # pass must not reclaim it before the copy reads it.
+            self.allocator.incref(cow)
+        fresh = self.allocator.alloc(self.blocks_needed(r) - len(shared))
+        blocks = list(shared) + fresh
+        prompt_len = len(r.tokens)
+        hit_tokens = len(shared) * self.block_size
+        if cow is not None:
+            dest = fresh[0]
+            self.pools = _copy_block(self.pools, jnp.int32(cow),
+                                     jnp.int32(dest))
+            if self.dpools is not None:
+                self.dpools = _copy_block(self.dpools, jnp.int32(cow),
+                                          jnp.int32(dest))
+            self.allocator.free([cow])  # unpin (back to cached if unshared)
+            hit_tokens = min(hit_tokens + self.block_size, prompt_len - 1)
+            self.stats["cow_copies"] += 1
+        self.stats["prompt_tokens"] += prompt_len
+        self.stats["prefix_hit_tokens"] += hit_tokens
+        if hit_tokens:
+            self.stats["prefix_hit_requests"] += 1
+            telemetry.metrics().inc("kv_prefix_hit_tokens_total", hit_tokens)
+        self.request_cached_tokens[r.rid] = hit_tokens
         self.slots[i] = _PagedSlot(
             rid=r.rid, history=list(r.tokens),
             remaining=r.max_new, generated=[],
             row_key=(jax.random.fold_in(
                 jax.random.fold_in(self.key, 1), r.rid)
                 if self.temperature > 0 else None),
-            prompt_len=len(r.tokens), prefilled=0,
-            admit_round=self.stats["rounds"], blocks=blocks)
+            prompt_len=prompt_len, prefilled=hit_tokens,
+            admit_round=self.stats["rounds"], blocks=blocks,
+            n_shared=len(shared), registered=len(shared), chain_key=chain,
+            cached_tokens=hit_tokens)
         self._record_block_gauges()
 
     # ---- rounds -----------------------------------------------------------
@@ -1311,6 +1636,7 @@ class PagedPool(_PoolBase):
                if s is not None and not self._prefilling(s)
                and s.remaining > 0]
         if not dec:
+            self._register_phase()  # prefill chunks fill blocks too
             self._record_block_gauges()
             return {}  # an all-prefill round emits no tokens
         decoding = {id(s) for s in dec}
@@ -1355,6 +1681,10 @@ class PagedPool(_PoolBase):
         counts = [chunk if (s is not None and id(s) in decoding) else 0
                   for s in self.slots]
         events = self._emit_events(out, 0, counts=counts)
+        # Surviving rows register their newly-full blocks so LIVE rows
+        # share prefixes too, not just retired ones (retiring rows
+        # registered inside _on_retire).
+        self._register_phase()
         self._record_block_gauges()
         return events
 
@@ -1401,6 +1731,7 @@ class PagedPool(_PoolBase):
         events = self._emit_events(greedy, 0, counts=kept)
         reg.observe("serve_spec_commit_ms",
                     (time.perf_counter() - t2) * 1e3)
+        self._register_phase()
         self._record_block_gauges()
         return events
 
@@ -1413,13 +1744,23 @@ class PagedPool(_PoolBase):
         reclaim — this repairs ADDRESS-SPACE spread (compactness -> 1.0)
         so long-lived pools keep their live set dense and a future
         pool-shrink (release the high tail to a co-tenant) stays
-        possible. Returns the number of blocks moved."""
+        possible. Shared blocks relocate ONCE (tables alias, so the
+        walk dedups), and the reclaimable CACHED set moves with its
+        content — packed after the live prefix, LRU order preserved —
+        with the hash index remapped through allocator.remap(), so
+        prefix hits survive a mid-flight defrag. Returns the number of
+        blocks moved."""
         mapping = {}
         nxt = 1
         for s in self.slots:
             if s is None:
                 continue
             for b in s.blocks:
+                if b not in mapping:  # tables may alias shared blocks
+                    mapping[b] = nxt
+                    nxt += 1
+        for b in self.allocator._cached:  # oldest-first: order survives
+            if b not in mapping:
                 mapping[b] = nxt
                 nxt += 1
         moved = sum(1 for old, new in mapping.items() if old != new)
@@ -1436,10 +1777,7 @@ class PagedPool(_PoolBase):
         for s in self.slots:
             if s is not None:
                 s.blocks = [mapping[b] for b in s.blocks]
-        used = set(mapping.values())
-        self.allocator._used = used
-        self.allocator._free = [i for i in range(1, n + 1) if i not in used]
-        heapq.heapify(self.allocator._free)
+        self.allocator.remap(mapping)
         self.stats["defrags"] += 1
         self._record_block_gauges()
         return moved
@@ -1453,7 +1791,8 @@ def serve(params: Params, cfg: ModelConfig, requests: list,
           draft_cfg: ModelConfig | None = None, gamma: int = 4,
           resident: bool = False, paged: bool = False,
           kv_blocks: int | None = None, block_size: int | None = None,
-          prefill_budget: int | None = None) -> dict:
+          prefill_budget: int | None = None,
+          prefix_cache: bool | None = None) -> dict:
     """Run every request through a ``batch_size``-slot continuously
     batched pool; returns {rid: generated token list}. ``eos_id``
     finishes a row at the first emission of that token (inclusive) —
@@ -1476,10 +1815,11 @@ def serve(params: Params, cfg: ModelConfig, requests: list,
     prefills that are the (O(length), flash-kernel-served) price of
     admission. ``resident=True`` swaps in the resident-cache engine;
     ``paged=True`` the block-paged one (``kv_blocks``/``block_size``/
-    ``prefill_budget`` forwarded to PagedPool, stats gaining
-    prefill_tokens/prefill_chunks/blocks_total/blocks_peak), with
-    queued requests held FIFO until the head's whole block footprint
-    fits."""
+    ``prefill_budget``/``prefix_cache`` forwarded to PagedPool, stats
+    gaining prefill_tokens/prefill_chunks/blocks_total/blocks_peak plus
+    the prefix-cache accounting prompt_tokens/prefix_hit_tokens/
+    prefix_hit_requests/cow_copies), with queued requests held FIFO
+    until the head's uncovered block footprint fits."""
     from tpu_bootstrap import telemetry
 
     if len({r.rid for r in requests}) != len(requests):
@@ -1498,7 +1838,7 @@ def serve(params: Params, cfg: ModelConfig, requests: list,
                          eos_id=eos_id, temperature=temperature,
                          top_k=top_k, top_p=top_p, key=key,
                          draft_params=draft_params, draft_cfg=draft_cfg,
-                         gamma=gamma)
+                         gamma=gamma, prefix_cache=prefix_cache)
     elif resident:
         # resident=True swaps the replay pool for the resident-cache
         # engine: no per-round history replay, per-row frontiers.
@@ -1697,4 +2037,4 @@ def static_schedule_slot_steps(requests: list, batch_size: int) -> int:
 
 
 __all__ = ["BlockAllocator", "PagedPool", "Request", "ResidentPool",
-           "SlotPool", "serve", "static_schedule_slot_steps"]
+           "SlotPool", "block_hash", "serve", "static_schedule_slot_steps"]
