@@ -926,6 +926,109 @@ except Exception as e:  # noqa: BLE001
     out["serve_prefix_bench_error"] = f"{type(e).__name__}: {e}"[:400]
 emit()
 
+# Overcommit scheduler (serving.Scheduler): an overcommitted burst —
+# mixed budgets whose WHOLE footprints structurally over-subscribe a
+# tight block pool — through expected-footprint admission vs PR 5's
+# whole-footprint refusal admission at EQUAL KV memory. The story the
+# gate watches: serve_admit_ratio (concurrent admissions, overcommit /
+# refusal — reservation following expectation instead of worst case is
+# the whole point), burst TTFT p99 under each policy (queued requests
+# start later; overcommit must not give the win back to preemption
+# thrash), queue-wait p50 and the preemption count under the
+# overcommitted run. serve_admit_ratio is a --check HARD key alongside
+# the paged/prefix SLO pairs.
+try:
+    from tpu_bootstrap.workload.serving import (
+        PagedPool as _OcPool,
+        Scheduler as _OcSched,
+    )
+
+    import numpy as _np4
+
+    def burst_workload(n=24, seed=19):
+        # Fixed seed, fresh rng per call (the serving comparator rule):
+        # 8-token prompts under ONE declared budget (64) far above the
+        # typical completion — the attractor eos below makes most rows
+        # finish far short of it, the declared-vs-actual gap refusal
+        # admission wastes capacity on (PAPERS.md's vLLM entry).
+        rng = _np4.random.default_rng(seed)
+        return [Request(rid=i,
+                        tokens=rng.integers(1, dcfg.vocab_size, 8).tolist(),
+                        max_new=64)
+                for i in range(n)]
+
+    # Greedy decode from this fixed random init converges to an
+    # attractor token within a few steps; serving it as eos_id gives
+    # the burst DETERMINISTIC early finishers (true lengths mostly
+    # single-digit against the 64 budget) without a trained
+    # checkpoint. A row that never emits it just runs to budget — the
+    # mix is the premise, not a pin.
+    _oc_eos = int(_np4.bincount(_np4.asarray(generate(
+        dparams, jnp.asarray([r.tokens for r in burst_workload(4)]),
+        dcfg, 16))[:, -1]).argmax())
+
+    # 16-token blocks, NOT the serving default of 64: at block 64 an
+    # 8-token-prompt burst rounds every whole footprint down to 1-2
+    # blocks and expected-footprint admission has nothing to save —
+    # the overcommit win lives at footprint granularity finer than the
+    # declared budget (whole footprint 5 blocks vs the EMA seed's 2).
+    _obs = 16
+    # Tight at EQUAL memory for both policies: ~1/3 of the burst's full
+    # footprint, so refusal admission must queue most of it.
+    _oc_blocks = max(2, sum(-(-(8 + r.max_new) // _obs)
+                            for r in burst_workload()) // 3)
+
+    def _oc_pool():
+        return _OcPool(dparams, dcfg, batch_size=24, block_size=_obs,
+                       kv_blocks=_oc_blocks, eos_id=_oc_eos)
+
+    def concurrent_admits(overcommit):
+        pool = _oc_pool()
+        sched = _OcSched(pool, overcommit=overcommit)
+        n = 0
+        for r in burst_workload():
+            res = sched.expected_new(r)
+            if pool.admits(r, reserve_new=res):
+                pool.admit(r, reserve_new=res)
+                n += 1
+        return n
+
+    n_oc = concurrent_admits(True)
+    n_ref = concurrent_admits(False)
+    out["serve_admit_ratio"] = round(n_oc / max(n_ref, 1), 2)
+    emit()
+
+    def burst_ttft_p99(overcommit):
+        # One full warm pass per policy (compile time is not TTFT).
+        for measured in (False, True):
+            pool = _oc_pool()
+            sched = _OcSched(pool, overcommit=overcommit)
+            t0 = time.time()
+            first = {}
+            for r in burst_workload():
+                sched.submit(r)
+            while sched.pending() or pool.has_active():
+                for rid, ev in sched.step().items():
+                    if ev["new"] and rid not in first:
+                        first[rid] = (time.time() - t0) * 1e3
+            if measured:
+                lat = sorted(first.values())
+                return (lat[min(int(0.99 * len(lat)), len(lat) - 1)],
+                        pool, sched)
+
+    oc_ttft, oc_pool, oc_sched = burst_ttft_p99(True)
+    ref_ttft, _, _ = burst_ttft_p99(False)
+    out.update({
+        "serve_overcommit_ttft_p99_ms": round(oc_ttft, 1),
+        "serve_refusal_ttft_p99_ms": round(ref_ttft, 1),
+        "serve_queue_wait_p50_ms": round(oc_sched.queue_wait_p50_ms(), 2),
+        "serve_preempt_total": oc_pool.stats["preemptions"],
+        "serve_overcommit_grown_blocks": oc_pool.stats["grown_blocks"],
+    })
+except Exception as e:  # noqa: BLE001
+    out["serve_overcommit_bench_error"] = f"{type(e).__name__}: {e}"[:400]
+emit()
+
 # Speculative decoding (VERDICT r3 item 5): committed-tokens/s for int8
 # SELF-speculation — the target's own int8 copy drafts gamma tokens, the
 # bf16 target verifies the chunk in one weight stream. The only reason
@@ -1213,13 +1316,17 @@ def _cache_workload(parsed: dict) -> None:
 _HIGHER_BETTER = ("per_sec", "speedup", "mfu_pct", "gbps",
                   "roofline_frac", "mean_committed", "committed_per_stream",
                   "slot_utilization", "temp_reduction", "agreement_pct",
-                  "hit_rate")
+                  "hit_rate", "admit_ratio", "accept_rate")
 # "_ms" must stay an endswith match (as a substring it would grab
 # unrelated keys); the rest are distinctive enough to match anywhere —
 # quality deltas carry format suffixes (quant_xent_delta_int8).
 _LOWER_BETTER_SUFFIX = ("_ms",)
+# preempt_total: at FIXED workload and pool size (the bench burst), a
+# preemption-count climb means the expected-footprint estimate or the
+# victim policy degraded into thrash — queue-wait and TTFT keys pay it.
 _LOWER_BETTER_ANYWHERE = ("bytes_per_token", "xent_delta", "ppl_delta",
-                          "temp_mb", "kv_blocks_peak_frac")
+                          "temp_mb", "kv_blocks_peak_frac",
+                          "preempt_total")
 # Excluded despite a matching suffix: pure tunnel/backend noise.
 _REGRESSION_EXEMPT = ("backend_init_s",)
 
@@ -1318,11 +1425,44 @@ def check_results(results: dict | None = None, threshold: float = 0.15):
     attached there are no live keys to judge — exits 0 with a note
     (staleness flagging alone is the old behavior this supersedes)."""
     try:
-        prev = json.loads(WORKLOAD_CACHE.read_text()).get("results", {})
+        cache = json.loads(WORKLOAD_CACHE.read_text())
+        prev = cache.get("results", {})
     except (OSError, json.JSONDecodeError):
         print(json.dumps({"check_note": "no last-good cache; nothing to "
                                         "gate against", "check_failed": 0}))
         return 0
+    # Baseline provenance, surfaced LOUDLY (the standing bench-cache
+    # hygiene item): the gate compares against whatever
+    # .workload_last_good.json holds, and a baseline measured on an
+    # older kernel stack silently turns the comparison into fiction
+    # (the stale 0.253 int8 roofline lesson). Age + per-key commit
+    # provenance go in the summary; a stale baseline WARNS on stderr —
+    # it does not fail, because a fresh on-chip run is exactly how the
+    # cache gets replaced.
+    head = _git_fingerprint()
+    key_commits = cache.get("key_commits") or {
+        k: cache.get("commit", "unknown") for k in prev}
+    stale_keys = sorted(k for k, c in key_commits.items() if c != head)
+    cache_age_days = None
+    try:
+        measured = time.mktime(time.strptime(
+            cache.get("measured_at", ""), "%Y-%m-%dT%H:%M:%SZ"))
+        cache_age_days = round((time.mktime(time.gmtime()) - measured)
+                               / 86400, 1)
+    except (ValueError, OverflowError):
+        pass
+    if stale_keys:
+        print(f"WARNING: --check baseline {WORKLOAD_CACHE.name} predates "
+              f"the current tree ({len(stale_keys)}/{len(key_commits)} "
+              f"cached keys measured at other commits, e.g. "
+              f"{stale_keys[0]} @ {key_commits[stale_keys[0]]}; cache "
+              f"commit {cache.get('commit', 'unknown')}, measured "
+              f"{cache.get('measured_at', '?')}"
+              + (f", {cache_age_days} days ago" if cache_age_days is not None
+                 else "")
+              + ") — regressions below are judged against numbers the "
+              "current kernel stack never produced; refresh the cache "
+              "with an on-chip run", file=sys.stderr)
     if results is None:
         results = workload_bench()
     live = {k: v for k, v in results.items() if not k.startswith("cached_")}
@@ -1330,11 +1470,14 @@ def check_results(results: dict | None = None, threshold: float = 0.15):
     regressions = live.get("workload_regressions", {})
     # Hard-failure families: the kernel-bandwidth contract, the paged
     # serving SLO pair (throughput and burst TTFT p99 — the two numbers
-    # the paged engine ships to improve), and the prefix-cache pair
+    # the paged engine ships to improve), the prefix-cache pair
     # (hit rate on the shared-prompt shape and warm-request TTFT p50 —
-    # the two numbers the cache ships to improve).
+    # the two numbers the cache ships to improve), and the overcommit
+    # scheduler's admitted-ratio (expected-footprint admission must
+    # keep beating refusal admission at equal KV memory).
     _HARD_KEYS = ("serve_paged_tokens_per_sec", "serve_ttft_p99_ms",
-                  "serve_prefix_hit_rate", "serve_cached_ttft_p50_ms")
+                  "serve_prefix_hit_rate", "serve_cached_ttft_p50_ms",
+                  "serve_admit_ratio")
     hard = {k: v for k, v in regressions.items()
             if "hbm_roofline_frac" in k or "achieved_gbps" in k
             or k in _HARD_KEYS}
@@ -1347,7 +1490,15 @@ def check_results(results: dict | None = None, threshold: float = 0.15):
         "check_regressions": regressions,
         "check_hard_failures": hard,
         "check_failed": len(hard),
+        # Baseline provenance: what the gate judged against, and how
+        # trustworthy that baseline is for THIS tree.
+        "check_cache_commit": cache.get("commit", "unknown"),
+        "check_cache_measured_at": cache.get("measured_at", "?"),
+        "check_cache_age_days": cache_age_days,
+        "check_cache_stale_key_count": len(stale_keys),
     }
+    if stale_keys:
+        summary["check_cache_stale_keys"] = stale_keys[:10]
     if judged == 0:
         summary["check_note"] = ("no live numeric keys overlap the cache "
                                  "(chip unavailable?); nothing gated")

@@ -270,3 +270,56 @@ def test_check_gates_paged_serving_slo_keys(tmp_path, monkeypatch, capsys):
     assert rc == 0
     assert "kv_blocks_peak_frac" in out["check_regressions"]
     assert out["check_failed"] == 0
+
+
+def test_check_gates_scheduler_keys_and_reports_cache_provenance(
+        tmp_path, monkeypatch, capsys):
+    """The overcommit scheduler's bench keys join the gate:
+    serve_admit_ratio is HARD (higher-better — expected-footprint
+    admission must keep beating refusal admission), queue-wait p50
+    (lower-better by _ms suffix) and serve_preempt_total (lower-better
+    by family) are soft flags; and every --check run reports the
+    baseline cache's provenance, WARNING loudly on stderr when cached
+    keys predate the current tree (the stale-roofline lesson)."""
+    monkeypatch.setattr(bench, "WORKLOAD_CACHE", tmp_path / "cache.json")
+    bench._cache_workload({"chip_alive": True,
+                           "serve_admit_ratio": 1.8,
+                           "serve_queue_wait_p50_ms": 40.0,
+                           "serve_preempt_total": 4})
+
+    # Admitted ratio down 33%: hard failure.
+    rc = bench.check_results({"serve_admit_ratio": 1.2,
+                              "serve_queue_wait_p50_ms": 41.0,
+                              "serve_preempt_total": 4})
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 1
+    assert "serve_admit_ratio" in out["check_hard_failures"]
+
+    # Queue wait + preemption thrash: flagged the right way, not fatal.
+    rc = bench.check_results({"serve_admit_ratio": 1.85,
+                              "serve_queue_wait_p50_ms": 90.0,
+                              "serve_preempt_total": 9})
+    captured = capsys.readouterr()
+    out = json.loads(captured.out.strip().splitlines()[-1])
+    assert rc == 0
+    assert "serve_queue_wait_p50_ms" in out["check_regressions"]
+    assert "serve_preempt_total" in out["check_regressions"]
+    # Fresh cache (written by this tree): provenance present, no stale
+    # warning.
+    assert out["check_cache_commit"] == bench._git_fingerprint()
+    assert out["check_cache_stale_key_count"] == 0
+    assert "predates the current tree" not in captured.err
+
+    # A baseline measured on another build warns LOUDLY and surfaces
+    # the stale keys, but does not fail by itself.
+    cache = json.loads((tmp_path / "cache.json").read_text())
+    cache["key_commits"] = {k: "0000000" for k in cache["results"]}
+    cache["commit"] = "0000000"
+    (tmp_path / "cache.json").write_text(json.dumps(cache))
+    rc = bench.check_results({"serve_admit_ratio": 1.85})
+    captured = capsys.readouterr()
+    out = json.loads(captured.out.strip().splitlines()[-1])
+    assert rc == 0
+    assert "predates the current tree" in captured.err
+    assert out["check_cache_stale_key_count"] == len(cache["results"])
+    assert "serve_admit_ratio" in out["check_cache_stale_keys"]

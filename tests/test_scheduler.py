@@ -1,0 +1,497 @@
+"""The overcommit Scheduler (serving.Scheduler): expected-footprint
+admission, SLO-aware queue ordering (priority, deadline, arrival),
+vLLM-style evict-and-recompute preemption on the paged pool, and the
+n-gram prompt-lookup draft source.
+
+Pins the PR's contracts: preempted-then-resumed token streams are
+byte-identical to never-preempted ones (greedy + sampled x kv_quant x
+prefix_cache), TPUBC_OVERCOMMIT=0 reproduces the PR 5 whole-footprint
+refusal admission exactly, fuzzed admit/preempt/resume/retire churn
+preserves the BlockAllocator's refcount/uniqueness invariants (pressure
+resolves by preemption, never OOM or corruption), and a priority
+inversion never outlives one round boundary."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_bootstrap.workload.decode import generate
+from tpu_bootstrap.workload.ingress import IngressServer
+from tpu_bootstrap.workload.model import ModelConfig, init_params
+from tpu_bootstrap.workload.serving import (
+    PagedPool,
+    Request,
+    Scheduler,
+    ngram_lookup_drafts,
+    serve,
+)
+
+TINY = ModelConfig(vocab_size=32, num_layers=1, num_heads=2, head_dim=8,
+                   embed_dim=16, mlp_dim=32, max_seq_len=64)
+TPARAMS = init_params(TINY, jax.random.PRNGKey(1))
+
+
+def _solo(tokens, max_new, **kw):
+    out = generate(TPARAMS, jnp.asarray([tokens], jnp.int32), TINY, max_new,
+                   kv_kernel=False, **kw)
+    return np.asarray(out[0]).tolist()
+
+
+def _requests(n, seed=0, lo_new=8, hi_new=24):
+    rng = np.random.default_rng(seed)
+    return [Request(rid=i,
+                    tokens=rng.integers(1, 32,
+                                        int(rng.integers(2, 10))).tolist(),
+                    max_new=int(rng.integers(lo_new, hi_new)))
+            for i in range(n)]
+
+
+def _drive(pool, sched, requests):
+    """serve()'s loop shape against an explicit Scheduler — the form
+    the preemption tests need to reach into pool/scheduler state."""
+    done = {}
+    for r in requests:
+        sched.submit(r)
+    rounds = 0
+    while sched.pending() or pool.has_active():
+        rounds += 1
+        assert rounds < 5000, "scheduler stopped making progress"
+        for rid, ev in sched.step().items():
+            if ev["done"]:
+                done[rid] = ev["generated"]
+    return done
+
+
+# ---- queue ordering ------------------------------------------------------
+
+
+def test_queue_orders_by_priority_then_deadline_then_arrival():
+    pool = PagedPool(TPARAMS, TINY, 1, block_size=8)
+    sched = Scheduler(pool)
+    sched.submit(Request(rid=0, tokens=[1, 2], max_new=2, priority=0))
+    sched.submit(Request(rid=1, tokens=[2, 3], max_new=2, priority=0,
+                         deadline=1e9))
+    sched.submit(Request(rid=2, tokens=[3, 4], max_new=2, priority=2))
+    sched.submit(Request(rid=3, tokens=[4, 5], max_new=2, priority=0,
+                         deadline=1.0))
+    order = []
+    while sched.pending() or pool.has_active():
+        for rid, ev in sched.step().items():
+            if ev["done"]:
+                order.append(rid)
+    # Highest class first; within class 0, explicit deadlines (EDF)
+    # ahead of the deadline-less rid 0, earlier deadline first.
+    assert order == [2, 3, 1, 0], order
+
+
+def test_expected_footprint_ema_converges_and_clamps():
+    pool = PagedPool(TPARAMS, TINY, 4, block_size=8)
+    sched = Scheduler(pool, overcommit=True, expected_new=16)
+    # EMA seed reserves min(budget, 16); observations drag it toward
+    # the true generated lengths (everything here retires at 3).
+    assert sched.expected_new(Request(rid=9, tokens=[1], max_new=40)) == 16
+    assert sched.expected_new(Request(rid=9, tokens=[1], max_new=2)) == 2
+    _drive(pool, sched, [Request(rid=i, tokens=[1 + i, 2], max_new=3)
+                         for i in range(6)])
+    assert sched._ema < 8, sched._ema
+    assert sched.expected_new(Request(rid=9, tokens=[1], max_new=40)) < 16
+    # Never below one token, never above the remaining budget.
+    assert sched.expected_new(Request(rid=9, tokens=[1], max_new=1)) == 1
+
+
+def test_overcommit_env_and_slot_engine_gating(monkeypatch):
+    monkeypatch.setenv("TPUBC_OVERCOMMIT", "0")
+    assert Scheduler(PagedPool(TPARAMS, TINY, 1)).overcommit is False
+    monkeypatch.delenv("TPUBC_OVERCOMMIT")
+    assert Scheduler(PagedPool(TPARAMS, TINY, 1)).overcommit is True
+    # Slot engines have no block pool: never overcommitted, reserve is
+    # the pool default.
+    from tpu_bootstrap.workload.serving import SlotPool
+    sp = Scheduler(SlotPool(TPARAMS, TINY, 1), overcommit=True)
+    assert sp.overcommit is False
+    assert sp.expected_new(Request(rid=0, tokens=[1], max_new=9)) is None
+
+
+# ---- PR 5 parity (overcommit off) ---------------------------------------
+
+
+def test_overcommit_off_reserves_whole_footprint_exactly():
+    """TPUBC_OVERCOMMIT=0 must be PR 5: admission reserves the full
+    ceil((prompt + max_new)/block) footprint up front, nothing grows,
+    nothing preempts — pinned against blocks_needed() per admitted
+    row and against the refusal pool's admits() decisions."""
+    reqs = _requests(12, seed=3)
+    pool = PagedPool(TPARAMS, TINY, 4, block_size=8, kv_blocks=12)
+    sched = Scheduler(pool, overcommit=False)
+    refusal = PagedPool(TPARAMS, TINY, 4, block_size=8, kv_blocks=12)
+    for r in reqs:
+        # The scheduler's reserve matches the PR 5 admits() decision...
+        assert (pool.admits(r, reserve_new=sched.expected_new(r))
+                == refusal.admits(r))
+        if pool.admits(r, reserve_new=sched.expected_new(r)):
+            pool.admit(r, reserve_new=sched.expected_new(r))
+            refusal.admit(r)
+            # ...and the reservation is the whole footprint.
+            s = next(s for s in pool.slots
+                     if s is not None and s.rid == r.rid)
+            assert len(s.blocks) == pool.blocks_needed(r)
+    done = _drive(pool, sched, [])
+    assert pool.stats["preemptions"] == 0
+    assert pool.stats["grown_blocks"] == 0
+    for rid, toks in done.items():
+        r = next(x for x in reqs if x.rid == rid)
+        assert toks == _solo(r.tokens, r.max_new)
+
+
+def test_serve_overcommit_off_matches_on_and_solo():
+    reqs = _requests(8, seed=5)
+    on = serve(TPARAMS, TINY, reqs, 4, paged=True, block_size=8,
+               prefill_budget=4)
+    off_stats: dict = {}
+    off = serve(TPARAMS, TINY, reqs, 4, paged=True, block_size=8,
+                prefill_budget=4, overcommit=False, stats=off_stats)
+    assert on == off
+    assert off_stats["preemptions"] == 0
+    for r in reqs:
+        assert on[r.rid] == _solo(r.tokens, r.max_new), r.rid
+
+
+# ---- preemption exactness -------------------------------------------------
+
+
+@pytest.mark.parametrize("kv_quant", [False, True])
+@pytest.mark.parametrize("prefix_cache", [True, False])
+@pytest.mark.parametrize("sampled", [False, True])
+def test_preempted_streams_byte_identical(kv_quant, prefix_cache, sampled):
+    """The acceptance pin: a tight pool under overcommit preempts, and
+    every preempted-then-resumed stream equals the never-preempted
+    (unpressured) stream — greedy and sampled, quantized KV or not,
+    prefix cache on or off. Eviction decrefs through the cache (when
+    on), re-prefill recomputes (or revives) the identical KV, and
+    sampled draws key off (rid, stream position), never scheduling."""
+    reqs = _requests(8, seed=7)
+    kw = {}
+    if sampled:
+        kw = {"temperature": 0.8, "top_k": 8, "key": jax.random.PRNGKey(2)}
+    roomy = serve(TPARAMS, TINY, reqs, 8, paged=True, block_size=8,
+                  prefill_budget=4, kv_quant=kv_quant,
+                  prefix_cache=prefix_cache, **kw)
+    pool = PagedPool(TPARAMS, TINY, 8, block_size=8, kv_blocks=8,
+                     prefill_budget=4, kv_quant=kv_quant,
+                     prefix_cache=prefix_cache, **kw)
+    sched = Scheduler(pool, overcommit=True, expected_new=2)
+    tight = _drive(pool, sched, reqs)
+    assert pool.stats["preemptions"] > 0, "pool was not actually tight"
+    assert sched.stats["requeues"] == pool.stats["preemptions"]
+    assert tight == roomy
+
+
+def test_preempted_spec_lookup_streams_byte_identical():
+    reqs = _requests(8, seed=11)
+    roomy = serve(TPARAMS, TINY, reqs, 8, paged=True, block_size=8,
+                  prefill_budget=4, spec_lookup=True, gamma=3)
+    pool = PagedPool(TPARAMS, TINY, 8, block_size=8, kv_blocks=10,
+                     prefill_budget=4, spec_lookup=True, gamma=3)
+    sched = Scheduler(pool, overcommit=True, expected_new=2)
+    tight = _drive(pool, sched, reqs)
+    assert pool.stats["preemptions"] > 0
+    assert tight == roomy
+    for r in reqs:
+        assert tight[r.rid] == _solo(r.tokens, r.max_new), r.rid
+
+
+# ---- priority preemption --------------------------------------------------
+
+
+def test_priority_inversion_never_exceeds_one_round():
+    """A higher-priority arrival that capacity cannot seat evicts the
+    lowest-priority/latest-arrival row at the very next round boundary
+    — the inversion lasts at most the round in which it arose — and
+    the victim still completes byte-identically after resuming."""
+    pool = PagedPool(TPARAMS, TINY, 2, block_size=8, kv_blocks=4,
+                     prefill_budget=8)
+    sched = Scheduler(pool, overcommit=True, expected_new=16)
+    low = Request(rid=0, tokens=[1, 2, 3, 4, 5, 6, 7, 8], max_new=24,
+                  priority=0)
+    sched.submit(low)
+    sched.step()  # low admitted, reserving 3 of the 4 blocks
+    assert {s.rid for s in pool.slots if s is not None} == {0}
+    high = Request(rid=1, tokens=[8, 7, 6, 5, 4, 3, 2, 1], max_new=24,
+                   priority=3)
+    sched.submit(high)  # needs 3 blocks; only 1 is free
+    events = sched.step()  # ONE round boundary later...
+    assert {s.rid for s in pool.slots if s is not None} == {1}, events
+    assert pool.stats["preemptions"] == 1
+    done = _drive(pool, sched, [])
+    assert done[0] == _solo(low.tokens, low.max_new)
+    assert done[1] == _solo(high.tokens, high.max_new)
+
+
+def test_equal_priority_never_preempts():
+    """Within a class order is FIFO and preemption is strictly-below
+    only — a peer arrival waits instead of thrashing the running row."""
+    pool = PagedPool(TPARAMS, TINY, 2, block_size=8, kv_blocks=4,
+                     prefill_budget=8)
+    sched = Scheduler(pool, overcommit=True, expected_new=16)
+    r0 = Request(rid=0, tokens=[1] * 8, max_new=24, priority=1)
+    r1 = Request(rid=1, tokens=[2] * 8, max_new=24, priority=1)
+    sched.submit(r0)
+    sched.step()  # r0 admitted, reserving 3 of the 4 blocks
+    sched.submit(r1)  # needs 3 blocks; only 1 free, same priority
+    sched._admit_phase()
+    assert {s.rid for s in pool.slots if s is not None} == {0}
+    assert pool.stats["preemptions"] == 0
+    assert sched.queue_depth() == 1
+    done = _drive(pool, sched, [])
+    assert done[0] == _solo(r0.tokens, r0.max_new)
+    assert done[1] == _solo(r1.tokens, r1.max_new)
+
+
+def test_victim_policy_prefers_decode_phase_rows():
+    """At equal priority the victim is a decode-phase row (latest
+    arrival among them), never a still-prefilling one: a prefilling
+    row has produced nothing a client can see, so evicting it would
+    convert its admission into pure queue-wait while its TTFT clock
+    keeps running."""
+    pool = PagedPool(TPARAMS, TINY, 3, block_size=8, kv_blocks=12,
+                     prefill_budget=64)
+    pool.admit(Request(rid=0, tokens=[1] * 8, max_new=24),
+               reserve_new=4, seq=0)
+    pool.admit(Request(rid=1, tokens=[2] * 8, max_new=24),
+               reserve_new=4, seq=1)
+    pool.step_round()  # prompts prefill fully; both rows reach decode
+    pool.admit(Request(rid=2, tokens=[3] * 8, max_new=24),
+               reserve_new=4, seq=2)  # latest arrival, still prefilling
+    rec = pool.preempt_one()
+    assert rec["request"].rid == 1, "decode-phase latest arrival evicts"
+    assert {s.rid for s in pool.slots if s is not None} == {0, 2}
+
+
+def test_admission_watermark_holds_back_imminent_growth():
+    """Overcommit admission keeps the blocks the running set will grow
+    into within the next block of tokens free: a waiting request that
+    RAW capacity could seat stays queued while admitting it would just
+    become the next dispatch's preemption."""
+    pool = PagedPool(TPARAMS, TINY, 2, block_size=8, kv_blocks=3,
+                     prefill_budget=64)
+    sched = Scheduler(pool, overcommit=True, expected_new=1)
+    r0 = Request(rid=0, tokens=[1] * 7, max_new=16)
+    sched.submit(r0)
+    sched.step()  # r0 admitted on 1 expected block; frontier now at 8
+    assert pool.imminent_growth() >= 1
+    r1 = Request(rid=1, tokens=[2] * 8, max_new=8)
+    res = sched.expected_new(r1)
+    assert pool.admits(r1, reserve_new=res), "raw capacity would admit"
+    sched.submit(r1)
+    sched._admit_phase()
+    assert {s.rid for s in pool.slots if s is not None} == {0}
+    assert sched.queue_depth() == 1
+    assert pool.stats["preemptions"] == 0
+    done = _drive(pool, sched, [])  # r1 admits once r0's blocks free
+    assert done[0] == _solo(r0.tokens, r0.max_new)
+    assert done[1] == _solo(r1.tokens, r1.max_new)
+
+
+def test_overcommit_chunk_follows_expectation_hint():
+    """With overcommit on, the Scheduler caps decode chunks at the
+    expected-length EMA (the majority-budget rule would provision the
+    worst case the capacity fold then has to evict for); with it off,
+    the hint stays None and PR 5's chunk rule is untouched."""
+    pool = PagedPool(TPARAMS, TINY, 2, block_size=8)
+    sched = Scheduler(pool, overcommit=True, expected_new=5)
+    sched.submit(Request(rid=0, tokens=[1, 2], max_new=8))
+    sched.step()
+    assert pool.chunk_hint == 5
+    pool2 = PagedPool(TPARAMS, TINY, 2, block_size=8)
+    sched2 = Scheduler(pool2, overcommit=False)
+    done = _drive(pool2, sched2, [Request(rid=0, tokens=[1, 2], max_new=8)])
+    assert pool2.chunk_hint is None
+    assert done[0] == _solo([1, 2], 8)
+
+
+# ---- fuzzed churn ---------------------------------------------------------
+
+
+def _check_allocator_invariants(pool):
+    alloc = pool.allocator
+    # Every table reference is a refcount; every live block is mapped.
+    refs: dict = {}
+    for s in pool.slots:
+        if s is not None:
+            for b in s.blocks:
+                refs[b] = refs.get(b, 0) + 1
+    assert set(refs) == set(alloc._ref), "live set != table-referenced set"
+    for b, c in refs.items():
+        assert alloc.refcount(b) == c, (b, c, alloc.refcount(b))
+    # Partition: every id is exactly one of free/live/cached.
+    assert len(alloc._free) == len(set(alloc._free)), "free-heap dup"
+    assert (len(alloc._free) + len(alloc._ref) + len(alloc._cached)
+            == alloc.num_blocks)
+    assert not (set(alloc._free) & set(alloc._ref))
+    assert not (set(alloc._free) & set(alloc._cached))
+    assert not (set(alloc._ref) & set(alloc._cached))
+
+
+def test_fuzzed_churn_preserves_invariants_and_exactness():
+    """Random submit/priority churn against a pool far too small for
+    the offered load: every round must preserve the allocator's
+    refcount/uniqueness partition (pressure resolves by preemption —
+    an OOM or aliasing here would raise or corrupt), and every
+    completed stream still equals its solo greedy run."""
+    rng = np.random.default_rng(42)
+    pool = PagedPool(TPARAMS, TINY, 4, block_size=4, kv_blocks=10,
+                     prefill_budget=4)
+    sched = Scheduler(pool, overcommit=True, expected_new=2)
+    done: dict = {}
+    by_rid: dict = {}
+    rid = 0
+    for _ in range(50):
+        if rng.random() < 0.6 and sched.queue_depth() < 6:
+            r = Request(rid=rid,
+                        tokens=rng.integers(
+                            1, 32, int(rng.integers(2, 10))).tolist(),
+                        max_new=int(rng.integers(1, 14)),
+                        priority=int(rng.integers(0, 3)))
+            by_rid[rid] = r
+            sched.submit(r)
+            rid += 1
+        for got_rid, ev in sched.step().items():
+            if ev["done"]:
+                done[got_rid] = ev["generated"]
+        _check_allocator_invariants(pool)
+    while sched.pending() or pool.has_active():
+        for got_rid, ev in sched.step().items():
+            if ev["done"]:
+                done[got_rid] = ev["generated"]
+        _check_allocator_invariants(pool)
+    assert pool.stats["preemptions"] > 0, "churn never hit pressure"
+    assert set(done) == set(by_rid)
+    for got_rid, toks in done.items():
+        r = by_rid[got_rid]
+        assert toks == _solo(r.tokens, r.max_new), got_rid
+
+
+# ---- n-gram prompt-lookup drafting ---------------------------------------
+
+
+def test_ngram_lookup_drafts_unit():
+    # Trailing [1, 2] last occurred earlier, followed by 3, 1, 2.
+    assert ngram_lookup_drafts([1, 2, 3, 1, 2], 3) == [3, 1, 2]
+    # Most RECENT occurrence wins over an older one.
+    assert ngram_lookup_drafts([1, 2, 9, 1, 2, 7, 1, 2], 1) == [7]
+    # Continuation truncated at the history end pads with the last
+    # token (no wraparound).
+    assert ngram_lookup_drafts([4, 5, 4, 5], 4) == [4, 5, 5, 5]
+    assert ngram_lookup_drafts([4, 5, 6, 4, 5, 6, 4, 5], 3) == [6, 4, 5]
+    # No match: repeat-last fallback.
+    assert ngram_lookup_drafts([1, 2, 3], 2) == [3, 3]
+    assert ngram_lookup_drafts([7], 3) == [7, 7, 7]
+    with pytest.raises(ValueError):
+        ngram_lookup_drafts([1, 2], 0)
+
+
+def test_spec_lookup_matches_plain_and_solo_with_acceptance_stats():
+    reqs = _requests(6, seed=13, lo_new=4, hi_new=12)
+    plain = serve(TPARAMS, TINY, reqs, 3, paged=True, block_size=8,
+                  prefill_budget=4)
+    for engine in ({"paged": True, "block_size": 8, "prefill_budget": 4},
+                   {"resident": True}):
+        stats: dict = {}
+        got = serve(TPARAMS, TINY, reqs, 3, spec_lookup=True, gamma=3,
+                    stats=stats, **engine)
+        assert got == plain, engine
+        # Zero model passes drafted; acceptance accounting populated.
+        assert stats["draft_steps"] == 0
+        assert stats["draft_proposed"] > 0
+        assert 0 <= stats["draft_accepted"] <= stats["draft_proposed"]
+    from tpu_bootstrap import telemetry
+    assert "serve_spec_accept_rate" in telemetry.metrics().to_json()
+
+
+def test_spec_lookup_loud_rejections():
+    with pytest.raises(ValueError, match="REPLACES the model draft"):
+        from tpu_bootstrap.workload.quant import quantize_params
+        PagedPool(TPARAMS, TINY, 2, draft_params=quantize_params(TPARAMS),
+                  draft_cfg=TINY, spec_lookup=True)
+    with pytest.raises(ValueError, match="greedy-only"):
+        PagedPool(TPARAMS, TINY, 2, spec_lookup=True, temperature=0.5,
+                  key=jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="resident/paged"):
+        serve(TPARAMS, TINY, [Request(rid=0, tokens=[1], max_new=1)], 2,
+              spec_lookup=True)
+    # gamma headroom applies to lookup drafting too (verify writes up
+    # to gamma past the frontier).
+    pool = PagedPool(TPARAMS, TINY, 2, spec_lookup=True, gamma=4)
+    with pytest.raises(ValueError, match="gamma"):
+        pool.validate(Request(rid=0, tokens=[1] * 32, max_new=32), TINY)
+
+
+# ---- ingress: 429, queue position, priority plumbing ---------------------
+
+
+ICFG = TINY
+IPARAMS = TPARAMS
+
+
+def _post(port, body, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/generate",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def test_ingress_429_on_transient_pressure_and_queue_ack():
+    """Server pressure is 429 + Retry-After (retryable), never the 400
+    reserved for never-fits requests; queued streams see their position
+    as the first line. Engine deliberately NOT started: the queue can
+    only fill."""
+    srv = IngressServer(IPARAMS, ICFG, port=0, batch_size=1, max_queue=1,
+                        host="127.0.0.1")
+    http = threading.Thread(target=srv.httpd.serve_forever, daemon=True)
+    http.start()
+    try:
+        r1 = _post(srv.port, {"tokens": [1, 2], "max_new": 2})
+        first = json.loads(r1.readline())
+        assert first["queued"] is True and first["queue_position"] == 0
+        assert first["tokens"] == []
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.port, {"tokens": [1, 2], "max_new": 2})
+        assert e.value.code == 429
+        assert e.value.headers["Retry-After"] == "1"
+        body = json.loads(e.value.read())
+        assert "no capacity" in body["error"]
+        # Never-fits stays a client error: 400, not 429.
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.port, {"tokens": [1, 2], "max_new": 4096})
+        assert e.value.code == 400
+        r1.close()
+    finally:
+        srv.httpd.shutdown()
+        srv.httpd.server_close()
+
+
+def test_ingress_priority_deadline_and_position_end_to_end():
+    srv = IngressServer(IPARAMS, ICFG, port=0, batch_size=2,
+                        host="127.0.0.1").start()
+    try:
+        with _post(srv.port, {"tokens": [3, 4], "max_new": 3,
+                              "stream": False, "priority": 2,
+                              "deadline_ms": 60000}) as resp:
+            out = json.loads(resp.read())
+        assert out["done"] is True
+        assert out["queue_position"] == 0
+        assert out["tokens"] == _solo([3, 4], 3)
+        # Malformed SLO fields are client errors.
+        for bad in ({"priority": "high"}, {"deadline_ms": -5}):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(srv.port, {"tokens": [1], "max_new": 1, **bad})
+            assert e.value.code == 400
+    finally:
+        srv.stop()

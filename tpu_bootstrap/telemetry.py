@@ -476,6 +476,30 @@ def record_kv_block_pool(total: int, used: int, free: int,
     reg.set_gauge("kv_blocks_compactness", round(compactness, 4))
 
 
+def record_scheduler(queue_depth: int, expected_new: float,
+                     submitted: int, admitted: int,
+                     preemptions: int) -> None:
+    """Scheduler gauges (serving.Scheduler feeds this after every
+    submit / admission phase / round): the waiting-queue depth, the
+    live expected-generated-length EMA that overcommit admission
+    reserves by (serve_expected_new — watching it converge from the
+    TPUBC_EXPECTED_NEW seed tells an operator how far traffic sits from
+    the estimate), the cumulative admitted-over-submitted ratio
+    (serve_admitted_ratio: < 1 means requests are still waiting), and
+    the evict-and-recompute counter mirror (serve_preempt_total is the
+    authoritative counter, inc'd at each eviction; the gauge here keeps
+    the pool-stats snapshot scrapeable next to the rest). The
+    queue-wait histogram (serve_queue_wait_ms) is observed per
+    admission by the Scheduler itself."""
+    reg = _metrics
+    reg.set_gauge("serve_sched_queue_depth", queue_depth)
+    reg.set_gauge("serve_expected_new", round(float(expected_new), 2))
+    if submitted > 0:
+        reg.set_gauge("serve_admitted_ratio",
+                      round(admitted / submitted, 4))
+    reg.set_gauge("serve_preemptions", preemptions)
+
+
 class RateWindow:
     """Rolling event-rate gauge feed (serve_qps, serve_tokens_per_sec):
     count events with add(), read events-per-second over the trailing

@@ -56,12 +56,15 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+import os
+
 from tpu_bootstrap import telemetry
 from tpu_bootstrap.workload.model import ModelConfig, Params
 from tpu_bootstrap.workload.serving import (
     PagedPool,
     Request,
     ResidentPool,
+    Scheduler,
     SlotPool,
 )
 
@@ -80,7 +83,10 @@ class IngressServer:
                  resident: bool = False, paged: bool = False,
                  kv_blocks: int | None = None, block_size: int | None = None,
                  prefill_budget: int | None = None,
-                 prefix_cache: bool | None = None, host: str = "0.0.0.0"):
+                 prefix_cache: bool | None = None,
+                 overcommit: bool | None = None,
+                 spec_lookup: bool | None = None,
+                 max_queue: int | None = None, host: str = "0.0.0.0"):
         self.cfg = cfg
         if paged and resident:
             # Same loud rejection as serve(): silently preferring one
@@ -105,7 +111,8 @@ class IngressServer:
                                   top_p=top_p, key=key,
                                   draft_params=draft_params,
                                   draft_cfg=draft_cfg, gamma=gamma,
-                                  prefix_cache=prefix_cache)
+                                  prefix_cache=prefix_cache,
+                                  spec_lookup=spec_lookup)
         elif resident:
             # Resident-cache engine: no history replay, per-row
             # frontiers; sampling composes (same per-request streams),
@@ -116,17 +123,37 @@ class IngressServer:
                                      temperature=temperature, top_k=top_k,
                                      top_p=top_p, key=key,
                                      draft_params=draft_params,
-                                     draft_cfg=draft_cfg, gamma=gamma)
+                                     draft_cfg=draft_cfg, gamma=gamma,
+                                     spec_lookup=spec_lookup)
         else:
+            if spec_lookup:
+                raise ValueError(
+                    "spec_lookup rides the resident/paged engines' split "
+                    "draft/verify seam; pick one of them")
             self.pool = SlotPool(params, cfg, batch_size, kv_quant=kv_quant,
                                  eos_id=eos_id, temperature=temperature,
                                  top_k=top_k, top_p=top_p, key=key,
                                  draft_params=draft_params,
                                  draft_cfg=draft_cfg, gamma=gamma)
+        # Admission/queueing/preemption policy lives in the Scheduler
+        # (priority classes, EDF-within-class, expected-footprint
+        # overcommit on the paged engine — TPUBC_OVERCOMMIT=0 restores
+        # whole-footprint refusal admission). Only the engine thread
+        # touches it; handlers hand requests over via _pending.
+        self.sched = Scheduler(self.pool, overcommit=overcommit)
+        # Transient-pressure backstop: beyond this many waiting
+        # requests the front door answers 429 + Retry-After instead of
+        # queueing unboundedly (server pressure is not a client error —
+        # 400 stays reserved for never-fits requests).
+        if max_queue is None:
+            max_queue = int(os.environ.get("TPUBC_INGRESS_MAX_QUEUE", "256"))
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.max_queue = max_queue
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
-        self._pending: list = []  # [(Request, out_queue)] awaiting a slot
-        self._streams: dict = {}  # rid -> out_queue for admitted requests
+        self._pending: list = []  # [(Request, out_queue)] awaiting handoff
+        self._streams: dict = {}  # rid -> out_queue once handed to the engine
         self._next_rid = 0
         self._stop = False
         self.last_error: str | None = None  # last failed round, /healthz
@@ -182,7 +209,11 @@ class IngressServer:
                     return self._json(404, {"error": f"unknown path {self.path}"})
                 with outer._lock:
                     active = sum(1 for s in outer.pool.slots if s is not None)
-                    queued = len(outer._pending)
+                    # Waiting = handed-off-but-unsubmitted plus the
+                    # Scheduler's ordered queue (len() reads are safe
+                    # without the engine's cooperation).
+                    queued = (len(outer._pending)
+                              + outer.sched.queue_depth())
                     last_error = outer.last_error
                     served = outer._served
                     ttft = sorted(outer._ttft_ms)
@@ -211,6 +242,12 @@ class IngressServer:
                     tokens = body["tokens"]
                     max_new = int(body["max_new"])
                     stream = bool(body.get("stream", True))
+                    priority = int(body.get("priority", 0))
+                    deadline_ms = body.get("deadline_ms")
+                    if deadline_ms is not None:
+                        deadline_ms = float(deadline_ms)
+                        if deadline_ms <= 0:
+                            raise ValueError("deadline_ms must be > 0")
                     if (not isinstance(tokens, list)
                             or not all(isinstance(t, int) for t in tokens)):
                         raise ValueError("tokens must be a list of ints")
@@ -220,7 +257,11 @@ class IngressServer:
                 except (KeyError, TypeError, ValueError,
                         json.JSONDecodeError) as e:
                     return self._json(400, {"error": f"bad request: {e}"})
-                req = Request(rid=-1, tokens=tokens, max_new=max_new)
+                req = Request(
+                    rid=-1, tokens=tokens, max_new=max_new,
+                    priority=priority,
+                    deadline=(time.monotonic() + deadline_ms / 1e3
+                              if deadline_ms is not None else None))
                 try:
                     # Validate BEFORE enqueueing, with the POOL'S OWN
                     # rules: the context-window/budget checks — and any
@@ -228,11 +269,25 @@ class IngressServer:
                     # gamma headroom — must reject at the front door,
                     # not poison the engine loop. (validate only reads
                     # the request; the placeholder rid is fine in
-                    # messages.)
+                    # messages.) A request that can NEVER fit is the
+                    # client's error — 400; transient pressure is NOT,
+                    # and 429s below instead.
                     outer.pool.validate(req, outer.cfg)
                 except ValueError as e:
                     return self._json(400, {"error": str(e)})
-                out_q = outer._submit(req)
+                submitted = outer._submit(req)
+                if submitted is None:
+                    # Server pressure, not a client error: the waiting
+                    # queue is at its bound. Retry-After is a crude
+                    # one-second hint — the queue drains at round
+                    # cadence, not a predictable rate.
+                    telemetry.metrics().inc("serve_throttled_total")
+                    return self._json(
+                        429, {"error": "no capacity: waiting queue is "
+                                       f"full ({outer.max_queue}); retry",
+                              "queued": outer.max_queue},
+                        headers={"Retry-After": "1"})
+                out_q, qpos = submitted
                 if stream:
                     self.send_response(200)
                     self.send_header("Content-Type", "application/jsonl")
@@ -244,6 +299,9 @@ class IngressServer:
                             line = json.dumps(
                                 {"tokens": ev["new"],
                                  **({"done": True} if ev["done"] else {}),
+                                 **({"queued": True,
+                                     "queue_position": ev["queue_position"]}
+                                    if ev.get("queued") else {}),
                                  **({"cached_tokens": ev["cached_tokens"]}
                                     if "cached_tokens" in ev else {}),
                                  **({"error": ev["error"]}
@@ -261,18 +319,21 @@ class IngressServer:
                     while True:
                         ev = out_q.get()
                         if ev["done"]:
-                            out = {"tokens": ev["generated"], "done": True}
+                            out = {"tokens": ev["generated"], "done": True,
+                                   "queue_position": qpos}
                             if "cached_tokens" in ev:
                                 out["cached_tokens"] = ev["cached_tokens"]
                             if ev.get("error"):
                                 out["error"] = ev["error"]
                             return self._json(200, out)
 
-            def _json(self, code, obj):
+            def _json(self, code, obj, headers=None):
                 payload = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(payload)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(payload)
 
@@ -283,59 +344,66 @@ class IngressServer:
 
     # ---- engine ----------------------------------------------------------
 
-    def _submit(self, req: Request) -> queue.Queue:
+    def _submit(self, req: Request):
+        """Assign a rid, hand the request to the engine, and ACK the
+        queueing to the client. Returns (out_queue, queue position at
+        submit) — or None when the waiting queue is at its bound (the
+        handler answers 429: server pressure is not a client error)."""
         out_q: queue.Queue = queue.Queue()
         with self._work:
+            depth = len(self._pending) + self.sched.queue_depth()
+            if depth >= self.max_queue:
+                return None
             req.rid = self._next_rid
             self._next_rid += 1
             self._pending.append((req, out_q))
             self._submit_t[req.rid] = (time.monotonic(), None)
-            telemetry.metrics().set_gauge("serve_queue_depth",
-                                          len(self._pending))
+            telemetry.metrics().set_gauge("serve_queue_depth", depth + 1)
+            # Queued acknowledgement BEFORE any engine event can race
+            # it: streaming clients see {"queued": true,
+            # "queue_position": N} as their first line instead of a
+            # silent stall; non-streaming responses carry the position
+            # on the final object.
+            out_q.put({"new": [], "done": False, "queued": True,
+                       "queue_position": depth})
             self._work.notify()
-        return out_q
+        return out_q, depth
 
     def _engine_loop(self):
         while True:
             with self._work:
                 while (not self._stop and not self._pending
-                       and not self.pool.has_active()):
+                       and not self.pool.has_active()
+                       and not self.sched.pending()):
                     self._work.wait()
                 if self._stop:
                     return
-                # Dequeue this round's admissions under the lock; the
-                # admits themselves run OUTSIDE it — ResidentPool.admit
-                # does real device work (prefill + first-bucket compile,
-                # seconds), and /healthz and _submit must not block on
-                # it. Streams register before admit so the failure path
-                # below can always reach the client.
-                to_admit = []
-                planned_blocks = 0
-                while (self._pending
-                       and self.pool.admits(self._pending[0][0],
-                                            extra_slots=len(to_admit),
-                                            extra_blocks=planned_blocks)):
-                    req, out_q = self._pending.pop(0)
+                # Take the handoff under the lock; scheduling itself
+                # runs OUTSIDE it — admission does real device work
+                # (prefill + first-bucket compile, seconds), and
+                # /healthz and _submit must not block on it. Streams
+                # register at handoff — BEFORE the engine touches the
+                # request — so the failure path below can always reach
+                # the client, queued or admitted alike.
+                incoming, self._pending = self._pending, []
+                for req, out_q in incoming:
                     self._streams[req.rid] = out_q
-                    to_admit.append(req)
-                    # FULL footprint, deliberately ignoring prefix-cache
-                    # hits: a hit counted here could be evicted by an
-                    # earlier admission in this same batch before this
-                    # request's admit() runs, so the batched plan
-                    # over-reserves and each admit stays infallible.
-                    planned_blocks += self.pool.blocks_needed(req)
-            # Admission + the round share one failure domain: either
-            # raises for the same reasons (backend error mid-program),
-            # and the engine must survive both.
+            # Submission + admission + the round share one failure
+            # domain: any of them can raise for the same reasons
+            # (backend error mid-program), and the engine must survive
+            # all three. Admission order, overcommit reservation, and
+            # preemption policy all live in the Scheduler.
             try:
-                for req in to_admit:
-                    self.pool.admit(req)
-                    # Paged engines report per-request prefix-cache hits
-                    # at admission; pop keeps the pool-side map bounded.
-                    self._cached_toks[req.rid] = getattr(
-                        self.pool, "request_cached_tokens", {}).pop(
-                            req.rid, 0)
-                events = self.pool.step_round()
+                for req, _ in incoming:
+                    self.sched.submit(req)
+                events = self.sched.step()
+                # Paged engines report per-request prefix-cache hits at
+                # admission (inside the scheduler's round); harvest and
+                # pop to keep the pool-side map bounded.
+                rct = getattr(self.pool, "request_cached_tokens", None)
+                if rct:
+                    for rid in list(rct):
+                        self._cached_toks[rid] = rct.pop(rid)
             except Exception as e:  # noqa: BLE001
                 # The engine must SURVIVE a failed round (a transient
                 # backend error would otherwise kill the thread and
@@ -359,6 +427,11 @@ class IngressServer:
                     self._last_ev_t.clear()
                     self._cached_toks.clear()
                     self.pool.reset()
+                    # Queued requests got their error events above (their
+                    # streams registered at handoff); drop them from the
+                    # waiting queue too, or the engine would replay dead
+                    # requests forever.
+                    self.sched.reset()
                 continue
             now = time.monotonic()
             reg = telemetry.metrics()
@@ -412,7 +485,8 @@ class IngressServer:
                 reg.set_gauge("serve_active_slots",
                               sum(1 for s in self.pool.slots
                                   if s is not None))
-                reg.set_gauge("serve_queue_depth", len(self._pending))
+                reg.set_gauge("serve_queue_depth",
+                              len(self._pending) + self.sched.queue_depth())
                 reg.set_gauge("serve_qps",
                               round(self._qps_window.per_sec(t=now), 3))
                 reg.set_gauge("serve_tokens_per_sec",

@@ -106,9 +106,10 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import heapq
+import math
 import os
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from functools import partial
 
 import jax
@@ -135,6 +136,12 @@ class Request:
     rid: int
     tokens: list  # prompt token ids
     max_new: int  # decode budget
+    # SLO inputs the Scheduler orders its waiting queue by: higher
+    # ``priority`` admits (and survives preemption) first; ``deadline``
+    # (absolute time.monotonic() seconds, None = best-effort) orders
+    # WITHIN a priority class ahead of deadline-less arrivals (EDF).
+    priority: int = 0
+    deadline: float | None = None
 
 
 @dataclasses.dataclass
@@ -144,6 +151,11 @@ class _Slot:
     remaining: int
     generated: list
     row_key: object = None  # per-request PRNG key, fixed at admission
+    # Scheduler victim-selection inputs: preemption evicts the lowest
+    # ``priority`` first, latest ``seq`` (arrival order) within it.
+    priority: int = 0
+    seq: int = 0
+    deadline: float | None = None
 
 
 def _bucket_up(n: int) -> int:
@@ -187,7 +199,7 @@ class _PoolBase:
 
     @staticmethod
     def _check_pool_args(batch_size, temperature, key, draft_params,
-                         draft_cfg, gamma) -> None:
+                         draft_cfg, gamma, spec_lookup=False) -> None:
         """The constructor checks every engine shares (one definition:
         a rule loosened in one pool but not another would let the same
         misconfiguration serve garbage under one engine flag only)."""
@@ -208,8 +220,19 @@ class _PoolBase:
                     "request's tokens would depend on its batch cohort")
             if draft_cfg is None:
                 raise ValueError("draft_params requires draft_cfg")
-            if gamma < 1:
-                raise ValueError(f"gamma must be >= 1, got {gamma}")
+        if spec_lookup:
+            if draft_params is not None:
+                raise ValueError(
+                    "spec_lookup REPLACES the model draft (drafts are "
+                    "copied from the prompt/prior output); drop "
+                    "draft_params or drop spec_lookup")
+            if temperature > 0:
+                raise ValueError(
+                    "spec_lookup serving is greedy-only, like every "
+                    "speculative mode: the verify-commit loop commits "
+                    "target argmaxes")
+        if (draft_params is not None or spec_lookup) and gamma < 1:
+            raise ValueError(f"gamma must be >= 1, got {gamma}")
 
     @staticmethod
     def validate(r: Request, cfg: ModelConfig) -> None:
@@ -257,8 +280,10 @@ class _PoolBase:
         cache slots up to gamma past a row's frontier, so the budget
         must leave that headroom below the cap (shared by the resident
         and paged engines — the replay pool re-prefills, so it never
-        writes past the committed frontier)."""
-        if self.draft_params is not None:
+        writes past the committed frontier). Applies to BOTH draft
+        sources: a model draft and prompt-lookup drafting share the
+        verify chunk's write pattern."""
+        if getattr(self, "_spec", self.draft_params is not None):
             if len(r.tokens) + r.max_new + self.gamma > cfg.max_seq_len:
                 raise ValueError(
                     f"request {r.rid}: prompt + max_new + gamma "
@@ -272,16 +297,36 @@ class _PoolBase:
         return 0
 
     def admits(self, r: Request, *, extra_slots: int = 0,
-               extra_blocks: int = 0) -> bool:
+               extra_blocks: int = 0, reserve_new: int | None = None,
+               preload: list | None = None) -> bool:
         """Whether the pool can take ``r`` right now, with
         ``extra_slots``/``extra_blocks`` already promised to requests
         ahead of it (the ingress batches admissions per engine pass).
-        Capacity only — validate() is the correctness gate."""
+        ``reserve_new``/``preload`` are the Scheduler's overcommit
+        inputs — meaningless for the slot engines, whose capacity is
+        slots, not blocks. Capacity only — validate() is the
+        correctness gate."""
         return self.free_slots() > extra_slots
 
     def _on_retire(self, i: int, s) -> None:
         """Hook invoked by the event fold just before a finished row's
         slot is cleared — the paged engine returns its blocks here."""
+
+    def _record_acceptance(self, counts, rows) -> None:
+        """Draft acceptance accounting shared by both draft sources
+        (model draft and prompt-lookup): ``rows`` are the slot indices
+        that actually decoded this verify round; counts[i] - 1 of each
+        row's gamma proposals were accepted. The cumulative ratio is
+        the serve_spec_accept_rate gauge — the number that says whether
+        a draft source is paying for its verify chunks."""
+        self.stats["draft_accepted"] += sum(
+            min(int(counts[i]) - 1, self.gamma) for i in rows)
+        self.stats["draft_proposed"] += self.gamma * len(rows)
+        if self.stats["draft_proposed"]:
+            telemetry.metrics().set_gauge(
+                "serve_spec_accept_rate",
+                round(self.stats["draft_accepted"]
+                      / self.stats["draft_proposed"], 4))
 
     def free_slots(self) -> int:
         return sum(1 for s in self.slots if s is None)
@@ -362,6 +407,7 @@ class SlotPool(_PoolBase):
         self.key = key
         self.draft_params, self.draft_cfg, self.gamma = (
             draft_params, draft_cfg, gamma)
+        self._spec = draft_params is not None
         # Dummy-row keys by slot, fixed once (domain 0; request keys use
         # domain 1 at admission — disjoint by construction).
         self._dummy_keys = (
@@ -381,16 +427,23 @@ class SlotPool(_PoolBase):
         beyond the slots."""
         self.slots = [None] * self.batch_size
 
-    def admit(self, r: Request) -> None:
+    def admit(self, r: Request, *, reserve_new: int | None = None,
+              preload: list | None = None, seq: int = 0) -> None:
         """Place a validated request in a free slot (raises when full —
-        callers check free_slots; the pool never queues)."""
+        callers check free_slots; the pool never queues). The Scheduler
+        kwargs are inert here: the slot engines neither overcommit
+        (``reserve_new``) nor preempt (``preload`` resumes)."""
+        if preload:
+            raise ValueError("slot engines never preempt, so they have "
+                             "nothing to resume (preload is paged-only)")
         self.validate(r, self.cfg)
         self.slots[self._free_index()] = _Slot(
             rid=r.rid, history=list(r.tokens),
             remaining=r.max_new, generated=[],
             row_key=(jax.random.fold_in(
                 jax.random.fold_in(self.key, 1), r.rid)
-                if self.temperature > 0 else None))
+                if self.temperature > 0 else None),
+            priority=r.priority, seq=seq, deadline=r.deadline)
 
     def _decode_round(self, batch, lens, chunk):
         """One chunk of plain (or sampled) decoding for the whole pool."""
@@ -675,9 +728,13 @@ class ResidentPool(_PoolBase):
                  kv_quant: bool = False, eos_id: int | None = None,
                  temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
                  key=None, draft_params: Params | None = None,
-                 draft_cfg: ModelConfig | None = None, gamma: int = 4):
+                 draft_cfg: ModelConfig | None = None, gamma: int = 4,
+                 spec_lookup: bool | None = None):
+        if spec_lookup is None:
+            spec_lookup = os.environ.get(
+                "TPUBC_SPEC_LOOKUP", "").lower() in ("1", "true")
         self._check_pool_args(batch_size, temperature, key, draft_params,
-                              draft_cfg, gamma)
+                              draft_cfg, gamma, spec_lookup=spec_lookup)
         self.params, self.cfg = params, cfg
         self.batch_size = batch_size
         self.kv_quant = kv_quant
@@ -686,6 +743,11 @@ class ResidentPool(_PoolBase):
         self.key = key
         self.draft_params, self.draft_cfg, self.gamma = (
             draft_params, draft_cfg, gamma)
+        self.spec_lookup = spec_lookup
+        # One flag for "rounds run the verify-commit loop": a model
+        # draft and prompt-lookup drafting share everything downstream
+        # of the draft source (verify, per-row commits, gamma headroom).
+        self._spec = draft_params is not None or spec_lookup
         # Same key-domain discipline as SlotPool: dummy rows draw from
         # slot keys in domain 0, requests from rid keys in domain 1.
         self._dummy_keys = (
@@ -699,9 +761,10 @@ class ResidentPool(_PoolBase):
         self.slots: list = [None] * batch_size
         self.stats = {"rounds": 0, "slot_steps": 0, "active_slot_steps": 0,
                       "prefill_tokens": 0}
-        if draft_params is not None:
+        if self._spec:
             self.stats.update({"verify_rounds": 0, "committed_tokens": 0,
-                               "draft_steps": 0})
+                               "draft_steps": 0, "draft_proposed": 0,
+                               "draft_accepted": 0})
         self._record_stream_gauges()
 
     def validate(self, r: Request, cfg: ModelConfig) -> None:
@@ -723,7 +786,11 @@ class ResidentPool(_PoolBase):
                                       self.cfg.max_seq_len,
                                       quantized=self.kv_quant)
 
-    def admit(self, r: Request) -> None:
+    def admit(self, r: Request, *, reserve_new: int | None = None,
+              preload: list | None = None, seq: int = 0) -> None:
+        if preload:
+            raise ValueError("slot engines never preempt, so they have "
+                             "nothing to resume (preload is paged-only)")
         self.validate(r, self.cfg)
         i = self._free_index()
         w = _bucket_up(len(r.tokens))
@@ -749,7 +816,8 @@ class ResidentPool(_PoolBase):
             remaining=r.max_new, generated=[],
             row_key=(jax.random.fold_in(
                 jax.random.fold_in(self.key, 1), r.rid)
-                if self.temperature > 0 else None))
+                if self.temperature > 0 else None),
+            priority=r.priority, seq=seq, deadline=r.deadline)
 
     def step_round(self) -> dict:
         active = [s for s in self.slots if s is not None]
@@ -761,7 +829,7 @@ class ResidentPool(_PoolBase):
         pos = jnp.asarray(
             [len(s.history) - 1 if s is not None else 0 for s in self.slots],
             jnp.int32)
-        if self.draft_params is not None:
+        if self._spec:
             return self._spec_round(active, last, pos)
         # Majority chunk (not the min): a single near-budget row no
         # longer serializes its cohort into 1-token rounds — it retires
@@ -817,19 +885,29 @@ class ResidentPool(_PoolBase):
         # get their own serve_spec_*_ms histogram, so a bad speedup is
         # attributable to a phase instead of a single opaque round time.
         window = _slice_windows(self.caches, lb)
-        dwindow = _slice_windows(self.dcaches, lb)
         t0 = time.perf_counter()
-        drafts, dwindow = _spec_draft_window(
-            self.draft_params, dwindow, last, pos, self.draft_cfg,
-            self.gamma)
-        drafts = jax.block_until_ready(drafts)
+        if self.draft_params is not None:
+            dwindow = _slice_windows(self.dcaches, lb)
+            drafts, dwindow = _spec_draft_window(
+                self.draft_params, dwindow, last, pos, self.draft_cfg,
+                self.gamma)
+            drafts = jax.block_until_ready(drafts)
+        else:
+            # Prompt-lookup drafting: the draft phase is a host-side
+            # n-gram copy — zero model passes, no draft cache at all.
+            # Dummy rows propose zeros (their commits are discarded).
+            drafts = jnp.asarray(
+                [ngram_lookup_drafts(s.history, self.gamma)
+                 if s is not None else [0] * self.gamma
+                 for s in self.slots], jnp.int32)
         t1 = time.perf_counter()
         greedy, counts, window = _spec_verify_window(
             self.params, window, drafts, last, pos, self.cfg, self.gamma)
         greedy = jax.block_until_ready(greedy)
         t2 = time.perf_counter()
         self.caches = _splice_windows(self.caches, window)
-        self.dcaches = _splice_windows(self.dcaches, dwindow)
+        if self.draft_params is not None:
+            self.dcaches = _splice_windows(self.dcaches, dwindow)
         greedy = np.asarray(greedy)
         counts = np.asarray(counts)
         reg = telemetry.metrics()
@@ -837,7 +915,10 @@ class ResidentPool(_PoolBase):
         reg.observe("serve_spec_verify_ms", (t2 - t1) * 1e3)
         self.stats["rounds"] += 1
         self.stats["verify_rounds"] += 1
-        self.stats["draft_steps"] += self.gamma + 1
+        if self.draft_params is not None:
+            self.stats["draft_steps"] += self.gamma + 1
+        self._record_acceptance(
+            counts, [i for i, s in enumerate(self.slots) if s is not None])
         # Kept = accepted, clamped to each row's budget (the cache
         # overshoot beyond a retiring row's budget is garbage its slot's
         # next occupant overwrites).
@@ -857,6 +938,37 @@ class ResidentPool(_PoolBase):
         reg.observe("serve_spec_commit_ms",
                     (time.perf_counter() - t2) * 1e3)
         return events
+
+
+def ngram_lookup_drafts(history: list, gamma: int, max_n: int = 3) -> list:
+    """Prompt-lookup drafting (the zero-model-pass draft source,
+    ROADMAP item 2b): propose the ``gamma`` tokens that FOLLOWED the
+    most recent earlier occurrence of the history's trailing n-gram —
+    free on the traffic shapes where continuations repeat (shared
+    prefixes, summarization, copy-heavy output), and harmless anywhere
+    else because the verify-commit loop commits the target's own
+    argmaxes regardless of draft quality.
+
+    Longest-match-first (n = max_n down to 1), most recent occurrence
+    wins (recency beats frequency on repetitive output); short
+    continuations pad — and a history with no match falls back to —
+    repeating the last token, an arbitrary-but-cheap guess the verify
+    chunk prices at zero extra model passes. O(len * max_n) per call
+    via a right-to-left scan; serving histories are cap-bounded."""
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    hist = list(history)
+    fallback = [hist[-1]] * gamma if hist else [0] * gamma
+    for n in range(min(max_n, len(hist) - 1), 0, -1):
+        pat = hist[-n:]
+        # Most recent earlier occurrence whose continuation is non-empty:
+        # start at len-n-1 so the match is strictly before the tail and
+        # has at least one following token to propose.
+        for start in range(len(hist) - n - 1, -1, -1):
+            if hist[start:start + n] == pat:
+                cont = hist[start + n:start + n + gamma]
+                return cont + fallback[:gamma - len(cont)]
+    return fallback
 
 
 def block_hash(parent: bytes, tokens) -> bytes:
@@ -1270,9 +1382,13 @@ class PagedPool(_PoolBase):
                  key=None, draft_params: Params | None = None,
                  draft_cfg: ModelConfig | None = None, gamma: int = 4,
                  paged_kernel: bool | None = None,
-                 prefix_cache: bool | None = None):
+                 prefix_cache: bool | None = None,
+                 spec_lookup: bool | None = None):
+        if spec_lookup is None:
+            spec_lookup = os.environ.get(
+                "TPUBC_SPEC_LOOKUP", "").lower() in ("1", "true")
         self._check_pool_args(batch_size, temperature, key, draft_params,
-                              draft_cfg, gamma)
+                              draft_cfg, gamma, spec_lookup=spec_lookup)
         if block_size is None:
             block_size = int(os.environ.get("TPUBC_KV_BLOCK", "64"))
         if block_size < 1:
@@ -1301,6 +1417,8 @@ class PagedPool(_PoolBase):
         self.key = key
         self.draft_params, self.draft_cfg, self.gamma = (
             draft_params, draft_cfg, gamma)
+        self.spec_lookup = spec_lookup
+        self._spec = draft_params is not None or spec_lookup
         if paged_kernel is None:
             # AUTO mirrors decode.generate's kv_kernel rule: the Pallas
             # path needs a quantized pool, a tileable block, and a
@@ -1327,6 +1445,15 @@ class PagedPool(_PoolBase):
             prefix_cache = os.environ.get(
                 "TPUBC_PREFIX_CACHE", "1").lower() not in ("0", "false")
         self.prefix_cache = prefix_cache
+        # Overcommit's decode-chunk cap, set by the Scheduler to its
+        # live expected-generated-length EMA before every round: the
+        # majority rule sizes chunks by remaining BUDGET, so on
+        # early-finishing traffic it provisions (capacity fold) and
+        # computes worst-case chunks for rows expected to retire in a
+        # few tokens — the same divergence overcommit admission
+        # removes. None (the default, and always with overcommit off)
+        # leaves chunks exactly at PR 5's rule.
+        self.chunk_hint: int | None = None
         # rid -> prompt tokens served from cache at admission; the
         # ingress surfaces it per response (and pops it — bounded) and
         # splits its TTFT histograms cached-vs-cold on it.
@@ -1346,40 +1473,69 @@ class PagedPool(_PoolBase):
                        if draft_params is not None else None)
         self.slots: list = [None] * batch_size
         self._pre_rr = 0  # round-robin cursor over prefilling rows
+        # Evict-and-recompute handoff: step_round parks the resume
+        # records of rows it preempted here; the Scheduler drains them
+        # back into its waiting queue after every step/preempt call.
+        self.preempted: list = []
         self.stats = {"rounds": 0, "slot_steps": 0, "active_slot_steps": 0,
                       "prefill_tokens": 0, "prefill_chunks": 0,
                       "blocks_total": kv_blocks, "blocks_peak": 0,
                       "defrags": 0, "prompt_tokens": 0,
                       "prefix_hit_tokens": 0, "prefix_hit_requests": 0,
-                      "cow_copies": 0}
-        if draft_params is not None:
+                      "cow_copies": 0, "preemptions": 0, "grown_blocks": 0}
+        if self._spec:
             self.stats.update({"verify_rounds": 0, "committed_tokens": 0,
-                               "draft_steps": 0})
+                               "draft_steps": 0, "draft_proposed": 0,
+                               "draft_accepted": 0})
         self._record_stream_gauges()
         self._record_block_gauges()
 
     # ---- capacity ---------------------------------------------------------
 
-    def blocks_needed(self, r: Request) -> int:
-        over = self.gamma if self.draft_params is not None else 0
-        return -(-(len(r.tokens) + r.max_new + over) // self.block_size)
+    def _over(self) -> int:
+        """Speculative rounds (model draft OR prompt-lookup) write up
+        to gamma positions past the frontier — every capacity
+        computation must cover the overshoot."""
+        return self.gamma if self._spec else 0
 
-    def _prefix_plan(self, r: Request):
-        """Longest cached full-block chain covering ``r``'s prompt:
+    def blocks_needed(self, r: Request) -> int:
+        """KV blocks the request's WHOLE footprint reserves — the PR 5
+        refusal-admission semantics (and still the conservative number
+        the ingress batches plans with). The Scheduler's overcommit
+        path reserves the expected footprint instead (``reserve_new``)
+        and grows lazily through step_round's capacity fold."""
+        return -(-(len(r.tokens) + r.max_new + self._over())
+                 // self.block_size)
+
+    def _reserve_blocks(self, history_len: int, remaining: int,
+                        reserve_new: int | None) -> int:
+        """Blocks admission reserves NOW: the whole history (its KV
+        must exist before the row decodes) plus the reserved slice of
+        the decode budget — all of it under refusal admission
+        (reserve_new None, PR 5 parity), the Scheduler's expected-
+        footprint estimate under overcommit (never less than one token,
+        never more than the budget) — plus the speculative overshoot."""
+        new = (remaining if reserve_new is None
+               else max(1, min(remaining, reserve_new)))
+        return -(-(history_len + new + self._over()) // self.block_size)
+
+    def _prefix_plan(self, tokens: list):
+        """Longest cached full-block chain covering ``tokens`` (a
+        prompt — or, resuming a preempted row, prompt + generated):
         returns (shared block ids, cow source id or None, chain key of
         the shared prefix). Shared blocks must sit strictly below the
-        row's first write position (the last prompt token, re-fed at
-        decode) — the one matched block that would contain it is
-        returned as the COW source instead, to be privately copied.
-        Read-only: refcounts move in admit()."""
+        row's first write position (the last token, re-fed at decode) —
+        the one matched block that would contain it is returned as the
+        COW source instead, to be privately copied. Read-only:
+        refcounts move in admit()."""
         if not self.prefix_cache:
             return [], None, b""
         bs = self.block_size
-        prompt_len = len(r.tokens)
+        prompt_len = len(tokens)
         key = b""
         hits = []  # (block id, chain key through this block)
         for j in range(prompt_len // bs):
-            key = block_hash(key, r.tokens[j * bs:(j + 1) * bs])
+            key = block_hash(key, tokens[j * bs:(j + 1) * bs])
             bid = self.allocator.lookup(key)
             if bid is None:
                 break
@@ -1390,10 +1546,13 @@ class PagedPool(_PoolBase):
         return [b for b, _ in hits[:n_sh]], cow, chain
 
     def admits(self, r: Request, *, extra_slots: int = 0,
-               extra_blocks: int = 0) -> bool:
+               extra_blocks: int = 0, reserve_new: int | None = None,
+               preload: list | None = None) -> bool:
         if self.free_slots() <= extra_slots:
             return False
-        shared, cow, _ = self._prefix_plan(r)
+        history = list(r.tokens) + list(preload or [])
+        remaining = r.max_new - len(preload or [])
+        shared, cow, _ = self._prefix_plan(history)
         # Cache-aware capacity math: shared blocks cost nothing fresh,
         # but a hit on a CACHED block revives it out of the reclaimable
         # set, so it must be debited from available() alongside the
@@ -1403,7 +1562,8 @@ class PagedPool(_PoolBase):
         if cow is not None and self.allocator.is_cached(cow):
             pinned += 1
         return (self.allocator.available() - extra_blocks - pinned
-                >= self.blocks_needed(r) - len(shared))
+                >= self._reserve_blocks(len(history), remaining,
+                                        reserve_new) - len(shared))
 
     def validate(self, r: Request, cfg: ModelConfig) -> None:
         _PoolBase.validate(r, cfg)
@@ -1429,6 +1589,7 @@ class PagedPool(_PoolBase):
         content the rebuilt (zeroed) arrays no longer hold."""
         self.slots = [None] * self.batch_size
         self.request_cached_tokens.clear()
+        self.preempted.clear()
         self.allocator = BlockAllocator(self.allocator.num_blocks,
                                         self.block_size)
         self.pools = init_paged_cache(self.cfg,
@@ -1503,7 +1664,8 @@ class PagedPool(_PoolBase):
 
     # ---- admission --------------------------------------------------------
 
-    def admit(self, r: Request) -> None:
+    def admit(self, r: Request, *, reserve_new: int | None = None,
+              preload: list | None = None, seq: int = 0) -> None:
         """Reserve the request's block footprint and enqueue its prompt.
         With prefix caching, the longest cached chain over the prompt is
         refcount-shared into the new table first: covered tokens skip
@@ -1512,15 +1674,29 @@ class PagedPool(_PoolBase):
         shared-prefix traffic. The only device work here is the
         occasional copy-on-write block duplicate (one block copy; the
         chunked prefill itself still rides the coming rounds), so
-        admission still never stalls live streams."""
+        admission still never stalls live streams.
+
+        ``reserve_new`` (the Scheduler's overcommit lever): reserve
+        blocks for only this many decode tokens now — whole-budget
+        reservation (None) is the PR 5 refusal semantics; anything less
+        relies on step_round's capacity fold to grow the table lazily
+        and preempt under pressure. ``preload`` resumes a PREEMPTED
+        request: tokens it had already generated rejoin the history (so
+        the re-prefill walks prompt + generated through the prefix
+        cache — mostly hits when its blocks were registered at
+        eviction) and the stream continues byte-identically, because KV
+        is a pure function of (token, position) and sampled draws key
+        off (rid, stream position), never scheduling."""
         self.validate(r, self.cfg)
         i = self._free_index()
-        if not self.admits(r):
+        if not self.admits(r, reserve_new=reserve_new, preload=preload):
             raise RuntimeError(
-                f"request {r.rid}: pool has a free slot but not "
-                f"{self.blocks_needed(r)} free KV blocks (callers check "
-                "admits() before admit — refusal, not corruption)")
-        shared, cow, chain = self._prefix_plan(r)
+                f"request {r.rid}: pool has a free slot but not enough "
+                "free KV blocks (callers check admits() before admit — "
+                "refusal, not corruption)")
+        history = list(r.tokens) + list(preload or [])
+        remaining = r.max_new - len(preload or [])
+        shared, cow, chain = self._prefix_plan(history)
         for b in shared:
             self.allocator.incref(b)
         if cow is not None:
@@ -1528,9 +1704,11 @@ class PagedPool(_PoolBase):
             # be sitting in the cached LRU set, and the alloc's eviction
             # pass must not reclaim it before the copy reads it.
             self.allocator.incref(cow)
-        fresh = self.allocator.alloc(self.blocks_needed(r) - len(shared))
+        fresh = self.allocator.alloc(
+            self._reserve_blocks(len(history), remaining, reserve_new)
+            - len(shared))
         blocks = list(shared) + fresh
-        prompt_len = len(r.tokens)
+        prompt_len = len(history)
         hit_tokens = len(shared) * self.block_size
         if cow is not None:
             dest = fresh[0]
@@ -1547,18 +1725,120 @@ class PagedPool(_PoolBase):
         if hit_tokens:
             self.stats["prefix_hit_requests"] += 1
             telemetry.metrics().inc("kv_prefix_hit_tokens_total", hit_tokens)
-        self.request_cached_tokens[r.rid] = hit_tokens
+        if preload is None:
+            # Resumes never touch the ingress-facing map: the client's
+            # cached_tokens answer describes its ORIGINAL admission.
+            self.request_cached_tokens[r.rid] = hit_tokens
         self.slots[i] = _PagedSlot(
-            rid=r.rid, history=list(r.tokens),
-            remaining=r.max_new, generated=[],
+            rid=r.rid, history=history,
+            remaining=remaining, generated=list(preload or []),
             row_key=(jax.random.fold_in(
                 jax.random.fold_in(self.key, 1), r.rid)
                 if self.temperature > 0 else None),
+            priority=r.priority, seq=seq, deadline=r.deadline,
             prompt_len=prompt_len, prefilled=hit_tokens,
             admit_round=self.stats["rounds"], blocks=blocks,
             n_shared=len(shared), registered=len(shared), chain_key=chain,
             cached_tokens=hit_tokens)
         self._record_block_gauges()
+
+    # ---- overcommit: preemption + lazy growth -----------------------------
+
+    def _preempt(self, i: int) -> dict:
+        """vLLM-style evict-and-recompute: register the victim's full
+        blocks first (so the recompute is mostly prefix-cache hits),
+        DECREF its whole table, clear the slot, and park a resume
+        record for the Scheduler to re-enqueue at the front of the
+        victim's priority class. Nothing is lost but work: the resumed
+        row re-prefills prompt + generated-so-far (cache-served where
+        registered) and its stream continues byte-identically — KV is a
+        pure function of (token, position), and sampled draws key off
+        (rid, stream position), never scheduling."""
+        s = self.slots[i]
+        if self.prefix_cache:
+            self._register_full(s)
+        self.allocator.free(s.blocks)
+        s.blocks = []
+        self.slots[i] = None
+        self.stats["preemptions"] += 1
+        telemetry.metrics().inc("serve_preempt_total")
+        prompt = s.history[:len(s.history) - len(s.generated)]
+        rec = {"request": Request(rid=s.rid, tokens=prompt,
+                                  max_new=len(s.generated) + s.remaining,
+                                  priority=s.priority, deadline=s.deadline),
+               "preload": list(s.generated), "seq": s.seq}
+        self.preempted.append(rec)
+        self._record_block_gauges()
+        return rec
+
+    def preempt_one(self, below: int | None = None) -> dict | None:
+        """Evict ONE row by the victim policy — lowest priority first,
+        then decode-phase rows before still-prefilling ones, latest
+        arrival within that — optionally restricted to priorities
+        strictly below ``below`` (the Scheduler's priority-admission
+        preemption, which must never evict a peer of the request it is
+        making room for). None when no row qualifies. Prefilling rows
+        are spared because they have produced nothing a client can see:
+        evicting one converts its admission into pure queue-wait (its
+        TTFT clock keeps running), while a decode-phase victim has
+        already emitted its first token and resumes with most of its
+        KV prefix-cache-served."""
+        cands = [(s.priority, self._prefilling(s), -s.seq, i)
+                 for i, s in enumerate(self.slots) if s is not None]
+        if below is not None:
+            cands = [c for c in cands if c[0] < below]
+        if not cands:
+            return None
+        return self._preempt(min(cands)[3])
+
+    def imminent_growth(self, horizon: int | None = None) -> int:
+        """Blocks the ACTIVE set will need within the next ``horizon``
+        decode tokens — the Scheduler's admission watermark. Admitting
+        new work into space running rows are about to grow into just
+        converts the admission into a preemption (thrash: the capacity
+        fold evicts at the next dispatch), so overcommit admission
+        keeps this many blocks free. Rows whose reservation already
+        covers the horizon (still-prefilling rows, whole-footprint
+        rows) contribute zero, so with overcommit off this is always 0
+        and parity holds."""
+        if horizon is None:
+            horizon = self.block_size
+        need = 0
+        for s in self.slots:
+            if s is None:
+                continue
+            short = (len(s.history) + min(horizon, s.remaining)
+                     - len(s.blocks) * self.block_size)
+            if short > 0:
+                need += -(-short // self.block_size)
+        return need
+
+    def _capacity_fold(self, dec: list, tokens_of) -> list:
+        """Overcommit's mid-flight allocation seam, run before every
+        decode/verify dispatch: grow each participating row's table to
+        cover ``tokens_of(s)`` positions (what this round will write),
+        evicting rows by the victim policy while the pool cannot cover
+        the deficit — pressure resolves by preemption, NEVER by letting
+        a scatter land in an unowned block. Returns the surviving
+        decode set. Progress is guaranteed: validate() caps any single
+        row's full footprint at the pool size, so once every other row
+        is evicted the remainder always fits. Under whole-footprint
+        reservation (overcommit off) every row already owns its blocks
+        and this is a no-op."""
+        while dec:
+            need = {id(s): max(0, -(-tokens_of(s) // self.block_size)
+                               - len(s.blocks))
+                    for s in dec}
+            if sum(need.values()) <= self.allocator.available():
+                for s in dec:
+                    if need[id(s)]:
+                        s.blocks += self.allocator.alloc(need[id(s)])
+                        self.stats["grown_blocks"] += need[id(s)]
+                break
+            self.preempt_one()
+            alive = {id(s) for s in self.slots if s is not None}
+            dec = [s for s in dec if id(s) in alive]
+        return dec
 
     # ---- rounds -----------------------------------------------------------
 
@@ -1635,10 +1915,36 @@ class PagedPool(_PoolBase):
         dec = [s for s in self.slots
                if s is not None and not self._prefilling(s)
                and s.remaining > 0]
+        # Overcommit capacity fold BEFORE any device arrays are built:
+        # every row entering the dispatch must own blocks covering the
+        # positions this round KEEPS — capped at the row's remaining
+        # budget, because writes past it (majority-chunk overshoot) are
+        # discarded by the event fold and deliberately land in the null
+        # block, exactly as under PR 5's whole-footprint reservation.
+        # Under pressure the fold evicts by the victim policy instead.
+        chunk = 0
+        if dec and self._spec:
+            dec = self._capacity_fold(
+                dec, lambda s: len(s.history) + min(self.gamma + 1,
+                                                    s.remaining))
+        elif dec:
+            chunk = _majority_chunk(dec, self.cfg.max_seq_len)
+            if any(self._prefilling(s)
+                   for s in self.slots if s is not None):
+                # Pending prompts: keep decode rounds short so prefill
+                # chunks interleave at budget cadence — the TTFT bound.
+                chunk = min(chunk, _bucket_down(self.prefill_budget))
+            if self.chunk_hint is not None:
+                # Overcommit: chunks follow expectation, not worst-case
+                # budget — bounds each round's capacity-fold growth to
+                # roughly the EMA instead of the whole remaining budget.
+                chunk = min(chunk, _bucket_down(max(1, self.chunk_hint)))
+            dec = self._capacity_fold(
+                dec, lambda s: len(s.history) + min(chunk, s.remaining) - 1)
         if not dec:
             self._register_phase()  # prefill chunks fill blocks too
             self._record_block_gauges()
-            return {}  # an all-prefill round emits no tokens
+            return {}  # an all-prefill (or all-preempted) round
         decoding = {id(s) for s in dec}
         last = jnp.asarray(
             [s.history[-1] if (s is not None and id(s) in decoding) else 0
@@ -1646,13 +1952,8 @@ class PagedPool(_PoolBase):
         pos = jnp.asarray(
             [len(s.history) - 1 if (s is not None and id(s) in decoding)
              else 0 for s in self.slots], jnp.int32)
-        if self.draft_params is not None:
+        if self._spec:
             return self._spec_round(dec, last, pos)
-        chunk = _majority_chunk(dec, self.cfg.max_seq_len)
-        if any(self._prefilling(s) for s in active):
-            # Pending prompts: keep decode rounds short so prefill
-            # chunks interleave at budget cadence — the TTFT bound.
-            chunk = min(chunk, _bucket_down(self.prefill_budget))
         nb = self._bucket_blocks(max(
             -(-(len(s.history) + chunk - 1) // self.block_size)
             for s in dec))
@@ -1698,27 +1999,42 @@ class PagedPool(_PoolBase):
             for s in dec))
         bt = self._table(nb, rows=dec)
         window = _gather_windows_jit(self.pools, bt)
-        dwindow = _gather_windows_jit(self.dpools, bt)
+        decoding = {id(s) for s in dec}
         t0 = time.perf_counter()
-        drafts, dwindow = _spec_draft_window(
-            self.draft_params, dwindow, last, pos, self.draft_cfg,
-            self.gamma)
-        drafts = jax.block_until_ready(drafts)
+        if self.draft_params is not None:
+            dwindow = _gather_windows_jit(self.dpools, bt)
+            drafts, dwindow = _spec_draft_window(
+                self.draft_params, dwindow, last, pos, self.draft_cfg,
+                self.gamma)
+            drafts = jax.block_until_ready(drafts)
+        else:
+            # Prompt-lookup drafting: host-side n-gram copy, zero model
+            # passes, no draft pool (non-decoding rows propose zeros —
+            # their commits are discarded by the count mask below).
+            drafts = jnp.asarray(
+                [ngram_lookup_drafts(s.history, self.gamma)
+                 if (s is not None and id(s) in decoding)
+                 else [0] * self.gamma
+                 for s in self.slots], jnp.int32)
         t1 = time.perf_counter()
         greedy, counts, window = _spec_verify_window(
             self.params, window, drafts, last, pos, self.cfg, self.gamma)
         greedy = jax.block_until_ready(greedy)
         t2 = time.perf_counter()
         self.pools = _scatter_windows_jit(self.pools, window, bt)
-        self.dpools = _scatter_windows_jit(self.dpools, dwindow, bt)
+        if self.draft_params is not None:
+            self.dpools = _scatter_windows_jit(self.dpools, dwindow, bt)
         greedy = np.asarray(greedy)
         counts = np.asarray(counts)
         reg = telemetry.metrics()
         reg.observe("serve_spec_draft_ms", (t1 - t0) * 1e3)
         reg.observe("serve_spec_verify_ms", (t2 - t1) * 1e3)
         self.stats["verify_rounds"] += 1
-        self.stats["draft_steps"] += self.gamma + 1
-        decoding = {id(s) for s in dec}
+        if self.draft_params is not None:
+            self.stats["draft_steps"] += self.gamma + 1
+        self._record_acceptance(
+            counts, [i for i, s in enumerate(self.slots)
+                     if s is not None and id(s) in decoding])
         kept = [min(int(counts[i]), s.remaining)
                 if (s is not None and id(s) in decoding) else 0
                 for i, s in enumerate(self.slots)]
@@ -1783,6 +2099,193 @@ class PagedPool(_PoolBase):
         return moved
 
 
+class Scheduler:
+    """ONE admission/queueing/preemption policy object for every
+    serving engine — the seam factored out of PagedPool's ad-hoc
+    admission and the serve()/ingress admit loops (ROADMAP item 1), and
+    the place spec drafting and fleet routing plug into next.
+
+    * WAITING QUEUE with SLO-aware ordering: requests queue instead of
+      being refused, ordered by priority class (higher
+      ``Request.priority`` first), then deadline (EDF — deadline-less
+      arrivals sort after every explicit deadline in their class), then
+      arrival. Head-of-line blocking within that order stays deliberate
+      (PR 4's rule): a small request must not starve a big one forever.
+    * OVERCOMMIT (paged engine only; ``TPUBC_OVERCOMMIT=0`` disables):
+      admission reserves the EXPECTED footprint — prompt blocks plus an
+      EMA of observed generated lengths (``TPUBC_EXPECTED_NEW`` seeds
+      the estimate before any retirement has been observed) — instead
+      of the whole worst-case ``max_new`` footprint. Most requests
+      finish far short of their budget (PAPERS.md's vLLM divergence),
+      so expected-footprint admission raises concurrency at equal KV
+      memory; the pool's capacity fold grows tables lazily and PREEMPTS
+      (evict-and-recompute) under pressure, so overcommit can never
+      corrupt a live row — pressure resolves by policy, not OOM. With
+      overcommit off, reservation is the whole footprint and admission
+      is EXACTLY the PR 5 refusal semantics (parity-pinned).
+    * PRIORITY PREEMPTION at admission: when the queue head outranks
+      running work and capacity alone cannot seat it, strictly
+      lower-priority rows are evicted (latest arrival first) until the
+      head fits — a priority inversion never outlives the round
+      boundary it is discovered at.
+    * Preempted rows re-enqueue under their ORIGINAL (priority,
+      deadline, arrival) key — ahead of everything that arrived after
+      them in their class — and resume byte-identically: eviction
+      decrefs through the prefix cache, so the re-prefill is mostly
+      cache hits on shared-prefix traffic.
+
+    Drive it with submit() + step(); serve() and the ingress engine
+    loop are both thin shells around that pair."""
+
+    def __init__(self, pool, *, overcommit: bool | None = None,
+                 expected_new: int | None = None, ema_alpha: float = 0.25):
+        self.pool = pool
+        if overcommit is None:
+            overcommit = os.environ.get(
+                "TPUBC_OVERCOMMIT", "1").lower() not in ("0", "false")
+        # Only the paged engine can overcommit: slot engines have no
+        # block pool to grow into and nothing to preempt for.
+        self.overcommit = bool(overcommit) and hasattr(pool, "allocator")
+        if expected_new is None:
+            expected_new = int(os.environ.get("TPUBC_EXPECTED_NEW", "16"))
+        if expected_new < 1:
+            raise ValueError(f"expected_new must be >= 1, "
+                             f"got {expected_new}")
+        if not 0 < ema_alpha <= 1:
+            raise ValueError(f"ema_alpha must be in (0, 1], got {ema_alpha}")
+        self._ema = float(expected_new)
+        self._alpha = ema_alpha
+        # Heap entries (-priority, deadline-or-inf, seq, Request,
+        # preload): seq is unique, so Request never enters a comparison.
+        self._waiting: list = []
+        self._seq = 0
+        self._qstart: dict = {}  # rid -> monotonic submit time
+        self._waits = deque(maxlen=512)  # recent queue waits (ms)
+        self.stats = {"submitted": 0, "admitted": 0, "requeues": 0,
+                      "retired": 0}
+
+    # ---- queue ------------------------------------------------------------
+
+    def expected_new(self, r: Request,
+                     preload: list | None = None) -> int | None:
+        """Decode tokens admission reserves blocks for NOW: None = the
+        pool's whole-budget reservation (overcommit off), else the EMA
+        estimate clamped into [1, remaining budget]."""
+        if not self.overcommit:
+            return None
+        rem = r.max_new - len(preload or [])
+        return max(1, min(rem, math.ceil(self._ema)))
+
+    def submit(self, r: Request) -> None:
+        """Validate loudly (a never-fits request is still a front-door
+        error, not a queue entry) and enqueue; admission happens at the
+        next step()'s round boundary."""
+        self.pool.validate(r, self.pool.cfg)
+        self._push(r, None, self._seq)
+        self._seq += 1
+        self.stats["submitted"] += 1
+        self._qstart[r.rid] = time.monotonic()
+        self._record_gauges()
+
+    def _push(self, r: Request, preload, seq: int) -> None:
+        heapq.heappush(self._waiting, (
+            -r.priority,
+            r.deadline if r.deadline is not None else float("inf"),
+            seq, r, preload))
+
+    def _drain_preempted(self) -> None:
+        """Re-enqueue every row the pool evicted since the last drain,
+        each under its original key — the front of its class relative
+        to later arrivals."""
+        for rec in getattr(self.pool, "preempted", ()):
+            self._push(rec["request"], rec["preload"], rec["seq"])
+            self.stats["requeues"] += 1
+        if getattr(self.pool, "preempted", None):
+            self.pool.preempted.clear()
+
+    def queue_depth(self) -> int:
+        return len(self._waiting)
+
+    def pending(self) -> bool:
+        return bool(self._waiting)
+
+    def queue_wait_p50_ms(self) -> float:
+        w = sorted(self._waits)
+        return w[len(w) // 2] if w else 0.0
+
+    # ---- rounds -----------------------------------------------------------
+
+    def _admit_phase(self) -> None:
+        while self._waiting:
+            negp, _dl, seq, r, preload = self._waiting[0]
+            reserve = self.expected_new(r, preload)
+            # Admission watermark (overcommit only): keep the blocks
+            # the running set will grow into within the next
+            # block-size tokens free — admitting into them would just
+            # turn this admission into the next dispatch's preemption.
+            extra = (self.pool.imminent_growth() if self.overcommit
+                     else 0)
+            if self.pool.admits(r, reserve_new=reserve, preload=preload,
+                                extra_blocks=extra):
+                heapq.heappop(self._waiting)
+                self.pool.admit(r, reserve_new=reserve, preload=preload,
+                                seq=seq)
+                if preload is None:
+                    self.stats["admitted"] += 1
+                t0 = self._qstart.pop(r.rid, None)
+                if t0 is not None:
+                    wait_ms = (time.monotonic() - t0) * 1e3
+                    self._waits.append(wait_ms)
+                    telemetry.metrics().observe("serve_queue_wait_ms",
+                                                wait_ms)
+                continue
+            # Priority-admission preemption: the head outranks running
+            # rows capacity alone cannot displace. Strictly-below only —
+            # evicting a peer would thrash FIFO order within a class.
+            if (self.overcommit
+                    and self.pool.preempt_one(below=-negp) is not None):
+                self._drain_preempted()
+                continue
+            break
+        self._record_gauges()
+
+    def step(self) -> dict:
+        """One scheduling round: admit (preempting for priority), run
+        the pool's round, drain evict-and-recompute records back into
+        the queue, and fold retirements into the expected-length EMA."""
+        self._admit_phase()
+        if self.overcommit:
+            # Decode chunks follow the same expectation admission
+            # reserves by (see PagedPool.chunk_hint).
+            self.pool.chunk_hint = max(1, math.ceil(self._ema))
+        events = self.pool.step_round()
+        self._drain_preempted()
+        for ev in events.values():
+            if ev["done"]:
+                self.stats["retired"] += 1
+                self._ema += self._alpha * (len(ev["generated"]) - self._ema)
+        self._record_gauges()
+        return events
+
+    def reset(self) -> None:
+        """Drop every queued request (the ingress failed-round recovery
+        — queued clients received their error events alongside the
+        in-flight ones; resetting the pool itself is the caller's
+        job). The length EMA survives: it describes traffic, not the
+        failed round."""
+        self._waiting.clear()
+        self._qstart.clear()
+
+    def _record_gauges(self) -> None:
+        telemetry.record_scheduler(
+            queue_depth=len(self._waiting),
+            expected_new=self._ema,
+            submitted=self.stats["submitted"],
+            admitted=self.stats["admitted"],
+            preemptions=getattr(self.pool, "stats",
+                                {}).get("preemptions", 0))
+
+
 def serve(params: Params, cfg: ModelConfig, requests: list,
           batch_size: int, *, kv_quant: bool = False,
           eos_id: int | None = None, temperature: float = 0.0,
@@ -1792,7 +2295,9 @@ def serve(params: Params, cfg: ModelConfig, requests: list,
           resident: bool = False, paged: bool = False,
           kv_blocks: int | None = None, block_size: int | None = None,
           prefill_budget: int | None = None,
-          prefix_cache: bool | None = None) -> dict:
+          prefix_cache: bool | None = None,
+          overcommit: bool | None = None,
+          spec_lookup: bool | None = None) -> dict:
     """Run every request through a ``batch_size``-slot continuously
     batched pool; returns {rid: generated token list}. ``eos_id``
     finishes a row at the first emission of that token (inclusive) —
@@ -1818,8 +2323,19 @@ def serve(params: Params, cfg: ModelConfig, requests: list,
     ``prefill_budget``/``prefix_cache`` forwarded to PagedPool, stats
     gaining prefill_tokens/prefill_chunks/blocks_total/blocks_peak plus
     the prefix-cache accounting prompt_tokens/prefix_hit_tokens/
-    prefix_hit_requests/cow_copies), with queued requests held FIFO
-    until the head's uncovered block footprint fits."""
+    prefix_hit_requests/cow_copies plus preemptions/grown_blocks).
+
+    Queueing and admission policy live in the ``Scheduler``: requests
+    queue ordered by (priority class, deadline, arrival); on the paged
+    engine admission OVERCOMMITS by default (expected footprint, not
+    worst case — ``overcommit=False`` / ``TPUBC_OVERCOMMIT=0`` restores
+    the PR 5 whole-footprint refusal semantics exactly) and block-pool
+    pressure resolves by evict-and-recompute preemption, never
+    corruption. ``spec_lookup=True`` (``TPUBC_SPEC_LOOKUP=1``) turns on
+    prompt-lookup drafting on the resident/paged engines — the
+    verify-commit loop with n-gram-copied drafts instead of a draft
+    model, zero extra model passes. ``stats`` additionally gains a
+    ``"scheduler"`` sub-dict (submitted/admitted/requeues/retired)."""
     from tpu_bootstrap import telemetry
 
     if len({r.rid for r in requests}) != len(requests):
@@ -1838,7 +2354,8 @@ def serve(params: Params, cfg: ModelConfig, requests: list,
                          eos_id=eos_id, temperature=temperature,
                          top_k=top_k, top_p=top_p, key=key,
                          draft_params=draft_params, draft_cfg=draft_cfg,
-                         gamma=gamma, prefix_cache=prefix_cache)
+                         gamma=gamma, prefix_cache=prefix_cache,
+                         spec_lookup=spec_lookup)
     elif resident:
         # resident=True swaps the replay pool for the resident-cache
         # engine: no per-round history replay, per-row frontiers.
@@ -1849,43 +2366,45 @@ def serve(params: Params, cfg: ModelConfig, requests: list,
                             eos_id=eos_id, temperature=temperature,
                             top_k=top_k, top_p=top_p, key=key,
                             draft_params=draft_params, draft_cfg=draft_cfg,
-                            gamma=gamma)
+                            gamma=gamma, spec_lookup=spec_lookup)
     else:
+        if spec_lookup:
+            raise ValueError(
+                "spec_lookup rides the resident/paged engines' split "
+                "draft/verify seam; the replay pool has no per-row "
+                "frontier to verify from")
         pool = SlotPool(params, cfg, batch_size, kv_quant=kv_quant,
                         eos_id=eos_id, temperature=temperature, top_k=top_k,
                         top_p=top_p, key=key, draft_params=draft_params,
                         draft_cfg=draft_cfg, gamma=gamma)
     for r in requests:
         pool.validate(r, cfg)  # ALL requests fail loudly before any compute
-    queue = list(requests)
+    sched = Scheduler(pool, overcommit=overcommit)
     done: dict = {}
-    admitted_us: dict = {}
-    # One span per batch plus one per request (admission -> retirement):
-    # the serving-side leg of the merged timeline. Request spans are
+    submitted_us: dict = {}
+    # One span per batch plus one per request (submit -> retirement,
+    # queue wait included — the latency a client actually sees): the
+    # serving-side leg of the merged timeline. Request spans are
     # recorded retroactively at retirement — the scheduler, not a with-
     # block, owns a request's lifetime.
     with telemetry.span("serve.batch", requests=len(requests),
                         batch_size=batch_size) as batch_span:
-        while queue or pool.has_active():
-            # Admission: FIFO while the pool can take the head request
-            # (a free slot — and, on the paged engine, the head's whole
-            # block footprint; head-of-line blocking is deliberate, a
-            # smaller request must not starve a big one forever).
-            while queue and pool.admits(queue[0]):
-                r = queue.pop(0)
-                admitted_us[r.rid] = telemetry.now_us()
-                pool.admit(r)
-            for rid, ev in pool.step_round().items():
+        for r in requests:
+            submitted_us[r.rid] = telemetry.now_us()
+            sched.submit(r)
+        while sched.pending() or pool.has_active():
+            for rid, ev in sched.step().items():
                 if ev["done"]:
                     done[rid] = ev["generated"]
                     telemetry.tracer().add_span(
-                        "serve.request", admitted_us[rid],
-                        telemetry.now_us() - admitted_us[rid],
+                        "serve.request", submitted_us[rid],
+                        telemetry.now_us() - submitted_us[rid],
                         trace_id=batch_span.trace_id,
                         parent_id=batch_span.span_id,
                         rid=rid, tokens=len(ev["generated"]))
     if stats is not None:
         stats.update(pool.stats)
+        stats["scheduler"] = dict(sched.stats)
     return done
 
 
@@ -2037,4 +2556,5 @@ def static_schedule_slot_steps(requests: list, batch_size: int) -> int:
 
 
 __all__ = ["BlockAllocator", "PagedPool", "Request", "ResidentPool",
-           "SlotPool", "block_hash", "serve", "static_schedule_slot_steps"]
+           "Scheduler", "SlotPool", "block_hash", "ngram_lookup_drafts",
+           "serve", "static_schedule_slot_steps"]
