@@ -322,15 +322,39 @@ class _Histogram:
         return self.counts[-1]
 
 
+def _label_key(name: str, labels) -> str:
+    """Internal storage key for a labeled series: the Prometheus-style
+    ``name{k="v",...}`` rendering (keys sorted — one label set, one
+    series). Unlabeled series keep the bare name, so every pre-existing
+    metric is byte-identical on both expositions."""
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+def _split_labels(key: str) -> tuple:
+    """Inverse of _label_key at render time: (family, label-string)."""
+    if key.endswith("}") and "{" in key:
+        family, rest = key.split("{", 1)
+        return family, rest[:-1]
+    return key, ""
+
+
 class MetricsRegistry:
-    """Named counters/gauges + fixed-bucket histograms (thread-safe)."""
+    """Named counters/gauges + fixed-bucket histograms (thread-safe).
+    ``labels`` on inc/observe records a per-label-set series (e.g. the
+    per-priority-class TTFT split) rendered with proper Prometheus
+    labels in the text exposition and as ``name{k="v"}``-keyed entries
+    in the JSON one."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._values: dict = {}      # counters and gauges share one map
         self._histograms: dict = {}
 
-    def inc(self, name: str, delta=1) -> None:
+    def inc(self, name: str, delta=1, labels=None) -> None:
+        name = _label_key(name, labels)
         with self._lock:
             self._values[name] = self._values.get(name, 0) + delta
 
@@ -338,9 +362,11 @@ class MetricsRegistry:
         with self._lock:
             self._values[name] = value
 
-    def observe(self, name: str, value: float, buckets=None) -> None:
+    def observe(self, name: str, value: float, buckets=None,
+                labels=None) -> None:
         """Record one observation; ``buckets`` fixes the bounds on the
         histogram's FIRST observation (later calls reuse them)."""
+        name = _label_key(name, labels)
         with self._lock:
             h = self._histograms.get(name)
             if h is None:
@@ -374,27 +400,41 @@ class MetricsRegistry:
     def to_prometheus(self) -> str:
         """Text exposition format: *_total render as counters, everything
         else as gauges; histograms get cumulative _bucket{le=...} series
-        (native to_prometheus parity)."""
+        (native to_prometheus parity). Labeled series render with real
+        Prometheus labels, grouped per family (all label sets of one
+        family stay contiguous, one TYPE line each — the format's
+        grouping rule)."""
         with self._lock:
             lines = []
-            for name in sorted(self._values):
-                counter = name.endswith("_total")
-                family = name[:-6] if counter else name
-                lines.append(f"# TYPE {family} "
-                             f"{'counter' if counter else 'gauge'}")
-                v = self._values[name]
-                lines.append(f"{name} {v:g}" if isinstance(v, float)
-                             else f"{name} {v}")
-            for name in sorted(self._histograms):
-                h = self._histograms[name]
-                lines.append(f"# TYPE {name} histogram")
+            typed = set()
+
+            def emit_type(family: str, kind: str) -> None:
+                if family not in typed:
+                    typed.add(family)
+                    lines.append(f"# TYPE {family} {kind}")
+
+            for key in sorted(self._values, key=_split_labels):
+                family, labels = _split_labels(key)
+                counter = family.endswith("_total")
+                emit_type(family[:-6] if counter else family,
+                          "counter" if counter else "gauge")
+                v = self._values[key]
+                lines.append(f"{key} {v:g}" if isinstance(v, float)
+                             else f"{key} {v}")
+            for key in sorted(self._histograms, key=_split_labels):
+                family, labels = _split_labels(key)
+                emit_type(family, "histogram")
+                h = self._histograms[key]
+                pre = labels + "," if labels else ""
+                suffix = f"{{{labels}}}" if labels else ""
                 cum = 0
                 for bound, c in zip(h.bounds, h.counts):
                     cum += c
-                    lines.append(f'{name}_bucket{{le="{bound:g}"}} {cum}')
-                lines.append(f'{name}_bucket{{le="+Inf"}} {h.count}')
-                lines.append(f"{name}_sum {h.sum:g}")
-                lines.append(f"{name}_count {h.count}")
+                    lines.append(
+                        f'{family}_bucket{{{pre}le="{bound:g}"}} {cum}')
+                lines.append(f'{family}_bucket{{{pre}le="+Inf"}} {h.count}')
+                lines.append(f"{family}_sum{suffix} {h.sum:g}")
+                lines.append(f"{family}_count{suffix} {h.count}")
             return "\n".join(lines) + ("\n" if lines else "")
 
     def reset(self) -> None:

@@ -40,6 +40,21 @@ philosophy):
   tracks the ENGINE thread (503 when dead); the p50s are rolling
   windows over the last 256 completions; last_error records the most
   recent failed round.
+* ``GET /requestz`` (``?rid=`` filters) — the data-plane flight
+  recorder: a bounded LRU ring of recent + in-flight requests, each
+  with its full lifecycle event list (enqueued/admitted/prefill_chunk/
+  decode_round/grown/preempted/resumed/retired) and phase breakdown
+  (queue/prefill/decode/recompute ms). ``GET /poolz`` — scheduler/pool
+  snapshot: per-state block counts, per-request footprints, waiting
+  queue with priorities/deadlines, the overcommit EMA, watermark
+  headroom. ``GET /traces.json`` — the workload tracer's span ring
+  (same shape as the daemons'), so a /requestz record's ``trace_id``
+  joins its span tree in one process.
+* The generate body accepts ``"trace_id"`` (or an ``X-Tpubc-Trace``
+  header): the request's span tree roots under it and the final
+  response echoes it, plus a ``"timing"`` phase-breakdown block —
+  where THIS request's time went (queue vs prefill vs decode vs
+  preempt-recompute), per Dapper's core lesson.
 
 Exactness rides the pool's guarantee: a request's concatenated stream
 bit-matches its solo `decode.generate` greedy output regardless of what
@@ -55,6 +70,7 @@ import queue
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
 
 import os
 
@@ -178,6 +194,11 @@ class IngressServer:
         # latency win prefix caching exists for must be attributable,
         # not averaged away.
         self._cached_toks: dict = {}
+        # rid -> (priority, effective trace id): the per-class TTFT
+        # label and the trace id echoed on the final response (the
+        # client's own id when it sent one, else the process root the
+        # span tree actually rooted under).
+        self._req_meta: dict = {}
         self._qps_window = telemetry.RateWindow()
         self._tps_window = telemetry.RateWindow()
 
@@ -205,6 +226,31 @@ class IngressServer:
                     return
                 if self.path == "/metrics.json":
                     return self._json(200, telemetry.metrics().to_json())
+                if self.path.startswith("/requestz"):
+                    # The data-plane /statusz: recent + in-flight
+                    # requests with full phase breakdown; ?rid= filters
+                    # to one; trace ids join /traces.json.
+                    q = parse_qs(urlparse(self.path).query)
+                    rid = q.get("rid", [None])[0]
+                    if rid is not None:
+                        try:
+                            rid = int(rid)
+                        except ValueError:
+                            return self._json(
+                                400, {"error": "rid must be an int"})
+                    return self._json(200, outer.sched.log.snapshot(rid=rid))
+                if self.path == "/poolz":
+                    # Scheduler/pool snapshot: per-state block counts,
+                    # per-request footprints, waiting-queue contents,
+                    # the overcommit EMA, and watermark headroom.
+                    return self._json(200, {
+                        "pool": outer.pool.snapshot(),
+                        "scheduler": outer.sched.snapshot()})
+                if self.path == "/traces.json":
+                    # Same shape as the daemons' /traces.json, so the
+                    # requestz/statusz trace-id join works against the
+                    # data plane too.
+                    return self._json(200, telemetry.tracer().to_json())
                 if self.path not in ("/healthz", "/health"):
                     return self._json(404, {"error": f"unknown path {self.path}"})
                 with outer._lock:
@@ -243,6 +289,16 @@ class IngressServer:
                     max_new = int(body["max_new"])
                     stream = bool(body.get("stream", True))
                     priority = int(body.get("priority", 0))
+                    # Client-supplied trace id (body wins over the
+                    # X-Tpubc-Trace header): the request's span tree
+                    # roots under it, joining the client's own trace to
+                    # the ingress -> scheduler legs; echoed on the
+                    # final response object.
+                    trace_id = (body.get("trace_id")
+                                or self.headers.get("X-Tpubc-Trace") or "")
+                    if not isinstance(trace_id, str) or len(trace_id) > 128:
+                        raise ValueError(
+                            "trace_id must be a string (<= 128 chars)")
                     deadline_ms = body.get("deadline_ms")
                     if deadline_ms is not None:
                         deadline_ms = float(deadline_ms)
@@ -259,7 +315,7 @@ class IngressServer:
                     return self._json(400, {"error": f"bad request: {e}"})
                 req = Request(
                     rid=-1, tokens=tokens, max_new=max_new,
-                    priority=priority,
+                    priority=priority, trace_id=trace_id,
                     deadline=(time.monotonic() + deadline_ms / 1e3
                               if deadline_ms is not None else None))
                 try:
@@ -304,6 +360,10 @@ class IngressServer:
                                     if ev.get("queued") else {}),
                                  **({"cached_tokens": ev["cached_tokens"]}
                                     if "cached_tokens" in ev else {}),
+                                 **({"timing": ev["timing"]}
+                                    if ev.get("timing") else {}),
+                                 **({"trace_id": ev["trace_id"]}
+                                    if ev.get("trace_id") else {}),
                                  **({"error": ev["error"]}
                                     if ev.get("error") else {})}
                             ).encode() + b"\n"
@@ -323,6 +383,10 @@ class IngressServer:
                                    "queue_position": qpos}
                             if "cached_tokens" in ev:
                                 out["cached_tokens"] = ev["cached_tokens"]
+                            if ev.get("timing"):
+                                out["timing"] = ev["timing"]
+                            if ev.get("trace_id"):
+                                out["trace_id"] = ev["trace_id"]
                             if ev.get("error"):
                                 out["error"] = ev["error"]
                             return self._json(200, out)
@@ -358,6 +422,8 @@ class IngressServer:
             self._next_rid += 1
             self._pending.append((req, out_q))
             self._submit_t[req.rid] = (time.monotonic(), None)
+            self._req_meta[req.rid] = (
+                req.priority, req.trace_id or telemetry.root_trace_id())
             telemetry.metrics().set_gauge("serve_queue_depth", depth + 1)
             # Queued acknowledgement BEFORE any engine event can race
             # it: streaming clients see {"queued": true,
@@ -426,6 +492,7 @@ class IngressServer:
                     self._submit_t.clear()
                     self._last_ev_t.clear()
                     self._cached_toks.clear()
+                    self._req_meta.clear()
                     self.pool.reset()
                     # Queued requests got their error events above (their
                     # streams registered at handoff); drop them from the
@@ -440,8 +507,15 @@ class IngressServer:
                     if ev["done"]:
                         # Surfaced on the final response object: how
                         # many prompt tokens this request never paid
-                        # prefill for.
+                        # prefill for — plus the phase-attributed
+                        # timing block and the trace id that joins
+                        # /requestz and /traces.json.
                         ev["cached_tokens"] = self._cached_toks.get(rid, 0)
+                        timing = self.sched.request_timing(rid)
+                        if timing is not None:
+                            ev["timing"] = timing
+                        ev["trace_id"] = self._req_meta.get(
+                            rid, (0, ""))[1]
                     self._streams[rid].put(ev)
                     t_submit, t_first = self._submit_t.get(rid, (now, None))
                     if ev["new"]:
@@ -459,6 +533,12 @@ class IngressServer:
                         self._submit_t[rid] = (t_submit, now)
                         self._ttft_ms.append((now - t_submit) * 1e3)
                         reg.observe("serve_ttft_ms", (now - t_submit) * 1e3)
+                        # Per-priority-class TTFT: the SLO a class is
+                        # judged by must not be blended across classes.
+                        reg.observe(
+                            "serve_ttft_ms", (now - t_submit) * 1e3,
+                            labels={"priority": str(
+                                self._req_meta.get(rid, (0, ""))[0])})
                         # Cached-vs-cold split: the whole point of
                         # prefix caching is the TTFT of requests whose
                         # prompt prefix skipped prefill — one averaged
@@ -472,6 +552,7 @@ class IngressServer:
                         self._submit_t.pop(rid, None)
                         self._last_ev_t.pop(rid, None)
                         self._cached_toks.pop(rid, None)
+                        self._req_meta.pop(rid, None)
                         self._total_ms.append((now - t_submit) * 1e3)
                         self._served += 1
                         reg.inc("serve_requests_total")

@@ -108,6 +108,7 @@ import hashlib
 import heapq
 import math
 import os
+import threading
 import time
 from collections import OrderedDict, deque
 from functools import partial
@@ -142,6 +143,11 @@ class Request:
     # WITHIN a priority class ahead of deadline-less arrivals (EDF).
     priority: int = 0
     deadline: float | None = None
+    # Client-supplied trace id (the ingress accepts it in the body or
+    # the X-Tpubc-Trace header): the request's lifecycle span tree
+    # roots under it, so client -> ingress -> scheduler traces join the
+    # propagated TPUBC_TRACE_ID chain. Empty = the process root id.
+    trace_id: str = ""
 
 
 @dataclasses.dataclass
@@ -190,12 +196,338 @@ def _majority_chunk(active, max_seq_len: int) -> int:
     return _bucket_down(max(1, min(majority, headroom)))
 
 
+REQUEST_EVENTS_ENV = "TPUBC_REQUEST_EVENTS"
+
+
+def request_events_enabled() -> bool:
+    """The request-lifecycle event log's master switch: off with
+    ``TPUBC_REQUEST_EVENTS=0`` or when tracing itself is disabled
+    (``TPUBC_TRACE_BUFFER=0``) — the overhead-guard contract is that
+    either spelling keeps token streams byte-identical and the serving
+    hot path free of event appends."""
+    if os.environ.get(REQUEST_EVENTS_ENV, "1").lower() in ("0", "false"):
+        return False
+    try:
+        if int(os.environ.get("TPUBC_TRACE_BUFFER", "4096")) <= 0:
+            return False
+    except ValueError:
+        pass
+    return True
+
+
+@dataclasses.dataclass
+class RequestRecord:
+    """One request's lifecycle in the flight recorder: the bounded
+    event list the Scheduler and pools append to, plus the summary
+    fields /requestz and the retirement span tree read."""
+
+    rid: int
+    trace_id: str
+    priority: int
+    deadline: float | None
+    submit_us: int
+    state: str = "queued"  # queued | running | preempted | retired
+    events: list = dataclasses.field(default_factory=list)
+    dropped_events: int = 0
+    legs: int = 0          # admissions: 1 + number of resumes
+    preemptions: int = 0
+    retire_reason: str = ""
+    generated: int = 0
+    footprint_blocks: int = 0
+    cached_tokens: int = 0
+
+
+# Phase in effect AFTER each event kind — the gap between consecutive
+# events is attributed to the phase the request was in DURING it, so
+# per-phase durations partition [first event, last event] exactly and
+# can never sum past the request span. prefill_chunk keeps the current
+# phase (prefill on a fresh leg, recompute on a resumed one — set by
+# the admitted/resumed event that opened the leg).
+_PHASE_AFTER = {
+    "enqueued": "queue",
+    "preempted": "queue",   # waiting to resume IS queue wait
+    "admitted": "prefill",
+    "resumed": "recompute",
+    "decode_round": "decode",
+    "grown": "decode",
+}
+
+
+def _phase_segments(events: list) -> list:
+    """[(phase, start_us, dur_us)] — contiguous same-phase runs of the
+    inter-event gaps (the child spans under the request span)."""
+    segs: list = []
+    if not events:
+        return segs
+    cur = "queue"
+    prev_t = events[0]["t_us"]
+    for e in events[1:]:
+        t = e["t_us"]
+        if t > prev_t:
+            if segs and segs[-1][0] == cur:
+                segs[-1] = (cur, segs[-1][1], segs[-1][2] + (t - prev_t))
+            else:
+                segs.append((cur, prev_t, t - prev_t))
+        prev_t = t
+        nxt = _PHASE_AFTER.get(e["kind"])
+        if nxt is not None:
+            cur = nxt
+    return segs
+
+
+class RequestLog:
+    """The serving data plane's flight recorder (the /statusz idea at
+    request granularity, Dapper's per-request causality instead of
+    aggregate gauges): a bounded LRU ring of recent + in-flight
+    requests, each carrying a bounded event list — enqueued / admitted /
+    prefill_chunk / decode_round / grown / preempted / resumed /
+    retired — appended by the Scheduler and the pools as the lifecycle
+    actually unfolds (no retroactive reconstruction).
+
+    Three consumers:
+
+    * ``/requestz`` (ingress) serves ``snapshot()``: full per-request
+      phase breakdown, ``?rid=`` filter, trace ids joining
+      ``/traces.json``.
+    * At retirement the event list materializes as a span tree in
+      ``telemetry.tracer()`` — one ``serve.request`` parent plus
+      ``serve.phase.{queue,prefill,decode,recompute}`` children — so
+      ``bench.py --trace-out`` Perfetto timelines show where each
+      request's time went instead of one opaque bar.
+    * SLO attribution: cumulative phase-share gauges
+      (``serve_phase_share_*``) and the per-request ``timing`` block
+      the ingress folds into the final ``/v1/generate`` response.
+
+    Ring capacity ``TPUBC_REQUESTZ_RING`` (default 256, retired records
+    evicted before in-flight ones), per-request event cap
+    ``TPUBC_REQUEST_EVENT_CAP`` (default 512, overflow counted in
+    ``dropped_events``). ``TPUBC_REQUEST_EVENTS=0`` (or
+    ``TPUBC_TRACE_BUFFER=0``) disables everything — token streams are
+    byte-identical either way (the log only observes)."""
+
+    PHASES = ("queue", "prefill", "decode", "recompute")
+
+    def __init__(self, capacity: int | None = None,
+                 max_events: int | None = None,
+                 enabled: bool | None = None):
+        if capacity is None:
+            try:
+                capacity = int(os.environ.get("TPUBC_REQUESTZ_RING", "256"))
+            except ValueError:
+                capacity = 256
+        if max_events is None:
+            try:
+                max_events = int(
+                    os.environ.get("TPUBC_REQUEST_EVENT_CAP", "512"))
+            except ValueError:
+                max_events = 512
+        self.capacity = max(1, capacity)
+        self.max_events = max(8, max_events)
+        self.enabled = (request_events_enabled() if enabled is None
+                        else enabled)
+        self._recs: OrderedDict = OrderedDict()  # rid -> RequestRecord
+        self._lock = threading.Lock()
+        self._phase_totals = {p: 0.0 for p in self.PHASES}
+
+    # ---- recording --------------------------------------------------------
+
+    def start(self, rid: int, *, trace_id: str = "", priority: int = 0,
+              deadline: float | None = None, queue_position: int = 0) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            t = telemetry.now_us()
+            rec = RequestRecord(
+                rid=rid, trace_id=trace_id or telemetry.root_trace_id(),
+                priority=priority, deadline=deadline, submit_us=t)
+            rec.events.append({
+                "kind": "enqueued", "t_us": t, "priority": priority,
+                "deadline": deadline, "queue_position": queue_position})
+            self._recs[rid] = rec
+            self._recs.move_to_end(rid)
+            while len(self._recs) > self.capacity:
+                # Retired records evict first (LRU within them); only a
+                # ring smaller than the in-flight set sheds live ones.
+                victim = next((r for r, v in self._recs.items()
+                               if v.state == "retired"), None)
+                if victim is None:
+                    victim = next(iter(self._recs))
+                del self._recs[victim]
+
+    def event(self, rid: int, kind: str, **attrs) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            rec = self._recs.get(rid)
+            if rec is None:
+                return  # evicted mid-flight, or started before the log
+            if len(rec.events) >= self.max_events:
+                rec.dropped_events += 1
+                return
+            e = {"kind": kind, "t_us": telemetry.now_us()}
+            e.update(attrs)
+            rec.events.append(e)
+            if kind in ("admitted", "resumed"):
+                rec.state = "running"
+                rec.legs += 1
+                if kind == "admitted":
+                    rec.cached_tokens = int(attrs.get("cached_tokens", 0))
+            elif kind == "preempted":
+                rec.state = "preempted"
+                rec.preemptions += 1
+            self._recs.move_to_end(rid)
+
+    def retire(self, rid: int) -> None:
+        """Finalize a record: fold the retired event's summary in, emit
+        the span tree, and roll its phase durations into the cumulative
+        share gauges. Idempotent (the ingress failure path may race a
+        regular retirement)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            rec = self._recs.get(rid)
+            if rec is None or rec.state == "retired":
+                return
+            rec.state = "retired"
+            last = rec.events[-1]
+            if last["kind"] == "retired":
+                rec.retire_reason = last.get("reason", "")
+                rec.generated = int(last.get("generated", 0))
+                rec.footprint_blocks = int(last.get("footprint_blocks", 0))
+            segs = _phase_segments(rec.events)
+            tr = telemetry.tracer()
+            parent = tr.add_span(
+                "serve.request", rec.submit_us,
+                last["t_us"] - rec.submit_us,
+                trace_id=rec.trace_id, rid=rec.rid, priority=rec.priority,
+                reason=rec.retire_reason, tokens=rec.generated,
+                preemptions=rec.preemptions, legs=rec.legs,
+                cached_tokens=rec.cached_tokens)
+            for ph, start, dur in segs:
+                tr.add_span(f"serve.phase.{ph}", start, dur,
+                            trace_id=rec.trace_id,
+                            parent_id=parent.span_id, rid=rec.rid)
+                self._phase_totals[ph] += dur
+            tot = sum(self._phase_totals.values())
+            if tot > 0:
+                reg = telemetry.metrics()
+                for ph, v in self._phase_totals.items():
+                    reg.set_gauge(f"serve_phase_share_{ph}",
+                                  round(v / tot, 4))
+
+    def abort_inflight(self, reason: str = "error") -> None:
+        """Close every non-retired record (the ingress failed-round
+        recovery: those clients got error events; the recorder must not
+        show them running forever)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            rids = [rid for rid, rec in self._recs.items()
+                    if rec.state != "retired"]
+        for rid in rids:
+            self.event(rid, "retired", reason=reason)
+            self.retire(rid)
+
+    # ---- reading ----------------------------------------------------------
+
+    def _phases_locked(self, rec: RequestRecord) -> dict:
+        out = {f"{p}_ms": 0.0 for p in self.PHASES}
+        for ph, _, dur in _phase_segments(rec.events):
+            out[f"{ph}_ms"] += dur / 1e3
+        out = {k: round(v, 3) for k, v in out.items()}
+        out["total_ms"] = round(
+            (rec.events[-1]["t_us"] - rec.submit_us) / 1e3, 3)
+        out["preemptions"] = rec.preemptions
+        out["legs"] = rec.legs
+        return out
+
+    def phases(self, rid: int) -> dict | None:
+        """The per-request phase breakdown (the response ``timing``
+        block): queue/prefill/decode/recompute ms + total/preemptions/
+        legs. None for unknown (or evicted) rids."""
+        with self._lock:
+            rec = self._recs.get(rid)
+            return None if rec is None else self._phases_locked(rec)
+
+    def trace_of(self, rid: int) -> str:
+        with self._lock:
+            rec = self._recs.get(rid)
+            return "" if rec is None else rec.trace_id
+
+    def phase_shares(self) -> dict:
+        """Cumulative fraction of retired-request time per phase."""
+        with self._lock:
+            tot = sum(self._phase_totals.values())
+            if tot <= 0:
+                return {p: 0.0 for p in self.PHASES}
+            return {p: round(v / tot, 4)
+                    for p, v in self._phase_totals.items()}
+
+    def snapshot(self, rid: int | None = None) -> dict:
+        """The /requestz document: most-recently-touched first."""
+        with self._lock:
+            recs = list(self._recs.values())
+            if rid is not None:
+                recs = [r for r in recs if r.rid == rid]
+            return {
+                "enabled": self.enabled,
+                "capacity": self.capacity,
+                "requests": [{
+                    "rid": r.rid,
+                    "trace_id": r.trace_id,
+                    "state": r.state,
+                    "priority": r.priority,
+                    "deadline": r.deadline,
+                    "submit_us": r.submit_us,
+                    "legs": r.legs,
+                    "preemptions": r.preemptions,
+                    "reason": r.retire_reason,
+                    "generated": r.generated,
+                    "footprint_blocks": r.footprint_blocks,
+                    "cached_tokens": r.cached_tokens,
+                    "dropped_events": r.dropped_events,
+                    "phases": self._phases_locked(r),
+                    "events": [dict(e) for e in r.events],
+                } for r in reversed(recs)],
+            }
+
+
 class _PoolBase:
     """What every serving engine shares — the admit/step_round interface
     contract ingress and serve() rely on to swap pools freely, and the
     pieces whose silent divergence between engines would be a bug: the
     admission validation, the free-slot scan, and the per-round
     event/eos/retirement emission."""
+
+    # The Scheduler wires its RequestLog here; pools driven bare (unit
+    # tests, bench capacity probes) keep None and pay one attribute
+    # read per would-be event.
+    request_log: RequestLog | None = None
+
+    def _levent(self, rid: int, kind: str, **attrs) -> None:
+        """Append one lifecycle event for ``rid`` (no-op without a log)."""
+        log = self.request_log
+        if log is not None:
+            log.event(rid, kind, **attrs)
+
+    def _slot_json(self, i: int, s) -> dict:
+        return {"slot": i, "rid": s.rid, "priority": s.priority,
+                "seq": s.seq, "deadline": s.deadline,
+                "history_tokens": len(s.history),
+                "generated": len(s.generated), "remaining": s.remaining}
+
+    def snapshot(self) -> dict:
+        """The /poolz pool half: engine, occupancy, per-row state, and
+        the cumulative stats dict. Read-only and defensive (the engine
+        thread mutates slots concurrently; a snapshot is advisory)."""
+        slots = [self._slot_json(i, s)
+                 for i, s in enumerate(list(self.slots)) if s is not None]
+        return {"engine": type(self).__name__,
+                "batch_size": self.batch_size,
+                "active": len(slots),
+                "free_slots": self.batch_size - len(slots),
+                "slots": slots,
+                "stats": dict(self.stats)}
 
     @staticmethod
     def _check_pool_args(batch_size, temperature, key, draft_params,
@@ -319,6 +651,10 @@ class _PoolBase:
         row's gamma proposals were accepted. The cumulative ratio is
         the serve_spec_accept_rate gauge — the number that says whether
         a draft source is paying for its verify chunks."""
+        # Per-slot accepted counts for this round's decode_round events
+        # (the event fold runs after this and has only the kept counts).
+        self._last_accepts = {i: min(int(counts[i]) - 1, self.gamma)
+                              for i in rows}
         self.stats["draft_accepted"] += sum(
             min(int(counts[i]) - 1, self.gamma) for i in rows)
         self.stats["draft_proposed"] += self.gamma * len(rows)
@@ -376,7 +712,25 @@ class _PoolBase:
             done = s.remaining == 0
             events[s.rid] = {"new": got, "done": done,
                              "generated": s.generated}
+            if self.request_log is not None and got:
+                dr = {"tokens": len(got),
+                      "round": self.stats.get("rounds", 0)}
+                acc = getattr(self, "_last_accepts", None)
+                if acc is not None and i in acc:
+                    dr["accepted"] = acc[i]
+                self._levent(s.rid, "decode_round", **dr)
             if done:
+                if self.request_log is not None:
+                    # Recorded BEFORE _on_retire clears the block table:
+                    # the final footprint is part of the record.
+                    reason = ("eos" if (self.eos_id is not None and got
+                                        and got[-1] == self.eos_id)
+                              else "budget")
+                    self._levent(
+                        s.rid, "retired", reason=reason,
+                        generated=len(s.generated),
+                        footprint_blocks=len(
+                            getattr(s, "blocks", ()) or ()))
                 self._on_retire(i, s)
                 self.slots[i] = None
         return events
@@ -437,6 +791,8 @@ class SlotPool(_PoolBase):
             raise ValueError("slot engines never preempt, so they have "
                              "nothing to resume (preload is paged-only)")
         self.validate(r, self.cfg)
+        self._levent(r.rid, "admitted", engine="slot",
+                     prompt=len(r.tokens))
         self.slots[self._free_index()] = _Slot(
             rid=r.rid, history=list(r.tokens),
             remaining=r.max_new, generated=[],
@@ -792,6 +1148,12 @@ class ResidentPool(_PoolBase):
             raise ValueError("slot engines never preempt, so they have "
                              "nothing to resume (preload is paged-only)")
         self.validate(r, self.cfg)
+        # Admitted stamped BEFORE the synchronous admission prefill so
+        # the device work lands in the record's prefill phase, not its
+        # queue wait (the paged engine's chunked prefill rides rounds
+        # instead and stamps per chunk).
+        self._levent(r.rid, "admitted", engine="resident",
+                     prompt=len(r.tokens))
         i = self._free_index()
         w = _bucket_up(len(r.tokens))
         row = np.zeros((1, w), np.int32)
@@ -807,6 +1169,8 @@ class ResidentPool(_PoolBase):
                                   self.draft_cfg, self.kv_quant)
             self.dcaches = _paste_row(self.dcaches, dtemp, jnp.int32(i))
         self.stats["prefill_tokens"] += len(r.tokens)
+        self._levent(r.rid, "prefill_chunk", tokens=len(r.tokens),
+                     prefilled=len(r.tokens))
         # frontier = the LAST prompt token's position: the first decode
         # step re-feeds that token (idempotent rewrite of its own KV)
         # and emits the first continuation logits — no per-row logits
@@ -1729,6 +2093,21 @@ class PagedPool(_PoolBase):
             # Resumes never touch the ingress-facing map: the client's
             # cached_tokens answer describes its ORIGINAL admission.
             self.request_cached_tokens[r.rid] = hit_tokens
+        else:
+            # The preemption's real price (serve_preempt_total counts
+            # events, not cost): the tokens the resume must actually
+            # re-prefill — whatever the prefix cache didn't retain from
+            # the victim's registered blocks.
+            telemetry.metrics().inc(
+                "serve_preempt_recompute_tokens_total",
+                max(0, prompt_len - 1 - hit_tokens))
+        self._levent(
+            r.rid, "resumed" if preload else "admitted",
+            blocks=len(blocks), shared_blocks=len(shared),
+            fresh_blocks=len(fresh),
+            expected_new=reserve_new, remaining=remaining,
+            cached_tokens=hit_tokens, cow=int(cow is not None),
+            prompt=prompt_len)
         self.slots[i] = _PagedSlot(
             rid=r.rid, history=history,
             remaining=remaining, generated=list(preload or []),
@@ -1744,7 +2123,7 @@ class PagedPool(_PoolBase):
 
     # ---- overcommit: preemption + lazy growth -----------------------------
 
-    def _preempt(self, i: int) -> dict:
+    def _preempt(self, i: int, reason: str = "capacity") -> dict:
         """vLLM-style evict-and-recompute: register the victim's full
         blocks first (so the recompute is mostly prefix-cache hits),
         DECREF its whole table, clear the slot, and park a resume
@@ -1755,6 +2134,11 @@ class PagedPool(_PoolBase):
         pure function of (token, position), and sampled draws key off
         (rid, stream position), never scheduling."""
         s = self.slots[i]
+        self._levent(s.rid, "preempted", reason=reason,
+                     phase=("prefill" if self._prefilling(s)
+                            else "decode"),
+                     generated=len(s.generated),
+                     blocks_freed=len(s.blocks))
         if self.prefix_cache:
             self._register_full(s)
         self.allocator.free(s.blocks)
@@ -1766,7 +2150,8 @@ class PagedPool(_PoolBase):
         rec = {"request": Request(rid=s.rid, tokens=prompt,
                                   max_new=len(s.generated) + s.remaining,
                                   priority=s.priority, deadline=s.deadline),
-               "preload": list(s.generated), "seq": s.seq}
+               "preload": list(s.generated), "seq": s.seq,
+               "t": time.monotonic()}  # serve_resume_gap_ms start
         self.preempted.append(rec)
         self._record_block_gauges()
         return rec
@@ -1789,7 +2174,17 @@ class PagedPool(_PoolBase):
             cands = [c for c in cands if c[0] < below]
         if not cands:
             return None
-        return self._preempt(min(cands)[3])
+        cands.sort()
+        victim = cands[0]
+        # Victim reason for the lifecycle record: which policy key
+        # actually selected it over the other candidates.
+        if below is not None or any(c[0] != victim[0] for c in cands[1:]):
+            reason = "priority"
+        elif any(c[1] != victim[1] for c in cands[1:]):
+            reason = "phase"
+        else:
+            reason = "arrival"
+        return self._preempt(victim[3], reason)
 
     def imminent_growth(self, horizon: int | None = None) -> int:
         """Blocks the ACTIVE set will need within the next ``horizon``
@@ -1834,6 +2229,8 @@ class PagedPool(_PoolBase):
                     if need[id(s)]:
                         s.blocks += self.allocator.alloc(need[id(s)])
                         self.stats["grown_blocks"] += need[id(s)]
+                        self._levent(s.rid, "grown", blocks=need[id(s)],
+                                     total_blocks=len(s.blocks))
                 break
             self.preempt_one()
             alive = {id(s) for s in self.slots if s is not None}
@@ -1889,6 +2286,9 @@ class PagedPool(_PoolBase):
                 budget -= w
                 self.stats["prefill_tokens"] += w
                 self.stats["prefill_chunks"] += 1
+                self._levent(s.rid, "prefill_chunk", tokens=w,
+                             prefilled=s.prefilled,
+                             round=self.stats["rounds"])
                 telemetry.metrics().observe(
                     "serve_prefill_chunk_tokens", w,
                     buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512))
@@ -2051,6 +2451,43 @@ class PagedPool(_PoolBase):
         self._record_block_gauges()
         return events
 
+    # ---- introspection ----------------------------------------------------
+
+    def _slot_json(self, i: int, s) -> dict:
+        d = super()._slot_json(i, s)
+        d.update({"blocks": len(s.blocks), "shared_blocks": s.n_shared,
+                  "registered_blocks": s.registered,
+                  "prompt_len": s.prompt_len, "prefilled": s.prefilled,
+                  "cached_tokens": s.cached_tokens,
+                  "prefilling": self._prefilling(s)})
+        return d
+
+    def snapshot(self) -> dict:
+        """/poolz, paged half: the per-state block accounting (free /
+        live / cached mirror the allocator's used()/cached()/available()
+        exactly — test-pinned), per-request block footprints via the
+        slot rows, and the overcommit watermark headroom (blocks the
+        running set will claim within one block's worth of decode)."""
+        snap = super().snapshot()
+        a = self.allocator
+        imminent = self.imminent_growth()
+        snap.update({
+            "block_size": self.block_size,
+            "prefix_cache": self.prefix_cache,
+            "paged_kernel": self.paged_kernel,
+            "blocks": {"total": a.num_blocks, "live": a.used(),
+                       "cached": a.cached(),
+                       "free": a.available() - a.cached(),
+                       "available": a.available(),
+                       "peak_used": a.stats["peak_used"],
+                       "evictions": a.stats["evictions"],
+                       "hash_hits": a.stats["hash_hits"],
+                       "compactness": round(a.compactness(), 4)},
+            "imminent_growth_blocks": imminent,
+            "watermark_headroom_blocks": a.available() - imminent,
+        })
+        return snap
+
     # ---- maintenance ------------------------------------------------------
 
     def defrag(self) -> int:
@@ -2160,9 +2597,15 @@ class Scheduler:
         self._waiting: list = []
         self._seq = 0
         self._qstart: dict = {}  # rid -> monotonic submit time
+        self._preempt_t: dict = {}  # rid -> monotonic eviction time
         self._waits = deque(maxlen=512)  # recent queue waits (ms)
         self.stats = {"submitted": 0, "admitted": 0, "requeues": 0,
                       "retired": 0}
+        # The request-lifecycle flight recorder: the Scheduler owns it
+        # (it sees every transition), the pool appends its own events
+        # through the request_log backref, /requestz serves snapshot().
+        self.log = RequestLog()
+        pool.request_log = self.log if self.log.enabled else None
 
     # ---- queue ------------------------------------------------------------
 
@@ -2181,6 +2624,9 @@ class Scheduler:
         error, not a queue entry) and enqueue; admission happens at the
         next step()'s round boundary."""
         self.pool.validate(r, self.pool.cfg)
+        self.log.start(r.rid, trace_id=getattr(r, "trace_id", ""),
+                       priority=r.priority, deadline=r.deadline,
+                       queue_position=len(self._waiting))
         self._push(r, None, self._seq)
         self._seq += 1
         self.stats["submitted"] += 1
@@ -2200,6 +2646,8 @@ class Scheduler:
         for rec in getattr(self.pool, "preempted", ()):
             self._push(rec["request"], rec["preload"], rec["seq"])
             self.stats["requeues"] += 1
+            if "t" in rec:
+                self._preempt_t[rec["request"].rid] = rec["t"]
         if getattr(self.pool, "preempted", None):
             self.pool.preempted.clear()
 
@@ -2232,12 +2680,26 @@ class Scheduler:
                                 seq=seq)
                 if preload is None:
                     self.stats["admitted"] += 1
+                else:
+                    # The anti-thrash watermark's measurable effect:
+                    # wall time a preempted stream sat evicted before
+                    # its resume admission.
+                    tp = self._preempt_t.pop(r.rid, None)
+                    if tp is not None:
+                        telemetry.metrics().observe(
+                            "serve_resume_gap_ms",
+                            (time.monotonic() - tp) * 1e3)
                 t0 = self._qstart.pop(r.rid, None)
                 if t0 is not None:
                     wait_ms = (time.monotonic() - t0) * 1e3
                     self._waits.append(wait_ms)
                     telemetry.metrics().observe("serve_queue_wait_ms",
                                                 wait_ms)
+                    # Per-priority-class split: SLO attribution needs
+                    # the class a wait was charged to, not the blend.
+                    telemetry.metrics().observe(
+                        "serve_queue_wait_ms", wait_ms,
+                        labels={"priority": str(r.priority)})
                 continue
             # Priority-admission preemption: the head outranks running
             # rows capacity alone cannot displace. Strictly-below only —
@@ -2260,12 +2722,36 @@ class Scheduler:
             self.pool.chunk_hint = max(1, math.ceil(self._ema))
         events = self.pool.step_round()
         self._drain_preempted()
-        for ev in events.values():
+        for rid, ev in events.items():
             if ev["done"]:
                 self.stats["retired"] += 1
                 self._ema += self._alpha * (len(ev["generated"]) - self._ema)
+                # Finalize the lifecycle record: emits the request span
+                # + phase-child spans and updates the share gauges.
+                self.log.retire(rid)
         self._record_gauges()
         return events
+
+    def request_timing(self, rid: int) -> dict | None:
+        """The response ``timing`` block: per-phase ms breakdown for one
+        request (None when events are disabled or the rid is unknown)."""
+        return self.log.phases(rid) if self.log.enabled else None
+
+    def snapshot(self) -> dict:
+        """/poolz, scheduler half: waiting-queue contents in admission
+        order (priority class desc, EDF, arrival), the overcommit EMA
+        admission reserves by, and the cumulative counters."""
+        waiting = [{"rid": r.rid, "priority": r.priority,
+                    "deadline": (None if dl == float("inf") else dl),
+                    "seq": seq, "resume": preload is not None}
+                   for (_negp, dl, seq, r, preload)
+                   in sorted(list(self._waiting))]
+        return {"overcommit": self.overcommit,
+                "expected_new_ema": round(self._ema, 3),
+                "queue_depth": len(waiting),
+                "waiting": waiting,
+                "queue_wait_p50_ms": round(self.queue_wait_p50_ms(), 2),
+                "stats": dict(self.stats)}
 
     def reset(self) -> None:
         """Drop every queued request (the ingress failed-round recovery
@@ -2275,6 +2761,10 @@ class Scheduler:
         failed round."""
         self._waiting.clear()
         self._qstart.clear()
+        self._preempt_t.clear()
+        # The flight recorder keeps its history but must not show the
+        # failed round's victims running forever.
+        self.log.abort_inflight("error")
 
     def _record_gauges(self) -> None:
         telemetry.record_scheduler(
@@ -2381,27 +2871,19 @@ def serve(params: Params, cfg: ModelConfig, requests: list,
         pool.validate(r, cfg)  # ALL requests fail loudly before any compute
     sched = Scheduler(pool, overcommit=overcommit)
     done: dict = {}
-    submitted_us: dict = {}
-    # One span per batch plus one per request (submit -> retirement,
-    # queue wait included — the latency a client actually sees): the
-    # serving-side leg of the merged timeline. Request spans are
-    # recorded retroactively at retirement — the scheduler, not a with-
-    # block, owns a request's lifetime.
+    # One span per batch; the per-request span TREE (serve.request +
+    # serve.phase.{queue,prefill,decode,recompute} children, preempted/
+    # resumed legs included) is emitted by the Scheduler's RequestLog at
+    # each retirement — the scheduler, which owns a request's lifetime,
+    # records it as it happens instead of one flat retroactive bar.
     with telemetry.span("serve.batch", requests=len(requests),
-                        batch_size=batch_size) as batch_span:
+                        batch_size=batch_size):
         for r in requests:
-            submitted_us[r.rid] = telemetry.now_us()
             sched.submit(r)
         while sched.pending() or pool.has_active():
             for rid, ev in sched.step().items():
                 if ev["done"]:
                     done[rid] = ev["generated"]
-                    telemetry.tracer().add_span(
-                        "serve.request", submitted_us[rid],
-                        telemetry.now_us() - submitted_us[rid],
-                        trace_id=batch_span.trace_id,
-                        parent_id=batch_span.span_id,
-                        rid=rid, tokens=len(ev["generated"]))
     if stats is not None:
         stats.update(pool.stats)
         stats["scheduler"] = dict(sched.stats)
@@ -2555,6 +3037,7 @@ def static_schedule_slot_steps(requests: list, batch_size: int) -> int:
     return total
 
 
-__all__ = ["BlockAllocator", "PagedPool", "Request", "ResidentPool",
-           "Scheduler", "SlotPool", "block_hash", "ngram_lookup_drafts",
+__all__ = ["BlockAllocator", "PagedPool", "Request", "RequestLog",
+           "RequestRecord", "ResidentPool", "Scheduler", "SlotPool",
+           "block_hash", "ngram_lookup_drafts", "request_events_enabled",
            "serve", "static_schedule_slot_steps"]
