@@ -1,0 +1,208 @@
+"""Targeted regressions for the lock-discipline fixes the tools.lint
+race checker drove (PR 8): Tracer snapshot coherence, thread-safe
+Scheduler snapshots, the engine-published /poolz (no live walks of
+engine-owned pool state from handler threads), and the locked
+_cached_toks harvest.
+
+The concurrency tests are hammer-style: a reader thread spins against
+the serving engine under a real burst. Before the fixes these raced
+mid-round mutations (sorted() over a heap being pushed, allocator
+arithmetic read between decref and index update); now every observable
+must hold EVERY time it is read."""
+
+import json
+import threading
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_bootstrap import telemetry
+from tpu_bootstrap.workload.ingress import IngressServer
+from tpu_bootstrap.workload.model import ModelConfig, init_params
+from tpu_bootstrap.workload.serving import PagedPool, Request, Scheduler
+
+TINY = ModelConfig(vocab_size=32, num_layers=1, num_heads=2, head_dim=8,
+                   embed_dim=16, mlp_dim=32, max_seq_len=64)
+TPARAMS = init_params(TINY, jax.random.PRNGKey(1))
+
+
+def test_tracer_to_json_pairs_spans_with_drop_count():
+    """to_json captures spans and the drop counter under ONE lock hold
+    (the counter was read bare before): a full buffer must report
+    exactly its overflow, never a torn mix."""
+    tr = telemetry.Tracer(process="t", capacity=4)
+    for i in range(7):
+        tr.add_span(f"s{i}", 1000 + i, 10)
+    doc = tr.to_json()
+    assert doc["dropped"] == 3
+    assert len(doc["spans"]) == 4
+    assert [s["name"] for s in doc["spans"]] == ["s3", "s4", "s5", "s6"]
+
+
+def test_tracer_to_json_consistent_under_concurrent_records():
+    tr = telemetry.Tracer(process="t", capacity=16)
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            tr.add_span(f"w{i}", 1, 1)
+            i += 1
+
+    def reader():
+        try:
+            while not stop.is_set():
+                doc = tr.to_json()
+                # Invariant at every read: the buffer never exceeds
+                # capacity and dropped only counts past-capacity spans.
+                assert len(doc["spans"]) <= 16
+                assert doc["dropped"] >= 0
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer),
+               threading.Thread(target=reader)]
+    for t in threads:
+        t.start()
+    threading.Event().wait(0.3)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert not errors, errors
+
+
+def test_scheduler_snapshot_safe_while_engine_runs():
+    """Scheduler.snapshot()/queue_depth() from a second thread while
+    the driving thread submits and steps a burst: before the Scheduler
+    grew its lock, snapshot sorted the live heap mid-push."""
+    pool = PagedPool(TPARAMS, TINY, batch_size=4, block_size=8,
+                     kv_blocks=24)
+    sched = Scheduler(pool, expected_new=2)
+    rng = np.random.default_rng(3)
+    reqs = [Request(rid=i,
+                    tokens=rng.integers(1, 32, int(rng.integers(2, 8)))
+                    .tolist(),
+                    max_new=int(rng.integers(4, 12)), priority=i % 3)
+            for i in range(12)]
+    errors = []
+    done = threading.Event()
+
+    def reader():
+        try:
+            while not done.is_set():
+                snap = sched.snapshot()
+                assert snap["queue_depth"] == len(snap["waiting"])
+                # Queue order invariant must hold in every snapshot:
+                # priority classes descend.
+                prios = [w["priority"] for w in snap["waiting"]]
+                assert prios == sorted(prios, reverse=True)
+                assert sched.queue_depth() >= 0
+                assert sched.queue_wait_p50_ms() >= 0
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    t = threading.Thread(target=reader)
+    t.start()
+    try:
+        retired = {}
+        for r in reqs:
+            sched.submit(r)
+        while sched.pending() or pool.has_active():
+            for rid, ev in sched.step().items():
+                if ev["done"]:
+                    retired[rid] = ev["generated"]
+    finally:
+        done.set()
+        t.join(timeout=30)
+    assert not errors, errors
+    assert len(retired) == len(reqs)
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = IngressServer(TPARAMS, TINY, port=0, batch_size=4, paged=True,
+                        block_size=8, kv_blocks=16, prefill_budget=8,
+                        host="127.0.0.1").start()
+    yield srv
+    srv.stop()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _post(port, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/generate",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=300) as r:
+        return json.loads(r.read())
+
+
+def test_poolz_is_published_and_coherent_under_load(server):
+    """/poolz while a burst runs: every response must be a coherent
+    round-boundary view — the block-state arithmetic (total = live +
+    cached + free) can only hold if the snapshot was never torn by a
+    mid-round mutation, which is exactly what the engine-published
+    _poolz guarantees (the old handler walked live pool state)."""
+    errors = []
+    stop = threading.Event()
+
+    def prober():
+        try:
+            while not stop.is_set():
+                pz = _get(server.port, "/poolz")
+                assert "as_of_us" in pz and pz["as_of_us"] > 0
+                b = pz["pool"]["blocks"]
+                assert b["free"] >= 0 and b["live"] >= 0
+                assert b["total"] == b["live"] + b["cached"] + b["free"]
+                assert b["available"] == b["free"] + b["cached"]
+                h = _get(server.port, "/healthz")
+                assert h["active"] >= 0 and h["queued"] >= 0
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    prober_t = threading.Thread(target=prober)
+    prober_t.start()
+    try:
+        rng = np.random.default_rng(7)
+        posts = [threading.Thread(target=_post, args=(server.port, {
+            "tokens": rng.integers(1, 32, int(rng.integers(2, 8))).tolist(),
+            "max_new": int(rng.integers(4, 12)), "stream": False}))
+            for _ in range(10)]
+        for p in posts:
+            p.start()
+        for p in posts:
+            p.join(timeout=300)
+    finally:
+        stop.set()
+        prober_t.join(timeout=30)
+    assert not errors, errors
+    # Idle again: the published snapshot must equal the allocator
+    # exactly (same pin as test_requestz's poolz test — publication
+    # changed the transport, not the numbers).
+    pz = _get(server.port, "/poolz")
+    assert pz["pool"]["blocks"]["live"] == server.pool.allocator.used()
+    assert pz["pool"]["blocks"]["cached"] == server.pool.allocator.cached()
+    h = _get(server.port, "/healthz")
+    assert h["active"] == 0
+
+
+def test_cached_tokens_still_reach_responses(server):
+    """The _cached_toks harvest moved under the ingress lock; the
+    surface it feeds (cached_tokens on the final response, after a
+    prefix-cache hit) must be intact."""
+    prompt = list(range(1, 17))   # two full 8-token blocks
+    first = _post(server.port, {"tokens": prompt, "max_new": 4,
+                                "stream": False})
+    again = _post(server.port, {"tokens": prompt, "max_new": 4,
+                                "stream": False})
+    assert first["done"] and again["done"]
+    assert again.get("cached_tokens", 0) > 0
+    assert again["tokens"] == first["tokens"]

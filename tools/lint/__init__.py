@@ -1,0 +1,125 @@
+"""tpubc-lint: repo-native static analysis (AST-based, stdlib-only).
+
+Three pass families, run over the whole tree by ``python -m tools.lint``
+and gated in CI:
+
+* ``locks``    — lock-discipline / race checking driven by the
+  ``# guarded-by: <lock>`` annotation convention, plus lock-ordering and
+  non-reentrant-reacquire analysis across the scanned classes.
+* ``hotpath``  — host-device sync and recompilation hazards inside
+  ``@jax.jit``-reachable functions and the serving decode/step/verify
+  hot loops.
+* ``registry`` — drift between the code and its registries: every
+  ``TPUBC_*`` env var documented in docs/ENV_VARS.md, every bench
+  ``--check`` key emitted and direction-classified exactly once, every
+  metric name consistently typed (counter vs gauge vs histogram).
+
+Deliberate exceptions live in ``tools/lint/allowlist.txt`` (one
+``rule path::qualname`` per line) or inline as a trailing
+``# lint: allow(rule)`` comment on the offending line.  Seeded-violation
+fixtures under ``tools/lint/fixtures/`` prove each pass fires; they are
+excluded from the default scan and exercised by tests/test_lint.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import tokenize
+from dataclasses import dataclass
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+ALLOWLIST_PATH = Path(__file__).resolve().parent / "allowlist.txt"
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str       # repo-relative
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class SourceFile:
+    """One parsed Python source: AST plus a line -> comment map (the
+    annotation convention rides comments, which ast discards)."""
+
+    def __init__(self, path: os.PathLike, root: os.PathLike | None = None):
+        self.path = Path(path)
+        self.rel = os.path.relpath(self.path, root or REPO_ROOT)
+        self.text = self.path.read_text()
+        self.tree = ast.parse(self.text, filename=str(self.path))
+        self.comments: dict = {}
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(self.text).readline):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string
+        except tokenize.TokenError:
+            pass
+
+    def comment(self, line: int) -> str:
+        return self.comments.get(line, "")
+
+    def comment_span(self, node: ast.AST) -> str:
+        """All comments attached to a (possibly multi-line) statement."""
+        end = getattr(node, "end_lineno", node.lineno)
+        return " ".join(self.comments.get(ln, "")
+                        for ln in range(node.lineno, end + 1)).strip()
+
+    def allows(self, line: int, rule: str) -> bool:
+        c = self.comments.get(line, "")
+        return f"lint: allow({rule})" in c or "lint: allow-all" in c
+
+
+def load_allowlist(path: os.PathLike | None = None) -> set:
+    """``rule path::qualname`` entries; '#' comments and blanks skipped."""
+    p = Path(path or ALLOWLIST_PATH)
+    entries = set()
+    if not p.exists():
+        return entries
+    for raw in p.read_text().splitlines():
+        line = raw.split("#", 1)[0].strip()
+        if not line:
+            continue
+        parts = line.split(None, 1)
+        if len(parts) == 2:
+            entries.add((parts[0], parts[1].strip()))
+    return entries
+
+
+def allowed(allowlist: set, rule: str, rel: str, qualname: str) -> bool:
+    return ((rule, f"{rel}::{qualname}") in allowlist
+            or (rule, rel) in allowlist)
+
+
+def python_targets(root: os.PathLike | None = None) -> list:
+    """The default scan set for the AST passes: the workload/runtime
+    Python tree plus the bench harness — not tests, not fixtures."""
+    root = Path(root or REPO_ROOT)
+    files = sorted((root / "tpu_bootstrap").rglob("*.py"))
+    files += [root / "bench.py"]
+    return [SourceFile(f, root) for f in files
+            if "__pycache__" not in f.parts and "fixtures" not in f.parts]
+
+
+def run_all(root: os.PathLike | None = None,
+            passes: tuple = ("locks", "hotpath", "registry")) -> list:
+    """Run the requested pass families over the tree; returns findings."""
+    from . import hotpath, locks, registry
+    root = Path(root or REPO_ROOT)
+    allowlist = load_allowlist()
+    findings: list = []
+    files = python_targets(root)
+    if "locks" in passes:
+        findings += locks.run(files, allowlist)
+    if "hotpath" in passes:
+        findings += hotpath.run(files, allowlist)
+    if "registry" in passes:
+        findings += registry.run(root, allowlist)
+    return findings

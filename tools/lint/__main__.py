@@ -1,0 +1,59 @@
+"""CLI: ``python -m tools.lint`` — run every pass, print findings,
+exit nonzero when any survive the allowlist.
+
+Options:
+    --only locks|hotpath|registry    run one pass family
+    --json                           machine-readable findings
+    --write-env-docs                 regenerate docs/ENV_VARS.md from
+                                     tools/lint/env_catalog.py and exit
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from . import REPO_ROOT, run_all
+from .env_catalog import render
+from .registry import ENV_DOC_PATH
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m tools.lint")
+    ap.add_argument("--only", choices=("locks", "hotpath", "registry"),
+                    action="append",
+                    help="run only the named pass family (repeatable)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as JSON")
+    ap.add_argument("--root", default=None,
+                    help="tree to scan (default: this repo)")
+    ap.add_argument("--write-env-docs", action="store_true",
+                    help="regenerate docs/ENV_VARS.md and exit")
+    args = ap.parse_args(argv)
+    root = Path(args.root) if args.root else REPO_ROOT
+
+    if args.write_env_docs:
+        out = root / ENV_DOC_PATH
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(render())
+        print(f"wrote {out}")
+        return 0
+
+    passes = tuple(args.only) if args.only else ("locks", "hotpath",
+                                                 "registry")
+    findings = run_all(root, passes)
+    if args.json:
+        print(json.dumps([f.__dict__ for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f)
+        n = len(findings)
+        print(f"tools.lint: {n} finding{'s' if n != 1 else ''} "
+              f"({', '.join(passes)})")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

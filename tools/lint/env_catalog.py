@@ -1,0 +1,167 @@
+"""The curated TPUBC_* knob registry.
+
+This dict is the single source of truth the registry-drift pass gates
+against: every ``TPUBC_*`` identifier the code reads (Python, C++, CMake,
+charts, hack scripts, CI) must have an entry here, every entry here must
+still exist in the code, and docs/ENV_VARS.md must be byte-identical to
+``render()`` (regenerate with ``python -m tools.lint --write-env-docs``).
+
+Entry: name -> (default, subsystem, description).  Use "-" for
+no-default (required / computed) knobs.
+"""
+
+CATALOG = {
+    # -- control plane / daemons --------------------------------------------
+    "TPUBC_LOG": (
+        "info", "daemons",
+        "Per-target log directives, longest-prefix match "
+        "(`info,kube=debug`; `off` silences)."),
+    "TPUBC_LOG_FORMAT": (
+        "text", "daemons",
+        "`json` switches to structured logs carrying trace/span ids."),
+    "TPUBC_LOG_RATELIMIT": (
+        "1", "daemons",
+        "`0` disables the per-(target,message) Warning token bucket."),
+    "TPUBC_LOG_RATELIMIT_BURST": (
+        "5", "daemons", "Token-bucket burst for repeated Warnings."),
+    "TPUBC_LOG_RATELIMIT_SECS": (
+        "10", "daemons", "Token-bucket refill interval in seconds."),
+    "TPUBC_STATUSZ_RING": (
+        "32", "daemons",
+        "Per-CR /statusz flight-recorder ring size (1024 objects LRU)."),
+    "TPUBC_TRACE_BUFFER": (
+        "4096", "telemetry",
+        "Span-ring capacity, native and Python tracers alike; `0` "
+        "disables request-event recording too."),
+    "TPUBC_TRACE_FILE": (
+        "-", "telemetry",
+        "When set, the span buffer dumps there as Chrome trace JSON at "
+        "shutdown/exit."),
+    "TPUBC_TRACE_ID": (
+        "-", "telemetry",
+        "Trace id injected into JobSet workers; workload spans root "
+        "under it (admission stamps the CR annotation it rides in on)."),
+    # -- slice bootstrap (controller-injected worker env) -------------------
+    "TPUBC_COORDINATOR_ADDRESS": (
+        "-", "bootstrap",
+        "Slice 0 / worker 0's stable address for jax.distributed "
+        "initialization (controller-injected)."),
+    "TPUBC_JOBSET_NAME": (
+        "-", "bootstrap", "Owning JobSet name (controller-injected)."),
+    "TPUBC_NUM_HOSTS": (
+        "1", "bootstrap", "Hosts per slice (Job parallelism)."),
+    "TPUBC_NUM_SLICES": (
+        "1", "bootstrap", "Multislice count (absent/1 = one slice)."),
+    "TPUBC_SLICE_ID": (
+        "0", "bootstrap", "This pod's slice index, from the JobSet."),
+    # -- serving data plane -------------------------------------------------
+    "TPUBC_KV_BLOCK": (
+        "64", "serving", "Paged-pool KV block size in tokens."),
+    "TPUBC_PREFILL_BUDGET": (
+        "64", "serving",
+        "Chunked-prefill token budget per scheduling round."),
+    "TPUBC_PREFIX_CACHE": (
+        "1", "serving",
+        "`0` disables content-hashed KV block sharing (PR 4 refusal "
+        "semantics return exactly)."),
+    "TPUBC_OVERCOMMIT": (
+        "1", "serving",
+        "`0` restores whole-footprint refusal admission on the paged "
+        "engine (no preemption)."),
+    "TPUBC_EXPECTED_NEW": (
+        "16", "serving",
+        "Seed for the expected-generated-length EMA overcommit "
+        "admission reserves by."),
+    "TPUBC_SPEC_LOOKUP": (
+        "0", "serving",
+        "`1` enables n-gram prompt-lookup drafting on the split "
+        "draft/verify seam (greedy only)."),
+    "TPUBC_INGRESS_MAX_QUEUE": (
+        "256", "serving",
+        "Waiting-queue bound beyond which /v1/generate answers 429 + "
+        "Retry-After."),
+    "TPUBC_REQUESTZ_RING": (
+        "256", "serving",
+        "/requestz flight-recorder ring capacity (retired records "
+        "evict first)."),
+    "TPUBC_REQUEST_EVENT_CAP": (
+        "512", "serving",
+        "Per-request lifecycle event cap (overflow counted in "
+        "dropped_events)."),
+    "TPUBC_REQUEST_EVENTS": (
+        "1", "serving",
+        "`0` disables request-lifecycle recording entirely (token "
+        "streams byte-identical)."),
+    # -- kernels / bench ----------------------------------------------------
+    "TPUBC_HBM_GBPS": (
+        "819", "kernels",
+        "HBM peak GB/s — the denominator of every roofline fraction "
+        "(v5e default; v5p ~2765, v4 ~1228)."),
+    "TPUBC_QUANT_AUTOTUNE": (
+        "1", "kernels",
+        "`0` disables the first-call-per-shape block autotuner "
+        "(defaults used)."),
+    "TPUBC_QUANT_BLOCKS": (
+        "-", "kernels",
+        "`N,K` pin for the quantized-matmul block sizes (bypasses the "
+        "autotuner)."),
+    "TPUBC_REPO": (
+        "-", "bench",
+        "Repo root handed to the bench workload subprocess for "
+        "sys.path."),
+    "TPUBC_WORKLOAD_TIMEOUT": (
+        "1700", "bench",
+        "Hard cap in seconds on the workload bench subprocess."),
+    "TPUBC_WORKLOAD_INIT_TIMEOUT": (
+        "420", "bench",
+        "Zero-output backend-init window before a bench attempt is "
+        "declared a dead tunnel."),
+    # -- native build -------------------------------------------------------
+    "TPUBC_SANITIZE": (
+        "-", "build",
+        "Sanitizer preset for the native build: `address,undefined` or "
+        "`thread` (CMake -DTPUBC_SANITIZE=... or env for the g++ "
+        "fallback build)."),
+    "TPUBC_LIBSSL": (
+        "-", "build",
+        "CMake variable (not env): the libssl/libcrypto runtime link "
+        "line selected for the image."),
+    # -- e2e harness --------------------------------------------------------
+    "TPUBC_E2E_API_URL": (
+        "-", "e2e",
+        "Real API-server URL for tests/test_e2e_real_apiserver.py "
+        "(unset = skip)."),
+    "TPUBC_E2E_TOKEN": (
+        "-", "e2e", "Bearer token for the e2e API server."),
+    "TPUBC_E2E_CA_FILE": (
+        "-", "e2e", "CA bundle for the e2e API server (optional)."),
+    "TPUBC_E2E_CLUSTER": (
+        "tpubc-e2e", "e2e", "kind cluster name hack/e2e-kind.sh uses."),
+    "TPUBC_E2E_HOST_IP": (
+        "-", "e2e",
+        "Host IP the kind nodes can reach the webhook on (computed by "
+        "hack/e2e-kind.sh)."),
+    "TPUBC_E2E_KEEP": (
+        "0", "e2e",
+        "`1` keeps the kind cluster alive after hack/e2e-kind.sh."),
+}
+
+_HEADER = """\
+# TPUBC_* knob reference
+
+GENERATED by `python -m tools.lint --write-env-docs` from
+tools/lint/env_catalog.py — edit the catalog, not this file.  The
+registry-drift lint pass fails when this table and the knobs the code
+actually reads diverge.
+
+| Knob | Default | Subsystem | Description |
+|---|---|---|---|
+"""
+
+
+def render() -> str:
+    rows = []
+    for name in sorted(CATALOG):
+        default, subsystem, desc = CATALOG[name]
+        rows.append(f"| `{name}` | `{default}` | {subsystem} | {desc} |")
+    return _HEADER + "\n".join(rows) + "\n"

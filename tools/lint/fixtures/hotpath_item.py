@@ -1,0 +1,52 @@
+"""Seeded JAX hot-path violations for the ``hotpath`` pass.  NOT
+scanned by the default run (and never imported — jax here is fictional
+as far as the linter is concerned; the pass reads ASTs, not modules)."""
+
+import os
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("gain",))
+def scale_rows(x, gain):
+    # VIOLATION jit-host-sync: .item() forces a device round-trip.
+    first = x[0, 0].item()
+    # VIOLATION jit-host-sync: np.asarray pulls the tracer to host.
+    host = np.asarray(x)
+    # VIOLATION jit-impure: trace-time clock baked into the program.
+    t = time.time()
+    # VIOLATION jit-impure: trace-time environment read.
+    flag = os.environ.get("HOTPATH_FIXTURE_FLAG", "")
+    # VIOLATION jit-scalar-cast: float() on a traced value.
+    bias = float(x[0, 1])
+    return x * gain + first + host.sum() + t + len(flag) + bias
+
+
+def helper(x):
+    # Reachable FROM scale_all below -> jit-reachable rules apply.
+    # VIOLATION jit-host-sync (transitive reachability).
+    return x.item()
+
+
+@jax.jit
+def scale_all(x):
+    if isinstance(x, jax.core.Tracer):
+        # Tracer-guarded: NOT flagged (the eager/trace split idiom).
+        probe = 0
+    else:
+        probe = int(np.asarray(x).sum())
+    return helper(x) + probe
+
+
+def cold_caller(x):
+    # VIOLATION static-by-keyword: `gain` is static but passed
+    # positionally (cold call sites compile just as wrong).
+    return scale_rows(x, 3)
+
+
+def fine_caller(x):
+    return scale_rows(x, gain=3)   # clean: statics by keyword

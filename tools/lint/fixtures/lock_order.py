@@ -1,0 +1,54 @@
+"""Seeded lock-ordering / reacquire violations for the ``locks`` pass.
+NOT scanned by the default run."""
+
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.rows: list = []  # guarded-by: _lock
+        self.journal = Journal()
+
+    def post(self):
+        # Acquisition order here: Ledger._lock -> Journal._lock ...
+        with self._lock:
+            self.rows.append(1)
+            self.journal.stamp()
+
+    def total(self):
+        with self._lock:
+            return len(self.rows)
+
+
+class Journal:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.marks = 0  # guarded-by: _lock
+        self.ledger: "Ledger" = Ledger()
+
+    def stamp(self):
+        with self._lock:
+            self.marks += 1
+
+    def reconcile(self):
+        # ... and here: Journal._lock -> Ledger._lock.
+        # VIOLATION lock-order: the two paths disagree (deadlock cycle).
+        with self._lock:
+            return self.ledger.total()
+
+
+class Nest:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0  # guarded-by: _lock
+
+    def bump(self):
+        with self._lock:
+            self.value += 1
+
+    def double_bump(self):
+        # VIOLATION lock-reacquire: bump() re-enters the non-reentrant
+        # lock this frame already holds (self-deadlock, not a race).
+        with self._lock:
+            self.bump()

@@ -1,0 +1,42 @@
+"""Seeded lock-discipline violations (tests/test_lint.py pins that the
+``locks`` pass catches every one).  NOT scanned by the default run."""
+
+import threading
+
+
+class Account:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.balance = 0  # guarded-by: _lock
+        self.entries: list = []  # guarded-by: _lock
+        self.owner = "nobody"   # unguarded on purpose: never flagged
+
+    def deposit(self, n):
+        with self._lock:
+            self.balance += n
+            self.entries.append(n)
+
+    def peek(self):
+        # VIOLATION lock-guard: read outside the lock.
+        return self.balance
+
+    def audit(self):
+        with self._lock:
+            total = self.balance
+        # VIOLATION lock-guard: the with block ended.
+        return total + len(self.entries)
+
+    def _apply_locked(self, n):
+        # Caller-holds convention: body reads are legal here.
+        self.balance += n
+
+    def safe_apply(self, n):
+        with self._lock:
+            self._apply_locked(n)
+
+    def sloppy_apply(self, n):
+        # VIOLATION lock-helper-unheld: _locked helper without the lock.
+        self._apply_locked(n)
+
+    def tolerated(self):
+        return self.balance  # lint: allow(lock-guard) — demo escape

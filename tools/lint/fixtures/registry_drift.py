@@ -1,0 +1,43 @@
+"""Seeded registry-drift violations for the ``registry`` pass.  NOT
+scanned by the default run (the env scanner skips tools/lint/fixtures);
+tests/test_lint.py points the pass at this file explicitly."""
+
+import os
+
+
+def read_knobs():
+    # VIOLATION env-undocumented (when scanned): no catalog entry.
+    return os.environ.get("TPUBC_FIXTURE_UNDOCUMENTED", "0")
+
+
+def emit_metrics(reg):
+    # VIOLATION metric-counter-name: counter without _total.
+    reg.inc("fixture_requests")
+    # VIOLATION metric-counter-name: gauge masquerading as a counter.
+    reg.set_gauge("fixture_blocks_total", 4)
+    # VIOLATION metric-type-conflict: one name, two types.
+    reg.observe("fixture_latency_ms", 1.0)
+    reg.set_gauge("fixture_latency_ms", 2.0)
+    # Clean: typed exactly once, suffix matches kind.
+    reg.inc("fixture_retries_total")
+    reg.observe("fixture_wait_ms", 3.0)
+
+
+# A miniature bench with an orphan hard key and an ambiguous family
+# (tests feed this SOURCE to check_bench_keys via a temp file).
+BENCH_FIXTURE_SRC = '''
+_HIGHER_BETTER = ("per_sec", "speedup")
+_LOWER_BETTER_SUFFIX = ("_ms",)
+_LOWER_BETTER_ANYWHERE = ("bytes_per_token",)
+_HARD_KEYS = ("fix_tokens_per_sec", "fix_never_emitted_per_sec",
+              "fix_unjudged_widgets", "fix_speedup_ms")
+_REGRESSION_EXEMPT = ("fix_noise_ms",)
+
+def bench():
+    out = {}
+    out["fix_tokens_per_sec"] = 1.0        # clean: emitted + one family
+    out["fix_unjudged_widgets"] = 2        # family-missing hard key
+    out["fix_speedup_ms"] = 3.0            # BOTH families: ambiguous
+    out["fix_noise_ms"] = 0.1              # exemption target: emitted
+    return out
+'''

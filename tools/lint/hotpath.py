@@ -1,0 +1,413 @@
+"""JAX hot-path purity pass.
+
+Two kinds of hot code:
+
+* JIT-REACHABLE: functions decorated ``@jax.jit`` / ``@partial(jax.jit,
+  ...)`` (or wrapped ``jax.jit(f)``), plus everything they call,
+  resolved through the scanned package's imports — the code that runs
+  under trace.  Host-device syncs there either fail under jit or
+  silently force a device round-trip per trace; impure reads bake a
+  trace-time value into the compiled program (the classic "time.time()
+  under jit returns the compile-time clock" bug).
+* HOT LOOPS: the serving decode/step/verify host loops (configured in
+  ``HOT_LOOPS``).  They legally sync with the device, but each sync is a
+  per-round stall — so every one must be DELIBERATE: either allowlisted
+  in tools/lint/allowlist.txt (the retirement folds, host-side ngram
+  drafting) or flagged.  Hot-loop checking is per-body (not transitive):
+  the loop's own statements are the round's critical path.
+
+Rules:
+
+* ``jit-host-sync``  — ``.item()``, ``np.asarray``/``np.array``,
+  ``jax.device_get``, ``jax.block_until_ready`` in jit-reachable code.
+* ``jit-impure``     — ``time.*()`` clock reads, ``os.environ``,
+  ``random.*`` in jit-reachable code (trace-time constants).
+* ``jit-scalar-cast``— ``float()/int()/bool()`` on a non-literal in
+  jit-reachable code (forces a concrete value out of a tracer).
+* ``hot-loop-sync``  — the sync calls above inside a configured hot
+  loop's own body.
+* ``static-by-keyword`` — a call to a jit function passing one of its
+  ``static_argnames`` POSITIONALLY (this repo pins statics-by-keyword:
+  see workload/decode.py's generate; a positional static silently
+  retraces per value or fails, depending on the jax version).
+
+``isinstance(x, jax.core.Tracer)``-guarded ``if`` statements are skipped
+entirely (both branches): that idiom is exactly how eager-only code
+excludes itself from the trace.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from . import Finding, allowed
+
+# The serving/scheduling host loops whose per-round syncs must be
+# deliberate.  module-dotted-suffix -> qualnames.
+HOT_LOOPS = {
+    "tpu_bootstrap.workload.serving": (
+        "SlotPool.step_round", "SlotPool._decode_round",
+        "SlotPool._speculative_round",
+        "ResidentPool.step_round", "ResidentPool._spec_round",
+        "PagedPool.step_round", "PagedPool._spec_round",
+        "PagedPool._prefill_phase",
+        "Scheduler.step",
+    ),
+}
+
+SYNC_ATTR_CALLS = {"item"}
+IMPURE_TIME = {"time", "monotonic", "monotonic_ns", "perf_counter",
+               "perf_counter_ns", "time_ns"}
+SCALAR_CASTS = {"float", "int", "bool"}
+
+
+def _module_name(rel: str) -> str:
+    return rel[:-3].replace("/", ".") if rel.endswith(".py") else rel
+
+
+def _dotted(node: ast.AST) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+class ModuleInfo:
+    def __init__(self, src):
+        self.src = src
+        self.name = _module_name(src.rel)
+        self.functions: dict = {}     # qualname -> FunctionDef
+        self.classes: dict = {}       # name -> ClassDef
+        self.import_aliases: dict = {}   # local name -> dotted module
+        self.from_imports: dict = {}     # local name -> (module, name)
+        self.np_aliases: set = set()     # names bound to the numpy module
+        self.jit_info: dict = {}      # qualname -> {params, statics}
+        self._collect()
+
+    def _collect(self) -> None:
+        for node in self.src.tree.body:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    local = a.asname or a.name.split(".")[0]
+                    self.import_aliases[local] = a.name
+                    if a.name == "numpy":
+                        self.np_aliases.add(local)
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.from_imports[a.asname or a.name] = (
+                        node.module, a.name)
+            elif isinstance(node, ast.FunctionDef):
+                self.functions[node.name] = node
+                self._collect_nested(node, node.name)
+            elif isinstance(node, ast.ClassDef):
+                self.classes[node.name] = node
+                for item in node.body:
+                    if isinstance(item, ast.FunctionDef):
+                        qual = f"{node.name}.{item.name}"
+                        self.functions[qual] = item
+                        self._collect_nested(item, qual)
+
+    def _collect_nested(self, fn: ast.FunctionDef, outer: str) -> None:
+        """Nested defs (the train/distill `step` closures that get
+        jax.jit-wrapped at the call site) register under a qualified
+        name, plus the bare name when it does not clash."""
+        for node in ast.walk(fn):
+            if isinstance(node, ast.FunctionDef) and node is not fn:
+                self.functions.setdefault(f"{outer}.<locals>.{node.name}",
+                                          node)
+                self.functions.setdefault(node.name, node)
+
+    def jit_roots(self) -> set:
+        roots = set()
+        for qual, fn in self.functions.items():
+            info = _jit_decoration(fn)
+            if info is not None:
+                self.jit_info[qual] = info
+                roots.add(qual)
+        # x = jax.jit(f) / jax.jit(f, ...) at module or function level.
+        for node in ast.walk(self.src.tree):
+            if (isinstance(node, ast.Call)
+                    and _dotted(node.func) in ("jax.jit", "jit")
+                    and node.args
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in self.functions):
+                roots.add(node.args[0].id)
+        return roots
+
+
+def _jit_decoration(fn: ast.FunctionDef) -> dict | None:
+    """{'params': [...], 'statics': {...}} when fn is jit-decorated."""
+    for dec in fn.decorator_list:
+        target, statics = None, set()
+        if _dotted(dec) in ("jax.jit", "jit"):
+            target = dec
+        elif isinstance(dec, ast.Call):
+            name = _dotted(dec.func)
+            if name in ("jax.jit", "jit"):
+                target = dec
+            elif name.endswith("partial") and dec.args and _dotted(
+                    dec.args[0]) in ("jax.jit", "jit"):
+                target = dec
+            if target is not None:
+                for kw in dec.keywords:
+                    if kw.arg == "static_argnames":
+                        for el in ast.walk(kw.value):
+                            if (isinstance(el, ast.Constant)
+                                    and isinstance(el.value, str)):
+                                statics.add(el.value)
+        if target is not None:
+            params = [a.arg for a in (fn.args.posonlyargs + fn.args.args)]
+            return {"params": params, "statics": statics}
+    return None
+
+
+def _is_tracer_guard(node: ast.If) -> bool:
+    for sub in ast.walk(node.test):
+        if isinstance(sub, ast.Attribute) and sub.attr == "Tracer":
+            return True
+        if isinstance(sub, ast.Name) and sub.id in ("tracing",
+                                                    "interpret"):
+            return True
+    return False
+
+
+class _HotChecker(ast.NodeVisitor):
+    def __init__(self, pass_ctx, mod: ModuleInfo, qual: str,
+                 fn: ast.FunctionDef, *, mode: str):
+        self.ctx = pass_ctx
+        self.mod = mod
+        self.qual = qual
+        self.fn = fn
+        self.mode = mode   # "jit" | "loop" | "static" (call sites only)
+        self.loop_mode = mode == "loop"
+        self.sync_rule = "hot-loop-sync" if self.loop_mode else \
+            "jit-host-sync"
+        # Names that hold TRACE-TIME Python values, not tracers: the
+        # function's own static_argnames plus anything unpacked from a
+        # `.shape`/`.ndim`/len() — casting those is how shape math is
+        # DONE under jit, not a sync hazard.
+        self.static_names: set = set(
+            mod.jit_info.get(qual, {}).get("statics", ()))
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                src = node.value
+                is_static_src = (
+                    (isinstance(src, ast.Attribute)
+                     and src.attr in ("shape", "ndim", "size"))
+                    or (isinstance(src, ast.Subscript)
+                        and isinstance(src.value, ast.Attribute)
+                        and src.value.attr == "shape")
+                    or (isinstance(src, ast.Call)
+                        and isinstance(src.func, ast.Name)
+                        and src.func.id == "len"))
+                if is_static_src:
+                    for tgt in node.targets:
+                        for el in ast.walk(tgt):
+                            if isinstance(el, ast.Name):
+                                self.static_names.add(el.id)
+
+    def visit_FunctionDef(self, node):
+        if node is self.fn:
+            self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        pass
+
+    def visit_If(self, node: ast.If):
+        if _is_tracer_guard(node):
+            return   # eager-only / trace-only split: both sides exempt
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        name = _dotted(node.func)
+        leaf = name.rsplit(".", 1)[-1]
+        head = name.split(".", 1)[0] if name else ""
+        line = node.lineno
+        if self.mode != "static" and isinstance(node.func, ast.Attribute):
+            if node.func.attr in SYNC_ATTR_CALLS and not node.args:
+                self._flag(self.sync_rule, line,
+                           f"`.{node.func.attr}()` forces a host-device "
+                           f"sync")
+            if head in self.mod.np_aliases and leaf in ("asarray",
+                                                        "array"):
+                self._flag(self.sync_rule, line,
+                           f"`{name}(...)` copies device values to host")
+            if name in ("jax.device_get", "jax.block_until_ready"):
+                self._flag(self.sync_rule, line,
+                           f"`{name}(...)` blocks on the device")
+            if not self.loop_mode:
+                if head == "time" and leaf in IMPURE_TIME:
+                    self._flag("jit-impure", line,
+                               f"`{name}()` under jit reads the "
+                               f"trace-time clock")
+                if head == "random":
+                    self._flag("jit-impure", line,
+                               f"`{name}()` under jit bakes one sample "
+                               f"into the trace")
+        elif self.mode == "jit" and leaf in SCALAR_CASTS and node.args:
+            arg = node.args[0]
+            benign = (
+                isinstance(arg, ast.Constant)
+                or (isinstance(arg, ast.Name)
+                    and arg.id in self.static_names)
+                or (isinstance(arg, ast.Attribute)
+                    and arg.attr in ("shape", "ndim", "size")))
+            if not benign:
+                self._flag("jit-scalar-cast", line,
+                           f"`{leaf}(...)` on a non-literal forces a "
+                           f"concrete value out of the tracer")
+        # static-by-keyword at resolvable call sites of jit functions.
+        callee = self.ctx.resolve(self.mod, name)
+        if callee is not None:
+            cmod, cqual = callee
+            info = cmod.jit_info.get(cqual)
+            if info and info["statics"] and node.args:
+                hit = [info["params"][i]
+                       for i in range(min(len(node.args),
+                                          len(info["params"])))
+                       if info["params"][i] in info["statics"]]
+                if hit:
+                    self._flag("static-by-keyword", line,
+                               f"call to jit fn {cqual} passes static "
+                               f"arg(s) {', '.join(hit)} positionally "
+                               f"(statics must go by keyword)")
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if self.mode == "jit" and _dotted(node) == "os.environ":
+            self._flag("jit-impure", node.lineno,
+                       "`os.environ` under jit reads the trace-time "
+                       "environment")
+        self.generic_visit(node)
+
+    def _flag(self, rule: str, line: int, why: str) -> None:
+        if self.mod.src.allows(line, rule):
+            return
+        if allowed(self.ctx.allowlist, rule, self.mod.src.rel, self.qual):
+            return
+        where = {"loop": "hot loop", "jit": "jit-reachable code",
+                 "static": "code"}[self.mode]
+        self.ctx.findings.append(Finding(
+            rule, self.mod.src.rel, line,
+            f"{why} (in {where} {self.qual})"))
+
+
+class _PassCtx:
+    def __init__(self, modules: dict, allowlist: set):
+        self.modules = modules         # dotted name -> ModuleInfo
+        self.allowlist = allowlist
+        self.findings: list = []
+
+    def resolve(self, mod: ModuleInfo, dotted: str):
+        """(ModuleInfo, qualname) for a call name, or None."""
+        if not dotted:
+            return None
+        if "." not in dotted:
+            if dotted in mod.functions:
+                return (mod, dotted)
+            imp = mod.from_imports.get(dotted)
+            if imp:
+                target = self._module(imp[0], mod)
+                if target and imp[1] in target.functions:
+                    return (target, imp[1])
+            return None
+        head, rest = dotted.split(".", 1)
+        if head == "self":
+            if "." in rest:
+                return None
+            for qual in mod.functions:
+                if qual.endswith(f".{rest}") or qual == rest:
+                    return (mod, qual)
+            return None
+        target_mod = mod.import_aliases.get(head)
+        if target_mod is None and head in mod.from_imports:
+            imod, iname = mod.from_imports[head]
+            target_mod = f"{imod}.{iname}"
+        if target_mod:
+            target = self._module(target_mod, mod)
+            if target and rest in target.functions:
+                return (target, rest)
+        return None
+
+    def _module(self, dotted: str, frm: ModuleInfo):
+        if dotted.startswith("."):
+            base = frm.name.rsplit(".", 1)[0]
+            dotted = base + dotted.rstrip(".")
+        for name, m in self.modules.items():
+            if name == dotted or name.endswith("." + dotted):
+                return m
+        return None
+
+
+def _called_quals(ctx: _PassCtx, mod: ModuleInfo, fn: ast.FunctionDef):
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            r = ctx.resolve(mod, _dotted(node.func))
+            if r is not None:
+                yield r
+        elif isinstance(node, ast.Name):
+            # bare function references (passed to scan/map/jit)
+            r = ctx.resolve(mod, node.id)
+            if r is not None:
+                yield r
+
+
+def run(files, allowlist: set | None = None) -> list:
+    allowlist = allowlist or set()
+    modules = {}
+    for src in files:
+        mod = ModuleInfo(src)
+        modules[mod.name] = mod
+    ctx = _PassCtx(modules, allowlist)
+
+    # Transitive jit-reachability from decorated/wrapped roots.
+    reachable: set = set()
+    work = []
+    for mod in modules.values():
+        for qual in mod.jit_roots():
+            work.append((mod, qual))
+    while work:
+        mod, qual = work.pop()
+        key = (mod.name, qual)
+        if key in reachable:
+            continue
+        reachable.add(key)
+        fn = mod.functions.get(qual)
+        if fn is None:
+            continue
+        for r in _called_quals(ctx, mod, fn):
+            if (r[0].name, r[1]) not in reachable:
+                work.append(r)
+
+    visited: set = set()
+    for mod_name, qual in sorted(reachable):
+        mod = modules[mod_name]
+        fn = mod.functions.get(qual)
+        if fn is not None:
+            visited.add((mod_name, qual))
+            _HotChecker(ctx, mod, qual, fn, mode="jit").visit(fn)
+
+    # Hot serving loops: body-only, syncs must be deliberate.
+    for suffix, quals in HOT_LOOPS.items():
+        mod = ctx._module(suffix, next(iter(modules.values())))
+        if mod is None:
+            continue
+        for qual in quals:
+            fn = mod.functions.get(qual)
+            if fn is not None and (mod.name, qual) not in reachable:
+                visited.add((mod.name, qual))
+                _HotChecker(ctx, mod, qual, fn, mode="loop").visit(fn)
+
+    # static-by-keyword applies at EVERY call site of a jit function,
+    # hot or cold — a cold caller compiles just as wrong.
+    for mod in modules.values():
+        for qual, fn in mod.functions.items():
+            if (mod.name, qual) not in visited and "<locals>" not in qual:
+                _HotChecker(ctx, mod, qual, fn, mode="static").visit(fn)
+    return ctx.findings

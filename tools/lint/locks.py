@@ -1,0 +1,385 @@
+"""Lock-discipline / race checker.
+
+Annotation convention
+---------------------
+
+A mutable attribute whose every read/write must happen under a lock gets
+a trailing comment on its assignment naming that lock::
+
+    self._recs = OrderedDict()   # guarded-by: _lock
+
+``_lock`` must be a ``threading.Lock``/``RLock`` attribute of the same
+class; ``threading.Condition(self._lock)`` attributes (and plain
+``self._work = self._lock`` aliases) count as acquiring the underlying
+lock.  ``# guarded-by: <owner>`` (angle brackets, e.g.
+``<engine-thread>``) declares single-thread OWNERSHIP instead: the
+annotation is machine-readable documentation — cross-thread access goes
+through a published snapshot, not the lock — and the checker records but
+does not lock-check those attributes.
+
+Checking
+--------
+
+* Every ``self.<attr>`` read or write of a lock-guarded attribute must
+  be lexically inside ``with self.<lock>:`` (or an alias) — except in
+  ``__init__`` (the object is not shared yet) and in methods whose name
+  ends in ``_locked`` (the caller-holds convention).  Call sites of
+  ``self.*_locked(...)`` helpers are then themselves checked for holding
+  the lock.
+* ``lock-reacquire``: calling a method that may acquire a lock the
+  caller already holds (``threading.Lock`` is not reentrant — this is a
+  self-deadlock, not a race).
+* ``lock-order``: nested acquisition order is collected across the whole
+  scanned tree (both lexical ``with`` nesting and calls made while a
+  lock is held, resolved through inferred attribute/return types); any
+  cycle in the resulting order graph is reported.
+
+Escapes: a trailing ``# lint: allow(lock-guard)`` comment, or an
+allowlist entry.  Nested functions and classes (handler closures) are
+not descended into — they run on other threads with other conventions.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from . import Finding, allowed
+
+GUARD_RE = re.compile(r"guarded-by:\s*(<[^>]+>|\w+)")
+LOCK_FACTORIES = {"Lock", "RLock"}
+
+
+def _is_self_attr(node: ast.AST) -> str | None:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _call_name(call: ast.Call) -> str:
+    """Dotted name of a call's func, best effort ('' when dynamic)."""
+    parts = []
+    node = call.func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+class ClassInfo:
+    def __init__(self, src, node: ast.ClassDef):
+        self.src = src
+        self.node = node
+        self.name = node.name
+        self.guarded: dict = {}      # attr -> lock name or "<owner>"
+        self.locks: set = set()      # attrs assigned threading.Lock/RLock
+        self.aliases: dict = {}      # attr -> underlying lock attr
+        self.methods: dict = {n.name: n for n in node.body
+                              if isinstance(n, ast.FunctionDef)}
+        self.attr_types: dict = {}   # attr -> set of class names
+        self._collect()
+
+    def _collect(self) -> None:
+        for meth in self.methods.values():
+            for stmt in ast.walk(meth):
+                if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                           else [stmt.target])
+                value = stmt.value
+                for tgt in targets:
+                    attr = _is_self_attr(tgt)
+                    if attr is None:
+                        continue
+                    m = GUARD_RE.search(self.src.comment_span(stmt))
+                    if m:
+                        self.guarded[attr] = m.group(1)
+                    if isinstance(value, ast.Call):
+                        callee = _call_name(value)
+                        leaf = callee.rsplit(".", 1)[-1]
+                        if leaf in LOCK_FACTORIES:
+                            self.locks.add(attr)
+                        elif leaf == "Condition":
+                            arg = value.args[0] if value.args else None
+                            under = _is_self_attr(arg) if arg else None
+                            if under:
+                                self.aliases[attr] = under
+                        elif leaf and leaf[0].isupper():
+                            self.attr_types.setdefault(attr, set()).add(leaf)
+                    other = _is_self_attr(value) if value else None
+                    if other and other != attr:
+                        # self._work = self._lock style alias
+                        self.aliases.setdefault(attr, other)
+
+    def canonical(self, attr: str) -> str:
+        seen = set()
+        while attr in self.aliases and attr not in seen:
+            seen.add(attr)
+            attr = self.aliases[attr]
+        return attr
+
+    def real_locks(self) -> set:
+        """Lock names referenced by guard annotations (non-ownership)."""
+        return {self.canonical(g) for g in self.guarded.values()
+                if not g.startswith("<")}
+
+
+def _classes(files) -> dict:
+    out: dict = {}
+    for src in files:
+        for node in src.tree.body:
+            if isinstance(node, ast.ClassDef):
+                out[node.name] = ClassInfo(src, node)
+    return out
+
+
+def _return_types(files) -> dict:
+    """Module-level ``def f(...) -> ClassName`` map, keyed by bare name."""
+    out: dict = {}
+    for src in files:
+        for node in src.tree.body:
+            if isinstance(node, ast.FunctionDef) and node.returns is not None:
+                ret = node.returns
+                if isinstance(ret, ast.Name):
+                    out[node.name] = ret.id
+                elif isinstance(ret, ast.Constant) and isinstance(
+                        ret.value, str):
+                    out[node.name] = ret.value.strip("'\" ")
+    return out
+
+
+def _receiver_class(call_func: ast.Attribute, cls: ClassInfo,
+                    classes: dict, returns: dict) -> list:
+    """Classes a ``<recv>.method(...)`` call may dispatch to."""
+    recv = call_func.value
+    if isinstance(recv, ast.Name) and recv.id == "self":
+        return [cls.name]
+    attr = _is_self_attr(recv)
+    if attr is not None:
+        return sorted(t for t in cls.attr_types.get(attr, ())
+                      if t in classes)
+    if isinstance(recv, ast.Call):
+        name = _call_name(recv).rsplit(".", 1)[-1]
+        t = returns.get(name)
+        if t in classes:
+            return [t]
+        if name in classes:  # direct constructor call
+            return [name]
+    return []
+
+
+def _method_calls(meth: ast.FunctionDef):
+    for node in ast.walk(meth):
+        if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute):
+            yield node
+
+
+def _acquire_summaries(classes: dict, returns: dict) -> dict:
+    """(class, method) -> set of (class, lock) the call MAY acquire,
+    transitively through resolvable calls (fixed point)."""
+    summaries: dict = {}
+    for cls in classes.values():
+        for mname, meth in cls.methods.items():
+            direct = set()
+            for node in ast.walk(meth):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        attr = _is_self_attr(item.context_expr)
+                        if attr and cls.canonical(attr) in cls.locks:
+                            direct.add((cls.name, cls.canonical(attr)))
+            summaries[(cls.name, mname)] = direct
+    changed = True
+    while changed:
+        changed = False
+        for cls in classes.values():
+            for mname, meth in cls.methods.items():
+                acc = summaries[(cls.name, mname)]
+                for call in _method_calls(meth):
+                    for tgt in _receiver_class(call.func, cls, classes,
+                                               returns):
+                        callee = (tgt, call.func.attr)
+                        extra = summaries.get(callee, set()) - acc
+                        if extra:
+                            acc |= extra
+                            changed = True
+    return summaries
+
+
+class _MethodChecker(ast.NodeVisitor):
+    """Walks one method body tracking the lexically-held lock set."""
+
+    def __init__(self, pass_ctx, cls: ClassInfo, meth: ast.FunctionDef,
+                 held: frozenset):
+        self.ctx = pass_ctx
+        self.cls = cls
+        self.meth = meth
+        self.held = set(held)
+
+    # Different execution contexts: do not descend.
+    def visit_FunctionDef(self, node):
+        if node is self.meth:
+            self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        pass
+
+    def visit_Lambda(self, node):
+        pass
+
+    def visit_With(self, node: ast.With):
+        acquired = []
+        for item in node.items:
+            attr = _is_self_attr(item.context_expr)
+            if attr is None:
+                continue
+            lock = self.cls.canonical(attr)
+            if lock not in self.cls.locks:
+                continue
+            me = (self.cls.name, lock)
+            if me in self.held:
+                self.ctx.finding(
+                    "lock-reacquire", self.cls, item.context_expr.lineno,
+                    f"{self.cls.name}.{self.meth.name} re-enters "
+                    f"self.{lock} it already holds (threading.Lock is "
+                    f"not reentrant)", self.meth.name)
+            for h in self.held:
+                self.ctx.edge(h, me, self.cls, item.context_expr.lineno)
+            acquired.append(me)
+            self.held.add(me)
+        for item in node.items:
+            self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        for me in acquired:
+            self.held.discard(me)
+
+    def visit_Attribute(self, node: ast.Attribute):
+        attr = _is_self_attr(node)
+        if attr is not None and attr in self.cls.guarded:
+            guard = self.cls.guarded[attr]
+            if not guard.startswith("<"):
+                lock = self.cls.canonical(guard)
+                if (self.cls.name, lock) not in self.held:
+                    self.ctx.finding(
+                        "lock-guard", self.cls, node.lineno,
+                        f"{self.cls.name}.{attr} accessed without "
+                        f"holding self.{guard} (guarded-by: {guard})",
+                        self.meth.name)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        if isinstance(node.func, ast.Attribute):
+            callee_name = node.func.attr
+            # _locked-suffix helpers assume the caller holds the lock.
+            if (_is_self_attr(node.func) is not None
+                    and callee_name.endswith("_locked")
+                    and callee_name in self.cls.methods):
+                need = {(self.cls.name, lk)
+                        for lk in self.cls.real_locks()}
+                if need and not need <= self.held:
+                    self.ctx.finding(
+                        "lock-helper-unheld", self.cls, node.lineno,
+                        f"{self.cls.name}.{callee_name} is a caller-"
+                        f"holds helper but {self.meth.name} calls it "
+                        f"without the lock", self.meth.name)
+            if self.held:
+                for tgt in _receiver_class(node.func, self.cls,
+                                           self.ctx.classes,
+                                           self.ctx.returns):
+                    summary = self.ctx.summaries.get(
+                        (tgt, callee_name), set())
+                    for lk in summary:
+                        if lk in self.held:
+                            self.ctx.finding(
+                                "lock-reacquire", self.cls, node.lineno,
+                                f"{self.cls.name}.{self.meth.name} holds "
+                                f"{lk[0]}.{lk[1]} and calls "
+                                f"{tgt}.{callee_name} which may acquire "
+                                f"it again (self-deadlock)",
+                                self.meth.name)
+                        else:
+                            for h in self.held:
+                                self.ctx.edge(h, lk, self.cls, node.lineno)
+        self.generic_visit(node)
+
+
+class _PassCtx:
+    def __init__(self, classes, returns, summaries, allowlist):
+        self.classes = classes
+        self.returns = returns
+        self.summaries = summaries
+        self.allowlist = allowlist
+        self.findings: list = []
+        self.edges: dict = {}     # (from, to) -> (rel, line)
+
+    def finding(self, rule, cls: ClassInfo, line, msg, qual) -> None:
+        rel = cls.src.rel
+        if cls.src.allows(line, rule):
+            return
+        if allowed(self.allowlist, rule, rel, f"{cls.name}.{qual}"):
+            return
+        self.findings.append(Finding(rule, rel, line, msg))
+
+    def edge(self, frm, to, cls: ClassInfo, line) -> None:
+        if frm != to:
+            self.edges.setdefault((frm, to), (cls.src.rel, line))
+
+
+def _find_cycles(edges: dict) -> list:
+    """Cycles in the lock-order digraph, reported once each."""
+    graph: dict = {}
+    for (frm, to) in edges:
+        graph.setdefault(frm, set()).add(to)
+    cycles, seen_cycles = [], set()
+
+    def dfs(node, stack, onstack):
+        for nxt in sorted(graph.get(node, ())):
+            if nxt in onstack:
+                cyc = tuple(stack[stack.index(nxt):] + [nxt])
+                key = frozenset(cyc)
+                if key not in seen_cycles:
+                    seen_cycles.add(key)
+                    cycles.append(cyc)
+            elif nxt not in visited:
+                visited.add(nxt)
+                dfs(nxt, stack + [nxt], onstack | {nxt})
+
+    visited: set = set()
+    for start in sorted(graph):
+        if start not in visited:
+            visited.add(start)
+            dfs(start, [start], {start})
+    return cycles
+
+
+def run(files, allowlist: set | None = None) -> list:
+    allowlist = allowlist or set()
+    classes = _classes(files)
+    returns = _return_types(files)
+    summaries = _acquire_summaries(classes, returns)
+    ctx = _PassCtx(classes, returns, summaries, allowlist)
+    for cls in classes.values():
+        if not cls.guarded and not cls.locks:
+            continue
+        locked_names = cls.real_locks()
+        for mname, meth in cls.methods.items():
+            if mname == "__init__":
+                continue
+            held = (frozenset((cls.name, lk) for lk in locked_names)
+                    if mname.endswith("_locked") else frozenset())
+            _MethodChecker(ctx, cls, meth, held).visit(meth)
+    for cyc in _find_cycles(ctx.edges):
+        pretty = " -> ".join(f"{c}.{lk}" for c, lk in cyc)
+        rel, line = ctx.edges.get((cyc[0], cyc[1]), ("", 0))
+        ctx.findings.append(Finding(
+            "lock-order", rel, line,
+            f"inconsistent lock acquisition order (cycle): {pretty}"))
+    return ctx.findings

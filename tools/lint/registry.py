@@ -1,0 +1,350 @@
+"""Registry-drift pass: env vars, bench --check keys, metric names.
+
+Three registries whose silent divergence has already cost this repo
+debugging rounds (the stale int8 roofline, the duplicated gauge names):
+
+* ENV VARS — every ``TPUBC_*`` identifier read anywhere (Python and C++
+  via regex, plus charts/hack/CI) must appear in the curated catalog
+  (tools/lint/env_catalog.py) and docs/ENV_VARS.md must be byte-equal to
+  its rendering; every catalog entry must still exist in code; every
+  ``TPUBC_*`` mention in the prose docs must name a real knob.
+* BENCH KEYS — every hard ``--check`` key (and regression-exemption) in
+  bench.py must be emitted by some bench section, and every emitted key
+  must match at most ONE direction family (higher-better vs
+  lower-better); a hard key matching neither family is ungated in the
+  wrong direction.
+* METRICS — every metric name recorded through the telemetry registry
+  (Python ``inc``/``observe``/``set_gauge`` call sites plus the native
+  ``Metrics::instance()`` ones) must keep ONE type (counter vs histogram
+  vs gauge), and the ``_total`` suffix must match countership exactly —
+  the Prometheus exposition renders types from that suffix, so a gauge
+  named ``*_total`` lies to every scraper.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from . import Finding, SourceFile, allowed
+from .env_catalog import CATALOG, render
+
+ENV_RE = re.compile(r"TPUBC_[A-Z0-9_]+")
+ENV_DOC_PATH = "docs/ENV_VARS.md"
+
+# Files/dirs scanned for env-var READS (code + deploy surface).
+ENV_CODE_GLOBS = (
+    "tpu_bootstrap/**/*.py", "bench.py",
+    "native/src/*.cc", "native/include/**/*.h", "native/bin/*.cc",
+    "native/CMakeLists.txt",
+    "charts/**/*.yaml", "charts/**/*.tpl",
+    "hack/*.sh", ".github/workflows/*.yml",
+    "tools/lint/fixtures/*.py",
+)
+# Prose docs checked for stale knob mentions.
+ENV_DOC_GLOBS = ("ARCHITECTURE.md", "README.md", "MIGRATION.md")
+
+NATIVE_METRIC_RE = re.compile(
+    r"\.(inc|observe|set_gauge)\(\s*\"([a-z0-9_]+)\"")
+
+_KIND = {"inc": "counter", "observe": "histogram", "set_gauge": "gauge"}
+
+
+# ---------------------------------------------------------------------------
+# env vars
+# ---------------------------------------------------------------------------
+
+def scan_env_vars(root: Path, globs=ENV_CODE_GLOBS) -> dict:
+    """name -> first (relpath, line) the identifier appears at."""
+    seen: dict = {}
+    for pattern in globs:
+        for path in sorted(root.glob(pattern)):
+            if "__pycache__" in path.parts or not path.is_file():
+                continue
+            if "fixtures" in path.parts and "tools" in path.parts:
+                continue  # seeded violations don't demand documentation
+            try:
+                text = path.read_text()
+            except (OSError, UnicodeDecodeError):
+                continue
+            for i, line in enumerate(text.splitlines(), 1):
+                for m in ENV_RE.finditer(line):
+                    seen.setdefault(
+                        m.group(0), (str(path.relative_to(root)), i))
+    return seen
+
+
+def check_env_vars(root: Path, catalog=None) -> list:
+    catalog = CATALOG if catalog is None else catalog
+    findings = []
+    seen = scan_env_vars(root)
+    for name, (rel, line) in sorted(seen.items()):
+        if name not in catalog:
+            findings.append(Finding(
+                "env-undocumented", rel, line,
+                f"{name} is read by the code but missing from "
+                f"tools/lint/env_catalog.py (+ docs/ENV_VARS.md)"))
+    for name in sorted(set(catalog) - set(seen)):
+        findings.append(Finding(
+            "env-stale-doc", "tools/lint/env_catalog.py", 1,
+            f"{name} is documented but nothing in the tree reads it"))
+    doc = root / ENV_DOC_PATH
+    if catalog is CATALOG:
+        want = render()
+        have = doc.read_text() if doc.exists() else ""
+        if have != want:
+            findings.append(Finding(
+                "env-docs-drift", ENV_DOC_PATH, 1,
+                "docs/ENV_VARS.md is out of date — regenerate with "
+                "`python -m tools.lint --write-env-docs`"))
+    for pattern in ENV_DOC_GLOBS:
+        for path in sorted(root.glob(pattern)):
+            for i, line in enumerate(path.read_text().splitlines(), 1):
+                for m in ENV_RE.finditer(line):
+                    if m.group(0) not in catalog and m.group(0) in seen:
+                        continue  # caught above as env-undocumented
+                    if m.group(0) not in catalog:
+                        findings.append(Finding(
+                            "env-stale-doc",
+                            str(path.relative_to(root)), i,
+                            f"{m.group(0)} is mentioned here but no "
+                            f"code reads it"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# bench --check keys
+# ---------------------------------------------------------------------------
+
+def _tuple_of_strings(node: ast.AST) -> list:
+    out = []
+    for el in ast.walk(node):
+        if isinstance(el, ast.Constant) and isinstance(el.value, str):
+            out.append(el.value)
+    return out
+
+
+def _emitted_patterns(tree: ast.AST) -> list:
+    """(pattern-regex, line) for every key the bench can emit: literal
+    and f-string keys of subscript stores plus dict literals (section
+    result blocks, .update() payloads)."""
+    pats = []
+
+    def add(node):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            pats.append((re.escape(node.value), node.lineno, node.value))
+        elif isinstance(node, ast.JoinedStr):
+            rx = ""
+            for part in node.values:
+                if isinstance(part, ast.Constant):
+                    rx += re.escape(str(part.value))
+                else:
+                    rx += r"[A-Za-z0-9_.\-]+"
+            pats.append((rx, node.lineno, None))
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for tgt in targets:
+                if isinstance(tgt, ast.Subscript):
+                    add(tgt.slice)
+        elif isinstance(node, ast.Dict):
+            for key in node.keys:
+                if key is not None:
+                    add(key)
+    return pats
+
+
+def _embedded_scripts(tree: ast.AST):
+    """The bench runs its workload half from embedded ``*_SCRIPT``
+    source strings (subprocess isolation); their emitted keys count."""
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id.endswith("_SCRIPT")
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            try:
+                yield ast.parse(node.value.value)
+            except SyntaxError:
+                yield None   # surfaced by the caller as bench-structure
+
+
+def check_bench_keys(bench_path: Path, rel: str = "bench.py") -> list:
+    findings: list = []
+    tree = ast.parse(bench_path.read_text(), filename=str(bench_path))
+    consts: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            if name in ("_HARD_KEYS", "_HIGHER_BETTER",
+                        "_LOWER_BETTER_SUFFIX", "_LOWER_BETTER_ANYWHERE",
+                        "_REGRESSION_EXEMPT"):
+                consts[name] = (_tuple_of_strings(node.value),
+                                node.lineno)
+    for want in ("_HARD_KEYS", "_HIGHER_BETTER", "_LOWER_BETTER_SUFFIX",
+                 "_LOWER_BETTER_ANYWHERE"):
+        if want not in consts:
+            findings.append(Finding(
+                "bench-structure", rel, 1,
+                f"could not locate {want} in the bench — the drift "
+                f"pass is blind without it"))
+            return findings
+    higher, _ = consts["_HIGHER_BETTER"]
+    lower_sfx, _ = consts["_LOWER_BETTER_SUFFIX"]
+    lower_any, _ = consts["_LOWER_BETTER_ANYWHERE"]
+
+    def direction(key: str) -> set:
+        d = set()
+        if any(s in key for s in higher):
+            d.add("higher")
+        if (any(key.endswith(s) for s in lower_sfx)
+                or any(s in key for s in lower_any)):
+            d.add("lower")
+        return d
+
+    emitted = _emitted_patterns(tree)
+    for sub in _embedded_scripts(tree):
+        if sub is None:
+            findings.append(Finding(
+                "bench-structure", rel, 1,
+                "an embedded *_SCRIPT source string does not parse — "
+                "its emitted keys are invisible to the drift gate"))
+            continue
+        emitted += _emitted_patterns(sub)
+
+    def is_emitted(key: str) -> bool:
+        return any(re.fullmatch(rx, key) for rx, _, _ in emitted)
+
+    hard, hard_line = consts["_HARD_KEYS"]
+    for key in hard:
+        if not is_emitted(key):
+            findings.append(Finding(
+                "bench-orphan-check-key", rel, hard_line,
+                f"--check hard key {key!r} is not emitted by any bench "
+                f"section"))
+        d = direction(key)
+        if len(d) == 0:
+            findings.append(Finding(
+                "bench-family-missing", rel, hard_line,
+                f"--check hard key {key!r} matches no higher/lower-"
+                f"better family — its regressions are invisible"))
+        elif len(d) == 2:
+            findings.append(Finding(
+                "bench-family-ambiguous", rel, hard_line,
+                f"--check hard key {key!r} matches BOTH direction "
+                f"families — the gate's direction is undefined"))
+    for key in consts.get("_REGRESSION_EXEMPT", ([], 0))[0]:
+        if not is_emitted(key):
+            findings.append(Finding(
+                "bench-orphan-check-key", rel,
+                consts["_REGRESSION_EXEMPT"][1],
+                f"regression exemption {key!r} matches no emitted key"))
+    # Any emitted literal key claimed by BOTH families is misjudged.
+    flagged = set()
+    for _, line, literal in emitted:
+        if literal is None or literal in flagged:
+            continue  # f-string keys are judged per concrete name
+        if len(direction(literal)) == 2:
+            flagged.add(literal)
+            findings.append(Finding(
+                "bench-family-ambiguous", rel, line,
+                f"bench key {literal!r} matches BOTH direction "
+                f"families"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# metric names
+# ---------------------------------------------------------------------------
+
+def _python_metric_sites(files) -> list:
+    """(pattern, is_pattern, kind, rel, line) for registry call sites."""
+    sites = []
+    for src in files:
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _KIND and node.args):
+                continue
+            arg = node.args[0]
+            kind = _KIND[node.func.attr]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                sites.append((arg.value, False, kind, src.rel,
+                              node.lineno))
+            elif isinstance(arg, ast.JoinedStr):
+                rx = ""
+                for part in arg.values:
+                    if isinstance(part, ast.Constant):
+                        rx += re.escape(str(part.value))
+                    else:
+                        rx += r"[A-Za-z0-9_]+"
+                sites.append((rx, True, kind, src.rel, node.lineno))
+    return sites
+
+
+def _native_metric_sites(root: Path) -> list:
+    sites = []
+    for path in sorted(root.glob("native/src/*.cc")):
+        for i, line in enumerate(path.read_text().splitlines(), 1):
+            for m in NATIVE_METRIC_RE.finditer(line):
+                sites.append((m.group(2), False, _KIND[m.group(1)],
+                              str(path.relative_to(root)), i))
+    return sites
+
+
+def check_metrics(sites, allowlist: set | None = None) -> list:
+    allowlist = allowlist or set()
+    findings: list = []
+    concrete: dict = {}   # name -> (kind, rel, line)
+    patterns = []
+    for name, is_pat, kind, rel, line in sites:
+        if is_pat:
+            patterns.append((name, kind, rel, line))
+            continue
+        prior = concrete.get(name)
+        if prior and prior[0] != kind:
+            findings.append(Finding(
+                "metric-type-conflict", rel, line,
+                f"metric {name!r} recorded as {kind} here but as "
+                f"{prior[0]} at {prior[1]}:{prior[2]} — one name, one "
+                f"type"))
+        concrete.setdefault(name, (kind, rel, line))
+    for name, (kind, rel, line) in sorted(concrete.items()):
+        if allowed(allowlist, "metric-counter-name", rel, name):
+            continue
+        if kind == "counter" and not name.endswith("_total"):
+            findings.append(Finding(
+                "metric-counter-name", rel, line,
+                f"counter {name!r} must end in _total (the Prometheus "
+                f"exposition types series by that suffix)"))
+        elif kind != "counter" and name.endswith("_total"):
+            findings.append(Finding(
+                "metric-counter-name", rel, line,
+                f"{kind} {name!r} ends in _total and will render as a "
+                f"counter to every scraper — rename it"))
+    for rx, kind, rel, line in patterns:
+        for name, (ckind, crel, cline) in concrete.items():
+            if ckind != kind and re.fullmatch(rx, name):
+                findings.append(Finding(
+                    "metric-type-conflict", rel, line,
+                    f"metric pattern {rx!r} ({kind}) collides with "
+                    f"{name!r} ({ckind}) at {crel}:{cline}"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+
+def run(root: Path, allowlist: set | None = None, files=None) -> list:
+    from . import python_targets
+    files = python_targets(root) if files is None else files
+    findings = check_env_vars(root)
+    bench = root / "bench.py"
+    if bench.exists():
+        findings += check_bench_keys(bench)
+    sites = _python_metric_sites(files) + _native_metric_sites(root)
+    findings += check_metrics(sites, allowlist)
+    return findings

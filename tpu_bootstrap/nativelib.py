@@ -33,14 +33,27 @@ def _libssl_flags() -> list:
 
 def _build_fallback(force: bool = False) -> None:
     """Direct g++ build for images without cmake/ninja (mirrors
-    CMakeLists.txt: one core objects set -> capi .so + four daemons).
-    Object files are cached by mtime against their source and the newest
-    header, so incremental edits recompile only what changed."""
-    obj_dir = BUILD_DIR / "obj"
+    CMakeLists.txt: one core objects set -> capi .so + four daemons,
+    -Wall -Wextra -Werror, TPUBC_SANITIZE presets). Object files are
+    cached by mtime against their source and the newest header, so
+    incremental edits recompile only what changed; sanitizer modes keep
+    their own object dirs and a mode stamp forces a relink when the
+    mode changes (a libtpubc_capi.so silently carrying last run's TSan
+    instrumentation would poison every non-sanitizer test)."""
+    sanitize = os.environ.get("TPUBC_SANITIZE", "")
+    obj_dir = BUILD_DIR / (f"obj-{sanitize.replace(',', '-')}" if sanitize
+                           else "obj")
     obj_dir.mkdir(parents=True, exist_ok=True)
+    stamp = BUILD_DIR / ".sanitize-mode"
+    prior = stamp.read_text() if stamp.exists() else ""
+    relink = force or prior != sanitize
     include = NATIVE_DIR / "include"
     newest_header = max(p.stat().st_mtime for p in include.rglob("*.h"))
-    cxx = ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", "-Wextra", f"-I{include}"]
+    cxx = ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", "-Wextra",
+           "-Werror", f"-I{include}"]
+    san_flags = ([f"-fsanitize={sanitize}", "-fno-omit-frame-pointer",
+                  "-g"] if sanitize else [])
+    cxx += san_flags
 
     def compile_one(src: Path) -> Path:
         obj = obj_dir / (src.stem + ".o")
@@ -56,34 +69,43 @@ def _build_fallback(force: bool = False) -> None:
     link = _libssl_flags() + ["-lpthread"]
 
     def link_if_stale(out: Path, objs: list, extra: list) -> None:
-        if (not force and out.exists()
+        if (not relink and out.exists()
                 and out.stat().st_mtime >= max(o.stat().st_mtime for o in objs)):
             return
-        subprocess.run(["g++"] + extra + [str(o) for o in objs] + ["-o", str(out)] + link,
+        subprocess.run(["g++"] + extra + san_flags + [str(o) for o in objs]
+                       + ["-o", str(out)] + link,
                        check=True, capture_output=True)
 
     link_if_stale(LIB_PATH, [capi] + core, ["-shared"])
     for daemon in DAEMONS:
         bin_obj = compile_one(NATIVE_DIR / "bin" / f"{daemon}.cc")
         link_if_stale(BUILD_DIR / f"tpubc-{daemon}", [bin_obj] + core, [])
+    stamp.write_text(sanitize)
 
 
 def build_native(force: bool = False) -> None:
     """Configure + build the native tree (cached; ninja makes this a no-op).
-    Falls back to a direct g++ build when cmake/ninja are not installed."""
+    Falls back to a direct g++ build when cmake/ninja are not installed.
+    TPUBC_SANITIZE in the environment selects the sanitizer preset on
+    either path (CMake -DTPUBC_SANITIZE=... cache entry / fallback
+    flags); switching modes reconfigures so a stale instrumented build
+    never leaks into a plain run."""
     if shutil.which("cmake") is None or shutil.which("ninja") is None:
         _build_fallback(force)
         return
-    if LIB_PATH.exists() and not force:
-        # ninja is fast; always re-run so edited C++ is picked up in dev.
-        pass
-    if not (BUILD_DIR / "build.ninja").exists():
+    sanitize = os.environ.get("TPUBC_SANITIZE", "")
+    stamp = BUILD_DIR / ".sanitize-mode"
+    prior = stamp.read_text() if stamp.exists() else ""
+    if not (BUILD_DIR / "build.ninja").exists() or prior != sanitize:
         subprocess.run(
-            ["cmake", "-S", str(NATIVE_DIR), "-B", str(BUILD_DIR), "-G", "Ninja"],
+            ["cmake", "-S", str(NATIVE_DIR), "-B", str(BUILD_DIR),
+             "-G", "Ninja", f"-DTPUBC_SANITIZE={sanitize}"],
             check=True,
             capture_output=True,
         )
     subprocess.run(["ninja", "-C", str(BUILD_DIR)], check=True, capture_output=True)
+    BUILD_DIR.mkdir(parents=True, exist_ok=True)
+    stamp.write_text(sanitize)
 
 
 class NativeError(RuntimeError):

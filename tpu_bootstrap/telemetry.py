@@ -99,9 +99,9 @@ class Tracer:
                 capacity = 4096
         self.process = process
         self.capacity = max(capacity, 1)
-        self._spans: deque = deque(maxlen=self.capacity)
-        self.dropped = 0
         self._lock = threading.Lock()
+        self._spans: deque = deque(maxlen=self.capacity)  # guarded-by: _lock
+        self.dropped = 0  # guarded-by: _lock
 
     def record(self, span: Span) -> None:
         with self._lock:
@@ -130,11 +130,17 @@ class Tracer:
             self.dropped = 0
 
     def to_json(self) -> dict:
-        """Same shape as the daemons' /traces.json."""
+        """Same shape as the daemons' /traces.json. Span list and drop
+        count are captured under ONE lock hold: a render racing a
+        recorder must not pair a fresh span list with a stale (or
+        torn) drop counter."""
+        with self._lock:
+            spans = list(self._spans)
+            dropped = self.dropped
         return {
             "process": self.process,
-            "dropped": self.dropped,
-            "spans": [s.to_dict() for s in self.spans()],
+            "dropped": dropped,
+            "spans": [s.to_dict() for s in spans],
         }
 
     def to_chrome(self) -> dict:
@@ -350,8 +356,9 @@ class MetricsRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._values: dict = {}      # counters and gauges share one map
-        self._histograms: dict = {}
+        # counters and gauges share one map
+        self._values: dict = {}      # guarded-by: _lock
+        self._histograms: dict = {}  # guarded-by: _lock
 
     def inc(self, name: str, delta=1, labels=None) -> None:
         name = _label_key(name, labels)
@@ -503,7 +510,11 @@ def record_kv_block_pool(total: int, used: int, free: int,
     shrinking it: the peak-headroom key must read a warm cache as
     reclaimable capacity, not as pressure."""
     reg = _metrics
-    reg.set_gauge("kv_blocks_total", total)
+    # "capacity", not "_total": the Prometheus exposition types series
+    # by the _total suffix, and a gauge named kv_blocks_total would
+    # render as a counter to every scraper (caught by the registry
+    # lint pass; see MIGRATION.md).
+    reg.set_gauge("kv_blocks_capacity", total)
     reg.set_gauge("kv_blocks_used", used)
     reg.set_gauge("kv_blocks_free", free)
     reg.set_gauge("kv_blocks_cached", cached)
@@ -547,22 +558,22 @@ class RateWindow:
 
     def __init__(self, window_secs: float = 60.0):
         self.window = window_secs
-        self._events = deque()  # (t, weight)
         self._lock = threading.Lock()
+        self._events = deque()  # (t, weight)  # guarded-by: _lock
 
     def add(self, weight: float = 1.0, t: float | None = None) -> None:
         t = time.monotonic() if t is None else t
         with self._lock:
             self._events.append((t, weight))
-            self._trim(t)
+            self._trim_locked(t)
 
     def per_sec(self, t: float | None = None) -> float:
         t = time.monotonic() if t is None else t
         with self._lock:
-            self._trim(t)
+            self._trim_locked(t)
             return sum(w for _, w in self._events) / self.window
 
-    def _trim(self, t: float) -> None:
+    def _trim_locked(self, t: float) -> None:
         cutoff = t - self.window
         while self._events and self._events[0][0] < cutoff:
             self._events.popleft()

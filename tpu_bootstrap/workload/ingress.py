@@ -168,39 +168,52 @@ class IngressServer:
         self.max_queue = max_queue
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
-        self._pending: list = []  # [(Request, out_queue)] awaiting handoff
-        self._streams: dict = {}  # rid -> out_queue once handed to the engine
-        self._next_rid = 0
-        self._stop = False
-        self.last_error: str | None = None  # last failed round, /healthz
+        self._pending: list = []  # [(Request, out_queue)] awaiting handoff  # guarded-by: _lock
+        self._streams: dict = {}  # rid -> out_queue once handed to the engine  # guarded-by: _lock
+        self._next_rid = 0  # guarded-by: _lock
+        self._stop = False  # guarded-by: _lock
+        self.last_error: str | None = None  # last failed round, /healthz  # guarded-by: _lock
         # Serving latency telemetry: per-rid submit time while in
         # flight; rolling windows of time-to-first-token and total
         # latency for completed requests (the operator-facing numbers a
         # serving deployment is judged by). Maxlen bounds memory on
         # long-lived slices.
-        self._submit_t: dict = {}   # rid -> (t_submit, t_first or None)
-        self._ttft_ms = collections.deque(maxlen=256)
-        self._total_ms = collections.deque(maxlen=256)
-        self._served = 0
+        self._submit_t: dict = {}   # rid -> (t_submit, t_first or None)  # guarded-by: _lock
+        self._ttft_ms = collections.deque(maxlen=256)  # guarded-by: _lock
+        self._total_ms = collections.deque(maxlen=256)  # guarded-by: _lock
+        self._served = 0  # guarded-by: _lock
         # The /metrics half of the same numbers (telemetry.metrics()):
         # TTFT/inter-token/total-latency histograms plus rolling
         # qps/tokens-per-sec gauges — the scrape surface the controller
         # folds into status.slice.workload.
-        self._last_ev_t: dict = {}  # rid -> last event time (inter-token)
+        self._last_ev_t: dict = {}  # rid -> last event time (inter-token)  # guarded-by: _lock
         # rid -> prompt tokens the paged engine served from its prefix
         # cache at admission (0 on other engines): surfaced as
         # ``cached_tokens`` on the request's final response object and
         # used to split the TTFT histograms cached-vs-cold — the
         # latency win prefix caching exists for must be attributable,
         # not averaged away.
-        self._cached_toks: dict = {}
+        self._cached_toks: dict = {}  # guarded-by: _lock
         # rid -> (priority, effective trace id): the per-class TTFT
         # label and the trace id echoed on the final response (the
         # client's own id when it sent one, else the process root the
         # span tree actually rooted under).
-        self._req_meta: dict = {}
+        self._req_meta: dict = {}  # guarded-by: _lock
         self._qps_window = telemetry.RateWindow()
         self._tps_window = telemetry.RateWindow()
+        # /poolz + /healthz occupancy: pool and scheduler internals are
+        # engine-owned (guarded-by: <engine-thread> in serving.py), so
+        # handler threads never walk them live — the ENGINE snapshots
+        # both at every round boundary (and after failed-round
+        # recovery) and publishes the result here. A reader gets one
+        # coherent round-boundary view or the previous one, never a
+        # half-mutated block table (the torn-/poolz race the lint
+        # lock pass exists to catch).
+        self._poolz: dict = {  # guarded-by: _lock
+            "as_of_us": telemetry.now_us(),
+            "pool": self.pool.snapshot(),
+            "scheduler": self.sched.snapshot(),
+        }
 
         outer = self
 
@@ -242,10 +255,16 @@ class IngressServer:
                 if self.path == "/poolz":
                     # Scheduler/pool snapshot: per-state block counts,
                     # per-request footprints, waiting-queue contents,
-                    # the overcommit EMA, and watermark headroom.
-                    return self._json(200, {
-                        "pool": outer.pool.snapshot(),
-                        "scheduler": outer.sched.snapshot()})
+                    # the overcommit EMA, and watermark headroom. The
+                    # pool half is the engine's round-boundary
+                    # publication (never a live walk of engine-owned
+                    # state); the scheduler half re-reads live under
+                    # the scheduler's own lock so freshly queued
+                    # requests show before their first round.
+                    with outer._lock:
+                        snap = dict(outer._poolz)
+                    snap["scheduler"] = outer.sched.snapshot()
+                    return self._json(200, snap)
                 if self.path == "/traces.json":
                     # Same shape as the daemons' /traces.json, so the
                     # requestz/statusz trace-id join works against the
@@ -254,16 +273,18 @@ class IngressServer:
                 if self.path not in ("/healthz", "/health"):
                     return self._json(404, {"error": f"unknown path {self.path}"})
                 with outer._lock:
-                    active = sum(1 for s in outer.pool.slots if s is not None)
-                    # Waiting = handed-off-but-unsubmitted plus the
-                    # Scheduler's ordered queue (len() reads are safe
-                    # without the engine's cooperation).
-                    queued = (len(outer._pending)
-                              + outer.sched.queue_depth())
+                    # Occupancy comes from the engine's round-boundary
+                    # publication: pool.slots is engine-owned and a
+                    # live walk here would race a mid-round scatter.
+                    active = outer._poolz["pool"]["active"]
                     last_error = outer.last_error
                     served = outer._served
+                    pending = len(outer._pending)
                     ttft = sorted(outer._ttft_ms)
                     total = sorted(outer._total_ms)
+                # Waiting = handed-off-but-unsubmitted plus the
+                # Scheduler's ordered queue (its own lock).
+                queued = pending + outer.sched.queue_depth()
                 # ok tracks the ENGINE, not just the counters: a dead
                 # engine thread means every request will hang, and the
                 # Service's readiness probe must see that.
@@ -465,11 +486,15 @@ class IngressServer:
                 events = self.sched.step()
                 # Paged engines report per-request prefix-cache hits at
                 # admission (inside the scheduler's round); harvest and
-                # pop to keep the pool-side map bounded.
+                # pop to keep the pool-side map bounded. _cached_toks
+                # is lock-guarded (handler threads observe it through
+                # the final-response path), so the harvest holds it —
+                # the lint lock pass caught this one running bare.
                 rct = getattr(self.pool, "request_cached_tokens", None)
                 if rct:
-                    for rid in list(rct):
-                        self._cached_toks[rid] = rct.pop(rid)
+                    with self._work:
+                        for rid in list(rct):
+                            self._cached_toks[rid] = rct.pop(rid)
             except Exception as e:  # noqa: BLE001
                 # The engine must SURVIVE a failed round (a transient
                 # backend error would otherwise kill the thread and
@@ -499,6 +524,7 @@ class IngressServer:
                     # waiting queue too, or the engine would replay dead
                     # requests forever.
                     self.sched.reset()
+                self._publish_poolz()
                 continue
             now = time.monotonic()
             reg = telemetry.metrics()
@@ -578,6 +604,23 @@ class IngressServer:
                         "serve_slot_utilization",
                         round(stats["active_slot_steps"]
                               / stats["slot_steps"], 3))
+            # Round boundary: the pool is quiescent, so NOW is the one
+            # moment a coherent cross-thread view of it exists —
+            # publish it for /poolz and /healthz.
+            self._publish_poolz()
+
+    def _publish_poolz(self) -> None:
+        """Snapshot pool + scheduler state and publish it under the
+        ingress lock (ENGINE THREAD ONLY: pool internals are
+        engine-owned; the snapshot walk itself is what must not race a
+        round)."""
+        snap = {
+            "as_of_us": telemetry.now_us(),
+            "pool": self.pool.snapshot(),
+            "scheduler": self.sched.snapshot(),
+        }
+        with self._work:
+            self._poolz = snap
 
     # ---- lifecycle -------------------------------------------------------
 

@@ -102,8 +102,8 @@ def eval_quality(base_params: Params, quant_params: Params,
       quantized model's argmax equals the base model's. THE serving
       number: greedy decode and speculative acceptance both live and die
       by argmax stability, not logit closeness."""
-    base_xent, base_argmax = score(base_params, tokens, cfg)
-    quant_xent, quant_argmax = score(quant_params, tokens, cfg)
+    base_xent, base_argmax = score(base_params, tokens, cfg=cfg)
+    quant_xent, quant_argmax = score(quant_params, tokens, cfg=cfg)
     ppl_base = float(np.exp(float(base_xent)))
     ppl_quant = float(np.exp(float(quant_xent)))
     agree = float(np.mean(np.asarray(base_argmax) == np.asarray(quant_argmax)))

@@ -325,9 +325,9 @@ class RequestLog:
         self.max_events = max(8, max_events)
         self.enabled = (request_events_enabled() if enabled is None
                         else enabled)
-        self._recs: OrderedDict = OrderedDict()  # rid -> RequestRecord
         self._lock = threading.Lock()
-        self._phase_totals = {p: 0.0 for p in self.PHASES}
+        self._recs: OrderedDict = OrderedDict()  # rid -> RequestRecord  # guarded-by: _lock
+        self._phase_totals = {p: 0.0 for p in self.PHASES}  # guarded-by: _lock
 
     # ---- recording --------------------------------------------------------
 
@@ -518,8 +518,12 @@ class _PoolBase:
 
     def snapshot(self) -> dict:
         """The /poolz pool half: engine, occupancy, per-row state, and
-        the cumulative stats dict. Read-only and defensive (the engine
-        thread mutates slots concurrently; a snapshot is advisory)."""
+        the cumulative stats dict. Pool state is engine-owned
+        (guarded-by: <engine-thread>), so this must be CALLED from the
+        engine thread — the ingress calls it at round boundaries and
+        publishes the result under its lock for handler threads
+        (IngressServer._poolz); calling it concurrently with a live
+        step_round would tear the slot walk."""
         slots = [self._slot_json(i, s)
                  for i, s in enumerate(list(self.slots)) if s is not None]
         return {"engine": type(self).__name__,
@@ -767,8 +771,11 @@ class SlotPool(_PoolBase):
         self._dummy_keys = (
             [jax.random.fold_in(jax.random.fold_in(key, 0), i)
              for i in range(batch_size)] if temperature > 0 else None)
-        self.slots: list = [None] * batch_size
-        self.stats = {"rounds": 0, "slot_steps": 0, "active_slot_steps": 0,
+        # Single-owner engine state: cross-thread consumers (the
+        # ingress /poolz, /healthz) read the snapshot the engine
+        # publishes at round boundaries, never these directly.
+        self.slots: list = [None] * batch_size  # guarded-by: <engine-thread>
+        self.stats = {"rounds": 0, "slot_steps": 0, "active_slot_steps": 0,  # guarded-by: <engine-thread>
                       "replayed_tokens": 0}
         if draft_params is not None:
             self.stats.update({"verify_rounds": 0, "committed_tokens": 0,
@@ -1114,8 +1121,8 @@ class ResidentPool(_PoolBase):
         self.dcaches = (init_cache(draft_cfg, batch_size, cfg.max_seq_len,
                                    quantized=kv_quant)
                         if draft_params is not None else None)
-        self.slots: list = [None] * batch_size
-        self.stats = {"rounds": 0, "slot_steps": 0, "active_slot_steps": 0,
+        self.slots: list = [None] * batch_size  # guarded-by: <engine-thread>
+        self.stats = {"rounds": 0, "slot_steps": 0, "active_slot_steps": 0,  # guarded-by: <engine-thread>
                       "prefill_tokens": 0}
         if self._spec:
             self.stats.update({"verify_rounds": 0, "committed_tokens": 0,
@@ -1159,14 +1166,15 @@ class ResidentPool(_PoolBase):
         row = np.zeros((1, w), np.int32)
         row[0, :len(r.tokens)] = r.tokens  # RIGHT-padded: row positions
         # are its true positions from 0
-        temp = _prefill_temp(self.params, jnp.asarray(row), self.cfg,
-                             self.kv_quant)
+        temp = _prefill_temp(self.params, jnp.asarray(row), cfg=self.cfg,
+                             kv_quant=self.kv_quant)
         self.caches = _paste_row(self.caches, temp, jnp.int32(i))
         if self.draft_params is not None:
             # The draft's resident cache mirrors the target's frontier:
             # prefill it once at admission too.
             dtemp = _prefill_temp(self.draft_params, jnp.asarray(row),
-                                  self.draft_cfg, self.kv_quant)
+                                  cfg=self.draft_cfg,
+                                  kv_quant=self.kv_quant)
             self.dcaches = _paste_row(self.dcaches, dtemp, jnp.int32(i))
         self.stats["prefill_tokens"] += len(r.tokens)
         self._levent(r.rid, "prefill_chunk", tokens=len(r.tokens),
@@ -1220,8 +1228,8 @@ class ResidentPool(_PoolBase):
             len(s.history) for s in active)) + chunk - 1),
             self.cfg.max_seq_len)
         out, self.caches, _ = _resident_chunk(
-            self.params, self.caches, last, pos, self.cfg, chunk, lb,
-            **sample_kw)
+            self.params, self.caches, last, pos, cfg=self.cfg,
+            chunk=chunk, lb=lb, **sample_kw)
         out = np.asarray(out)
         self.stats["rounds"] += 1
         self.stats["slot_steps"] += self.batch_size * chunk
@@ -1248,13 +1256,13 @@ class ResidentPool(_PoolBase):
         # draft scan, the target verify, and the host-side commit each
         # get their own serve_spec_*_ms histogram, so a bad speedup is
         # attributable to a phase instead of a single opaque round time.
-        window = _slice_windows(self.caches, lb)
+        window = _slice_windows(self.caches, lb=lb)
         t0 = time.perf_counter()
         if self.draft_params is not None:
-            dwindow = _slice_windows(self.dcaches, lb)
+            dwindow = _slice_windows(self.dcaches, lb=lb)
             drafts, dwindow = _spec_draft_window(
-                self.draft_params, dwindow, last, pos, self.draft_cfg,
-                self.gamma)
+                self.draft_params, dwindow, last, pos,
+                draft_cfg=self.draft_cfg, gamma=self.gamma)
             drafts = jax.block_until_ready(drafts)
         else:
             # Prompt-lookup drafting: the draft phase is a host-side
@@ -1266,7 +1274,8 @@ class ResidentPool(_PoolBase):
                  for s in self.slots], jnp.int32)
         t1 = time.perf_counter()
         greedy, counts, window = _spec_verify_window(
-            self.params, window, drafts, last, pos, self.cfg, self.gamma)
+            self.params, window, drafts, last, pos, cfg=self.cfg,
+            gamma=self.gamma)
         greedy = jax.block_until_ready(greedy)
         t2 = time.perf_counter()
         self.caches = _splice_windows(self.caches, window)
@@ -1377,12 +1386,18 @@ class BlockAllocator:
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
         self.num_blocks, self.block_size = num_blocks, block_size
-        self._free = list(range(1, num_blocks + 1))  # already a valid heap
-        self._ref: dict = {}           # live block id -> refcount (>= 1)
-        self._cached = OrderedDict()   # ref-0 registered blocks, LRU order
-        self._index: dict = {}         # content key -> block id (live|cached)
-        self._key_of: dict = {}        # registered block id -> content key
-        self.stats = {"allocs": 0, "frees": 0, "peak_used": 0,
+        # All mutable state below is single-owner: only the engine
+        # thread (or the sole serve() thread) touches an allocator.
+        # Cross-thread visibility goes through the pool snapshot the
+        # engine PUBLISHES at round boundaries (/poolz), never through
+        # direct reads — the guarded-by annotations make the ownership
+        # machine-checkable documentation for tools.lint.
+        self._free = list(range(1, num_blocks + 1))  # valid heap  # guarded-by: <engine-thread>
+        self._ref: dict = {}           # live block id -> refcount (>= 1)  # guarded-by: <engine-thread>
+        self._cached = OrderedDict()   # ref-0 registered blocks, LRU order  # guarded-by: <engine-thread>
+        self._index: dict = {}         # content key -> block id (live|cached)  # guarded-by: <engine-thread>
+        self._key_of: dict = {}        # registered block id -> content key  # guarded-by: <engine-thread>
+        self.stats = {"allocs": 0, "frees": 0, "peak_used": 0,  # guarded-by: <engine-thread>
                       "evictions": 0, "hash_hits": 0}
 
     # ---- accounting -------------------------------------------------------
@@ -1835,13 +1850,13 @@ class PagedPool(_PoolBase):
         self.dpools = (init_paged_cache(draft_cfg, kv_blocks + 1, block_size,
                                         quantized=kv_quant)
                        if draft_params is not None else None)
-        self.slots: list = [None] * batch_size
-        self._pre_rr = 0  # round-robin cursor over prefilling rows
+        self.slots: list = [None] * batch_size  # guarded-by: <engine-thread>
+        self._pre_rr = 0  # round-robin cursor over prefilling rows  # guarded-by: <engine-thread>
         # Evict-and-recompute handoff: step_round parks the resume
         # records of rows it preempted here; the Scheduler drains them
         # back into its waiting queue after every step/preempt call.
-        self.preempted: list = []
-        self.stats = {"rounds": 0, "slot_steps": 0, "active_slot_steps": 0,
+        self.preempted: list = []  # guarded-by: <engine-thread>
+        self.stats = {"rounds": 0, "slot_steps": 0, "active_slot_steps": 0,  # guarded-by: <engine-thread>
                       "prefill_tokens": 0, "prefill_chunks": 0,
                       "blocks_total": kv_blocks, "blocks_peak": 0,
                       "defrags": 0, "prompt_tokens": 0,
@@ -2276,11 +2291,11 @@ class PagedPool(_PoolBase):
                     [s.history[s.prefilled:s.prefilled + w]], jnp.int32)
                 pos = jnp.asarray([s.prefilled], jnp.int32)
                 self.pools = _paged_prefill_chunk(
-                    self.params, self.pools, bt, tokens, pos, self.cfg)
+                    self.params, self.pools, bt, tokens, pos, cfg=self.cfg)
                 if self.draft_params is not None:
                     self.dpools = _paged_prefill_chunk(
                         self.draft_params, self.dpools, bt, tokens, pos,
-                        self.draft_cfg)
+                        cfg=self.draft_cfg)
                 s.prefilled += w
                 s.prefill_chunks += 1
                 budget -= w
@@ -2404,8 +2419,8 @@ class PagedPool(_PoolBase):
         if self.draft_params is not None:
             dwindow = _gather_windows_jit(self.dpools, bt)
             drafts, dwindow = _spec_draft_window(
-                self.draft_params, dwindow, last, pos, self.draft_cfg,
-                self.gamma)
+                self.draft_params, dwindow, last, pos,
+                draft_cfg=self.draft_cfg, gamma=self.gamma)
             drafts = jax.block_until_ready(drafts)
         else:
             # Prompt-lookup drafting: host-side n-gram copy, zero model
@@ -2418,7 +2433,8 @@ class PagedPool(_PoolBase):
                  for s in self.slots], jnp.int32)
         t1 = time.perf_counter()
         greedy, counts, window = _spec_verify_window(
-            self.params, window, drafts, last, pos, self.cfg, self.gamma)
+            self.params, window, drafts, last, pos, cfg=self.cfg,
+            gamma=self.gamma)
         greedy = jax.block_until_ready(greedy)
         t2 = time.perf_counter()
         self.pools = _scatter_windows_jit(self.pools, window, bt)
@@ -2590,16 +2606,24 @@ class Scheduler:
                              f"got {expected_new}")
         if not 0 < ema_alpha <= 1:
             raise ValueError(f"ema_alpha must be in (0, 1], got {ema_alpha}")
-        self._ema = float(expected_new)
+        # The scheduler's queue state is MUTATED only by the engine
+        # thread (submit/step run there), but /poolz and /healthz read
+        # snapshot()/queue_depth() from HTTP handler threads — so every
+        # mutable field is lock-guarded and the mutators hold the lock
+        # across each state transition, never across pool device work
+        # (admission prefill can take seconds; a blocked /healthz probe
+        # would mark a healthy slice dead).
+        self._lock = threading.Lock()
+        self._ema = float(expected_new)  # guarded-by: _lock
         self._alpha = ema_alpha
         # Heap entries (-priority, deadline-or-inf, seq, Request,
         # preload): seq is unique, so Request never enters a comparison.
-        self._waiting: list = []
-        self._seq = 0
-        self._qstart: dict = {}  # rid -> monotonic submit time
-        self._preempt_t: dict = {}  # rid -> monotonic eviction time
-        self._waits = deque(maxlen=512)  # recent queue waits (ms)
-        self.stats = {"submitted": 0, "admitted": 0, "requeues": 0,
+        self._waiting: list = []  # guarded-by: _lock
+        self._seq = 0  # guarded-by: _lock
+        self._qstart: dict = {}  # rid -> monotonic submit time  # guarded-by: _lock
+        self._preempt_t: dict = {}  # rid -> monotonic eviction time  # guarded-by: _lock
+        self._waits = deque(maxlen=512)  # recent queue waits (ms)  # guarded-by: _lock
+        self.stats = {"submitted": 0, "admitted": 0, "requeues": 0,  # guarded-by: _lock
                       "retired": 0}
         # The request-lifecycle flight recorder: the Scheduler owns it
         # (it sees every transition), the pool appends its own events
@@ -2617,23 +2641,27 @@ class Scheduler:
         if not self.overcommit:
             return None
         rem = r.max_new - len(preload or [])
-        return max(1, min(rem, math.ceil(self._ema)))
+        with self._lock:
+            return max(1, min(rem, math.ceil(self._ema)))
 
     def submit(self, r: Request) -> None:
         """Validate loudly (a never-fits request is still a front-door
         error, not a queue entry) and enqueue; admission happens at the
         next step()'s round boundary."""
         self.pool.validate(r, self.pool.cfg)
+        with self._lock:
+            position = len(self._waiting)
         self.log.start(r.rid, trace_id=getattr(r, "trace_id", ""),
                        priority=r.priority, deadline=r.deadline,
-                       queue_position=len(self._waiting))
-        self._push(r, None, self._seq)
-        self._seq += 1
-        self.stats["submitted"] += 1
-        self._qstart[r.rid] = time.monotonic()
+                       queue_position=position)
+        with self._lock:
+            self._push_locked(r, None, self._seq)
+            self._seq += 1
+            self.stats["submitted"] += 1
+            self._qstart[r.rid] = time.monotonic()
         self._record_gauges()
 
-    def _push(self, r: Request, preload, seq: int) -> None:
+    def _push_locked(self, r: Request, preload, seq: int) -> None:
         heapq.heappush(self._waiting, (
             -r.priority,
             r.deadline if r.deadline is not None else float("inf"),
@@ -2643,29 +2671,46 @@ class Scheduler:
         """Re-enqueue every row the pool evicted since the last drain,
         each under its original key — the front of its class relative
         to later arrivals."""
-        for rec in getattr(self.pool, "preempted", ()):
-            self._push(rec["request"], rec["preload"], rec["seq"])
-            self.stats["requeues"] += 1
-            if "t" in rec:
-                self._preempt_t[rec["request"].rid] = rec["t"]
-        if getattr(self.pool, "preempted", None):
-            self.pool.preempted.clear()
+        recs = list(getattr(self.pool, "preempted", ()))
+        if not recs:
+            return
+        with self._lock:
+            for rec in recs:
+                self._push_locked(rec["request"], rec["preload"],
+                                  rec["seq"])
+                self.stats["requeues"] += 1
+                if "t" in rec:
+                    self._preempt_t[rec["request"].rid] = rec["t"]
+        self.pool.preempted.clear()
 
     def queue_depth(self) -> int:
-        return len(self._waiting)
+        with self._lock:
+            return len(self._waiting)
 
     def pending(self) -> bool:
-        return bool(self._waiting)
+        with self._lock:
+            return bool(self._waiting)
 
     def queue_wait_p50_ms(self) -> float:
+        with self._lock:
+            return self._queue_wait_p50_locked()
+
+    def _queue_wait_p50_locked(self) -> float:
         w = sorted(self._waits)
         return w[len(w) // 2] if w else 0.0
 
     # ---- rounds -----------------------------------------------------------
 
     def _admit_phase(self) -> None:
-        while self._waiting:
-            negp, _dl, seq, r, preload = self._waiting[0]
+        while True:
+            with self._lock:
+                if not self._waiting:
+                    break
+                # Peek only: the engine thread is the sole popper, so
+                # the head cannot change between this read and the pop
+                # below — the lock is for reader consistency, not
+                # mutual exclusion between admitters.
+                negp, _dl, seq, r, preload = self._waiting[0]
             reserve = self.expected_new(r, preload)
             # Admission watermark (overcommit only): keep the blocks
             # the running set will grow into within the next
@@ -2675,24 +2720,31 @@ class Scheduler:
                      else 0)
             if self.pool.admits(r, reserve_new=reserve, preload=preload,
                                 extra_blocks=extra):
-                heapq.heappop(self._waiting)
+                with self._lock:
+                    heapq.heappop(self._waiting)
+                # Pool admission may do device work (resident prefill
+                # compiles+runs); it must never run under the lock.
                 self.pool.admit(r, reserve_new=reserve, preload=preload,
                                 seq=seq)
                 if preload is None:
-                    self.stats["admitted"] += 1
+                    with self._lock:
+                        self.stats["admitted"] += 1
                 else:
                     # The anti-thrash watermark's measurable effect:
                     # wall time a preempted stream sat evicted before
                     # its resume admission.
-                    tp = self._preempt_t.pop(r.rid, None)
+                    with self._lock:
+                        tp = self._preempt_t.pop(r.rid, None)
                     if tp is not None:
                         telemetry.metrics().observe(
                             "serve_resume_gap_ms",
                             (time.monotonic() - tp) * 1e3)
-                t0 = self._qstart.pop(r.rid, None)
+                with self._lock:
+                    t0 = self._qstart.pop(r.rid, None)
+                    if t0 is not None:
+                        wait_ms = (time.monotonic() - t0) * 1e3
+                        self._waits.append(wait_ms)
                 if t0 is not None:
-                    wait_ms = (time.monotonic() - t0) * 1e3
-                    self._waits.append(wait_ms)
                     telemetry.metrics().observe("serve_queue_wait_ms",
                                                 wait_ms)
                     # Per-priority-class split: SLO attribution needs
@@ -2719,13 +2771,18 @@ class Scheduler:
         if self.overcommit:
             # Decode chunks follow the same expectation admission
             # reserves by (see PagedPool.chunk_hint).
-            self.pool.chunk_hint = max(1, math.ceil(self._ema))
+            with self._lock:
+                self.pool.chunk_hint = max(1, math.ceil(self._ema))
         events = self.pool.step_round()
         self._drain_preempted()
-        for rid, ev in events.items():
-            if ev["done"]:
-                self.stats["retired"] += 1
-                self._ema += self._alpha * (len(ev["generated"]) - self._ema)
+        retired = [rid for rid, ev in events.items() if ev["done"]]
+        if retired:
+            with self._lock:
+                for rid in retired:
+                    self.stats["retired"] += 1
+                    self._ema += self._alpha * (
+                        len(events[rid]["generated"]) - self._ema)
+            for rid in retired:
                 # Finalize the lifecycle record: emits the request span
                 # + phase-child spans and updates the share gauges.
                 self.log.retire(rid)
@@ -2740,18 +2797,22 @@ class Scheduler:
     def snapshot(self) -> dict:
         """/poolz, scheduler half: waiting-queue contents in admission
         order (priority class desc, EDF, arrival), the overcommit EMA
-        admission reserves by, and the cumulative counters."""
-        waiting = [{"rid": r.rid, "priority": r.priority,
-                    "deadline": (None if dl == float("inf") else dl),
-                    "seq": seq, "resume": preload is not None}
-                   for (_negp, dl, seq, r, preload)
-                   in sorted(list(self._waiting))]
-        return {"overcommit": self.overcommit,
-                "expected_new_ema": round(self._ema, 3),
-                "queue_depth": len(waiting),
-                "waiting": waiting,
-                "queue_wait_p50_ms": round(self.queue_wait_p50_ms(), 2),
-                "stats": dict(self.stats)}
+        admission reserves by, and the cumulative counters. Thread-safe
+        (one lock hold — handler threads get a consistent queue view,
+        never a heap mid-push)."""
+        with self._lock:
+            waiting = [{"rid": r.rid, "priority": r.priority,
+                        "deadline": (None if dl == float("inf") else dl),
+                        "seq": seq, "resume": preload is not None}
+                       for (_negp, dl, seq, r, preload)
+                       in sorted(self._waiting)]
+            return {"overcommit": self.overcommit,
+                    "expected_new_ema": round(self._ema, 3),
+                    "queue_depth": len(waiting),
+                    "waiting": waiting,
+                    "queue_wait_p50_ms": round(
+                        self._queue_wait_p50_locked(), 2),
+                    "stats": dict(self.stats)}
 
     def reset(self) -> None:
         """Drop every queued request (the ingress failed-round recovery
@@ -2759,19 +2820,27 @@ class Scheduler:
         in-flight ones; resetting the pool itself is the caller's
         job). The length EMA survives: it describes traffic, not the
         failed round."""
-        self._waiting.clear()
-        self._qstart.clear()
-        self._preempt_t.clear()
+        with self._lock:
+            self._waiting.clear()
+            self._qstart.clear()
+            self._preempt_t.clear()
         # The flight recorder keeps its history but must not show the
-        # failed round's victims running forever.
+        # failed round's victims running forever. (Outside the lock:
+        # RequestLog takes its own, and holding both here would impose
+        # an ordering on every other caller pair.)
         self.log.abort_inflight("error")
 
     def _record_gauges(self) -> None:
+        with self._lock:
+            queue_depth = len(self._waiting)
+            expected = self._ema
+            submitted = self.stats["submitted"]
+            admitted = self.stats["admitted"]
         telemetry.record_scheduler(
-            queue_depth=len(self._waiting),
-            expected_new=self._ema,
-            submitted=self.stats["submitted"],
-            admitted=self.stats["admitted"],
+            queue_depth=queue_depth,
+            expected_new=expected,
+            submitted=submitted,
+            admitted=admitted,
             preemptions=getattr(self.pool, "stats",
                                 {}).get("preemptions", 0))
 

@@ -352,8 +352,9 @@ def speculative_generate(target_params: Params, draft_params: Params,
                      and _multi_device(draft_params) is False)
     out, stats = _speculative(
         target_params, draft_params, prompt,
-        jax.random.PRNGKey(0) if key is None else key, target_cfg,
-        draft_cfg, steps=steps, gamma=gamma, temperature=float(temperature),
+        jax.random.PRNGKey(0) if key is None else key,
+        target_cfg=target_cfg, draft_cfg=draft_cfg, steps=steps,
+        gamma=gamma, temperature=float(temperature),
         kv_quant=kv_quant, kv_kernel=kv_kernel,
         prompt_lengths=prompt_lengths)
     return (out, stats) if with_stats else out
