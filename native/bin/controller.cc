@@ -14,13 +14,16 @@
 //  * /metrics endpoint with reconcile counters for the bench harness.
 #include <algorithm>
 #include <chrono>
+#include <cmath>
 #include <condition_variable>
 #include <deque>
 #include <functional>
 #include <map>
 #include <mutex>
+#include <random>
 #include <set>
 #include <thread>
+#include <unordered_map>
 #include <vector>
 
 #include <unistd.h>
@@ -788,13 +791,41 @@ bool reconcile_one(KubeClient& client, const ControllerConfig& cfg, const std::s
 // the JobSet's headless service (the same wiring
 // TPUBC_COORDINATOR_ADDRESS rides), or CONF_WORKLOAD_SCRAPE_ADDR when an
 // operator (or the fake-API test harness) fronts the pod differently.
+// Per-replica scrape backoff: a failing worker endpoint re-probes on an
+// exponential schedule with jitter (the policy documented for kube API
+// retries) instead of the fixed cadence — N dead replicas must not turn
+// the scraper into a synchronized 5s-timeout convoy. First failure is
+// still immediate (the probe that DISCOVERS the failure rides the normal
+// cadence); the delay gates re-probes only, and a success resets it.
+struct ScrapeBackoff {
+  int failures = 0;
+  int64_t next_attempt_ms = 0;
+};
+
 void scrape_workloads(KubeClient& client, const ControllerConfig& cfg,
                       const ObjectCache& cache) {
+  // Scraper-thread-owned (one scraper thread per process; see main()).
+  static std::unordered_map<std::string, ScrapeBackoff> backoff;
+  static std::mt19937 jitter_rng(0x7b5c);
+  // Drop state for CRs that left the cache — a deleted replica must not
+  // pin map entries (or the gauge) forever.
+  {
+    const std::vector<std::string> live = cache.names();
+    for (auto it = backoff.begin(); it != backoff.end();) {
+      if (std::find(live.begin(), live.end(), it->first) == live.end())
+        it = backoff.erase(it);
+      else
+        ++it;
+    }
+  }
   for (const std::string& name : cache.names()) {
     if (stop_requested().load()) return;
     Json ub;
     if (!cache.get(name, &ub)) continue;
     if (ub.get("status").get("slice").get_string("phase") != "Running") continue;
+    auto bo = backoff.find(name);
+    if (bo != backoff.end() && monotonic_ms() < bo->second.next_attempt_ms)
+      continue;  // still backing off this replica
     std::string addr = cfg.scrape_addr;
     if (addr.empty()) {
       const int64_t port = workload_metrics_port(ub);
@@ -816,6 +847,7 @@ void scrape_workloads(KubeClient& client, const ControllerConfig& cfg,
         throw std::runtime_error("scrape HTTP " + std::to_string(resp.status));
       Json summary = workload_summary(Json::parse(resp.body), now_rfc3339());
       Metrics::instance().inc("workload_scrapes_total");
+      backoff.erase(name);  // healthy again: next pass probes on cadence
       if (summary.is_object()) {
         client.merge_status(
             kApiVersion, kKind, "", name,
@@ -826,13 +858,35 @@ void scrape_workloads(KubeClient& client, const ControllerConfig& cfg,
       }
     } catch (const std::exception& e) {
       Metrics::instance().inc("workload_scrape_errors_total");
+      // interval * 2^(failures-1), capped at 5 minutes, jittered
+      // +/-20% so a fleet of replicas that died together doesn't
+      // re-probe in lockstep.
+      ScrapeBackoff& st = backoff[name];
+      st.failures++;
+      double delay_s = std::min<double>(
+          static_cast<double>(cfg.scrape_interval_secs) *
+              std::pow(2.0, st.failures - 1),
+          300.0);
+      std::uniform_real_distribution<double> jitter(0.8, 1.2);
+      delay_s *= jitter(jitter_rng);
+      st.next_attempt_ms = monotonic_ms() + static_cast<int64_t>(delay_s * 1000.0);
       entry.error = e.what();
       log_warn("workload scrape failed",
-               {{"name", name}, {"addr", addr}, {"error", e.what()}});
+               {{"name", name}, {"addr", addr}, {"error", e.what()},
+                {"backoff_s", std::to_string(static_cast<int64_t>(delay_s))},
+                {"failures", std::to_string(st.failures)}});
     }
     entry.duration_ms = static_cast<double>(monotonic_ms() - t0);
     Statusz::instance().record(name, std::move(entry));
   }
+  // Operator surface: the longest remaining per-replica backoff, in
+  // seconds (0 = every Running replica is being probed on cadence).
+  int64_t worst_remaining_s = 0;
+  const int64_t now = monotonic_ms();
+  for (const auto& kv : backoff)
+    worst_remaining_s = std::max<int64_t>(
+        worst_remaining_s, (kv.second.next_attempt_ms - now + 999) / 1000);
+  Metrics::instance().set("tpubc_scrape_backoff_seconds", worst_remaining_s);
 }
 
 }  // namespace

@@ -1,0 +1,732 @@
+"""Fault injection (workload/faults.py) + the hardened failure path:
+crash-is-preemption recovery, deadline enforcement, graceful drain, and
+the ingress engine watchdog.
+
+Pins the PR's contracts: the injector is a pure function of its spec
+string (one-shot and seeded-stochastic rules, loud parse errors, inert
+when disabled), recovered-after-crash and deadline-survivor streams are
+byte-identical to uninterrupted runs (greedy/sampled x kv_quant x
+prefix_cache), fuzzed fault schedules never corrupt a completed stream,
+never leak KV blocks, and never deadlock, SIGTERM drains with a final
+{"draining": true} chunk instead of a dropped socket, and the watchdog
+flips /healthz on a stalled heartbeat and restarts a dead engine thread
+with every in-flight stream completing exactly.
+
+The three ``test_chaos_*`` tests are CI's pinned chaos schedules (the
+``chaos`` job runs them by node id); each dumps its observed timeline to
+``TPUBC_CHAOS_ARTIFACT`` when that is set so a failing run uploads the
+evidence.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_bootstrap import telemetry
+from tpu_bootstrap.workload import faults
+from tpu_bootstrap.workload.decode import generate
+from tpu_bootstrap.workload.faults import FaultInjector, InjectedFault
+from tpu_bootstrap.workload.ingress import IngressServer
+from tpu_bootstrap.workload.model import ModelConfig, init_params
+from tpu_bootstrap.workload.serving import (
+    BlockAllocator,
+    PagedPool,
+    Request,
+    Scheduler,
+    serve,
+)
+
+TINY = ModelConfig(vocab_size=32, num_layers=1, num_heads=2, head_dim=8,
+                   embed_dim=16, mlp_dim=32, max_seq_len=64)
+TPARAMS = init_params(TINY, jax.random.PRNGKey(1))
+
+
+@pytest.fixture(autouse=True)
+def _no_lingering_faults():
+    """Every test leaves the process-wide injector disabled — a leaked
+    schedule would fire inside an unrelated suite's serving rounds."""
+    yield
+    faults.install(None)
+
+
+def _solo(tokens, max_new, **kw):
+    out = generate(TPARAMS, jnp.asarray([tokens], jnp.int32), TINY, max_new,
+                   kv_kernel=False, **kw)
+    return np.asarray(out[0]).tolist()
+
+
+def _requests(n, seed=0, lo_new=8, hi_new=24):
+    rng = np.random.default_rng(seed)
+    return [Request(rid=i,
+                    tokens=rng.integers(1, 32,
+                                        int(rng.integers(2, 10))).tolist(),
+                    max_new=int(rng.integers(lo_new, hi_new)))
+            for i in range(n)]
+
+
+def _drive(pool, sched, requests, check=None):
+    done = {}
+    for r in requests:
+        sched.submit(r)
+    rounds = 0
+    while sched.pending() or pool.has_active():
+        rounds += 1
+        assert rounds < 5000, "scheduler stopped making progress"
+        for rid, ev in sched.step().items():
+            if ev["done"]:
+                done[rid] = ev["generated"]
+        if check is not None:
+            check()
+    return done
+
+
+def _check_allocator_invariants(pool):
+    """Refcount/uniqueness partition (the fuzz oracle from the
+    overcommit suite): every table reference is a refcount, every id is
+    exactly one of free/live/cached, and nothing aliases."""
+    alloc = pool.allocator
+    refs: dict = {}
+    for s in pool.slots:
+        if s is not None:
+            for b in s.blocks:
+                refs[b] = refs.get(b, 0) + 1
+    assert set(refs) == set(alloc._ref), "live set != table-referenced set"
+    for b, c in refs.items():
+        assert alloc.refcount(b) == c, (b, c, alloc.refcount(b))
+    assert len(alloc._free) == len(set(alloc._free)), "free-heap dup"
+    assert (len(alloc._free) + len(alloc._ref) + len(alloc._cached)
+            == alloc.num_blocks)
+    assert not (set(alloc._free) & set(alloc._ref))
+    assert not (set(alloc._free) & set(alloc._cached))
+    assert not (set(alloc._ref) & set(alloc._cached))
+
+
+# ---- injector unit behavior (host-only, tier-1) ---------------------------
+
+
+def test_spec_parsing_is_loud():
+    with pytest.raises(ValueError, match="unknown site"):
+        FaultInjector("warp.core")
+    with pytest.raises(ValueError, match="outside"):
+        FaultInjector("alloc:1.5")
+    # Every documented site parses.
+    for site in faults.SITES:
+        FaultInjector(site)
+    # Empty segments are tolerated (trailing comma from shell quoting).
+    FaultInjector("alloc:1:3,")
+
+
+def test_one_shot_rule_fires_exactly_once():
+    inj = FaultInjector("alloc:1:3")
+    fired = []
+    for i in range(1, 11):
+        try:
+            inj.fire("alloc")
+        except InjectedFault as e:
+            fired.append((i, e.site, e.count))
+    # prob omitted/1 = one-shot: exactly call after_n + 1, never again.
+    assert fired == [(4, "alloc", 4)]
+    assert inj.stats() == {"spec": "alloc:1:3", "calls": {"alloc": 10},
+                           "fired": {"alloc": 1}}
+    # Other sites are untouched pass-throughs.
+    inj.fire("scrape")
+
+
+def test_multi_shot_schedule_repeats_a_site():
+    inj = FaultInjector("pool.device:1:2,pool.device:1:5")
+    fired = []
+    for i in range(1, 9):
+        try:
+            inj.fire("pool.device")
+        except InjectedFault:
+            fired.append(i)
+    assert fired == [3, 6]
+
+
+def test_stochastic_rules_are_seed_deterministic():
+    def pattern(spec):
+        inj = FaultInjector(spec)
+        out = []
+        for i in range(200):
+            try:
+                inj.fire("ingress.write")
+            except InjectedFault:
+                out.append(i)
+        return out
+    a = pattern("ingress.write:0.2:5:77")
+    assert a == pattern("ingress.write:0.2:5:77"), "same spec, same faults"
+    assert a and min(a) >= 5, "after_n must gate the stochastic arm too"
+    assert a != pattern("ingress.write:0.2:5:78"), "seed changes the stream"
+
+
+def test_disabled_injector_is_inert():
+    assert faults.install(None) is None
+    assert faults.install("") is None
+    assert not faults.active()
+    for site in faults.SITES:
+        faults.fire(site)  # plain no-op — the zero-overhead path
+    inj = faults.install("ckpt.save")
+    assert faults.active() and inj is not None
+    with pytest.raises(InjectedFault) as e:
+        faults.fire("ckpt.save")
+    assert e.value.site == "ckpt.save" and e.value.count == 1
+    assert "ckpt.save" in str(e.value)
+
+
+def test_ckpt_save_fault_fires_before_any_write():
+    from tpu_bootstrap.workload import checkpoint
+
+    class MgrMustNotBeTouched:
+        def save(self, *a, **k):
+            raise AssertionError("orbax save started after injected fault")
+
+    faults.install("ckpt.save")
+    with pytest.raises(InjectedFault):
+        checkpoint.save(MgrMustNotBeTouched(), 0, None, None)
+
+
+def test_allocator_quarantine_to_cache_partitions():
+    """The crash-recovery salvage: every live reference drops, blocks
+    with registered (complete, content-addressed) KV park in the cached
+    LRU set still indexed, and unregistered tails return to the heap —
+    the partition invariant holds on the far side."""
+    a = BlockAllocator(8, 4)
+    ids = a.alloc(5)
+    assert a.register(ids[0], b"k0") and a.register(ids[1], b"k1")
+    a.incref(ids[0])  # shared by two rows, like a prefix-cache hit
+    a.quarantine_to_cache()
+    assert a.used() == 0
+    assert a.is_cached(ids[0]) and a.is_cached(ids[1])
+    assert a.lookup(b"k0") == ids[0] and a.lookup(b"k1") == ids[1]
+    assert len(a._free) + a.cached() == a.num_blocks
+    # The salvaged cache is still reclaimable capacity: a full-pool
+    # alloc succeeds by evicting it.
+    assert len(a.alloc(8)) == 8
+
+
+def test_retry_after_tracks_queue_drain_rate():
+    pool = PagedPool(TPARAMS, TINY, 2, block_size=8, kv_blocks=8)
+    sched = Scheduler(pool)
+    # Cold scheduler (no retirement observed): the old 1-second hint.
+    assert sched.retry_after_s(depth=50) == 1
+    sched._retire_window.add(30)  # 30 retires in the 60s window = 0.5/s
+    assert sched.retry_after_s(depth=10) == 20
+    assert sched.retry_after_s(depth=1000) == 30, "clamped to 30s"
+    assert sched.retry_after_s(depth=0) == 1, "empty queue floors at 1s"
+
+
+def test_queue_deadline_shed_without_compute():
+    """An already-expired waiting request sheds at the next round
+    boundary — terminal 504-shaped event, serve_deadline_shed_total,
+    retired(reason=deadline) in the request log — without the pool ever
+    dispatching a round for it."""
+    pool = PagedPool(TPARAMS, TINY, 2, block_size=8, kv_blocks=8)
+    sched = Scheduler(pool)
+    before = telemetry.metrics().to_json().get(
+        "serve_deadline_shed_total", 0)
+    sched.submit(Request(rid=7, tokens=[1, 2, 3], max_new=8,
+                         deadline=time.monotonic() - 1.0))
+    events = sched.step()
+    assert events[7]["done"] and events[7]["deadline"]
+    assert events[7]["generated"] == []
+    assert "deadline" in events[7]["error"]
+    assert sched.stats["deadline_shed"] == 1
+    assert not sched.pending() and not pool.has_active()
+    after = telemetry.metrics().to_json()["serve_deadline_shed_total"]
+    assert after == before + 1
+
+
+# ---- crash-is-preemption recovery (serving rounds, slow tier) -------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kv_quant", [False, True])
+@pytest.mark.parametrize("prefix_cache", [True, False])
+@pytest.mark.parametrize("sampled", [False, True])
+def test_crash_recovery_byte_identity_matrix(kv_quant, prefix_cache,
+                                             sampled):
+    """The acceptance pin: a multi-shot device-abort + allocator-breach
+    schedule mid-burst, and every recovered stream equals the fault-free
+    run — greedy and sampled, quantized KV or not, prefix cache on or
+    off. Recovery IS preemption: quarantine, salvage the cache, resume
+    through the same records eviction uses."""
+    reqs = _requests(6, seed=7)
+    kw = {"paged": True, "block_size": 8, "prefill_budget": 4,
+          "kv_quant": kv_quant, "prefix_cache": prefix_cache}
+    if sampled:
+        kw.update(temperature=0.8, top_k=8, key=jax.random.PRNGKey(7))
+    clean = serve(TPARAMS, TINY, reqs, 4, **kw)
+    inj = faults.install("pool.device:1:2,pool.device:1:6,alloc:1:4")
+    stats: dict = {}
+    faulted = serve(TPARAMS, TINY, reqs, 4, stats=stats, **kw)
+    fired = inj.stats()["fired"]
+    faults.install(None)
+    assert fired.get("pool.device") == 2 and fired.get("alloc") == 1, fired
+    assert stats["scheduler"]["recoveries"] == 3
+    assert faulted == clean
+    if not sampled:
+        for r in reqs:
+            assert faulted[r.rid] == _solo(r.tokens, r.max_new), r.rid
+
+
+@pytest.mark.slow
+def test_recovery_salvages_prefix_cache_and_counts_metrics():
+    """After a crash the surviving full blocks re-register: a follow-up
+    burst sharing the prompt prefix still hits the cache, and the
+    restart/recovery metrics move."""
+    mj = telemetry.metrics().to_json()
+    restarts0 = mj.get("serve_engine_restarts_total", 0)
+    pool = PagedPool(TPARAMS, TINY, 4, block_size=4, kv_blocks=24,
+                     prefill_budget=8, prefix_cache=True)
+    sched = Scheduler(pool)
+    prompt = [5, 6, 7, 8, 9, 10, 11, 12]
+    done = _drive(pool, sched, [Request(rid=0, tokens=prompt, max_new=6)],
+                  check=lambda: _check_allocator_invariants(pool))
+    assert done[0] == _solo(prompt, 6)
+    faults.install("pool.device")  # one-shot, next dispatched round
+    done = _drive(pool, sched, [Request(rid=1, tokens=prompt, max_new=6)],
+                  check=lambda: _check_allocator_invariants(pool))
+    faults.install(None)
+    assert sched.stats["recoveries"] == 1
+    assert done[1] == _solo(prompt, 6)
+    assert pool.stats["prefix_hit_tokens"] > 0, (
+        "quarantine must re-register surviving cache content")
+    mj = telemetry.metrics().to_json()
+    assert mj["serve_engine_restarts_total"] == restarts0 + 1
+    assert "serve_recovery_ms" in json.dumps(mj)
+
+
+@pytest.mark.slow
+def test_crash_loop_bound_gives_up_loudly(monkeypatch):
+    """A persistent fault must not recover forever: past
+    TPUBC_ENGINE_MAX_RESTARTS consecutive failed rounds the exception
+    propagates (the ingress backstop aborts streams loudly)."""
+    monkeypatch.setenv("TPUBC_ENGINE_MAX_RESTARTS", "3")
+    pool = PagedPool(TPARAMS, TINY, 2, block_size=8, kv_blocks=8)
+    sched = Scheduler(pool)
+    assert sched._max_restarts == 3
+    faults.install(",".join(["pool.device:1:%d" % i for i in range(12)]))
+    sched.submit(Request(rid=0, tokens=[1, 2], max_new=4))
+    with pytest.raises(InjectedFault):
+        for _ in range(20):
+            sched.step()
+    assert sched.stats["recoveries"] == 3
+
+
+@pytest.mark.slow
+def test_deadline_mid_decode_cancel_frees_blocks_for_cohort():
+    """A resident row whose deadline expires mid-decode cancels at the
+    round boundary: terminal deadline event carrying the committed
+    prefix, blocks freed (allocator partition intact), and the
+    surviving cohort row completes byte-identically."""
+    pool = PagedPool(TPARAMS, TINY, 2, block_size=8, kv_blocks=16,
+                     prefill_budget=16)
+    sched = Scheduler(pool)
+    doomed = Request(rid=0, tokens=[1, 2, 3], max_new=40,
+                     deadline=time.monotonic() + 0.35)
+    survivor = Request(rid=1, tokens=[4, 5], max_new=40)
+    sched.submit(doomed)
+    sched.submit(survivor)
+    done, deadline_ev = {}, None
+    rounds = 0
+    while sched.pending() or pool.has_active():
+        rounds += 1
+        assert rounds < 5000
+        for rid, ev in sched.step().items():
+            if ev.get("deadline"):
+                deadline_ev = ev
+            if ev["done"]:
+                done[rid] = ev
+        _check_allocator_invariants(pool)
+    assert deadline_ev is not None, "deadline never enforced"
+    assert done[0] is deadline_ev
+    assert 0 < len(deadline_ev["generated"]) < 40, (
+        "cancel should land mid-decode for this window")
+    assert done[1]["generated"] == _solo([4, 5], 40)
+    assert sched.stats["deadline_shed"] == 1
+    assert pool.allocator.used() == 0
+
+
+@pytest.mark.slow
+def test_fault_schedule_fuzz_never_corrupts_leaks_or_hangs():
+    """Satellite pin: random seeded schedules over a live mini-burst.
+    Completed streams stay exact vs solo, the allocator partition holds
+    after every round (so after every recovery), and the drive is
+    bounded (the _drive round cap is the deadlock tripwire)."""
+    rng = np.random.default_rng(2026)
+    t0 = time.monotonic()
+    for trial in range(4):
+        nrules = int(rng.integers(1, 4))
+        spec = ",".join(
+            "%s:%s:%d:%d" % (
+                rng.choice(["pool.device", "alloc", "sched.admit"]),
+                rng.choice(["1", "0.25"]),
+                int(rng.integers(0, 8)),
+                int(rng.integers(0, 1000)))
+            for _ in range(nrules))
+        reqs = _requests(5, seed=100 + trial, lo_new=4, hi_new=12)
+        pool = PagedPool(TPARAMS, TINY, 3, block_size=4, kv_blocks=12,
+                         prefill_budget=4)
+        sched = Scheduler(pool, overcommit=True, expected_new=2)
+        faults.install(spec)
+        done = _drive(pool, sched, reqs,
+                      check=lambda p=pool: _check_allocator_invariants(p))
+        faults.install(None)
+        assert set(done) == {r.rid for r in reqs}, spec
+        for r in reqs:
+            assert done[r.rid] == _solo(r.tokens, r.max_new), (spec, r.rid)
+        assert pool.allocator.used() == 0, spec
+    assert time.monotonic() - t0 < 300, "fuzz must stay bounded"
+
+
+# ---- ingress: drain, watchdog, socket faults (slow tier) ------------------
+
+
+CHAOS_ENV = "TPUBC_CHAOS_ARTIFACT"
+
+
+def _write_chaos_artifact(payload) -> None:
+    path = os.environ.get(CHAOS_ENV)
+    if path:
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=2, default=str)
+
+
+def _post(port, body, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/generate",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def _get_json(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+            return r.getcode(), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _stream_lines(port, body, out, timeout=120):
+    try:
+        with _post(port, body, timeout=timeout) as resp:
+            for ln in resp:
+                if ln.strip():
+                    out.append(json.loads(ln))
+    except Exception as e:  # surfaced to the asserting test
+        out.append({"client_error": repr(e)})
+
+
+def _paged_server(**kw):
+    return IngressServer(TPARAMS, TINY, port=0, batch_size=2, paged=True,
+                         kv_blocks=24, block_size=8, host="127.0.0.1",
+                         **kw).start()
+
+
+@pytest.mark.slow
+def test_drain_flushes_streams_with_final_draining_chunk():
+    """The S6 bugfix pin: drain() mid-stream ends every open response
+    with {"done": true, "draining": true} + the committed prefix —
+    never a dropped socket — while the front door answers 503 with an
+    honest Retry-After and /healthz shows draining."""
+    srv = _paged_server()
+    try:
+        with _post(srv.port, {"tokens": [2, 3], "max_new": 2}) as r:
+            [ln for ln in r]  # warm the jit so the burst decodes slowly
+        lines: list = []
+        t = threading.Thread(target=_stream_lines, args=(
+            srv.port, {"tokens": [1, 2, 3], "max_new": 56}, lines))
+        t.start()
+        spin = time.monotonic() + 60
+        while not any(ln.get("tokens") for ln in lines):
+            assert time.monotonic() < spin, "stream never started"
+            time.sleep(0.01)  # decode underway, stream mid-flight
+        done = {"ms": None}
+        dt = threading.Thread(
+            target=lambda: done.update(ms=srv.drain(timeout_ms=250)))
+        dt.start()
+        time.sleep(0.05)
+        code, h = _get_json(srv.port, "/healthz")
+        assert code == 503 and h.get("draining") is True
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.port, {"tokens": [9], "max_new": 2})
+        assert e.value.code == 503
+        assert int(e.value.headers["Retry-After"]) >= 1
+        assert json.loads(e.value.read()).get("draining") is True
+        dt.join(timeout=60)
+        t.join(timeout=60)
+        assert done["ms"] is not None and done["ms"] < 40_000
+        final = lines[-1]
+        assert final.get("done") is True and final.get("draining") is True
+        assert "draining" in final["error"]
+        code, rz = _get_json(srv.port, "/requestz")
+        assert any(ev.get("reason") == "drain"
+                   for req in rz["requests"] for ev in req["events"]), rz
+        mj = telemetry.metrics().to_json()
+        assert mj.get("serve_drain_ms", -1) >= 0
+    finally:
+        srv.stop()
+
+
+@pytest.mark.slow
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_watchdog_flags_stall_and_restarts_dead_engine():
+    """A wedged round flips /healthz 503 (stalled_ms + last_error) and
+    clears when the heartbeat resumes; a DEAD engine thread triggers
+    crash-is-preemption recovery on a fresh thread with the in-flight
+    stream completing byte-identically."""
+    mj0 = telemetry.metrics().to_json()
+    srv = _paged_server(watchdog_stall_ms=300)
+    try:
+        with _post(srv.port, {"tokens": [2, 3], "max_new": 4}) as r:
+            [ln for ln in r]
+        real_step = srv.sched.step
+        mode = {"next": None}
+
+        def fake_step():
+            m, mode["next"] = mode["next"], None
+            if m == "hang":
+                time.sleep(1.2)
+            elif m == "die":
+                raise SystemExit("injected engine-thread death")
+            return real_step()
+
+        srv.sched.step = fake_step
+        # Stall: engine alive but no heartbeat past the threshold.
+        mode["next"] = "hang"
+        lines: list = []
+        t = threading.Thread(target=_stream_lines, args=(
+            srv.port, {"tokens": [1, 2, 3], "max_new": 50}, lines))
+        t.start()
+        time.sleep(0.8)
+        code, h = _get_json(srv.port, "/healthz")
+        assert code == 503 and "stalled_ms" in h
+        assert "stall" in h["last_error"]
+        t.join(timeout=60)
+        assert lines[-1].get("done") and not lines[-1].get("error")
+        code, _ = _get_json(srv.port, "/healthz")
+        assert code == 200, "stall must clear once rounds resume"
+        # Death: the watchdog quarantines, requeues, restarts — the
+        # stream still finishes exactly.
+        mode["next"] = "die"
+        lines = []
+        _stream_lines(srv.port, {"tokens": [1, 2, 3], "max_new": 50}, lines)
+        assert lines[-1].get("done") and not lines[-1].get("error"), lines[-1]
+        got = [tok for ln in lines for tok in ln.get("tokens", [])]
+        assert got == _solo([1, 2, 3], 50)
+        mj = telemetry.metrics().to_json()
+        assert (mj["serve_engine_stalls_total"]
+                > mj0.get("serve_engine_stalls_total", 0))
+        assert (mj["serve_engine_restarts_total"]
+                > mj0.get("serve_engine_restarts_total", 0))
+    finally:
+        srv.stop()
+
+
+@pytest.mark.slow
+def test_ingress_write_fault_kills_one_stream_not_the_server():
+    """An injected socket death mid-stream is the client's problem: the
+    server keeps its engine, later requests decode exactly, and
+    /healthz stays ok."""
+    srv = _paged_server()
+    try:
+        with _post(srv.port, {"tokens": [2, 3], "max_new": 2}) as r:
+            [ln for ln in r]
+        faults.install("ingress.write:1:1")  # 2nd write to any stream
+        lines: list = []
+        _stream_lines(srv.port, {"tokens": [1, 2], "max_new": 30}, lines)
+        faults.install(None)
+        toks = [t for ln in lines for t in ln.get("tokens", [])]
+        assert len(toks) < 30, "stream should have been cut short"
+        assert not any(ln.get("done") for ln in lines)
+        with _post(srv.port, {"tokens": [5, 6], "max_new": 6}) as r:
+            out = [json.loads(ln) for ln in r if ln.strip()]
+        assert out[-1]["done"] and not out[-1].get("error")
+        got = [t for ln in out for t in ln.get("tokens", [])]
+        assert got == _solo([5, 6], 6)
+        code, h = _get_json(srv.port, "/healthz")
+        assert code == 200 and h["ok"] is True
+    finally:
+        srv.stop()
+
+
+@pytest.mark.slow
+def test_scrape_fault_returns_500_not_a_crash():
+    srv = _paged_server()
+    try:
+        faults.install("scrape")
+        code, body = _get_json(srv.port, "/metrics.json")
+        assert code == 500 and "injected fault at scrape" in body["error"]
+        faults.install(None)
+        code, _ = _get_json(srv.port, "/metrics.json")
+        assert code == 200
+    finally:
+        srv.stop()
+
+
+# ---- CI chaos schedules (run by node id in the chaos job) -----------------
+
+
+@pytest.mark.slow
+def test_chaos_device_abort_mid_decode():
+    """Pinned schedule #1: two device aborts land mid-burst through the
+    live HTTP path; every stream recovers byte-identically and
+    /requestz shows the preempted(reason=crash) legs."""
+    srv = _paged_server()
+    artifact = {"schedule": "pool.device:1:2,pool.device:1:5"}
+    try:
+        with _post(srv.port, {"tokens": [2, 3], "max_new": 2}) as r:
+            [ln for ln in r]
+        jobs = [([3, 5, 7], 30), ([9, 2], 24), ([4, 4, 4, 4], 26)]
+        inj = faults.install(artifact["schedule"])
+        outs = [[] for _ in jobs]
+        threads = [threading.Thread(target=_stream_lines, args=(
+            srv.port, {"tokens": t, "max_new": m}, out))
+            for (t, m), out in zip(jobs, outs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        artifact["streams"] = outs
+        artifact["injector"] = inj.stats()
+        faults.install(None)
+        code, rz = _get_json(srv.port, "/requestz")
+        artifact["requestz"] = rz
+        _write_chaos_artifact(artifact)
+        assert inj.stats()["fired"].get("pool.device") == 2
+        for (tokens, max_new), out in zip(jobs, outs):
+            assert out[-1].get("done") and not out[-1].get("error"), out[-1]
+            got = [t for ln in out for t in ln.get("tokens", [])]
+            assert got == _solo(tokens, max_new), tokens
+        crash_legs = [ev for req in rz["requests"] for ev in req["events"]
+                      if ev.get("kind") == "preempted"
+                      and ev.get("reason") == "crash"]
+        assert crash_legs, "recovery must land preempted(reason=crash)"
+    except BaseException:
+        _write_chaos_artifact(artifact)
+        raise
+    finally:
+        srv.stop()
+
+
+@pytest.mark.slow
+def test_chaos_allocator_breach():
+    """Pinned schedule #2: an allocator invariant breach during
+    admission; recovery quarantines a self-consistent heap, the burst
+    completes exactly, and the partition invariant holds after."""
+    srv = _paged_server()
+    artifact = {"schedule": "alloc:1:1"}
+    try:
+        with _post(srv.port, {"tokens": [2, 3], "max_new": 2}) as r:
+            [ln for ln in r]
+        inj = faults.install(artifact["schedule"])
+        jobs = [([1, 2, 3], 12), ([7, 8], 10)]
+        outs = []
+        for tokens, max_new in jobs:
+            out: list = []
+            _stream_lines(srv.port, {"tokens": tokens, "max_new": max_new},
+                          out)
+            outs.append(out)
+        artifact["streams"] = outs
+        fired_stats = inj.stats()
+        artifact["injector"] = fired_stats
+        faults.install(None)
+        code, rz = _get_json(srv.port, "/requestz")
+        artifact["requestz"] = rz
+        _write_chaos_artifact(artifact)
+        assert fired_stats["fired"].get("alloc") == 1
+        for (tokens, max_new), out in zip(jobs, outs):
+            got = [t for ln in out for t in ln.get("tokens", [])]
+            assert got == _solo(tokens, max_new), tokens
+        _check_allocator_invariants(srv.pool)
+    except BaseException:
+        _write_chaos_artifact(artifact)
+        raise
+    finally:
+        srv.stop()
+
+
+_SIGTERM_CHILD = r"""
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+from tpu_bootstrap.workload.ingress import IngressServer
+from tpu_bootstrap.workload.model import ModelConfig, init_params
+cfg = ModelConfig(vocab_size=32, num_layers=1, num_heads=2, head_dim=8,
+                  embed_dim=16, mlp_dim=32, max_seq_len=64)
+srv = IngressServer(init_params(cfg, jax.random.PRNGKey(1)), cfg, port=0,
+                    batch_size=2, paged=True, kv_blocks=24, block_size=8,
+                    host="127.0.0.1")
+srv.serve_forever()
+"""
+
+
+@pytest.mark.slow
+def test_chaos_sigterm_mid_burst():
+    """Pinned schedule #3: a REAL SIGTERM to a serve_forever process
+    mid-stream. The old behavior dropped the socket; now the drain
+    window expires, residents checkpoint-preempt, and the client's last
+    chunk is {"done": true, "draining": true} before a clean exit."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "TPUBC_DRAIN_TIMEOUT_MS": "300"}
+    proc = subprocess.Popen([sys.executable, "-c", _SIGTERM_CHILD],
+                            cwd=os.path.dirname(os.path.dirname(
+                                os.path.abspath(__file__))),
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, env=env, text=True)
+    artifact = {"child": "serve_forever + SIGTERM"}
+    try:
+        port = None
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                break
+            if "ingress: serving on :" in line:
+                port = int(line.split(":")[-1].split()[0].rstrip(")"))
+                break
+        assert port, "child never came up"
+        with _post(port, {"tokens": [2, 3], "max_new": 2}) as r:
+            [ln for ln in r]  # pay the jit before the timed part
+        lines: list = []
+        t = threading.Thread(target=_stream_lines, args=(
+            port, {"tokens": [1, 2, 3], "max_new": 56}, lines))
+        t.start()
+        spin = time.monotonic() + 120
+        while not any(ln.get("tokens") for ln in lines):
+            assert proc.poll() is None, "child died before the burst"
+            assert time.monotonic() < spin, "stream never started"
+            time.sleep(0.01)
+        proc.send_signal(signal.SIGTERM)
+        t.join(timeout=120)
+        artifact["stream"] = lines
+        _write_chaos_artifact(artifact)
+        final = lines[-1]
+        assert final.get("done") is True, final
+        assert final.get("draining") is True, (
+            "SIGTERM must flush a draining final chunk, not drop the "
+            "socket")
+        assert proc.wait(timeout=60) == 0
+    except BaseException:
+        _write_chaos_artifact(artifact)
+        if proc.poll() is None:
+            proc.kill()
+        raise
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.stdout.close()

@@ -332,6 +332,9 @@ def test_scrape_failure_is_counted_not_fatal(fake):  # noqa: F811
         scrapes = [o for o in doc["objects"]["bob"] if o["op"] == "scrape"]
         assert scrapes and not scrapes[-1]["ok"]
         assert scrapes[-1]["error"]
+        # The failing replica is now on an exponential re-probe schedule,
+        # surfaced as the worst remaining per-replica backoff.
+        assert d.metrics().get("tpubc_scrape_backoff_seconds", 0) >= 1
         # The control loop is unharmed.
         wait_for(lambda: (fake.get(fake.KEY_UB, "bob") or {}).get(
             "status", {}).get("slice", {}).get("phase") == "Running",

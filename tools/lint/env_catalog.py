@@ -92,6 +92,23 @@ CATALOG = {
         "1", "serving",
         "`0` disables request-lifecycle recording entirely (token "
         "streams byte-identical)."),
+    "TPUBC_FAULT": (
+        "-", "serving",
+        "Deterministic fault schedule `site[:prob][:after_n][:seed],...` "
+        "(sites: pool.device, alloc, sched.admit, ingress.write, "
+        "ckpt.save, scrape). Unset = zero-overhead no-op."),
+    "TPUBC_DRAIN_TIMEOUT_MS": (
+        "5000", "serving",
+        "Graceful-drain window: residents finish or checkpoint-preempt "
+        "within this before streams flush with `draining: true`."),
+    "TPUBC_WATCHDOG_STALL_MS": (
+        "30000", "serving",
+        "Engine-watchdog stall threshold on round heartbeats (/healthz "
+        "503 + last_error past it; `0` disables the watchdog)."),
+    "TPUBC_ENGINE_MAX_RESTARTS": (
+        "8", "serving",
+        "Consecutive failed-round recoveries before crash-is-preemption "
+        "gives up and the failure propagates (reset on any good round)."),
     # -- kernels / bench ----------------------------------------------------
     "TPUBC_HBM_GBPS": (
         "819", "kernels",
@@ -144,6 +161,10 @@ CATALOG = {
     "TPUBC_E2E_KEEP": (
         "0", "e2e",
         "`1` keeps the kind cluster alive after hack/e2e-kind.sh."),
+    "TPUBC_CHAOS_ARTIFACT": (
+        "-", "e2e",
+        "Path the pinned chaos tests dump their /requestz + stream "
+        "timeline JSON to (CI uploads it on failure)."),
 }
 
 _HEADER = """\

@@ -21,6 +21,8 @@ from __future__ import annotations
 import jax
 import orbax.checkpoint as ocp
 
+from tpu_bootstrap.workload import faults
+
 STATE_KEY = "state"
 
 
@@ -32,6 +34,9 @@ def make_manager(directory: str, max_to_keep: int = 3) -> ocp.CheckpointManager:
 
 
 def save(mgr: ocp.CheckpointManager, step: int, params, opt_state) -> None:
+    # Injected write failure (a full disk / revoked GCS token); orbax's
+    # async machinery never starts, so the previous checkpoint survives.
+    faults.fire("ckpt.save")
     state = {"params": params, "opt_state": opt_state}
     mgr.save(step, args=ocp.args.Composite(**{STATE_KEY: ocp.args.StandardSave(state)}))
 

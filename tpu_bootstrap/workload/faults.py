@@ -1,0 +1,160 @@
+"""Deterministic fault injection for the serving data plane.
+
+Failure handling that is never exercised is failure handling that does
+not work, so every hardened seam in this tree carries a named injection
+site (`fire("pool.device")`, `fire("alloc")`, ...) that is a no-op in
+production and a replayable, typed failure under test.  The schedule
+comes from ``TPUBC_FAULT``:
+
+    TPUBC_FAULT="site[:prob][:after_n][:seed],..."
+
+- ``site``     one of :data:`SITES` (unknown names fail loudly at parse
+               time, same policy as the env-knob catalog).
+- ``prob``     omitted or ``1`` makes the rule ONE-SHOT: it fires
+               exactly once, on call ``after_n + 1`` to that site — the
+               form CI's pinned chaos schedules use.  ``prob < 1``
+               makes every call after ``after_n`` fire independently
+               with that probability from a seeded stream — the fuzz
+               form.  Either way the schedule is a pure function of the
+               spec string: same spec, same run, same faults.
+- ``after_n``  calls to skip before the rule arms (default 0).
+- ``seed``     the per-rule RNG seed for ``prob < 1`` rules (default 0).
+
+Repeating a site makes a multi-shot schedule
+(``"pool.device:1:3,pool.device:1:9"`` aborts rounds 4 and 10).
+
+Zero overhead when disabled (the PR 7 request-events pattern): with
+``TPUBC_FAULT`` unset, :func:`fire` is one global check and token
+streams are byte-identical to a tree without this module.  Tests drive
+the injector programmatically via :func:`install`.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+
+FAULT_ENV = "TPUBC_FAULT"
+
+# The named seams, each standing in for a real failure class:
+#   pool.device   TPU preemption / XLA abort inside a scheduling round
+#   alloc         BlockAllocator invariant breach (fires before any
+#                 allocator mutation, so recovery sees a clean heap)
+#   sched.admit   admission failure between queue pop and slot placement
+#   ingress.write a client socket dying mid-stream
+#   ckpt.save     checkpoint write failure
+#   scrape        the /metrics(.json) seam the controller scrapes (the
+#                 handler answers 500 instead of raising)
+SITES = ("pool.device", "alloc", "sched.admit", "ingress.write",
+         "ckpt.save", "scrape")
+
+
+class InjectedFault(RuntimeError):
+    """A scheduled failure; carries the site and the 1-based call count
+    at which it fired so logs and /requestz stay replay-correlatable."""
+
+    def __init__(self, site: str, count: int):
+        super().__init__(f"injected fault at {site} (call #{count})")
+        self.site = site
+        self.count = count
+
+
+class _Rule:
+    __slots__ = ("site", "prob", "after_n", "seed", "_rng")
+
+    def __init__(self, site: str, prob: float, after_n: int, seed: int):
+        self.site = site
+        self.prob = prob
+        self.after_n = after_n
+        self.seed = seed
+        self._rng = random.Random(seed)
+
+    def should_fire(self, count: int) -> bool:
+        if count <= self.after_n:
+            return False
+        if self.prob >= 1.0:
+            return count == self.after_n + 1  # one-shot
+        return self._rng.random() < self.prob
+
+
+class FaultInjector:
+    """Parsed schedule + per-site call counters.  Single instance per
+    process, swapped wholesale by :func:`install` — the serving engine
+    only ever reads it from one thread per site, and counters under the
+    injector lock stay exact even if a site is hit from two."""
+
+    def __init__(self, spec: str):
+        self._lock = threading.Lock()
+        self._rules: dict[str, list[_Rule]] = {}
+        self._calls: dict[str, int] = {}  # guarded-by: _lock
+        self._fired: dict[str, int] = {}  # guarded-by: _lock
+        self.spec = spec
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            fields = part.split(":")
+            site = fields[0]
+            if site not in SITES:
+                raise ValueError(
+                    f"TPUBC_FAULT: unknown site {site!r} (known: "
+                    f"{', '.join(SITES)})")
+            prob = float(fields[1]) if len(fields) > 1 and fields[1] else 1.0
+            if not 0.0 <= prob <= 1.0:
+                raise ValueError(f"TPUBC_FAULT: prob {prob} outside [0, 1]")
+            after_n = int(fields[2]) if len(fields) > 2 and fields[2] else 0
+            seed = int(fields[3]) if len(fields) > 3 and fields[3] else 0
+            self._rules.setdefault(site, []).append(
+                _Rule(site, prob, after_n, seed))
+
+    def fire(self, site: str) -> None:
+        rules = self._rules.get(site)
+        if not rules:
+            return
+        with self._lock:
+            count = self._calls.get(site, 0) + 1
+            self._calls[site] = count
+            hit = any(r.should_fire(count) for r in rules)
+            if hit:
+                self._fired[site] = self._fired.get(site, 0) + 1
+        if hit:
+            from tpu_bootstrap import telemetry
+            telemetry.metrics().inc("fault_injected_total",
+                                    labels={"site": site})
+            raise InjectedFault(site, count)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"spec": self.spec, "calls": dict(self._calls),
+                    "fired": dict(self._fired)}
+
+
+_ACTIVE = False
+_INJECTOR: FaultInjector | None = None
+
+
+def install(spec: str | None) -> FaultInjector | None:
+    """(Re)configure the process-wide injector.  ``None``/empty disables
+    it and restores the zero-overhead path.  Returns the injector so
+    tests can read ``stats()`` afterwards."""
+    global _ACTIVE, _INJECTOR
+    inj = FaultInjector(spec) if spec else None
+    _INJECTOR = inj
+    _ACTIVE = inj is not None
+    return inj
+
+
+def active() -> bool:
+    return _ACTIVE
+
+
+def fire(site: str) -> None:
+    """Raise :class:`InjectedFault` if the schedule says this call to
+    ``site`` fails.  The disabled path is one global check."""
+    if not _ACTIVE:
+        return
+    _INJECTOR.fire(site)
+
+
+install(os.environ.get(FAULT_ENV))

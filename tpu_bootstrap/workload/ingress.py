@@ -74,7 +74,10 @@ from urllib.parse import parse_qs, urlparse
 
 import os
 
+import signal
+
 from tpu_bootstrap import telemetry
+from tpu_bootstrap.workload import faults
 from tpu_bootstrap.workload.model import ModelConfig, Params
 from tpu_bootstrap.workload.serving import (
     PagedPool,
@@ -102,7 +105,8 @@ class IngressServer:
                  prefix_cache: bool | None = None,
                  overcommit: bool | None = None,
                  spec_lookup: bool | None = None,
-                 max_queue: int | None = None, host: str = "0.0.0.0"):
+                 max_queue: int | None = None, host: str = "0.0.0.0",
+                 watchdog_stall_ms: float | None = None):
         self.cfg = cfg
         if paged and resident:
             # Same loud rejection as serve(): silently preferring one
@@ -214,6 +218,29 @@ class IngressServer:
             "pool": self.pool.snapshot(),
             "scheduler": self.sched.snapshot(),
         }
+        # Graceful drain (SIGTERM / drain()): once draining, the front
+        # door answers 503 + honest Retry-After, the engine finishes or
+        # checkpoint-preempts residents within TPUBC_DRAIN_TIMEOUT_MS,
+        # and every still-open stream gets a final {"draining": true}
+        # chunk instead of a dropped socket.
+        self._draining = False  # guarded-by: _lock
+        self._drained = False  # guarded-by: _lock
+        self._drain_deadline: float | None = None  # guarded-by: _lock
+        # Engine watchdog: the engine stamps a heartbeat at every round
+        # boundary; a stale heartbeat with streams in flight flips
+        # /healthz unhealthy (stall), and a DEAD engine thread triggers
+        # crash-is-preemption recovery + a fresh engine thread.
+        self._beat = time.monotonic()  # guarded-by: _lock
+        self._stalled = False  # guarded-by: _lock
+        if watchdog_stall_ms is None:
+            watchdog_stall_ms = float(os.environ.get(
+                "TPUBC_WATCHDOG_STALL_MS", "30000"))
+        self.watchdog_stall_ms = watchdog_stall_ms  # 0 disables
+        self._watchdog: threading.Thread | None = None
+        # The watchdog ticks on its OWN event, never on _work: a
+        # condition waiter consumes notifications, and a watchdog
+        # parked in _work.wait() would steal the engine's wakeups.
+        self._watchdog_stop = threading.Event()
 
         outer = self
 
@@ -225,6 +252,14 @@ class IngressServer:
                 pass
 
             def do_GET(self):
+                if self.path in ("/metrics", "/metrics.json"):
+                    # The seam the controller's workload-scrape loop
+                    # reads: an injected failure answers 500 (driving
+                    # the scraper's backoff), never a dropped socket.
+                    try:
+                        faults.fire("scrape")
+                    except faults.InjectedFault as e:
+                        return self._json(500, {"error": str(e)})
                 if self.path == "/metrics":
                     # Prometheus text exposition, same routes a daemon
                     # serves — worker 0 of a serve slice is scrapeable
@@ -282,14 +317,29 @@ class IngressServer:
                     pending = len(outer._pending)
                     ttft = sorted(outer._ttft_ms)
                     total = sorted(outer._total_ms)
+                    draining = outer._draining
+                    stalled_ms = (time.monotonic() - outer._beat) * 1e3
+                    # Re-validate the watchdog's cached verdict against
+                    # the live heartbeat: once a stall resolves, health
+                    # must flip back before the next watchdog tick.
+                    stalled = (outer._stalled
+                               and stalled_ms > outer.watchdog_stall_ms)
                 # Waiting = handed-off-but-unsubmitted plus the
                 # Scheduler's ordered queue (its own lock).
                 queued = pending + outer.sched.queue_depth()
                 # ok tracks the ENGINE, not just the counters: a dead
                 # engine thread means every request will hang, and the
-                # Service's readiness probe must see that.
-                health = {"ok": outer._engine.is_alive(), "active": active,
+                # Service's readiness probe must see that. A stalled
+                # heartbeat (watchdog) or a draining replica likewise
+                # answers 503 so readiness steers traffic away.
+                health = {"ok": (outer._engine.is_alive() and not stalled
+                                 and not draining),
+                          "active": active,
                           "queued": queued, "served": served}
+                if draining:
+                    health["draining"] = True
+                if stalled:
+                    health["stalled_ms"] = round(stalled_ms, 1)
                 if ttft:
                     # Rolling p50s over the last 256 completions — the
                     # numbers a serving deployment is judged by.
@@ -352,18 +402,34 @@ class IngressServer:
                     outer.pool.validate(req, outer.cfg)
                 except ValueError as e:
                     return self._json(400, {"error": str(e)})
+                with outer._lock:
+                    draining = outer._draining
+                if draining:
+                    # Shutting down: stop admitting. 503 (not 429 — the
+                    # replica is going away, not busy) with an honest
+                    # Retry-After: by then this replica has finished
+                    # draining and its replacement — or the rest of the
+                    # fleet — is the right target.
+                    return self._json(
+                        503, {"error": "draining: replica is shutting "
+                                       "down; retry elsewhere",
+                              "draining": True},
+                        headers={"Retry-After":
+                                 str(outer._drain_retry_after_s())})
                 submitted = outer._submit(req)
                 if submitted is None:
                     # Server pressure, not a client error: the waiting
-                    # queue is at its bound. Retry-After is a crude
-                    # one-second hint — the queue drains at round
-                    # cadence, not a predictable rate.
+                    # queue is at its bound. Retry-After is the
+                    # scheduler's estimate of the queue's drain time
+                    # (depth over the observed retirement rate, clamped
+                    # to [1, 30]s; 1s when cold).
                     telemetry.metrics().inc("serve_throttled_total")
                     return self._json(
                         429, {"error": "no capacity: waiting queue is "
                                        f"full ({outer.max_queue}); retry",
                               "queued": outer.max_queue},
-                        headers={"Retry-After": "1"})
+                        headers={"Retry-After": str(
+                            outer.sched.retry_after_s(outer.max_queue))})
                 out_q, qpos = submitted
                 if stream:
                     self.send_response(200)
@@ -385,16 +451,25 @@ class IngressServer:
                                     if ev.get("timing") else {}),
                                  **({"trace_id": ev["trace_id"]}
                                     if ev.get("trace_id") else {}),
+                                 **({"draining": True}
+                                    if ev.get("draining") else {}),
+                                 **({"deadline_exceeded": True}
+                                    if ev.get("deadline") else {}),
                                  **({"error": ev["error"]}
                                     if ev.get("error") else {})}
                             ).encode() + b"\n"
+                            # Injected socket failure: one client's dead
+                            # connection must cost exactly what a real
+                            # BrokenPipeError costs — nothing, to anyone
+                            # else.
+                            faults.fire("ingress.write")
                             self.wfile.write(
                                 f"{len(line):x}\r\n".encode() + line + b"\r\n")
                             self.wfile.flush()
                             if ev["done"]:
                                 break
                         self.wfile.write(b"0\r\n\r\n")
-                    except BrokenPipeError:
+                    except (BrokenPipeError, faults.InjectedFault):
                         pass  # client left; the pool finishes its budget
                 else:
                     while True:
@@ -410,7 +485,19 @@ class IngressServer:
                                 out["trace_id"] = ev["trace_id"]
                             if ev.get("error"):
                                 out["error"] = ev["error"]
-                            return self._json(200, out)
+                            # Deadline shed/cancel is a GATEWAY TIMEOUT
+                            # (the request was accepted, its SLO was
+                            # not met); a drain flush is 503 like the
+                            # front door. Both carry the committed
+                            # prefix — partial work is still work.
+                            code = 200
+                            if ev.get("deadline"):
+                                out["deadline_exceeded"] = True
+                                code = 504
+                            elif ev.get("draining"):
+                                out["draining"] = True
+                                code = 503
+                            return self._json(code, out)
 
             def _json(self, code, obj, headers=None):
                 payload = json.dumps(obj).encode()
@@ -453,16 +540,25 @@ class IngressServer:
             # on the final object.
             out_q.put({"new": [], "done": False, "queued": True,
                        "queue_position": depth})
-            self._work.notify()
+            # notify_all, not notify: drain() can be waiting on the
+            # same condition, and a single notification delivered to
+            # the wrong waiter would leave the engine asleep with this
+            # request stranded in _pending.
+            self._work.notify_all()
         return out_q, depth
 
     def _engine_loop(self):
         while True:
             with self._work:
+                self._beat = time.monotonic()
                 while (not self._stop and not self._pending
                        and not self.pool.has_active()
-                       and not self.sched.pending()):
+                       and not self.sched.pending()
+                       and not (self._draining and not self._drained)):
                     self._work.wait()
+                    # Idle waits are not stalls: stamp the heartbeat on
+                    # every wakeup so the watchdog only measures rounds.
+                    self._beat = time.monotonic()
                 if self._stop:
                     return
                 # Take the handoff under the lock; scheduling itself
@@ -495,16 +591,27 @@ class IngressServer:
                     with self._work:
                         for rid in list(rct):
                             self._cached_toks[rid] = rct.pop(rid)
+                # Crash-is-preemption recoveries happen INSIDE
+                # sched.step() on the paged engine (streams survive,
+                # byte-identical); surface the cause on /healthz so the
+                # operator sees the failure even though no client did.
+                recovery_err = self.sched.last_error
+                if recovery_err:
+                    with self._work:
+                        self.last_error = recovery_err
             except Exception as e:  # noqa: BLE001
-                # The engine must SURVIVE a failed round (a transient
-                # backend error would otherwise kill the thread and
-                # leave every client blocked on out_q.get() forever,
-                # with /healthz still green). Fail EVERY in-flight
-                # request loudly — including ones whose admit never
-                # finished — reset the pool (the resident engine's
-                # donated caches may be consumed; reset rebuilds them),
-                # record the error for /healthz, and keep serving new
-                # traffic.
+                # The abort-all backstop, reached only when in-round
+                # recovery is unavailable (slot/resident engines — a
+                # resumed sampled stream could not keep its key
+                # offsets) or exhausted (TPUBC_ENGINE_MAX_RESTARTS
+                # consecutive failures). A failed round must still not
+                # kill the thread: that would leave every client
+                # blocked on out_q.get() forever with /healthz green.
+                # Fail EVERY in-flight request loudly — including ones
+                # whose admit never finished — reset the pool (the
+                # resident engine's donated caches may be consumed;
+                # reset rebuilds them), record the error for /healthz,
+                # and keep serving new traffic.
                 msg = f"{type(e).__name__}: {e}"[:300]
                 with self._work:
                     self.last_error = msg
@@ -608,6 +715,60 @@ class IngressServer:
             # moment a coherent cross-thread view of it exists —
             # publish it for /poolz and /healthz.
             self._publish_poolz()
+            with self._work:
+                draining = self._draining and not self._drained
+            if draining:
+                self._drain_tick()
+
+    def _drain_tick(self) -> None:
+        """ENGINE THREAD ONLY — one drain-progress check at a round
+        boundary. Residents keep decoding until they finish or the
+        drain window (TPUBC_DRAIN_TIMEOUT_MS) expires; at expiry the
+        leftovers are checkpoint-preempted (quarantine: resume records
+        + lifecycle events + blocks parked in the prefix cache) and
+        every still-open stream gets a final ``{"draining": true}``
+        chunk — an honest goodbye, never a dropped socket."""
+        with self._work:
+            idle = (not self._pending and not self._streams
+                    and not self.sched.pending()
+                    and not self.pool.has_active())
+            expired = (self._drain_deadline is not None
+                       and time.monotonic() >= self._drain_deadline)
+            if not (idle or expired):
+                return
+            if not idle:
+                generated = {s.rid: list(s.generated)
+                             for s in self.pool.slots if s is not None}
+                if hasattr(self.pool, "quarantine"):
+                    # Records are dropped, not requeued: the process is
+                    # exiting, and the events + cache salvage are what
+                    # outlive it into /requestz and any residual reads.
+                    self.pool.quarantine(reason="drain")
+                else:
+                    for i, s in enumerate(self.pool.slots):
+                        if s is not None:
+                            self.pool.cancel(i, reason="drain")
+                # _pending covers the race where a request slipped past
+                # the front-door check as the flag flipped: its stream
+                # never registered, but its client still gets the
+                # goodbye chunk.
+                flush = list(self._streams.items()) + [
+                    (req.rid, q) for req, q in self._pending]
+                self._pending = []
+                for rid, q in flush:
+                    q.put({"new": [], "done": True, "draining": True,
+                           "error": "draining: replica shut down before "
+                                    "completion",
+                           "generated": generated.get(rid, [])})
+                self._streams.clear()
+                self._submit_t.clear()
+                self._last_ev_t.clear()
+                self._cached_toks.clear()
+                self._req_meta.clear()
+                self.sched.reset(reason="drain")
+            self._drained = True
+            self._work.notify_all()
+        self._publish_poolz()
 
     def _publish_poolz(self) -> None:
         """Snapshot pool + scheduler state and publish it under the
@@ -622,19 +783,157 @@ class IngressServer:
         with self._work:
             self._poolz = snap
 
+    # ---- drain / watchdog ------------------------------------------------
+
+    def _drain_retry_after_s(self) -> int:
+        """Retry-After for 503-while-draining: the remaining drain
+        window rounded up (afterwards this replica is gone and the
+        retry should land elsewhere), clamped to [1, 30]s."""
+        with self._lock:
+            deadline = self._drain_deadline
+        if deadline is None:
+            return 1
+        return max(1, min(30, int(deadline - time.monotonic()) + 1))
+
+    def drain(self, timeout_ms: float | None = None) -> float:
+        """Graceful drain (the SIGTERM path; tests call it directly):
+        flip the front door to 503 + Retry-After, let the engine finish
+        — or, at the window's expiry, checkpoint-preempt — residents,
+        flush every still-open stream with a final {"draining": true}
+        chunk, and publish ``draining`` on /healthz throughout.
+        Blocks until the engine reports drained (with a grace period
+        past the window for a wedged round) and returns the wall-clock
+        ms the drain took (also the serve_drain_ms gauge).
+        Idempotent; safe from any thread."""
+        if timeout_ms is None:
+            timeout_ms = float(os.environ.get(
+                "TPUBC_DRAIN_TIMEOUT_MS", "5000"))
+        t0 = time.monotonic()
+        with self._work:
+            if not self._draining:
+                self._draining = True
+                self._drain_deadline = t0 + timeout_ms / 1e3
+            self._work.notify_all()
+            # The engine flushes at a round boundary; a wedged round
+            # must not hold the drain hostage forever — past the grace
+            # window the caller proceeds to stop() and the OS reaps the
+            # sockets (the watchdog will have marked the stall).
+            grace = t0 + timeout_ms / 1e3 + 30.0
+            while not self._drained and time.monotonic() < grace:
+                self._work.wait(0.1)
+        ms = (time.monotonic() - t0) * 1e3
+        telemetry.metrics().set_gauge("serve_drain_ms", round(ms, 1))
+        return ms
+
+    def _watchdog_loop(self) -> None:
+        """Stall detector + engine resurrection. The engine stamps
+        ``_beat`` at every round boundary; streams in flight with a
+        stale heartbeat flip /healthz unhealthy (stall episodes are
+        counted once), and a DEAD engine thread (an error past the
+        in-loop boundaries) gets crash-is-preemption recovery and a
+        fresh thread — the in-process version of "the replica came
+        back"."""
+        period = max(0.02, self.watchdog_stall_ms / 1e3 / 4)
+        while not self._watchdog_stop.wait(period):
+            dead = False
+            with self._work:
+                if self._stop:
+                    return
+                busy = bool(self._streams) or bool(self._pending)
+                age_ms = (time.monotonic() - self._beat) * 1e3
+                alive = self._engine.is_alive()
+                stalled = (busy and alive
+                           and age_ms > self.watchdog_stall_ms)
+                if stalled and not self._stalled:
+                    self.last_error = (f"engine stall: no round "
+                                       f"heartbeat for {age_ms:.0f}ms")
+                    telemetry.metrics().inc("serve_engine_stalls_total")
+                self._stalled = stalled
+                if not alive and busy:
+                    dead = True
+            if dead:
+                self._restart_engine()
+
+    def _restart_engine(self) -> None:
+        """Watchdog path for a DEAD engine thread (a failure the
+        in-loop exception boundary could not catch). The thread is
+        gone, so the watchdog briefly OWNS the engine state: quarantine
+        whatever it left (resume records re-queued under original keys
+        — recovered streams stay byte-identical on the paged engine;
+        slot engines fail their streams loudly, the abort-all
+        contract), then hand ownership to a fresh engine thread."""
+        reg = telemetry.metrics()
+        if hasattr(self.pool, "quarantine"):
+            self.sched.requeue(self.pool.quarantine())
+        else:
+            with self._work:
+                generated = {s.rid: list(s.generated)
+                             for s in self.pool.slots if s is not None}
+                for rid, q in list(self._streams.items()):
+                    q.put({"new": [], "done": True,
+                           "error": "engine thread died",
+                           "generated": generated.get(rid, [])})
+                self._streams.clear()
+                self._submit_t.clear()
+                self._last_ev_t.clear()
+                self._cached_toks.clear()
+                self._req_meta.clear()
+            self.pool.reset()
+            self.sched.reset()
+        with self._work:
+            if self._stop:
+                return
+            if not self.last_error:
+                self.last_error = "engine thread died (restarted)"
+            self._engine = threading.Thread(target=self._engine_loop,
+                                            daemon=True)
+            self._engine.start()
+            self._work.notify_all()
+        reg.inc("serve_engine_restarts_total")
+
+    def _start_watchdog(self) -> None:
+        if self.watchdog_stall_ms <= 0 or self._watchdog is not None:
+            return
+        self._watchdog = threading.Thread(target=self._watchdog_loop,
+                                          daemon=True)
+        self._watchdog.start()
+
+    def _install_sigterm(self) -> None:
+        """SIGTERM -> graceful drain, then stop — what a pod deletion
+        sends. The handler only spawns the drain thread (signal context
+        must not block); drain() itself does the waiting."""
+
+        def _on_sigterm(signum, frame):
+            threading.Thread(target=self._drain_then_stop,
+                             daemon=True).start()
+
+        try:
+            signal.signal(signal.SIGTERM, _on_sigterm)
+        except ValueError:
+            pass  # not the main thread (embedded/test harness)
+
+    def _drain_then_stop(self) -> None:
+        self.drain()
+        self.stop()
+
     # ---- lifecycle -------------------------------------------------------
 
     def start(self) -> "IngressServer":
         """Background mode (tests): engine + HTTP threads, return."""
         self._engine.start()
+        self._start_watchdog()
         self._http_thread = threading.Thread(
             target=self.httpd.serve_forever, daemon=True)
         self._http_thread.start()
         return self
 
     def serve_forever(self) -> None:
-        """Foreground mode (the JobSet entry): block in the HTTP loop."""
+        """Foreground mode (the JobSet entry): block in the HTTP loop.
+        Installs the SIGTERM -> drain -> stop handler: a pod deletion
+        becomes a graceful drain, not a dropped-socket massacre."""
         self._engine.start()
+        self._start_watchdog()
+        self._install_sigterm()
         print(f"ingress: serving on :{self.port} "
               f"(pool={self.pool.batch_size}, "
               f"speculative="
@@ -647,6 +946,7 @@ class IngressServer:
         with self._work:
             self._stop = True
             self._work.notify_all()
+        self._watchdog_stop.set()
         self.httpd.shutdown()
         self.httpd.server_close()
 

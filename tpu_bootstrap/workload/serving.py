@@ -119,7 +119,7 @@ import numpy as np
 from jax import lax
 
 from tpu_bootstrap import telemetry
-from tpu_bootstrap.workload import decode_attention
+from tpu_bootstrap.workload import decode_attention, faults
 from tpu_bootstrap.workload.decode import (
     _multi_device,
     decode_step,
@@ -648,6 +648,20 @@ class _PoolBase:
         """Hook invoked by the event fold just before a finished row's
         slot is cleared — the paged engine returns its blocks here."""
 
+    def cancel(self, i: int, reason: str = "deadline") -> dict:
+        """Cancel a resident row at a round boundary (deadline
+        enforcement): emit its terminal lifecycle event, release its
+        resources through the retirement hook (the paged engine returns
+        blocks to the cohort), clear the slot, and return the terminal
+        stream event carrying whatever prefix was committed."""
+        s = self.slots[i]
+        self._levent(s.rid, "retired", reason=reason,
+                     generated=len(s.generated),
+                     footprint_blocks=len(getattr(s, "blocks", ()) or ()))
+        self._on_retire(i, s)
+        self.slots[i] = None
+        return {"new": [], "done": True, "generated": list(s.generated)}
+
     def _record_acceptance(self, counts, rows) -> None:
         """Draft acceptance accounting shared by both draft sources
         (model draft and prompt-lookup): ``rows`` are the slot indices
@@ -873,6 +887,9 @@ class SlotPool(_PoolBase):
         active = [s for s in self.slots if s is not None]
         if not active:
             return {}
+        # Simulated TPU preemption / XLA abort: fires only when a round
+        # would actually dispatch, like the real thing.
+        faults.fire("pool.device")
         # Chunk: largest power of two <= the smallest remaining budget —
         # at least one row retires or halves per round, and chunk sizes
         # stay a log-bounded compile set.
@@ -1195,6 +1212,7 @@ class ResidentPool(_PoolBase):
         active = [s for s in self.slots if s is not None]
         if not active:
             return {}
+        faults.fire("pool.device")
         last = jnp.asarray(
             [s.history[-1] if s is not None else 0 for s in self.slots],
             jnp.int32)
@@ -1426,6 +1444,11 @@ class BlockAllocator:
     # ---- alloc / refcount lifecycle ---------------------------------------
 
     def alloc(self, n: int) -> list:
+        # The injected "invariant breach" fires BEFORE any mutation:
+        # recovery then quarantines an allocator whose heap/refcount
+        # state is still self-consistent, which is what a real caught
+        # breach must also guarantee (the invariant checks are loud).
+        faults.fire("alloc")
         if n < 1:
             raise ValueError(f"alloc of {n} blocks")
         if n > self.available():
@@ -1535,6 +1558,23 @@ class BlockAllocator:
         self._free = [i for i in range(1, self.num_blocks + 1)
                       if i not in taken]
         heapq.heapify(self._free)
+
+    def quarantine_to_cache(self) -> None:
+        """Crash recovery's allocator half (PagedPool.quarantine): drop
+        EVERY live reference — the row tables those refcounts mirrored
+        died with the crashed engine's slots — while retaining
+        registered content as cached, so the resumed rows' re-prefill
+        revives its own prefix from the index instead of recomputing
+        it. Unregistered live blocks (partial tails, COW duplicates)
+        return to the heap. Tolerates any refcount state, including a
+        half-finished admission's. Invariants afterwards: no live
+        blocks, cached == registered, heap == everything else."""
+        for bid in list(self._ref):
+            del self._ref[bid]
+            if bid in self._key_of:
+                self._cached[bid] = self._key_of[bid]
+            else:
+                heapq.heappush(self._free, bid)
 
     def compactness(self) -> float:
         """1.0 = the LIVE set is a perfect prefix of the id space; lower
@@ -1982,6 +2022,62 @@ class PagedPool(_PoolBase):
                                            quantized=self.kv_quant)
         self._record_block_gauges()
 
+    def quarantine(self, reason: str = "crash") -> list:
+        """Crash-is-preemption (the engine watchdog / recovery path):
+        an engine failure is treated as "preempt every resident row at
+        once" — each live slot becomes the same resume record
+        ``_preempt`` parks (prompt + committed generation as preload),
+        a ``preempted(reason=crash)`` lifecycle event lands in
+        /requestz, and the records (plus any already-pending
+        evict-and-recompute handoffs) are returned for the Scheduler to
+        re-queue. KV is a pure function of (token, position), so the
+        resumed streams are byte-identical to uninterrupted ones.
+
+        The physical arrays survive when the failure struck before the
+        round jit dispatched (the donated pools were not consumed) —
+        then registered content is salvaged into the content-hash cache
+        (``quarantine_to_cache``) and re-prefill mostly hits. A failure
+        inside a donating jit consumes the arrays (``is_deleted``), and
+        the pool rebuilds from scratch instead."""
+        recs = list(self.preempted)
+        self.preempted.clear()
+        layers = list(self.pools) + list(self.dpools or [])
+        alive = not any(getattr(a, "is_deleted", lambda: False)()
+                        for layer in layers for a in layer.values())
+        for s in self.slots:
+            if s is None:
+                continue
+            self._levent(s.rid, "preempted", reason=reason,
+                         phase=("prefill" if self._prefilling(s)
+                                else "decode"),
+                         generated=len(s.generated),
+                         blocks_freed=len(s.blocks))
+            self.stats["crash_preempts"] = (
+                self.stats.get("crash_preempts", 0) + 1)
+            prompt = s.history[:len(s.history) - len(s.generated)]
+            recs.append({"request": Request(rid=s.rid, tokens=prompt,
+                                            max_new=(len(s.generated)
+                                                     + s.remaining),
+                                            priority=s.priority,
+                                            deadline=s.deadline),
+                         "preload": list(s.generated), "seq": s.seq,
+                         "t": time.monotonic()})
+        if alive:
+            try:
+                if self.prefix_cache:
+                    for s in self.slots:
+                        if s is not None:
+                            self._register_full(s)
+                self.allocator.quarantine_to_cache()
+            except Exception:  # noqa: BLE001 - salvage is best-effort
+                alive = False
+        self.slots = [None] * self.batch_size
+        self.request_cached_tokens.clear()
+        if not alive:
+            self.reset()
+        self._record_block_gauges()
+        return recs
+
     def _register_full(self, s) -> None:
         """Enter ``s``'s newly-FULL blocks into the content-hash index.
         A block is registerable once every position it covers holds
@@ -2325,6 +2421,9 @@ class PagedPool(_PoolBase):
         active = [s for s in self.slots if s is not None]
         if not active:
             return {}
+        # Simulated TPU preemption / XLA abort, before this round's
+        # donated dispatch — the quarantine salvage path's common case.
+        faults.fire("pool.device")
         self.stats["rounds"] += 1
         self._prefill_phase()
         dec = [s for s in self.slots
@@ -2624,7 +2723,19 @@ class Scheduler:
         self._preempt_t: dict = {}  # rid -> monotonic eviction time  # guarded-by: _lock
         self._waits = deque(maxlen=512)  # recent queue waits (ms)  # guarded-by: _lock
         self.stats = {"submitted": 0, "admitted": 0, "requeues": 0,  # guarded-by: _lock
-                      "retired": 0}
+                      "retired": 0, "deadline_shed": 0, "recoveries": 0}
+        # Crash-is-preemption recovery (engine-thread state): a failed
+        # round quarantines the pool and re-queues its residents; the
+        # streak bounds a crash loop (a persistent fault re-raises
+        # after TPUBC_ENGINE_MAX_RESTARTS consecutive failures instead
+        # of burning the drain window forever).
+        self._fail_streak = 0  # guarded-by: <engine-thread>
+        self._max_restarts = int(os.environ.get(
+            "TPUBC_ENGINE_MAX_RESTARTS", "8"))
+        self.last_error = ""  # guarded-by: _lock
+        # Observed retirement rate -> the honest Retry-After estimate
+        # (RateWindow locks itself).
+        self._retire_window = telemetry.RateWindow()
         # The request-lifecycle flight recorder: the Scheduler owns it
         # (it sees every transition), the pool appends its own events
         # through the request_log backref, /requestz serves snapshot().
@@ -2674,6 +2785,13 @@ class Scheduler:
         recs = list(getattr(self.pool, "preempted", ()))
         if not recs:
             return
+        self.requeue(recs)
+        self.pool.preempted.clear()
+
+    def requeue(self, recs: list) -> None:
+        """Re-enqueue resume records under their original keys — the
+        evict-and-recompute path and crash/watchdog recovery share
+        it."""
         with self._lock:
             for rec in recs:
                 self._push_locked(rec["request"], rec["preload"],
@@ -2681,11 +2799,22 @@ class Scheduler:
                 self.stats["requeues"] += 1
                 if "t" in rec:
                     self._preempt_t[rec["request"].rid] = rec["t"]
-        self.pool.preempted.clear()
 
     def queue_depth(self) -> int:
         with self._lock:
             return len(self._waiting)
+
+    def retry_after_s(self, depth: int | None = None) -> int:
+        """Honest 429/503 Retry-After: current queue depth over the
+        observed retirement rate (a RateWindow over retires), clamped
+        to [1, 30]s. A cold scheduler (no retirement observed yet)
+        keeps the old 1-second hint."""
+        if depth is None:
+            depth = self.queue_depth()
+        rate = self._retire_window.per_sec()
+        if rate <= 0 or depth <= 0:
+            return 1
+        return max(1, min(30, math.ceil(depth / rate)))
 
     def pending(self) -> bool:
         with self._lock:
@@ -2720,12 +2849,23 @@ class Scheduler:
                      else 0)
             if self.pool.admits(r, reserve_new=reserve, preload=preload,
                                 extra_blocks=extra):
+                faults.fire("sched.admit")
                 with self._lock:
                     heapq.heappop(self._waiting)
-                # Pool admission may do device work (resident prefill
-                # compiles+runs); it must never run under the lock.
-                self.pool.admit(r, reserve_new=reserve, preload=preload,
-                                seq=seq)
+                try:
+                    # Pool admission may do device work (resident
+                    # prefill compiles+runs); it must never run under
+                    # the lock.
+                    self.pool.admit(r, reserve_new=reserve,
+                                    preload=preload, seq=seq)
+                except Exception:
+                    # Crash-is-preemption must not lose the victim: the
+                    # popped request goes straight back under its key
+                    # before recovery quarantines whatever admission
+                    # half-did.
+                    with self._lock:
+                        self._push_locked(r, preload, seq)
+                    raise
                 if preload is None:
                     with self._lock:
                         self.stats["admitted"] += 1
@@ -2763,23 +2903,99 @@ class Scheduler:
             break
         self._record_gauges()
 
-    def step(self) -> dict:
-        """One scheduling round: admit (preempting for priority), run
-        the pool's round, drain evict-and-recompute records back into
-        the queue, and fold retirements into the expected-length EMA."""
-        self._admit_phase()
-        if self.overcommit:
-            # Decode chunks follow the same expectation admission
-            # reserves by (see PagedPool.chunk_hint).
+    def _shed_expired(self) -> dict:
+        """Deadline enforcement at the round boundary: expired waiting
+        requests shed from the queue (the ingress answers their streams
+        504), expired RESIDENTS cancel — freeing their blocks for the
+        cohort — and both emit terminal events carrying the committed
+        prefix. Deadline-less traffic pays one monotonic read and a
+        heap scan."""
+        now = time.monotonic()
+        events: dict = {}
+        with self._lock:
+            expired = [e for e in self._waiting if e[1] <= now]
+            if expired:
+                keep = [e for e in self._waiting if e[1] > now]
+                heapq.heapify(keep)
+                self._waiting = keep
+        for (_negp, _dl, _seq, r, preload) in expired:
             with self._lock:
-                self.pool.chunk_hint = max(1, math.ceil(self._ema))
-        events = self.pool.step_round()
+                self._qstart.pop(r.rid, None)
+                self._preempt_t.pop(r.rid, None)
+                self.stats["deadline_shed"] += 1
+            telemetry.metrics().inc("serve_deadline_shed_total")
+            self.log.event(r.rid, "retired", reason="deadline",
+                           generated=len(preload or []))
+            events[r.rid] = {"new": [], "done": True,
+                             "generated": list(preload or []),
+                             "deadline": True,
+                             "error": "deadline exceeded"}
+        for i, s in enumerate(self.pool.slots):
+            if (s is None or s.deadline is None or s.deadline > now):
+                continue
+            events[s.rid] = {**self.pool.cancel(i, reason="deadline"),
+                             "deadline": True,
+                             "error": "deadline exceeded"}
+            with self._lock:
+                self.stats["deadline_shed"] += 1
+            telemetry.metrics().inc("serve_deadline_shed_total")
+        return events
+
+    def _recover(self, exc: Exception) -> None:
+        """Crash-is-preemption: quarantine the pool (resume records +
+        prefix-cache salvage where the arrays survived) and re-queue
+        every in-flight row under its original key. The next round's
+        re-prefill resumes each stream byte-identically."""
+        t0 = time.perf_counter()
+        self._fail_streak += 1
+        recs = self.pool.quarantine()
+        self.requeue(recs)
+        with self._lock:
+            self.stats["recoveries"] += 1
+            self.last_error = f"{type(exc).__name__}: {exc}"
+        reg = telemetry.metrics()
+        reg.inc("serve_engine_restarts_total")
+        reg.observe("serve_recovery_ms",
+                    (time.perf_counter() - t0) * 1e3)
+
+    def step(self) -> dict:
+        """One scheduling round: shed expired deadlines, admit
+        (preempting for priority), run the pool's round, drain
+        evict-and-recompute records back into the queue, and fold
+        retirements into the expected-length EMA. A failed round on the
+        paged engine RECOVERS crash-is-preemption style (see _recover)
+        up to TPUBC_ENGINE_MAX_RESTARTS consecutive times; slot engines
+        (no quarantine — a resumed sampled stream could not keep its
+        key offsets) re-raise to the caller's abort-all path."""
+        shed: dict = {}
+        try:
+            shed = self._shed_expired()
+            self._admit_phase()
+            if self.overcommit:
+                # Decode chunks follow the same expectation admission
+                # reserves by (see PagedPool.chunk_hint).
+                with self._lock:
+                    self.pool.chunk_hint = max(1, math.ceil(self._ema))
+            events = self.pool.step_round()
+            self._fail_streak = 0
+        except Exception as e:  # noqa: BLE001 - the recovery boundary
+            if (not hasattr(self.pool, "quarantine")
+                    or self._fail_streak >= self._max_restarts):
+                raise
+            self._recover(e)
+            events = {}
+        events.update(shed)
         self._drain_preempted()
         retired = [rid for rid, ev in events.items() if ev["done"]]
         if retired:
+            self._retire_window.add(len(retired))
             with self._lock:
                 for rid in retired:
                     self.stats["retired"] += 1
+                    if events[rid].get("deadline"):
+                        # A shed stream's length says nothing about how
+                        # long completed traffic runs.
+                        continue
                     self._ema += self._alpha * (
                         len(events[rid]["generated"]) - self._ema)
             for rid in retired:
@@ -2814,12 +3030,12 @@ class Scheduler:
                         self._queue_wait_p50_locked(), 2),
                     "stats": dict(self.stats)}
 
-    def reset(self) -> None:
+    def reset(self, reason: str = "error") -> None:
         """Drop every queued request (the ingress failed-round recovery
         — queued clients received their error events alongside the
         in-flight ones; resetting the pool itself is the caller's
-        job). The length EMA survives: it describes traffic, not the
-        failed round."""
+        job; graceful drain passes reason="drain"). The length EMA
+        survives: it describes traffic, not the failed round."""
         with self._lock:
             self._waiting.clear()
             self._qstart.clear()
@@ -2828,7 +3044,7 @@ class Scheduler:
         # failed round's victims running forever. (Outside the lock:
         # RequestLog takes its own, and holding both here would impose
         # an ordering on every other caller pair.)
-        self.log.abort_inflight("error")
+        self.log.abort_inflight(reason)
 
     def _record_gauges(self) -> None:
         with self._lock:
