@@ -1205,6 +1205,75 @@ except Exception as e:  # noqa: BLE001
     out["serve_chaos_bench_error"] = f"{type(e).__name__}: {e}"[:400]
 emit()
 
+# Fleet plane (fleetz ISSUE): the aggregator's two shipped numbers. A
+# two-replica mini-fleet (same weights, independent pools) serves a
+# shared-prefix prompt on replica A only; fleet_digest_match_uplift is
+# how many leading prompt blocks A's published cache digest scores
+# above cold B's — the router's placement signal, and the gate that the
+# digest actually distinguishes a warm replica from a cold one.
+# fleet_scrape_staleness_p99_ms is the aggregator's own freshness tail
+# across the poll cycles — the /fleetz pane must not go stale while
+# claiming to watch the fleet.
+try:
+    import json as _json6
+    import urllib.request as _url6
+
+    from tpu_bootstrap.workload import serving as _srv6
+    from tpu_bootstrap.workload.fleetz import FleetAggregator as _Fleet
+    from tpu_bootstrap.workload.ingress import IngressServer as _FlIngress
+
+    _fl_a = _FlIngress(dparams, dcfg, port=0, batch_size=4, paged=True,
+                       block_size=16, kv_blocks=64,
+                       host="127.0.0.1").start()
+    _fl_b = _FlIngress(dparams, dcfg, port=0, batch_size=4, paged=True,
+                       block_size=16, kv_blocks=64,
+                       host="127.0.0.1").start()
+    _fl_agg = None
+    try:
+        def _fl_post(port, toks, n=8):
+            rq = _url6.Request(
+                f"http://127.0.0.1:{port}/v1/generate",
+                data=_json6.dumps({"tokens": toks, "max_new": n,
+                                   "stream": False}).encode(),
+                headers={"Content-Type": "application/json"})
+            with _url6.urlopen(rq, timeout=120) as resp:
+                return _json6.loads(resp.read())
+
+        _fl_prompt = list(range(1, 49))
+        _fl_post(_fl_a.port, _fl_prompt)  # warm A: registers the prefix
+        _fl_post(_fl_a.port, _fl_prompt)  # hit it: blocks provably shared
+        _fl_agg = _Fleet(
+            [f"127.0.0.1:{_fl_a.port}", f"127.0.0.1:{_fl_b.port}"],
+            port=0, host="127.0.0.1", poll_s=0.1).start()
+        _fl_t0 = time.time()
+        while time.time() - _fl_t0 < 30:
+            fz = _fl_agg.fleetz_json()
+            if fz["fleet"]["healthy"] == 2 and fz["fleet"]["digest_blocks"]:
+                break
+            time.sleep(0.05)
+        fz = _fl_agg.fleetz_json()
+        _fl_da = (fz["replicas"][f"127.0.0.1:{_fl_a.port}"]["cache_digest"]
+                  or {})
+        _fl_db = (fz["replicas"][f"127.0.0.1:{_fl_b.port}"]["cache_digest"]
+                  or {})
+        out.update({
+            "fleet_digest_match_uplift":
+                _srv6.digest_match_len(_fl_prompt, _fl_da)
+                - _srv6.digest_match_len(_fl_prompt, _fl_db),
+            "fleet_scrape_staleness_p99_ms": round(
+                _fl_agg.reg.quantile("fleet_scrape_staleness_ms", 0.99), 3),
+            "fleet_replicas_healthy": fz["fleet"]["healthy"],
+            "fleet_digest_blocks": fz["fleet"]["digest_blocks"],
+        })
+    finally:
+        if _fl_agg is not None:
+            _fl_agg.stop()
+        _fl_a.stop()
+        _fl_b.stop()
+except Exception as e:  # noqa: BLE001
+    out["fleet_bench_error"] = f"{type(e).__name__}: {e}"[:400]
+emit()
+
 # Speculative decoding (VERDICT r3 item 5): committed-tokens/s for int8
 # SELF-speculation — the target's own int8 copy drafts gamma tokens, the
 # bf16 target verifies the chunk in one weight stream. The only reason
@@ -1492,7 +1561,8 @@ def _cache_workload(parsed: dict) -> None:
 _HIGHER_BETTER = ("per_sec", "speedup", "mfu_pct", "gbps",
                   "roofline_frac", "mean_committed", "committed_per_stream",
                   "slot_utilization", "temp_reduction", "agreement_pct",
-                  "hit_rate", "admit_ratio", "accept_rate", "goodput_frac")
+                  "hit_rate", "admit_ratio", "accept_rate", "goodput_frac",
+                  "uplift")
 # "_ms" must stay an endswith match (as a substring it would grab
 # unrelated keys); the rest are distinctive enough to match anywhere —
 # quality deltas carry format suffixes (quant_xent_delta_int8).
@@ -1653,9 +1723,15 @@ def check_results(results: dict | None = None, threshold: float = 0.15):
     # keep beating refusal admission at equal KV memory), and the chaos
     # goodput fraction (recovery must keep completing within SLO under
     # the pinned fault schedule).
+    # ... plus the fleet plane's pair: the cache digest must keep
+    # ranking a warm replica above a cold one (uplift in blocks), and
+    # the aggregator's scrape-staleness tail must not grow — a stale
+    # /fleetz pane silently lies to the router/autoscaler reading it.
     _HARD_KEYS = ("serve_paged_tokens_per_sec", "serve_ttft_p99_ms",
                   "serve_prefix_hit_rate", "serve_cached_ttft_p50_ms",
-                  "serve_admit_ratio", "serve_chaos_goodput_frac")
+                  "serve_admit_ratio", "serve_chaos_goodput_frac",
+                  "fleet_digest_match_uplift",
+                  "fleet_scrape_staleness_p99_ms")
     hard = {k: v for k, v in regressions.items()
             if "hbm_roofline_frac" in k or "achieved_gbps" in k
             or k in _HARD_KEYS}
@@ -2239,6 +2315,96 @@ def trace_capture(out_path: str):
     return summary
 
 
+FLEET_REPLICA_SCRIPT = r"""
+import os, sys
+sys.path.insert(0, os.environ["TPUBC_REPO"])
+import jax
+jax.config.update("jax_platforms",
+                  os.environ.get("JAX_PLATFORMS", "cpu") or "cpu")
+from tpu_bootstrap.workload.ingress import IngressServer
+from tpu_bootstrap.workload.model import ModelConfig, init_params
+
+cfg = ModelConfig(vocab_size=32, num_layers=1, num_heads=2, head_dim=8,
+                  embed_dim=16, mlp_dim=32, max_seq_len=64)
+params = init_params(cfg, jax.random.PRNGKey(0))
+IngressServer(params, cfg, port=int(sys.argv[1]), batch_size=2, paged=True,
+              block_size=8, host="127.0.0.1").serve_forever()
+"""
+
+
+def fleet_trace_capture(out_path: str):
+    """--trace-out --fleet: the cross-replica half of the trace story.
+    Two SUBPROCESS serve replicas (separate tracer buffers — the stitch
+    below is a real out-of-band join, not one process talking to
+    itself) each serve one request under the SAME trace id; the fleetz
+    aggregator scrapes both /traces.json buffers and writes the
+    stitched Chrome timeline (one pid per replica, rows grouped by
+    trace id) to out_path. Prints one JSON summary line."""
+    from tpu_bootstrap.workload.fleetz import FleetAggregator, stitch_chrome
+
+    ports = [free_port(), free_port()]
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", FLEET_REPLICA_SCRIPT, str(p)],
+        env={**os.environ, "TPUBC_REPO": str(REPO),
+             "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS") or "cpu"},
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+        for p in ports]
+    trace_id = "f1ee7" + os.urandom(6).hex()
+    try:
+        for port, proc in zip(ports, procs):
+            deadline = time.time() + 120
+            while True:
+                if proc.poll() is not None:
+                    raise RuntimeError("fleet replica exited: "
+                                       + proc.stderr.read().decode()[-2000:])
+                try:
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz", timeout=1)
+                    break
+                except OSError:
+                    if time.time() > deadline:
+                        raise TimeoutError("fleet replica health timeout")
+                    time.sleep(0.05)
+        for port in ports:  # one request per replica, one shared trace id
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/generate",
+                data=json.dumps({"tokens": [1, 2, 3], "max_new": 4,
+                                 "stream": False,
+                                 "trace_id": trace_id}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=300) as r:
+                assert json.loads(r.read())["done"]
+        agg = FleetAggregator([f"127.0.0.1:{p}" for p in ports],
+                              port=0, host="127.0.0.1", poll_s=0.1)
+        try:
+            agg.poll_once()
+            doc = stitch_chrome(agg._trace_docs())
+        finally:
+            agg.httpd.server_close()
+        Path(out_path).write_text(json.dumps(doc))
+    finally:
+        for proc in procs:
+            proc.send_signal(signal.SIGTERM)
+        for proc in procs:
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    events = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    shared = [e for e in events
+              if e.get("args", {}).get("trace_id") == trace_id]
+    summary = {
+        "trace_out": str(out_path),
+        "trace_id": trace_id,
+        "replicas": len(ports),
+        "span_count": len(events),
+        "spans_in_shared_trace": len(shared),
+        "pids_in_shared_trace": len({e["pid"] for e in shared}),
+    }
+    print(json.dumps(summary))
+    return summary
+
+
 def slo_report(out_path: str, n_crs: int = 30):
     """--slo-report: the operator-facing SLO summary for one bench
     trajectory. Two legs share one process:
@@ -2478,6 +2644,11 @@ def main():
                         help="capture one webhook->controller->workload "
                              "lifecycle and write a merged Chrome trace to "
                              "PATH instead of running the full bench")
+    parser.add_argument("--fleet", action="store_true",
+                        help="with --trace-out: capture a two-replica serve "
+                             "fleet instead — separate replica processes, "
+                             "one shared trace id, Chrome timeline stitched "
+                             "by the fleetz aggregator")
     parser.add_argument("--slo-report", metavar="PATH",
                         help="drive a serve run + CR trajectory and write a "
                              "JSON SLO summary (time-to-Running p50/p99, "
@@ -2497,6 +2668,10 @@ def main():
                    else json.loads(Path(args.check).read_text()))
         sys.exit(check_results(results))
 
+    if args.trace_out and args.fleet:
+        # Pure-Python fleet: no native daemons involved, no build needed.
+        fleet_trace_capture(args.trace_out)
+        return
     nativelib.build_native()
     if args.trace_out:
         trace_capture(args.trace_out)

@@ -812,10 +812,13 @@ void scrape_workloads(KubeClient& client, const ControllerConfig& cfg,
   {
     const std::vector<std::string> live = cache.names();
     for (auto it = backoff.begin(); it != backoff.end();) {
-      if (std::find(live.begin(), live.end(), it->first) == live.end())
+      if (std::find(live.begin(), live.end(), it->first) == live.end()) {
+        Metrics::instance().remove("tpubc_scrape_backoff_seconds{replica=\"" +
+                                   it->first + "\"}");
         it = backoff.erase(it);
-      else
+      } else {
         ++it;
+      }
     }
   }
   for (const std::string& name : cache.names()) {
@@ -847,7 +850,9 @@ void scrape_workloads(KubeClient& client, const ControllerConfig& cfg,
         throw std::runtime_error("scrape HTTP " + std::to_string(resp.status));
       Json summary = workload_summary(Json::parse(resp.body), now_rfc3339());
       Metrics::instance().inc("workload_scrapes_total");
-      backoff.erase(name);  // healthy again: next pass probes on cadence
+      if (backoff.erase(name))  // healthy again: next pass probes on cadence
+        Metrics::instance().remove(
+            "tpubc_scrape_backoff_seconds{replica=\"" + name + "\"}");
       if (summary.is_object()) {
         client.merge_status(
             kApiVersion, kKind, "", name,
@@ -883,9 +888,18 @@ void scrape_workloads(KubeClient& client, const ControllerConfig& cfg,
   // seconds (0 = every Running replica is being probed on cadence).
   int64_t worst_remaining_s = 0;
   const int64_t now = monotonic_ms();
-  for (const auto& kv : backoff)
-    worst_remaining_s = std::max<int64_t>(
-        worst_remaining_s, (kv.second.next_attempt_ms - now + 999) / 1000);
+  for (const auto& kv : backoff) {
+    const int64_t remaining_s =
+        std::max<int64_t>(0, (kv.second.next_attempt_ms - now + 999) / 1000);
+    worst_remaining_s = std::max(worst_remaining_s, remaining_s);
+    // Per-replica view (fleetz scrape-state parity): which replica is
+    // backing off, not just how badly the worst one is. Removed on
+    // recovery and on CR deletion above — a labeled gauge that only
+    // ever grows would report ghosts.
+    Metrics::instance().set(
+        "tpubc_scrape_backoff_seconds{replica=\"" + kv.first + "\"}",
+        remaining_s);
+  }
   Metrics::instance().set("tpubc_scrape_backoff_seconds", worst_remaining_s);
 }
 

@@ -37,6 +37,10 @@ class Metrics {
   static Metrics& instance();
   void inc(const std::string& name, int64_t delta = 1);
   void set(const std::string& name, int64_t value);
+  // Drop one counter/gauge series (e.g. a labeled per-replica gauge whose
+  // replica left the fleet — a deleted CR must not pin a stale series in
+  // the exposition forever). No-op when the name was never recorded.
+  void remove(const std::string& name);
   // Record one observation (e.g. a duration in ms) into the named
   // histogram. Buckets are fixed (1ms..10s, log-ish spacing) — right for
   // control-plane latencies.

@@ -62,6 +62,11 @@ void Metrics::set(const std::string& name, int64_t value) {
   counters_[name] = value;
 }
 
+void Metrics::remove(const std::string& name) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  counters_.erase(name);
+}
+
 namespace {
 // Control-plane latency bounds in ms; +Inf overflow bucket is implicit
 // (the last slot of bucket_counts).
@@ -128,6 +133,16 @@ std::vector<const typename Map::value_type*> sorted_entries(const Map& m) {
             [](const auto* a, const auto* b) { return a->first < b->first; });
   return out;
 }
+
+// "name{labels}" -> "name": the metric family a labeled series belongs
+// to. Grouping/TYPE decisions must look at the family, not the full key
+// — "_total" detection against a key ending in '}' would misclassify
+// every labeled counter, and per-key TYPE lines would repeat per label
+// set (the format allows exactly one per family).
+std::string metric_family(const std::string& key) {
+  const size_t brace = key.find('{');
+  return brace == std::string::npos ? key : key.substr(0, brace);
+}
 }  // namespace
 
 Json Metrics::to_json() const {
@@ -151,13 +166,26 @@ Json Metrics::to_json() const {
 std::string Metrics::to_prometheus() const {
   std::lock_guard<std::mutex> lock(mutex_);
   std::string out;
-  for (const auto* kv : sorted_entries(counters_)) {
-    const bool counter = kv->first.size() > 6 &&
-                         kv->first.compare(kv->first.size() - 6, 6, "_total") == 0;
+  // Sort by (family, key) so every label set of one family renders
+  // contiguously under a single TYPE line.
+  auto entries = sorted_entries(counters_);
+  std::stable_sort(entries.begin(), entries.end(),
+                   [](const auto* a, const auto* b) {
+                     return metric_family(a->first) < metric_family(b->first);
+                   });
+  std::string typed;
+  for (const auto* kv : entries) {
+    const std::string family = metric_family(kv->first);
+    const bool counter = family.size() > 6 &&
+                         family.compare(family.size() - 6, 6, "_total") == 0;
     // Prometheus counter metric names are exposed WITH the _total suffix;
     // the TYPE line names the metric family (suffix stripped).
-    std::string family = counter ? kv->first.substr(0, kv->first.size() - 6) : kv->first;
-    out += "# TYPE " + family + (counter ? " counter\n" : " gauge\n");
+    const std::string type_name =
+        counter ? family.substr(0, family.size() - 6) : family;
+    if (type_name != typed) {
+      typed = type_name;
+      out += "# TYPE " + type_name + (counter ? " counter\n" : " gauge\n");
+    }
     out += kv->first + " " + std::to_string(kv->second) + "\n";
   }
   for (const auto* kv : sorted_entries(histograms_)) {

@@ -1,0 +1,567 @@
+"""The fleet telemetry plane (ISSUE 11): prefix-cache digests published
+by the paged pool, the time-series rings behind /metrics.json?window=N,
+and the fleetz aggregator — merge over fake replicas, SRE multi-window
+burn-rate math, cross-replica trace stitching, scrape backoff on a
+failing replica, and the off-switch byte-identity contract
+(TPUBC_CACHE_DIGEST=0 / ring=0 leave token streams untouched).
+
+The pure cases (digest maintenance, ring math, burn rates, stitching,
+fake-replica aggregation) ride in the tier-1 budget; the jit-running
+ones (live pool / live ingress) carry the slow mark like their
+paged-engine siblings — CI's unfiltered run and the fleet smoke step
+cover them on every push."""
+
+import json
+import threading
+import urllib.request
+from collections import Counter
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_bootstrap import telemetry
+from tpu_bootstrap.workload import fleetz
+from tpu_bootstrap.workload.fleetz import (
+    FleetAggregator,
+    SloEngine,
+    SloObjective,
+    parse_objective,
+    stitch,
+    stitch_chrome,
+)
+from tpu_bootstrap.workload.model import ModelConfig, init_params
+from tpu_bootstrap.workload.serving import (
+    BlockAllocator,
+    PagedPool,
+    Request,
+    block_hash,
+    digest_match_len,
+    key_fingerprint,
+)
+
+TINY = ModelConfig(vocab_size=32, num_layers=1, num_heads=2, head_dim=8,
+                   embed_dim=16, mlp_dim=32, max_seq_len=64)
+TPARAMS = init_params(TINY, jax.random.PRNGKey(1))
+
+
+def _drain(pool):
+    got = {}
+    while pool.has_active():
+        for rid, ev in pool.step_round().items():
+            if ev["done"]:
+                got[rid] = ev["generated"]
+    return got
+
+
+def _shared_prefix_requests(n, sys_len=24, tail=4, max_new=6, seed=0):
+    rng = np.random.default_rng(seed)
+    sys = rng.integers(1, TINY.vocab_size, sys_len).tolist()
+    return [Request(rid=i,
+                    tokens=sys + rng.integers(1, TINY.vocab_size,
+                                              tail).tolist(),
+                    max_new=max_new)
+            for i in range(n)]
+
+
+# ---- digest maintenance --------------------------------------------------
+
+
+def _rebuilt(a: BlockAllocator) -> set:
+    """The digest recomputed from scratch — the incremental one must
+    equal this after every mutation."""
+    return {key_fingerprint(k) for k in a._index}
+
+
+def _chain_keys(n, salt=1):
+    keys, key = [], b""
+    for j in range(n):
+        key = block_hash(key, [salt + j] * 8)
+        keys.append(key)
+    return keys
+
+
+def test_digest_incremental_equals_rebuilt_under_churn():
+    """register / duplicate-register / decref-to-cache / pressure-evict
+    / quarantine / remap: after every allocator mutation the
+    incrementally maintained fingerprint set equals one rebuilt from
+    the content-hash index."""
+    a = BlockAllocator(6, 8)
+    keys = _chain_keys(4)
+    ids = a.alloc(4)
+    for bid, k in zip(ids, keys):
+        assert a.register(bid, k)
+        assert a._digest == _rebuilt(a)
+    # A duplicate key keeps the existing entry; digest unchanged.
+    extra = a.alloc(1)
+    assert not a.register(extra[0], keys[0])
+    assert a._digest == _rebuilt(a)
+    # Decref parks registered blocks as cached: still indexed, still
+    # in the digest (registration, not residency, makes a block
+    # hittable).
+    a.free(ids)
+    a.free(extra)
+    assert a._digest == _rebuilt(a) == {key_fingerprint(k) for k in keys}
+    # Pressure-evict: the heap holds 2 blocks, asking for 4 reclaims
+    # the 2 oldest cached — their fingerprints must leave the digest.
+    again = a.alloc(4)
+    assert a._digest == _rebuilt(a)
+    assert key_fingerprint(keys[0]) not in a._digest
+    assert key_fingerprint(keys[1]) not in a._digest
+    # Crash recovery (quarantine) retains registrations.
+    a.quarantine_to_cache()
+    assert a._digest == _rebuilt(a)
+    # Defrag remap rewrites ids, never keys: digest invariant.
+    taken = sorted(set(a._ref) | set(a._cached))
+    a.remap({b: i + 1 for i, b in enumerate(taken)})
+    assert a._digest == _rebuilt(a)
+    d = a.digest_json()
+    assert d["version"] == 1 and d["block_size"] == 8
+    assert d["blocks"] == len(a._index) == len(d["fps"])
+    assert d["fps"] == sorted(d["fps"])
+    del again
+
+
+@pytest.mark.slow
+def test_digest_match_len_oracle_vs_prefix_plan():
+    """digest_match_len against a live pool's published digest must
+    equal a chain walk over the REAL index, and _prefix_plan's shared
+    count must equal that clamped by the write-position rule."""
+    pool = PagedPool(TPARAMS, TINY, 3, kv_blocks=16, block_size=8)
+    reqs = _shared_prefix_requests(2, sys_len=24, tail=8)
+    for r in reqs:
+        assert pool.admits(r)
+        pool.admit(r)
+    _drain(pool)
+    digest = pool.allocator.digest_json()
+    assert digest["blocks"] == len(pool.allocator._index) > 0
+
+    probes = [
+        list(reqs[0].tokens),                 # full warm prompt
+        list(reqs[0].tokens[:24]),            # the shared system prefix
+        list(reqs[0].tokens[:12]),            # 1.5 blocks
+        list(reqs[1].tokens),
+        [7] * 24,                             # cold prompt
+        list(reqs[0].tokens[:8]) + [9] * 16,  # diverges after block 0
+        [],
+    ]
+    for probe in probes:
+        key, oracle = b"", 0
+        for j in range(len(probe) // 8):
+            key = block_hash(key, probe[j * 8:(j + 1) * 8])
+            if pool.allocator.lookup(key) is None:
+                break
+            oracle += 1
+        assert digest_match_len(probe, digest) == oracle, probe
+        if probe:  # _prefix_plan's domain is validated non-empty prompts
+            shared, _cow, _ = pool._prefix_plan(probe)
+            assert len(shared) == min(oracle, (len(probe) - 1) // 8)
+    # The warm system prefix must actually be covered (not a 0 == 0
+    # vacuous pass).
+    assert digest_match_len(list(reqs[0].tokens[:24]), digest) == 3
+    # Degenerate digests score 0, never raise.
+    assert digest_match_len([1] * 16, None) == 0
+    assert digest_match_len([1] * 16, {}) == 0
+    assert digest_match_len(
+        [1] * 16, {"block_size": 0, "fps": [1]}) == 0
+
+
+@pytest.mark.slow
+def test_digest_off_switch_streams_byte_identical(monkeypatch):
+    """TPUBC_CACHE_DIGEST=0 kills all digest maintenance but may not
+    move a single token: the digest is observability, not data path."""
+    pool_on = PagedPool(TPARAMS, TINY, 3, kv_blocks=16, block_size=8)
+    for r in _shared_prefix_requests(3):
+        pool_on.admit(r)
+    on = _drain(pool_on)
+    assert pool_on.allocator.digest_json()["blocks"] > 0
+
+    monkeypatch.setenv("TPUBC_CACHE_DIGEST", "0")
+    pool_off = PagedPool(TPARAMS, TINY, 3, kv_blocks=16, block_size=8)
+    assert pool_off.allocator.digest_enabled is False
+    for r in _shared_prefix_requests(3):
+        pool_off.admit(r)
+    off = _drain(pool_off)
+    assert on == off
+    assert pool_off.allocator.digest_json() == {
+        "version": 1, "block_size": 8, "blocks": 0, "fps": []}
+    assert pool_off.allocator._digest == set()
+    # The pool snapshot still embeds the (empty) digest shape.
+    snap = pool_off.snapshot()
+    assert snap["cache_digest"]["blocks"] == 0
+
+
+# ---- time-series rings ---------------------------------------------------
+
+
+def test_window_json_counter_delta_and_rate():
+    reg = telemetry.MetricsRegistry(ring=8)
+    for _ in range(5):
+        reg.inc("reqs_total")
+    e = reg.window_json(60)["series"]["reqs_total"]
+    # Unsaturated ring = full history: the baseline is exactly zero.
+    assert e["now"] == 5 and e["delta"] == 5 and e["samples"] == 5
+    assert e["rate_per_sec"] == round(5 / 60, 6)
+    # Age the first three samples past the window: the baseline becomes
+    # the last sample at/before the cutoff (value 3), delta the rest.
+    ring = reg._rings["reqs_total"]
+    for i in range(3):
+        t, v = ring[i]
+        ring[i] = (t - 120.0, v)
+    e = reg.window_json(60)["series"]["reqs_total"]
+    assert e["delta"] == 2 and e["samples"] == 2
+
+
+def test_window_json_saturated_ring_uses_oldest_retained():
+    reg = telemetry.MetricsRegistry(ring=4)
+    for _ in range(10):
+        reg.inc("reqs_total")
+    e = reg.window_json(3600)["series"]["reqs_total"]
+    # Ring kept values 7..10 only: best-effort baseline is the oldest
+    # retained sample, not a fictional zero.
+    assert e["now"] == 10 and e["delta"] == 3 and e["samples"] == 4
+
+
+def test_window_json_histogram_windowed_quantiles():
+    reg = telemetry.MetricsRegistry(ring=16)
+    for _ in range(3):
+        reg.observe("lat_ms", 800.0)
+    ring = reg._rings["lat_ms"]
+    for i in range(3):
+        ring[i] = (ring[i][0] - 120.0,) + tuple(ring[i][1:])
+    for _ in range(2):
+        reg.observe("lat_ms", 3.0)
+    doc = reg.window_json(60)["series"]["lat_ms"]
+    assert doc["count"] == 5 and doc["count_delta"] == 2
+    assert doc["sum_delta"] == pytest.approx(6.0)
+    # Windowed p99 sees only the two fast observations; the lifetime
+    # p99 is dominated by the aged-out slow ones.
+    assert doc["p99"] <= 10.0
+    assert reg.to_json()["lat_ms_p99"] >= 500.0
+
+
+def test_rings_disabled_reports_instants_only():
+    reg = telemetry.MetricsRegistry(ring=0)
+    reg.inc("reqs_total", 3)
+    assert reg._rings == {}
+    doc = reg.window_json(30)
+    assert doc["ring"] == 0
+    e = doc["series"]["reqs_total"]
+    assert e == {"now": 3}  # no delta/rate/samples without history
+
+
+# ---- SLO burn rates ------------------------------------------------------
+
+
+_LAT = SloObjective("lat", "p99", "gt", 100.0, target=0.9)
+
+
+def test_burn_rate_multi_window_math():
+    """10 samples, 5 violating, 10% error budget -> burn 5.0 in both
+    windows, combined 5.0, firing above threshold 1.0."""
+    eng = SloEngine(objectives=[_LAT], windows=(300, 3600),
+                    burn_threshold=1.0, ring=64)
+    now = 10_000.0
+    for i in range(10):
+        eng.record("r1", {"p99": 200.0 if i < 5 else 50.0}, t=now - 10 - i)
+    d = eng.evaluate(now=now)["r1"]["lat"]
+    assert d["windows"]["300s"] == pytest.approx(5.0)
+    assert d["windows"]["3600s"] == pytest.approx(5.0)
+    assert d["burn"] == pytest.approx(5.0)
+    assert d["firing"]
+    alerts = eng.alerts()
+    assert [(a["replica"], a["slo"]) for a in alerts["firing"]] == [
+        ("r1", "lat")]
+    assert alerts["transitions"][-1]["event"] == "firing"
+    # Recovery: 400s later the bad samples have left the short window
+    # and fresh good ones fill it — min across windows drops to 0,
+    # the alert resolves.
+    later = now + 400.0
+    for i in range(10):
+        eng.record("r1", {"p99": 10.0}, t=later - 5 - i)
+    d = eng.evaluate(now=later)["r1"]["lat"]
+    assert d["windows"]["300s"] == 0.0
+    assert d["windows"]["3600s"] > 1.0
+    assert d["burn"] == 0.0 and not d["firing"]
+    alerts = eng.alerts()
+    assert alerts["firing"] == []
+    assert alerts["transitions"][-1]["event"] == "resolved"
+
+
+def test_burn_rate_spike_needs_every_window():
+    """An OLD incident (bad samples only beyond the short window) may
+    not page: the short window is clean, and the page condition is ALL
+    windows above threshold."""
+    eng = SloEngine(objectives=[_LAT], windows=(300, 3600), ring=64)
+    now = 10_000.0
+    for i in range(10):
+        eng.record("r2", {"p99": 500.0}, t=now - 2000 - i)  # old, all bad
+    for i in range(10):
+        eng.record("r2", {"p99": 10.0}, t=now - 5 - i)      # fresh, good
+    d = eng.evaluate(now=now)["r2"]["lat"]
+    assert d["windows"]["300s"] == 0.0
+    assert d["windows"]["3600s"] == pytest.approx(5.0)
+    assert d["burn"] == 0.0 and not d["firing"]
+
+
+def test_burn_rate_skips_missing_and_non_numeric_keys():
+    eng = SloEngine(objectives=[_LAT], windows=(300,), ring=8)
+    eng.record("r3", {"other": 1.0, "p99": True, "p99_note": "n/a"}, t=1.0)
+    assert eng.evaluate(now=2.0) == {}
+
+
+def test_parse_objective_grammar():
+    o = parse_objective("lat:serve_ttft_ms_p99:gt:2500:0.999")
+    assert o == SloObjective("lat", "serve_ttft_ms_p99", "gt", 2500.0,
+                             0.999)
+    assert parse_objective("g:x:lt:0.5").target == 0.99
+    for bad in ("lat:x:ge:1", "lat:x:gt", "lat:x:gt:1:1.5"):
+        with pytest.raises(ValueError):
+            parse_objective(bad)
+
+
+# ---- the aggregator over fake replicas -----------------------------------
+
+
+class _FakeReplica:
+    """Canned-JSON replica endpoint; flip ``fail`` to answer 500s."""
+
+    def __init__(self, payloads):
+        self.payloads = dict(payloads)
+        self.fail = False
+        self.hits = Counter()
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                outer.hits[path] += 1
+                if outer.fail:
+                    code, body = 500, b'{"error": "injected"}'
+                elif path in outer.payloads:
+                    code = 200
+                    body = json.dumps(outer.payloads[path]).encode()
+                else:
+                    code, body = 404, b'{"error": "no such path"}'
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.addr = f"127.0.0.1:{self.httpd.server_address[1]}"
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _span(trace, span, name, start, dur):
+    return {"trace_id": trace, "span_id": span, "parent_id": None,
+            "name": name, "start_us": start, "dur_us": dur, "attrs": {}}
+
+
+def _payloads(tag, queue_depth, digest_blocks, trace_spans):
+    fps = list(range(1, digest_blocks + 1))
+    digest = {"version": 1, "block_size": 8, "blocks": digest_blocks,
+              "fps": fps}
+    return {
+        "/healthz": {"ok": True, "state": "serving", "tag": tag},
+        "/metrics.json": {"serve_queue_depth": queue_depth,
+                          "serve_qps": 2.5, "serve_tokens_per_sec": 80.0,
+                          "serve_ttft_ms_p99": 120.0, "requests_total": 7},
+        "/poolz": {"as_of_us": 1, "pool": {
+            "blocks": {"total": 64, "live": 10, "cached": digest_blocks},
+            "cache_digest": digest}},
+        "/cachez": {"as_of_us": 1, "digest": digest},
+        "/traces.json": {"process": f"replica-{tag}", "dropped": 0,
+                         "spans": trace_spans},
+    }
+
+
+def test_aggregator_merges_two_replicas_one_goes_stale():
+    a = _FakeReplica(_payloads(
+        "a", 3, 4, [_span("t-shared", "sa", "ingress", 100, 50)]))
+    b = _FakeReplica(_payloads(
+        "b", 5, 2, [_span("t-shared", "sb", "prefill", 40, 30)]))
+    agg = FleetAggregator([a.addr, b.addr], poll_s=0.5, stale_after_s=2.0)
+    try:
+        t0 = 1000.0
+        assert sorted(agg.poll_once(now=t0)) == sorted([a.addr, b.addr])
+        doc = agg.fleetz_json(now=t0)
+        assert doc["fleet"]["replicas"] == 2 and doc["fleet"]["healthy"] == 2
+        assert doc["fleet"]["queue_depth"] == 8
+        assert doc["fleet"]["digest_blocks"] == 6
+        assert doc["fleet"]["blocks"]["total"] == 128
+        assert doc["fleet"]["serve_qps"] == pytest.approx(5.0)
+        assert doc["replicas"][a.addr]["state"] == "healthy"
+        assert doc["replicas"][a.addr]["digest_blocks"] == 4
+        assert doc["replicas"][a.addr]["health"]["tag"] == "a"
+        # SLO samples landed for both replicas.
+        assert set(doc["slo"]["burn"]) == {a.addr, b.addr}
+
+        # Federated text: every series re-labeled per replica, one TYPE
+        # line per family, counters typed as counters.
+        text = agg.federated_metrics()
+        assert f'serve_queue_depth{{replica="{a.addr}"}} 3' in text
+        assert f'serve_queue_depth{{replica="{b.addr}"}} 5' in text
+        assert text.count("# TYPE serve_queue_depth gauge") == 1
+        assert "# TYPE requests counter" in text
+        assert f'fleet_replica_up{{replica="{a.addr}"}} 1' in text
+
+        # Stitched traces join the shared trace id across replicas.
+        st = stitch(agg._trace_docs())
+        assert st["traces"]["t-shared"]["spans"] == 2
+        assert set(st["traces"]["t-shared"]["replicas"]) == {a.addr,
+                                                             b.addr}
+
+        # b starts failing AND its last good scrape ages out: one more
+        # round, then render past the staleness horizon.
+        b.fail = True
+        t1 = t0 + 1.0
+        assert sorted(agg.poll_once(now=t1)) == sorted([a.addr, b.addr])
+        doc = agg.fleetz_json(now=t1 + 1.5)  # a: 1.5s old; b: 2.5s old
+        assert doc["replicas"][a.addr]["state"] == "healthy"
+        assert doc["replicas"][b.addr]["state"] == "stale"
+        assert doc["replicas"][b.addr]["failures"] == 1
+        assert doc["replicas"][b.addr]["backoff_s"] > 0
+        assert "/metrics.json" in doc["replicas"][b.addr]["last_err"]
+        assert doc["fleet"]["healthy"] == 1
+        # The last-good snapshot survives the outage (still merged).
+        assert doc["replicas"][b.addr]["queue_depth"] == 5
+    finally:
+        agg.httpd.server_close()
+        a.stop()
+        b.stop()
+
+
+def test_aggregator_backoff_on_500ing_replica():
+    f = _FakeReplica(_payloads("f", 0, 0, []))
+    f.fail = True
+    agg = FleetAggregator([f.addr], poll_s=0.1, stale_after_s=1e9)
+    try:
+        t = 100.0
+        delays = []
+        for i in range(4):
+            assert agg.poll_once(now=t) == [f.addr]
+            with agg._lock:
+                st = dict(agg._state[f.addr])
+            assert st["failures"] == i + 1
+            assert st["state"] == "unreachable"
+            delays.append(st["backoff_s"])
+            # Not due again until the backoff elapses — no scrape, no
+            # extra hits on the replica.
+            before = dict(f.hits)
+            assert agg.poll_once(now=t + st["backoff_s"] * 0.4) == []
+            assert dict(f.hits) == before
+            t = st["next_attempt"] + 1e-3
+        # Exponential growth within the +/-20% jitter band.
+        for i, d in enumerate(delays):
+            nominal = 0.1 * (2 ** i)
+            assert 0.8 * nominal - 1e-3 <= d <= 1.2 * nominal + 1e-3
+        assert delays[3] > delays[0]
+        m = agg.reg.to_json()
+        assert m[f'fleet_scrape_errors_total{{replica="{f.addr}"}}'] == 4
+        assert m[f'fleet_replica_up{{replica="{f.addr}"}}'] == 0
+        assert m[f'fleet_scrape_backoff_seconds{{replica="{f.addr}"}}'] > 0
+
+        # Recovery resets the schedule to the plain poll cadence.
+        f.fail = False
+        assert agg.poll_once(now=t) == [f.addr]
+        with agg._lock:
+            st = dict(agg._state[f.addr])
+        assert st["failures"] == 0 and st["state"] == "healthy"
+        assert st["next_attempt"] == pytest.approx(t + 0.1)
+    finally:
+        agg.httpd.server_close()
+        f.stop()
+
+
+# ---- trace stitching (pure) ----------------------------------------------
+
+
+def test_stitch_joins_shared_trace_across_replicas():
+    docs = {
+        "a:1": {"process": "r-a", "dropped": 0, "spans": [
+            _span("t-shared", "s1", "ingress", 100, 50),
+            _span("t-solo", "s2", "decode", 10, 5)]},
+        "b:2": {"process": "r-b", "dropped": 1, "spans": [
+            _span("t-shared", "s3", "prefill", 60, 30)]},
+    }
+    doc = stitch(docs)
+    assert doc["stitched"] and doc["process"] == "tpubc-fleetz"
+    assert doc["replicas"] == ["a:1", "b:2"]
+    assert doc["dropped"] == 1
+    assert doc["traces"]["t-shared"]["spans"] == 2
+    # Replica order inside a trace follows span start time: the b-side
+    # prefill (60us) precedes the a-side ingress (100us).
+    assert doc["traces"]["t-shared"]["replicas"] == ["b:2", "a:1"]
+    assert [s["span_id"] for s in doc["spans"]] == ["s3", "s1", "s2"]
+    assert all(s["attrs"]["replica"] in ("a:1", "b:2")
+               for s in doc["spans"])
+
+    c = stitch_chrome(docs)
+    metas = [e for e in c["traceEvents"] if e["ph"] == "M"]
+    spans = [e for e in c["traceEvents"] if e["ph"] == "X"]
+    assert {m["args"]["name"] for m in metas} == {"replica a:1",
+                                                 "replica b:2"}
+    shared = [e for e in spans if e["args"]["trace_id"] == "t-shared"]
+    assert {e["pid"] for e in shared} == {1, 2}  # one pid per replica
+    assert {e["tid"] for e in shared} == {telemetry._chrome_tid("t-shared")}
+
+
+def test_relabel_hops_histogram_suffix_over_labels():
+    assert fleetz._relabel('serve_ttft_ms{class="rt"}_p99', "r:1") == (
+        "serve_ttft_ms_p99",
+        'serve_ttft_ms_p99{class="rt",replica="r:1"}')
+    assert fleetz._relabel("serve_qps", "r:1") == (
+        "serve_qps", 'serve_qps{replica="r:1"}')
+
+
+# ---- live ingress surfaces (/cachez, ?window=N) --------------------------
+
+
+@pytest.mark.slow
+def test_ingress_cachez_and_windowed_metrics():
+    from tpu_bootstrap.workload.ingress import IngressServer
+    srv = IngressServer(TPARAMS, TINY, port=0, batch_size=2, paged=True,
+                        block_size=8, host="127.0.0.1").start()
+    try:
+        reqs = _shared_prefix_requests(1, sys_len=24, tail=4, max_new=4)
+        body = json.dumps({"tokens": reqs[0].tokens, "max_new": 4,
+                           "stream": False}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/generate", data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=300) as r:
+            assert json.loads(r.read())["done"] is True
+
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}{path}", timeout=30) as r:
+                return json.loads(r.read())
+
+        cz = get("/cachez")
+        assert cz["digest"]["block_size"] == 8
+        assert cz["digest"]["blocks"] >= 1
+        assert digest_match_len(reqs[0].tokens, cz["digest"]) >= 1
+        # /poolz embeds the very same digest.
+        assert get("/poolz")["pool"]["cache_digest"] == cz["digest"]
+        # Windowed scrape: ring-backed series with deltas present.
+        wj = get("/metrics.json?window=30")
+        assert wj["window_secs"] == 30.0 and wj["ring"] > 0
+        assert any("delta" in e for e in wj["series"].values())
+        plain = get("/metrics.json")
+        assert plain["serve_qps_window_secs"] > 0
+        assert plain["serve_tokens_per_sec_window_secs"] > 0
+    finally:
+        srv.stop()

@@ -109,6 +109,20 @@ CATALOG = {
         "8", "serving",
         "Consecutive failed-round recoveries before crash-is-preemption "
         "gives up and the failure propagates (reset on any good round)."),
+    "TPUBC_CACHE_DIGEST": (
+        "1", "serving",
+        "`0` disables prefix-cache digest maintenance (/cachez and "
+        "/poolz publish empty digests; token streams byte-identical)."),
+    # -- telemetry / fleet --------------------------------------------------
+    "TPUBC_TS_RING": (
+        "256", "telemetry",
+        "Per-series time-series ring capacity backing "
+        "`/metrics.json?window=N` (deltas/rates/windowed quantiles); "
+        "`0` disables history entirely."),
+    "TPUBC_FLEET_POLL_MS": (
+        "2000", "telemetry",
+        "fleetz aggregator scrape cadence per replica (failures back "
+        "off exponentially from this, capped at 300s)."),
     # -- kernels / bench ----------------------------------------------------
     "TPUBC_HBM_GBPS": (
         "819", "kernels",

@@ -328,6 +328,16 @@ class _Histogram:
         return self.counts[-1]
 
 
+def ring_capacity() -> int:
+    """Per-series time-series ring capacity (TPUBC_TS_RING, default 256;
+    0 disables history entirely — instants-only registries, zero ring
+    overhead, byte-identical token streams)."""
+    try:
+        return max(0, int(os.environ.get("TPUBC_TS_RING", "256")))
+    except ValueError:
+        return 256
+
+
 def _label_key(name: str, labels) -> str:
     """Internal storage key for a labeled series: the Prometheus-style
     ``name{k="v",...}`` rendering (keys sorted — one label set, one
@@ -354,20 +364,39 @@ class MetricsRegistry:
     labels in the text exposition and as ``name{k="v"}``-keyed entries
     in the JSON one."""
 
-    def __init__(self):
+    def __init__(self, ring: int | None = None):
         self._lock = threading.Lock()
         # counters and gauges share one map
         self._values: dict = {}      # guarded-by: _lock
         self._histograms: dict = {}  # guarded-by: _lock
+        # Bounded per-series history, sampled at record time (no ticker
+        # thread — a series that never moves costs nothing and a burst
+        # is captured at its own cadence): value series ring
+        # (t, value); histogram series ring (t, count, sum,
+        # cumulative-bucket-counts tuple). window_json() turns these
+        # into deltas, rates, and windowed quantiles.
+        self.ring = ring_capacity() if ring is None else max(0, ring)
+        self._rings: dict = {}       # series key -> deque  # guarded-by: _lock
+
+    def _ring_append_locked(self, name: str, entry) -> None:
+        ring = self._rings.get(name)
+        if ring is None:
+            ring = self._rings[name] = deque(maxlen=self.ring)
+        ring.append(entry)
 
     def inc(self, name: str, delta=1, labels=None) -> None:
         name = _label_key(name, labels)
         with self._lock:
-            self._values[name] = self._values.get(name, 0) + delta
+            v = self._values[name] = self._values.get(name, 0) + delta
+            if self.ring:
+                self._ring_append_locked(name, (time.monotonic(), v))
 
-    def set_gauge(self, name: str, value) -> None:
+    def set_gauge(self, name: str, value, labels=None) -> None:
+        name = _label_key(name, labels)
         with self._lock:
             self._values[name] = value
+            if self.ring:
+                self._ring_append_locked(name, (time.monotonic(), value))
 
     def observe(self, name: str, value: float, buckets=None,
                 labels=None) -> None:
@@ -380,6 +409,10 @@ class MetricsRegistry:
                 h = self._histograms[name] = _Histogram(
                     buckets or DEFAULT_BUCKETS)
             h.observe(value)
+            if self.ring:
+                self._ring_append_locked(
+                    name,
+                    (time.monotonic(), h.count, h.sum, tuple(h.counts)))
 
     def quantile(self, name: str, q: float) -> float:
         with self._lock:
@@ -444,10 +477,87 @@ class MetricsRegistry:
                 lines.append(f"{family}_count{suffix} {h.count}")
             return "\n".join(lines) + ("\n" if lines else "")
 
+    def window_json(self, window_secs: float, now: float | None = None) -> dict:
+        """The windowed view over the rings ``/metrics.json?window=N``
+        serves — deltas, rates, and window-local quantiles instead of
+        process-lifetime instants (the burn-rate engine's raw
+        material). For each value series: the instant, the delta over
+        the trailing window, and delta/window as a rate. For each
+        histogram: count/sum deltas, the bucket-count deltas, and
+        p50/p99 computed over ONLY the window's observations. A series
+        with no ring (rings disabled, or no sample yet) reports its
+        instant only. When no sample predates the window: an
+        unsaturated ring holds the series' FULL history, so the
+        baseline is zero (exact for counters, "since first set" for
+        gauges); a saturated ring has evicted its past and falls back
+        to the oldest retained sample (best effort)."""
+        now = time.monotonic() if now is None else now
+        cutoff = now - max(float(window_secs), 0.0)
+        with self._lock:
+            series: dict = {}
+            for name in sorted(self._values):
+                cur = self._values[name]
+                entry: dict = {"now": cur}
+                ring = self._rings.get(name)
+                if ring:
+                    base = None
+                    n_in = 0
+                    for t, v in ring:
+                        if t <= cutoff:
+                            base = v
+                        else:
+                            n_in += 1
+                    if base is None:
+                        base = ring[0][1] if len(ring) == ring.maxlen else 0
+                    entry["samples"] = n_in
+                    if (isinstance(cur, (int, float))
+                            and isinstance(base, (int, float))):
+                        entry["delta"] = cur - base
+                        if window_secs > 0:
+                            entry["rate_per_sec"] = round(
+                                (cur - base) / window_secs, 6)
+                series[name] = entry
+            for name in sorted(self._histograms):
+                h = self._histograms[name]
+                ring = self._rings.get(name)
+                base = None
+                if ring:
+                    for t, cnt, s, counts in ring:
+                        if t <= cutoff:
+                            base = (cnt, s, counts)
+                        else:
+                            break
+                    if base is None and len(ring) == ring.maxlen:
+                        # Saturated ring: its past is gone — the oldest
+                        # retained sample is the best available baseline.
+                        base = tuple(ring[0][1:])
+                b_cnt, b_sum, b_counts = base or (0, 0.0, (0,) * len(h.counts))
+                wh = _Histogram(h.bounds)
+                wh.counts = [a - b for a, b in zip(h.counts, b_counts)]
+                wh.count = h.count - b_cnt
+                wh.sum = h.sum - b_sum
+                series[name] = {
+                    "count": h.count,
+                    "count_delta": wh.count,
+                    "sum_delta": round(wh.sum, 6),
+                    "p50": wh.quantile(0.50),
+                    "p99": wh.quantile(0.99),
+                    "bucket_deltas": list(wh.counts),
+                    "bounds": list(h.bounds),
+                }
+                if window_secs > 0:
+                    series[name]["rate_per_sec"] = round(
+                        wh.count / window_secs, 6)
+            return {"window_secs": float(window_secs),
+                    "as_of_us": now_us(),
+                    "ring": self.ring,
+                    "series": series}
+
     def reset(self) -> None:
         with self._lock:
             self._values.clear()
             self._histograms.clear()
+            self._rings.clear()
 
 
 _metrics = MetricsRegistry()
@@ -579,15 +689,57 @@ class RateWindow:
             self._events.popleft()
 
 
-def start_metrics_server(port: int, host: str = "0.0.0.0"):
-    """Serve the registry at /metrics (text) + /metrics.json next to a
-    /healthz, on a daemon thread. The train-mode counterpart of the
-    ingress routes: a WORKLOAD_METRICS_PORT-configured train worker
-    exposes step-time/tokens-per-sec/goodput for the controller's
-    status.slice.workload scrape. Returns the HTTPServer (its .server_
-    address[1] reports the bound port; port 0 = ephemeral)."""
+# ---------------------------------------------------------------------------
+# Train-slice heartbeat: the step loop stamps (step, monotonic time)
+# after every step, and the worker-0 metrics server's /healthz reports
+# the stamp's age — so the fleet aggregator can tell a training slice
+# that is making progress from one whose step loop wedged, exactly like
+# the ingress watchdog's round heartbeat. Module-level (the step loop
+# and handler threads live in different call trees); the lock keeps the
+# (step, t) pair coherent.
+# ---------------------------------------------------------------------------
+
+_beat_lock = threading.Lock()
+_beat = {"t": None, "step": None}  # guarded-by: _beat_lock
+
+
+def heartbeat(step: int | None = None) -> None:
+    """Stamp liveness (train step loop; any long-running worker loop).
+    /healthz freshness is measured from the latest stamp."""
+    with _beat_lock:
+        _beat["t"] = time.monotonic()
+        if step is not None:
+            _beat["step"] = step
+
+
+def heartbeat_snapshot() -> tuple:
+    """(last step or None, age in ms or None when never stamped)."""
+    with _beat_lock:
+        t, step = _beat["t"], _beat["step"]
+    if t is None:
+        return step, None
+    return step, (time.monotonic() - t) * 1e3
+
+
+def start_metrics_server(port: int, host: str = "0.0.0.0",
+                         process: str = "tpu-bootstrap-workload"):
+    """Serve the registry at /metrics (text) + /metrics.json (instants,
+    or ``?window=N`` for ring-windowed deltas/rates/quantiles) next to
+    /healthz, /statusz, and /traces.json, on a daemon thread. The
+    train-mode counterpart of the ingress routes: a
+    WORKLOAD_METRICS_PORT-configured train worker exposes
+    step-time/tokens-per-sec/goodput for the controller's
+    status.slice.workload scrape, and the same introspection routes the
+    fleet aggregator polls on serving replicas — so fleetz watches
+    train slices and ingresses uniformly. /healthz reports last-step
+    heartbeat freshness (heartbeat()); a stamp older than
+    TPUBC_WATCHDOG_STALL_MS answers 503 (never-stamped processes stay
+    healthy — not every metrics-server host has a step loop). Returns
+    the HTTPServer (its .server_address[1] reports the bound port;
+    port 0 = ephemeral)."""
     import json as _json
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    from urllib.parse import parse_qs, urlparse
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -596,25 +748,71 @@ def start_metrics_server(port: int, host: str = "0.0.0.0"):
             pass
 
         def do_GET(self):
-            if self.path == "/metrics":
+            parsed = urlparse(self.path)
+            route = parsed.path
+            code = 200
+            if route == "/metrics":
                 body = _metrics.to_prometheus().encode()
                 ctype = "text/plain; version=0.0.4"
-            elif self.path == "/metrics.json":
-                body = _json.dumps(_metrics.to_json()).encode()
+            elif route == "/metrics.json":
+                q = parse_qs(parsed.query)
+                if "window" in q:
+                    try:
+                        w = float(q["window"][0])
+                    except ValueError:
+                        return self._json(
+                            400, {"error": "window must be a number"})
+                    doc = _metrics.window_json(w)
+                else:
+                    doc = _metrics.to_json()
+                body = _json.dumps(doc).encode()
                 ctype = "application/json"
-            elif self.path in ("/healthz", "/health"):
-                body = b'{"ok": true}'
+            elif route in ("/healthz", "/health"):
+                step, age_ms = heartbeat_snapshot()
+                health: dict = {"ok": True}
+                if step is not None:
+                    health["last_step"] = step
+                if age_ms is not None:
+                    health["heartbeat_age_ms"] = round(age_ms, 1)
+                    try:
+                        stall_ms = float(os.environ.get(
+                            "TPUBC_WATCHDOG_STALL_MS", "30000"))
+                    except ValueError:
+                        stall_ms = 30000.0
+                    if stall_ms > 0 and age_ms > stall_ms:
+                        health["ok"] = False
+                        health["stalled_ms"] = round(age_ms, 1)
+                code = 200 if health["ok"] else 503
+                body = _json.dumps(health).encode()
+                ctype = "application/json"
+            elif route == "/statusz":
+                step, age_ms = heartbeat_snapshot()
+                tj = _tracer.to_json()
+                body = _json.dumps({
+                    "process": process,
+                    "last_step": step,
+                    "heartbeat_age_ms": (round(age_ms, 1)
+                                         if age_ms is not None else None),
+                    "metrics_series": len(_metrics.to_json()),
+                    "tracer": {"spans": len(tj["spans"]),
+                               "dropped": tj["dropped"]},
+                }).encode()
+                ctype = "application/json"
+            elif route == "/traces.json":
+                body = _json.dumps(_tracer.to_json()).encode()
                 ctype = "application/json"
             else:
-                body = b'{"error": "not found"}'
-                self.send_response(404)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-                return
-            self.send_response(200)
+                return self._json(404, {"error": "not found"})
+            self.send_response(code)
             self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _json(self, code, obj):
+            body = _json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)

@@ -252,7 +252,9 @@ class IngressServer:
                 pass
 
             def do_GET(self):
-                if self.path in ("/metrics", "/metrics.json"):
+                url = urlparse(self.path)
+                path = url.path
+                if path in ("/metrics", "/metrics.json"):
                     # The seam the controller's workload-scrape loop
                     # reads: an injected failure answers 500 (driving
                     # the scraper's backoff), never a dropped socket.
@@ -260,7 +262,7 @@ class IngressServer:
                         faults.fire("scrape")
                     except faults.InjectedFault as e:
                         return self._json(500, {"error": str(e)})
-                if self.path == "/metrics":
+                if path == "/metrics":
                     # Prometheus text exposition, same routes a daemon
                     # serves — worker 0 of a serve slice is scrapeable
                     # like the control plane is.
@@ -272,13 +274,26 @@ class IngressServer:
                     self.end_headers()
                     self.wfile.write(body)
                     return
-                if self.path == "/metrics.json":
+                if path == "/metrics.json":
+                    # ?window=N -> the time-series view (deltas, rates,
+                    # windowed quantiles over the per-series rings) the
+                    # fleet aggregator's burn-rate engine consumes; bare
+                    # -> the familiar instant snapshot.
+                    w = parse_qs(url.query).get("window", [None])[0]
+                    if w is not None:
+                        try:
+                            w = float(w)
+                        except ValueError:
+                            return self._json(
+                                400, {"error": "window must be a number"})
+                        return self._json(
+                            200, telemetry.metrics().window_json(w))
                     return self._json(200, telemetry.metrics().to_json())
-                if self.path.startswith("/requestz"):
+                if path.startswith("/requestz"):
                     # The data-plane /statusz: recent + in-flight
                     # requests with full phase breakdown; ?rid= filters
                     # to one; trace ids join /traces.json.
-                    q = parse_qs(urlparse(self.path).query)
+                    q = parse_qs(url.query)
                     rid = q.get("rid", [None])[0]
                     if rid is not None:
                         try:
@@ -287,7 +302,23 @@ class IngressServer:
                             return self._json(
                                 400, {"error": "rid must be an int"})
                     return self._json(200, outer.sched.log.snapshot(rid=rid))
-                if self.path == "/poolz":
+                if path == "/cachez":
+                    # The routing digest alone: the replica's published
+                    # prefix-cache fingerprint set (same round-boundary
+                    # snapshot /poolz carries), small enough for a
+                    # router to poll at placement frequency. Pools
+                    # without a prefix cache answer an empty digest, not
+                    # a 404 — a fleet poller treats every replica
+                    # uniformly.
+                    with outer._lock:
+                        as_of = outer._poolz.get("as_of_us")
+                        digest = outer._poolz["pool"].get("cache_digest")
+                    if digest is None:
+                        digest = {"version": 1, "block_size": 0,
+                                  "blocks": 0, "fps": []}
+                    return self._json(200, {"as_of_us": as_of,
+                                            "digest": digest})
+                if path == "/poolz":
                     # Scheduler/pool snapshot: per-state block counts,
                     # per-request footprints, waiting-queue contents,
                     # the overcommit EMA, and watermark headroom. The
@@ -300,13 +331,13 @@ class IngressServer:
                         snap = dict(outer._poolz)
                     snap["scheduler"] = outer.sched.snapshot()
                     return self._json(200, snap)
-                if self.path == "/traces.json":
+                if path == "/traces.json":
                     # Same shape as the daemons' /traces.json, so the
                     # requestz/statusz trace-id join works against the
                     # data plane too.
                     return self._json(200, telemetry.tracer().to_json())
-                if self.path not in ("/healthz", "/health"):
-                    return self._json(404, {"error": f"unknown path {self.path}"})
+                if path not in ("/healthz", "/health"):
+                    return self._json(404, {"error": f"unknown path {path}"})
                 with outer._lock:
                     # Occupancy comes from the engine's round-boundary
                     # publication: pool.slots is engine-owned and a
@@ -705,6 +736,13 @@ class IngressServer:
                               round(self._qps_window.per_sec(t=now), 3))
                 reg.set_gauge("serve_tokens_per_sec",
                               round(self._tps_window.per_sec(t=now), 1))
+                # The rolling values' denominators, stated explicitly so
+                # consumers (the fleet burn-rate engine included) stop
+                # guessing what window a rate was computed over.
+                reg.set_gauge("serve_qps_window_secs",
+                              self._qps_window.window)
+                reg.set_gauge("serve_tokens_per_sec_window_secs",
+                              self._tps_window.window)
                 stats = self.pool.stats
                 if stats.get("slot_steps"):
                     reg.set_gauge(

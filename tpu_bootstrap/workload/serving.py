@@ -1376,6 +1376,50 @@ def block_hash(parent: bytes, tokens) -> bytes:
         parent + np.asarray(tokens, np.int64).tobytes()).digest()
 
 
+def key_fingerprint(key: bytes) -> int:
+    """64-bit fingerprint of a block's chain key — the cache-digest
+    unit. The full 256-bit key stays the sharing authority (a digest
+    hit is a ROUTING hint, never a content match: admission re-walks
+    the real index); 8 bytes keeps an entire pool's digest small enough
+    to publish at every round boundary."""
+    return int.from_bytes(key[:8], "big")
+
+
+def digest_match_len(tokens, digest) -> int:
+    """How many LEADING full blocks of ``tokens`` a replica's published
+    cache digest covers — the router's placement score (ROADMAP item
+    1): pick the replica whose digest covers the longest prefix chain.
+    Pure: recomputes the radix-chained block hashes locally and walks
+    them against the digest's fingerprint set; stops at the first miss
+    (the chain rule — a later block's key commits to every block before
+    it, so a hole ends the usable prefix). ``digest`` is the wire dict
+    a ``/cachez`` scrape returns ({"block_size": bs, "fps": [...]}).
+    Note the score counts cache-held blocks; an admission additionally
+    clamps to (prompt_len - 1) // block_size shared blocks
+    (_prefix_plan's write-position rule), so a score one above a rival
+    is still a strictly better placement."""
+    if not isinstance(digest, dict):
+        return 0
+    bs = int(digest.get("block_size") or 0)
+    fps = digest.get("fps") or ()
+    if bs < 1 or not fps:
+        return 0
+    fpset = set(fps)
+    key = b""
+    n = 0
+    for j in range(len(tokens) // bs):
+        key = block_hash(key, tokens[j * bs:(j + 1) * bs])
+        if key_fingerprint(key) not in fpset:
+            break
+        n += 1
+    return n
+
+
+def _digest_enabled() -> bool:
+    return os.environ.get(
+        "TPUBC_CACHE_DIGEST", "1").lower() not in ("0", "false")
+
+
 class BlockAllocator:
     """Bookkeeping for the shared pool of fixed-size KV blocks: ids
     1..num_blocks (id 0 is the caller's null/pad block, never owned),
@@ -1417,6 +1461,15 @@ class BlockAllocator:
         self._key_of: dict = {}        # registered block id -> content key  # guarded-by: <engine-thread>
         self.stats = {"allocs": 0, "frees": 0, "peak_used": 0,  # guarded-by: <engine-thread>
                       "evictions": 0, "hash_hits": 0}
+        # Prefix-cache digest: 64-bit fingerprints of every registered
+        # chain key (CACHED + shareable LIVE blocks — exactly _index's
+        # key set), maintained incrementally on register/evict so the
+        # round-boundary /poolz snapshot can publish it without walking
+        # the index. TPUBC_CACHE_DIGEST=0 disables all maintenance
+        # (digest_json then reports empty; streams are untouched either
+        # way — the digest is observability, not data path).
+        self.digest_enabled = _digest_enabled()
+        self._digest: set = set()  # guarded-by: <engine-thread>
 
     # ---- accounting -------------------------------------------------------
 
@@ -1464,6 +1517,8 @@ class BlockAllocator:
             bid, key = self._cached.popitem(last=False)
             del self._index[key]
             del self._key_of[bid]
+            if self.digest_enabled:
+                self._digest.discard(key_fingerprint(key))
             heapq.heappush(self._free, bid)
             self.stats["evictions"] += 1
         ids = [heapq.heappop(self._free) for _ in range(n)]
@@ -1535,6 +1590,8 @@ class BlockAllocator:
             return False
         self._index[key] = bid
         self._key_of[bid] = key
+        if self.digest_enabled:
+            self._digest.add(key_fingerprint(key))
         return True
 
     def lookup(self, key: bytes) -> int | None:
@@ -1575,6 +1632,21 @@ class BlockAllocator:
                 self._cached[bid] = self._key_of[bid]
             else:
                 heapq.heappush(self._free, bid)
+
+    def digest_json(self) -> dict:
+        """Routable digest of the prefix-cache contents: the 64-bit
+        fingerprint of every registered chain key (CACHED blocks plus
+        shareable LIVE ones — registration, not residency state, is
+        what makes a block hittable). Maintained incrementally on
+        register/evict, so this is O(registered) to serialize and O(1)
+        per mutation; a router scores placements against it with
+        ``digest_match_len`` without ever seeing token text."""
+        if not self.digest_enabled:
+            return {"version": 1, "block_size": self.block_size,
+                    "blocks": 0, "fps": []}
+        return {"version": 1, "block_size": self.block_size,
+                "blocks": len(self._digest),
+                "fps": sorted(self._digest)}
 
     def compactness(self) -> float:
         """1.0 = the LIVE set is a perfect prefix of the id space; lower
@@ -2600,6 +2672,7 @@ class PagedPool(_PoolBase):
                        "compactness": round(a.compactness(), 4)},
             "imminent_growth_blocks": imminent,
             "watermark_headroom_blocks": a.available() - imminent,
+            "cache_digest": a.digest_json(),
         })
         return snap
 

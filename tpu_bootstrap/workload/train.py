@@ -401,6 +401,10 @@ def train_loop(cfg: TrainConfig, steps: int, *, checkpoint_dir: str | None = Non
                       round(tokens_per_step / max(step_ms / 1e3, 1e-9), 1))
         reg.set_gauge("workload_goodput_frac",
                       round(busy_s / max(_time.monotonic() - t_loop, 1e-9), 4))
+        # Liveness stamp for the metrics server's /healthz freshness
+        # check (and the fleet aggregator's staleness view): a wedged
+        # step loop goes 503 after TPUBC_WATCHDOG_STALL_MS.
+        telemetry.heartbeat(i + 1)
         if log_every > 0 and (i + 1) % log_every == 0:
             now = _time.time()
             tps = tokens_per_step * (i + 1 - last_logged) / max(now - t_log, 1e-9)
