@@ -1,0 +1,437 @@
+"""Device-time attribution: the engine busy/idle ledger, per-class
+device-seconds, the shared FLOPs/MFU pricing, preemption cost arms, the
+on-demand /profilez capture, and the fleetz ?window= federation.
+
+Pins the PR's contracts: the ledger CONSERVES — busy + idle == wall and
+attributed + unattributed == busy per scheduler, with summed per-request
+device_ms equal to attributed busy time — and keeps conserving under
+churn (preemptions, deadline sheds, crash-is-preemption recovery).
+Token streams are byte-identical with the ledger disabled (and with the
+event log disabled on top). flops_model() is the one price list serving
+and train share. /profilez is 403 until an operator opts in, then
+returns a ledger summary (busy_frac, MFU, round deltas) for a bounded
+window. flatten_window() turns a replica's windowed /metrics.json doc
+into federable flat series, and the aggregator passes ?window= through
+end-to-end."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import Counter
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_bootstrap import telemetry
+from tpu_bootstrap.workload import faults
+from tpu_bootstrap.workload.decode import generate
+from tpu_bootstrap.workload.fleetz import FleetAggregator, flatten_window
+from tpu_bootstrap.workload.ingress import IngressServer
+from tpu_bootstrap.workload.model import (
+    ModelConfig,
+    flops_model,
+    init_params,
+    kv_bytes_per_token,
+)
+from tpu_bootstrap.workload.serving import (
+    PagedPool,
+    Request,
+    Scheduler,
+    device_ledger_enabled,
+    serve,
+)
+
+TINY = ModelConfig(vocab_size=32, num_layers=1, num_heads=2, head_dim=8,
+                   embed_dim=16, mlp_dim=32, max_seq_len=64)
+TPARAMS = init_params(TINY, jax.random.PRNGKey(1))
+
+
+@pytest.fixture(autouse=True)
+def _no_lingering_faults():
+    yield
+    faults.install(None)
+
+
+def _solo(tokens, max_new):
+    out = generate(TPARAMS, jnp.asarray([tokens], jnp.int32), TINY, max_new,
+                   kv_kernel=False)
+    return np.asarray(out[0]).tolist()
+
+
+def _requests(n, seed=0, lo_new=8, hi_new=24):
+    rng = np.random.default_rng(seed)
+    return [Request(rid=i,
+                    tokens=rng.integers(1, 32,
+                                        int(rng.integers(2, 10))).tolist(),
+                    max_new=int(rng.integers(lo_new, hi_new)))
+            for i in range(n)]
+
+
+def _drive(pool, sched, requests):
+    done = {}
+    for r in requests:
+        sched.submit(r)
+    rounds = 0
+    while sched.pending() or pool.has_active():
+        rounds += 1
+        assert rounds < 5000, "scheduler stopped making progress"
+        for rid, ev in sched.step().items():
+            if ev["done"]:
+                done[rid] = ev["generated"]
+    return done
+
+
+def _assert_conserved(sched):
+    led = sched.ledger
+    assert led["rounds"] > 0
+    assert led["busy_ms"] + led["idle_ms"] == pytest.approx(
+        led["wall_ms"], abs=1e-6)
+    assert led["attributed_ms"] + led["unattributed_ms"] == pytest.approx(
+        led["busy_ms"], abs=0.05)
+    # Every retirement moved its live total into the cumulative ledger.
+    assert sched.device_ms_by_rid == {}
+    assert led["retired_device_ms"] == pytest.approx(
+        led["attributed_ms"], abs=0.05)
+    # The flight recorder's per-request device_ms is the SAME money:
+    # summed across records it equals attributed busy time.
+    recs = sched.log.snapshot()["requests"]
+    total = sum(r["phases"].get("device_ms", 0.0) for r in recs)
+    assert total == pytest.approx(led["attributed_ms"], abs=0.1)
+    return led
+
+
+# ---- the acceptance pin: conservation, including under churn --------------
+
+
+def test_ledger_conserves_on_a_plain_run():
+    pool = PagedPool(TPARAMS, TINY, 4, block_size=8)
+    sched = Scheduler(pool)
+    assert sched.ledger_enabled is device_ledger_enabled() is True
+    done = _drive(pool, sched, _requests(4, seed=1))
+    led = _assert_conserved(sched)
+    # Back-to-back step() calls: the engine never idled between rounds,
+    # so busy dominates wall.
+    assert led["busy_ms"] > 0 and led["flops"] > 0
+    for r in _requests(4, seed=1):
+        assert done[r.rid] == _solo(r.tokens, r.max_new), r.rid
+
+
+def test_ledger_conserves_under_churn():
+    """Preemptions (tight overcommitted pool), deadline sheds, AND a
+    crash-is-preemption recovery in one burst — conservation is exactly
+    the property churn would break (a dropped fold, a double-count on
+    the recovery path, a shed row holding its live entry forever)."""
+    reqs = _requests(8, seed=5)
+    # Two arrivals whose deadline already passed: shed from the queue at
+    # the first round boundary (deterministic — no timing race).
+    past = time.monotonic() - 1.0
+    reqs += [Request(rid=100 + i, tokens=[1 + i, 2, 3], max_new=8,
+                     deadline=past) for i in range(2)]
+    pool = PagedPool(TPARAMS, TINY, 8, block_size=8, kv_blocks=8,
+                     prefill_budget=4)
+    sched = Scheduler(pool, overcommit=True, expected_new=2)
+    faults.install("pool.device:1:3")  # one device abort mid-burst
+    done = _drive(pool, sched, reqs)
+    faults.install(None)
+    assert pool.stats["preemptions"] > 0, "pool was not actually tight"
+    assert sched.stats["deadline_shed"] == 2
+    assert sched.stats["recoveries"] == 1
+    _assert_conserved(sched)
+    # The ledger is observability, not control flow: recovered and
+    # preempted streams stay byte-identical to solo runs; shed streams
+    # report the deadline, not tokens.
+    for r in reqs:
+        if r.deadline is not None:
+            assert done[r.rid] == []  # shed before any token advanced
+        else:
+            assert done[r.rid] == _solo(r.tokens, r.max_new), r.rid
+
+
+def test_streams_byte_identical_ledger_on_and_off(monkeypatch):
+    reqs = _requests(6, seed=3)
+    on = serve(TPARAMS, TINY, reqs, 4, paged=True, block_size=8,
+               prefill_budget=4)
+    monkeypatch.setenv("TPUBC_DEVICE_LEDGER", "0")
+    assert device_ledger_enabled() is False
+    off = serve(TPARAMS, TINY, reqs, 4, paged=True, block_size=8,
+                prefill_budget=4)
+    # ... and with the request-event log ALSO off — the fully dark
+    # configuration the overhead contract is quoted against.
+    monkeypatch.setenv("TPUBC_REQUEST_EVENTS", "0")
+    dark = serve(TPARAMS, TINY, reqs, 4, paged=True, block_size=8,
+                 prefill_budget=4)
+    assert on == off == dark
+    # Disabled really means disabled: no folds, no attribution state.
+    pool = PagedPool(TPARAMS, TINY, 2, block_size=8)
+    sched = Scheduler(pool)
+    assert sched.ledger_enabled is False
+    _drive(pool, sched, [Request(rid=0, tokens=[1, 2], max_new=2)])
+    assert sched.ledger["rounds"] == 0
+    assert sched.device_ms_by_rid == {}
+    assert pool.ledger_tokens is None
+
+
+# ---- per-class device-seconds + headline gauges ---------------------------
+
+
+def test_per_class_device_ms_and_gauges():
+    mj0 = telemetry.metrics().to_json()
+
+    def cls(c, snap):
+        return snap.get(f'serve_device_ms_total{{priority="{c}"}}', 0.0)
+
+    reqs = [Request(rid=i, tokens=[1 + i, 2, 3], max_new=4, priority=i % 2)
+            for i in range(6)]
+    pool = PagedPool(TPARAMS, TINY, 4, block_size=8)
+    sched = Scheduler(pool)
+    _drive(pool, sched, reqs)
+    mj = telemetry.metrics().to_json()
+    deltas = {c: cls(c, mj) - cls(c, mj0) for c in ("0", "1")}
+    assert deltas["0"] > 0 and deltas["1"] > 0
+    # The class split is a PARTITION of attributed busy time.
+    assert sum(deltas.values()) == pytest.approx(
+        sched.ledger["attributed_ms"], abs=0.1)
+    assert 0 < mj["serve_engine_busy_frac"] <= 1.0
+    assert mj["serve_mfu"] > 0
+    assert (mj.get("serve_model_flops_total", 0)
+            - mj0.get("serve_model_flops_total", 0)) == pytest.approx(
+        sched.ledger["flops"], rel=1e-6)
+    # Provenance gauges: which peaks priced these numbers, and whether
+    # they came from the environment or the built-in default.
+    assert mj["serve_peak_tflops"] == telemetry.peak_tflops()
+    assert mj["serve_host_xfer_gbps"] == telemetry.host_xfer_gbps()
+    # The text exposition renders REAL labels the official parser reads.
+    from prometheus_client.parser import text_string_to_metric_families
+
+    classes = {s.labels["priority"]
+               for f in text_string_to_metric_families(
+                   telemetry.metrics().to_prometheus())
+               for s in f.samples
+               if s.name == "serve_device_ms_total"
+               and "priority" in s.labels}
+    assert {"0", "1"} <= classes
+
+
+def test_flops_model_is_the_shared_price_list():
+    f = flops_model(TINY)
+    assert set(f) == {"prefill", "decode", "verify", "train", "params"}
+    assert all(v > 0 for v in f.values())
+    # Prefill skips the vocab head; decode and verify pay it equally;
+    # train is the standard 3x rule on the head-bearing price.
+    assert f["prefill"] < f["decode"] == f["verify"]
+    assert f["train"] == pytest.approx(3 * f["decode"])
+    # Sanity anchor: per-token forward ~= 2 * params + attention.
+    assert f["decode"] > 2 * f["params"] * 0.5
+
+
+def test_preempt_cost_publishes_both_arms():
+    reqs = _requests(8, seed=7)
+    pool = PagedPool(TPARAMS, TINY, 8, block_size=8, kv_blocks=8,
+                     prefill_budget=4)
+    sched = Scheduler(pool, overcommit=True, expected_new=2)
+    _drive(pool, sched, reqs)
+    assert pool.stats["preemptions"] > 0
+    mj = telemetry.metrics().to_json()
+    # Every preemption prices the modeled swap arm from the victim's
+    # history x kv_bytes_per_token over the host link...
+    swap = mj.get('serve_preempt_cost{arm="swap_est"}')
+    assert swap is not None and swap >= 0
+    assert kv_bytes_per_token(TINY) > 0
+    # ... and each resume prices the measured-recompute arm from the
+    # observed prefill throughput.
+    rec = mj.get('serve_preempt_cost{arm="recompute"}')
+    assert rec is not None and rec >= 0
+
+
+# ---- /profilez ------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = IngressServer(TPARAMS, TINY, port=0, batch_size=4, paged=True,
+                        block_size=8, host="127.0.0.1").start()
+    yield srv
+    srv.stop()
+
+
+def _post(port, path, body=None, timeout=300):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode() if body is not None else b"",
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def test_profilez_disabled_is_403_and_bad_ms_is_400(server, monkeypatch):
+    monkeypatch.delenv("TPUBC_PROFILEZ", raising=False)
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server.port, "/profilez")
+    assert e.value.code == 403
+    monkeypatch.setenv("TPUBC_PROFILEZ", "1")
+    for bad in ("0", "-5", "999999", "zzz"):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(server.port, f"/profilez?ms={bad}")
+        assert e.value.code == 400, bad
+
+
+def test_profilez_capture_summarizes_ledger(server, monkeypatch, tmp_path):
+    monkeypatch.setenv("TPUBC_PROFILEZ", str(tmp_path))
+    # Traffic DURING the window, so the utilization answer is non-empty.
+    stop = threading.Event()
+
+    def traffic():
+        i = 0
+        while not stop.is_set():
+            i += 1
+            _post(server.port, "/v1/generate",
+                  {"tokens": [1 + i % 7, 2, 3], "max_new": 6,
+                   "stream": False})
+
+    t = threading.Thread(target=traffic, daemon=True)
+    t.start()
+    try:
+        out = _post(server.port, "/profilez?ms=300", timeout=60)
+    finally:
+        stop.set()
+        t.join(timeout=60)
+    assert out["requested_ms"] == 300
+    assert out["measured_ms"] >= 300
+    led = out["ledger"]
+    assert led["rounds"] > 0 and led["busy_ms"] > 0
+    assert led["busy_ms"] + led["idle_ms"] == pytest.approx(
+        led["wall_ms"], abs=0.01)
+    assert 0 < out["busy_frac"] <= 1.0
+    assert out["mfu"] >= 0
+    assert out["mode"] in ("profiler", "ledger")
+    if out["mode"] == "profiler":
+        assert out["artifact_dir"] == str(tmp_path)
+    # The engine survived the capture and still serves.
+    ok = _post(server.port, "/v1/generate",
+               {"tokens": [4, 5], "max_new": 3, "stream": False})
+    assert ok["done"] and "device_ms" in ok["timing"]
+
+
+# ---- fleetz: windowed federation ------------------------------------------
+
+
+def _window_doc():
+    return {
+        "window_secs": 60.0, "as_of_us": 1, "ring": {"maxlen": 512},
+        "series": {
+            "serve_tokens_per_sec": {
+                "now": 80.0, "samples": 4, "delta": 20.0,
+                "rate_per_sec": 0.33},
+            "serve_device_ms_total": {
+                "now": 500.0, "samples": 4, "delta": 120.0,
+                "rate_per_sec": 2.0},
+            'serve_device_ms_total{priority="1"}': {
+                "now": 200.0, "samples": 4, "delta": 40.0,
+                "rate_per_sec": 0.67},
+            "serve_ttft_ms": {
+                "count": 9, "count_delta": 6, "sum_delta": 300.0,
+                "p50": 40.0, "p99": 90.0, "bucket_deltas": [6],
+                "bounds": [100.0], "rate_per_sec": 0.1},
+        },
+    }
+
+
+def test_flatten_window_series_and_histograms():
+    flat = flatten_window(_window_doc())
+    assert flat["serve_tokens_per_sec"] == 80.0
+    assert flat["serve_tokens_per_sec_window_delta"] == 20.0
+    assert flat["serve_device_ms_total_window_rate_per_sec"] == 2.0
+    # Labeled series keep the suffix AFTER the label braces (the json
+    # exposition's spelling); _relabel hops it inside the family when
+    # the aggregator adds the replica label.
+    assert flat['serve_device_ms_total{priority="1"}_window_delta'] == 40.0
+    assert flat["serve_ttft_ms_window_p99"] == 90.0
+    assert flat["serve_ttft_ms_window_count_delta"] == 6
+    # The real registry produces the same shape end-to-end.
+    reg = telemetry.metrics()
+    reg.inc("ledgertest_total", 3.0)
+    live = flatten_window(reg.window_json(60))
+    assert "ledgertest_total" in live
+
+
+class _WindowReplica:
+    """Replica stub whose /metrics.json answers BOTH spellings: the
+    lifetime scrape (no query) and the windowed fetch (?window=N)."""
+
+    def __init__(self):
+        self.hits = Counter()
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                path, _, query = self.path.partition("?")
+                outer.hits[self.path] += 1
+                docs = {
+                    "/healthz": {"ok": True, "state": "serving"},
+                    "/metrics.json": (
+                        _window_doc() if "window=" in query
+                        else {"serve_queue_depth": 2, "serve_qps": 2.5,
+                              "serve_engine_busy_frac": 0.75,
+                              "serve_mfu": 0.125}),
+                }
+                body = json.dumps(docs.get(path, {})).encode()
+                code = 200 if path in docs else 404
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.addr = f"127.0.0.1:{self.httpd.server_address[1]}"
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_fleetz_window_passthrough_end_to_end():
+    rep = _WindowReplica()
+    agg = FleetAggregator([rep.addr], poll_s=3600.0, stale_after_s=1e9)
+    try:
+        agg.poll_once(now=100.0)
+        # Lifetime view: per-replica busy_frac/MFU ride /fleetz, and the
+        # fleet block carries their mean.
+        doc = agg.fleetz_json(now=100.0)
+        assert doc["window_secs"] is None
+        entry = doc["replicas"][rep.addr]
+        assert entry["busy_frac"] == 0.75 and entry["mfu"] == 0.125
+        assert doc["fleet"]["busy_frac"] == pytest.approx(0.75)
+        assert doc["fleet"]["mfu"] == pytest.approx(0.125)
+        assert "window" not in entry
+        # ?window=N fans the window out to each replica live and embeds
+        # the windowed doc per replica.
+        doc = agg.fleetz_json(now=100.0, window=60)
+        assert doc["window_secs"] == 60.0
+        win = doc["replicas"][rep.addr]["window"]
+        assert win["series"]["serve_ttft_ms"]["p99"] == 90.0
+        assert any("window=60" in p for p in rep.hits)
+        # Federated text flips from lifetime gauges to windowed series,
+        # each re-labeled per replica.
+        text = agg.federated_metrics()
+        assert f'serve_queue_depth{{replica="{rep.addr}"}} 2' in text
+        wtext = agg.federated_metrics(window=60)
+        assert (f'serve_ttft_ms_window_p99{{replica="{rep.addr}"}} 90'
+                in wtext)
+        assert (f'serve_device_ms_total_window_delta{{priority="1",'
+                f'replica="{rep.addr}"}} 40' in wtext)
+    finally:
+        agg.httpd.server_close()
+        rep.stop()

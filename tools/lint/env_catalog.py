@@ -101,6 +101,19 @@ CATALOG = {
         "5000", "serving",
         "Graceful-drain window: residents finish or checkpoint-preempt "
         "within this before streams flush with `draining: true`."),
+    "TPUBC_DEVICE_LEDGER": (
+        "1", "serving",
+        "`0` disables the per-round busy/idle device-time ledger "
+        "(attribution gauges stop; token streams byte-identical)."),
+    "TPUBC_HOST_XFER_GBPS": (
+        "16", "serving",
+        "Host<->device transfer GB/s — prices the modeled swap arm of "
+        "`serve_preempt_cost` next to the measured recompute arm."),
+    "TPUBC_PROFILEZ": (
+        "-", "serving",
+        "Enables `POST /profilez` on-demand capture: `1` writes traces "
+        "under the system temp dir, any other value is the artifact "
+        "dir. Unset/`0` keeps the endpoint 403."),
     "TPUBC_WATCHDOG_STALL_MS": (
         "30000", "serving",
         "Engine-watchdog stall threshold on round heartbeats (/healthz "
@@ -128,6 +141,11 @@ CATALOG = {
         "819", "kernels",
         "HBM peak GB/s — the denominator of every roofline fraction "
         "(v5e default; v5p ~2765, v4 ~1228)."),
+    "TPUBC_PEAK_TFLOPS": (
+        "197", "kernels",
+        "Chip peak bf16 TFLOP/s — the MFU denominator shared by the "
+        "serving ledger and the train loop (v5e default; v5p ~459, "
+        "v4 ~275)."),
     "TPUBC_QUANT_AUTOTUNE": (
         "1", "kernels",
         "`0` disables the first-call-per-shape block autotuner "

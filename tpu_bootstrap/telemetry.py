@@ -582,6 +582,50 @@ def hbm_peak_gbps() -> float:
         return DEFAULT_HBM_PEAK_GBPS
 
 
+# v5e bf16 matmul peak, TFLOP/s — the denominator of every MFU number
+# (serving's serve_mfu, train's workload_train_mfu). Override with
+# TPUBC_PEAK_TFLOPS for other parts (v5p ~459, v4 ~275).
+PEAK_TFLOPS_ENV = "TPUBC_PEAK_TFLOPS"
+DEFAULT_PEAK_TFLOPS = 197.0
+
+
+def peak_tflops() -> float:
+    try:
+        return float(os.environ.get(PEAK_TFLOPS_ENV, DEFAULT_PEAK_TFLOPS))
+    except ValueError:
+        return DEFAULT_PEAK_TFLOPS
+
+
+# Host<->device transfer bandwidth, GB/s — the denominator of the
+# MODELED swap arm in serve_preempt_cost (ROADMAP item 2's host-memory
+# KV tier would move bytes at this rate instead of recomputing them).
+HOST_XFER_ENV = "TPUBC_HOST_XFER_GBPS"
+DEFAULT_HOST_XFER_GBPS = 16.0
+
+
+def host_xfer_gbps() -> float:
+    try:
+        return float(os.environ.get(HOST_XFER_ENV, DEFAULT_HOST_XFER_GBPS))
+    except ValueError:
+        return DEFAULT_HOST_XFER_GBPS
+
+
+def record_peak_provenance() -> None:
+    """Publish the MFU/roofline denominators AND where they came from,
+    PR 3's roofline-gauge discipline extended to compute peak: a
+    chip-down (or mis-configured) run's serve_mfu is only as honest as
+    its peak, so the peak itself and a from-env flag (1 = operator
+    asserted it, 0 = repo default — possibly the wrong part) ride the
+    same scrape the fractions do."""
+    reg = _metrics
+    reg.set_gauge("serve_peak_tflops", peak_tflops())
+    reg.set_gauge("serve_peak_tflops_from_env",
+                  int(PEAK_TFLOPS_ENV in os.environ))
+    reg.set_gauge("serve_host_xfer_gbps", host_xfer_gbps())
+    reg.set_gauge("serve_host_xfer_gbps_from_env",
+                  int(HOST_XFER_ENV in os.environ))
+
+
 def record_kernel_bandwidth(kernel: str, bytes_moved: int, seconds: float,
                             peak_gbps: float | None = None) -> None:
     """Set the per-kernel achieved-bandwidth gauges from one measured

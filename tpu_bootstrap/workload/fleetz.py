@@ -236,6 +236,36 @@ def _relabel(key: str, replica: str) -> tuple:
     return key, f"{key}{{{rep}}}"
 
 
+def flatten_window(doc: dict) -> dict:
+    """A replica ``/metrics.json?window=N`` document flattened back to
+    the flat ``{key: number}`` shape ``federate()`` speaks: value
+    series contribute their instant plus windowed delta/rate
+    (``_window_delta`` / ``_window_rate_per_sec``), histograms their
+    windowed count/sum deltas and window-local quantiles. Suffixes ride
+    AFTER any label braces — ``_relabel`` hops them back inside the
+    family, same as the lifetime exposition's histogram suffixes."""
+    out: dict = {}
+    for name, e in (doc.get("series") or {}).items():
+        if not isinstance(e, dict):
+            continue
+        if "now" in e:  # value series
+            if isinstance(e.get("now"), (int, float)):
+                out[name] = e["now"]
+            for src, suffix in (("delta", "_window_delta"),
+                                ("rate_per_sec", "_window_rate_per_sec")):
+                if isinstance(e.get(src), (int, float)):
+                    out[f"{name}{suffix}"] = e[src]
+        else:  # histogram series
+            for src, suffix in (("count_delta", "_window_count_delta"),
+                                ("sum_delta", "_window_sum_delta"),
+                                ("p50", "_window_p50"),
+                                ("p99", "_window_p99"),
+                                ("rate_per_sec", "_window_rate_per_sec")):
+                if isinstance(e.get(src), (int, float)):
+                    out[f"{name}{suffix}"] = e[src]
+    return out
+
+
 def federate(per_replica: dict, own: str = "") -> str:
     """Prometheus text for the whole fleet: every replica's scraped
     /metrics.json instant re-labeled with replica=..., grouped per
@@ -383,10 +413,18 @@ class FleetAggregator:
             def do_GET(self):
                 url = urlparse(self.path)
                 path = url.path
+                window = parse_qs(url.query).get("window", [None])[0]
+                if window is not None:
+                    try:
+                        window = float(window)
+                    except ValueError:
+                        return self._json(
+                            400, {"error": "window must be a number"})
                 if path == "/fleetz":
-                    return self._json(200, outer.fleetz_json())
+                    return self._json(200,
+                                      outer.fleetz_json(window=window))
                 if path == "/metrics":
-                    body = outer.federated_metrics().encode()
+                    body = outer.federated_metrics(window=window).encode()
                     self.send_response(200)
                     self.send_header("Content-Type",
                                      "text/plain; version=0.0.4")
@@ -569,8 +607,33 @@ class FleetAggregator:
             return "unreachable"
         return st["state"]
 
-    def fleetz_json(self, now: float | None = None) -> dict:
+    def _windowed_metrics(self, window: float) -> dict:
+        """Live per-replica ``/metrics.json?window=N`` fetch, fanned out
+        on threads (never under the aggregator lock). On demand because
+        the poll loop's lifetime scrape cannot anticipate arbitrary
+        windows; an unreachable replica contributes None."""
+        out: dict = {}
+
+        def fetch(r: str) -> None:
+            try:
+                out[r] = self._fetch_json(
+                    r, f"/metrics.json?window={window:g}")
+            except Exception:  # noqa: BLE001 - render survives any replica
+                out[r] = None
+
+        threads = [threading.Thread(target=fetch, args=(r,), daemon=True)
+                   for r in self.replicas]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=self.timeout_s + 1.0)
+        return out
+
+    def fleetz_json(self, now: float | None = None,
+                    window: float | None = None) -> dict:
         now = time.monotonic() if now is None else now
+        windowed = (self._windowed_metrics(window)
+                    if window is not None else {})
         with self._lock:
             snap = {r: dict(st) for r, st in self._state.items()}
             for st in snap.values():
@@ -601,10 +664,20 @@ class FleetAggregator:
                 "queue_depth": m.get("serve_queue_depth"),
                 "qps": m.get("serve_qps"),
                 "tokens_per_sec": m.get("serve_tokens_per_sec"),
+                # The router/autoscaler's utilization signal: device
+                # busy fraction and MFU from the replica's round
+                # ledger (None on replicas without a serving plane).
+                "busy_frac": m.get("serve_engine_busy_frac"),
+                "mfu": m.get("serve_mfu"),
                 "blocks": blocks or None,
                 "digest_blocks": digest.get("blocks"),
                 "cache_digest": digest or None,
             }
+            if window is not None:
+                # The ?window=N pass-through: the replica's own windowed
+                # series (deltas, rates, window-local quantiles), fetched
+                # live — recent behavior, not process-lifetime blend.
+                entry["window"] = windowed.get(r)
             replicas[r] = entry
             if eff == "healthy":
                 fleet["healthy"] += 1
@@ -619,9 +692,18 @@ class FleetAggregator:
             for k in ("total", "live", "cached"):
                 if isinstance(blocks.get(k), int):
                     fleet["blocks"][k] += blocks[k]
+        # Fleet utilization: mean busy-frac/MFU over replicas reporting
+        # one — the scale-on-utilization signal, next to queue depth.
+        for key, src in (("busy_frac", "busy_frac"), ("mfu", "mfu")):
+            vals = [e[src] for e in replicas.values()
+                    if isinstance(e.get(src), (int, float))]
+            fleet[key] = (round(sum(vals) / len(vals), 6)
+                          if vals else None)
         burn = self.slo.evaluate(now=now)
+        out_window = None if window is None else float(window)
         return {
             "as_of_us": telemetry.now_us(),
+            "window_secs": out_window,
             "poll_ms": round(self.poll_s * 1e3, 1),
             "replicas": replicas,
             "fleet": fleet,
@@ -635,9 +717,18 @@ class FleetAggregator:
             "alerts": self.slo.alerts(),
         }
 
-    def federated_metrics(self) -> str:
-        with self._lock:
-            per = {r: st["metrics"] for r, st in self._state.items()}
+    def federated_metrics(self, window: float | None = None) -> str:
+        """Federated Prometheus text. ``window=N`` swaps the poll loop's
+        lifetime instants for a live per-replica windowed scrape
+        (deltas/rates/window-quantiles as ``*_window_*`` families) —
+        the ?window=N contract holds end-to-end, replica through
+        aggregator."""
+        if window is not None:
+            per = {r: (flatten_window(doc) if doc else None)
+                   for r, doc in self._windowed_metrics(window).items()}
+        else:
+            with self._lock:
+                per = {r: st["metrics"] for r, st in self._state.items()}
         return federate(per, own=self.reg.to_prometheus())
 
     def _trace_docs(self) -> dict:
@@ -711,5 +802,5 @@ if __name__ == "__main__":
 
 
 __all__ = ["FleetAggregator", "SloEngine", "SloObjective",
-           "parse_objective", "federate", "stitch", "stitch_chrome",
-           "DEFAULT_OBJECTIVES"]
+           "parse_objective", "federate", "flatten_window", "stitch",
+           "stitch_chrome", "DEFAULT_OBJECTIVES"]

@@ -67,6 +67,7 @@ from __future__ import annotations
 import collections
 import json
 import queue
+import tempfile
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -241,6 +242,14 @@ class IngressServer:
         # condition waiter consumes notifications, and a watchdog
         # parked in _work.wait() would steal the engine's wakeups.
         self._watchdog_stop = threading.Event()
+        # On-demand device capture (POST /profilez?ms=N, gated by
+        # TPUBC_PROFILEZ): the handler parks one capture record here
+        # and waits on its event; the ENGINE thread — the only thread
+        # allowed to touch JAX — opens jax.profiler at the next round
+        # boundary, closes it at the first boundary past the deadline
+        # (ledger-only fallback when no profiler backend exists), and
+        # publishes the summary. One capture in flight at a time.
+        self._profile: dict | None = None  # guarded-by: _lock
 
         outer = self
 
@@ -382,6 +391,8 @@ class IngressServer:
                 self._json(200 if health["ok"] else 503, health)
 
             def do_POST(self):
+                if urlparse(self.path).path == "/profilez":
+                    return self._profilez(urlparse(self.path))
                 if self.path != "/v1/generate":
                     return self._json(404, {"error": f"unknown path {self.path}"})
                 try:
@@ -530,6 +541,48 @@ class IngressServer:
                                 code = 503
                             return self._json(code, out)
 
+            def _profilez(self, url):
+                # Guarded: profiling writes artifacts to disk and costs
+                # device time — an operator opts in per replica.
+                # TPUBC_PROFILEZ=1 captures into a tmp dir; any other
+                # truthy value IS the artifact directory.
+                mode = os.environ.get("TPUBC_PROFILEZ", "0")
+                if mode.lower() in ("", "0", "false"):
+                    return self._json(403, {
+                        "error": "profilez disabled: set TPUBC_PROFILEZ=1 "
+                                 "(tmp-dir artifacts) or =<artifact dir>"})
+                try:
+                    ms = float(parse_qs(url.query).get("ms", ["500"])[0])
+                except ValueError:
+                    return self._json(400, {"error": "ms must be a number"})
+                if not 0 < ms <= 60000:
+                    return self._json(
+                        400, {"error": "ms must be in (0, 60000]"})
+                out_dir = (os.path.join(tempfile.gettempdir(),
+                                        "tpubc-profilez")
+                           if mode.lower() in ("1", "true") else mode)
+                ev = threading.Event()
+                with outer._work:
+                    if outer._profile is not None:
+                        return self._json(
+                            409, {"error": "a capture is already in "
+                                           "flight; retry after it"})
+                    outer._profile = {"ms": ms, "dir": out_dir,
+                                      "event": ev, "deadline": None,
+                                      "result": None}
+                    # Wake an idle engine: idle time is part of the
+                    # answer, and the capture clock starts at the next
+                    # round boundary, not the next request.
+                    outer._work.notify_all()
+                ok = ev.wait(timeout=ms / 1e3 + 30.0)
+                with outer._work:
+                    prof, outer._profile = outer._profile, None
+                if not ok or prof is None or prof.get("result") is None:
+                    return self._json(
+                        504, {"error": "capture did not complete "
+                                       "(engine stalled or dead?)"})
+                return self._json(200, prof["result"])
+
             def _json(self, code, obj, headers=None):
                 payload = json.dumps(obj).encode()
                 self.send_response(code)
@@ -585,7 +638,8 @@ class IngressServer:
                 while (not self._stop and not self._pending
                        and not self.pool.has_active()
                        and not self.sched.pending()
-                       and not (self._draining and not self._drained)):
+                       and not (self._draining and not self._drained)
+                       and self._profile is None):
                     self._work.wait()
                     # Idle waits are not stalls: stamp the heartbeat on
                     # every wakeup so the watchdog only measures rounds.
@@ -602,6 +656,20 @@ class IngressServer:
                 incoming, self._pending = self._pending, []
                 for req, out_q in incoming:
                     self._streams[req.rid] = out_q
+                has_work = (bool(incoming) or self.pool.has_active()
+                            or self.sched.pending()
+                            or (self._draining and not self._drained))
+            # Capture ticks ride round boundaries (and, idle, this
+            # bounded poll): start/stop jax.profiler on the engine
+            # thread only — JAX is engine-owned.
+            self._profile_tick()
+            if not has_work:
+                # A capture is in flight but the pool is idle: idle
+                # time is part of the utilization answer — poll the
+                # capture deadline instead of spinning empty scheduler
+                # rounds that would bill phantom busy time.
+                time.sleep(0.02)
+                continue
             # Submission + admission + the round share one failure
             # domain: any of them can raise for the same reasons
             # (backend error mid-program), and the engine must survive
@@ -820,6 +888,74 @@ class IngressServer:
         }
         with self._work:
             self._poolz = snap
+
+    def _profile_tick(self) -> None:
+        """ENGINE THREAD ONLY — drive an on-demand /profilez capture.
+        First tick after the handler parked a request: snapshot the
+        scheduler's device-time ledger and open ``jax.profiler``
+        (falling back to a ledger-only capture when no profiler backend
+        exists). First tick past the deadline: close the trace,
+        summarize the ledger delta (busy/idle split, FLOPs, MFU), and
+        set the handler's event. Field writes happen-before event.set()
+        — the handler only reads ``result`` after the wait."""
+        with self._work:
+            prof = self._profile
+        if prof is None or prof.get("result") is not None:
+            return
+        now = time.monotonic()
+        if prof["deadline"] is None:
+            prof["mode"] = "profiler"
+            try:
+                import jax  # noqa: PLC0415 - engine-thread-only seam
+                os.makedirs(prof["dir"], exist_ok=True)
+                jax.profiler.start_trace(prof["dir"])
+            except Exception as e:  # noqa: BLE001 - ledger-only fallback
+                prof["mode"] = "ledger"
+                prof["profiler_error"] = f"{type(e).__name__}: {e}"[:200]
+            # Clock starts AFTER the trace opens: first-use profiler
+            # backend init can take seconds, and counting it would let
+            # the whole capture window elapse inside start_trace with
+            # zero rounds observed.
+            now = time.monotonic()
+            prof["base"] = dict(self.sched.ledger)
+            prof["t0"] = now
+            prof["deadline"] = now + prof["ms"] / 1e3
+            return
+        if now < prof["deadline"]:
+            return
+        if prof["mode"] == "profiler":
+            try:
+                import jax  # noqa: PLC0415 - engine-thread-only seam
+                jax.profiler.stop_trace()
+            except Exception as e:  # noqa: BLE001 - keep the ledger half
+                prof["mode"] = "ledger"
+                prof["profiler_error"] = f"{type(e).__name__}: {e}"[:200]
+        led, base = self.sched.ledger, prof["base"]
+        delta = {k: (round(v - base.get(k, 0.0), 3)
+                     if isinstance(v, float) else v - base.get(k, 0))
+                 for k, v in led.items()}
+        # Denominator is the CAPTURE WINDOW, not the ledger wall delta:
+        # the first in-window round's wall reaches back to the previous
+        # round's end, which may long predate t0.
+        window = (now - prof["t0"]) * 1e3
+        flops = delta["flops"]
+        result = {
+            "mode": prof["mode"],
+            "requested_ms": prof["ms"],
+            "measured_ms": round(window, 1),
+            "ledger": delta,
+            "busy_frac": (round(min(1.0, delta["busy_ms"] / window), 4)
+                          if window > 0 else 0.0),
+            "mfu": (round(flops / (window * 1e-3
+                                   * telemetry.peak_tflops() * 1e12), 9)
+                    if window > 0 else 0.0),
+        }
+        if prof["mode"] == "profiler":
+            result["artifact_dir"] = prof["dir"]
+        if prof.get("profiler_error"):
+            result["profiler_error"] = prof["profiler_error"]
+        prof["result"] = result
+        prof["event"].set()
 
     # ---- drain / watchdog ------------------------------------------------
 

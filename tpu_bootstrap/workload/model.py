@@ -129,6 +129,75 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
     return params
 
 
+def flops_model(cfg: ModelConfig) -> dict:
+    """Price one token's forward pass in FLOPs — the shared denominator
+    of every MFU number this repo reports (serving's ``serve_mfu``,
+    train's ``workload_train_mfu``, and the round ledger's token
+    weights all read THIS table, so an attribution and an efficiency
+    claim can never disagree about what a token costs).
+
+    Pure function of the config: matmul terms only (norms/rotary/
+    softmax fuse into the surrounding matmuls and are noise at any real
+    size), 2 FLOPs per MAC, attention scored at the half-window nominal
+    context (``max_seq_len / 2`` — a config-only price list cannot know
+    each request's live context, and the nominal keeps prefill and
+    decode comparable instead of ignoring attention entirely).
+
+    Keys: ``prefill`` (KV-producing prompt token, logits discarded — no
+    head matmul), ``decode`` and ``verify`` (frontier tokens that DO
+    pay the vocab head; verify is priced like decode — the target
+    forward is the same matmuls whether the token was drafted or
+    sampled), ``train`` (backward ~= 2x forward, the standard 3x rule,
+    on the head-bearing price), and ``params`` (matmul parameter count,
+    the sanity anchor: per-token forward ~= 2 * params + attention).
+    """
+    e, h, d, hk = cfg.embed_dim, cfg.num_heads, cfg.head_dim, cfg.kv_heads
+    # Attention projections: q + (k, v at the GQA head count) + out.
+    proj = 2 * e * (h * d) + 2 * e * (2 * hk * d) + 2 * (h * d) * e
+    # Scores + value gather at the nominal half-window context, all
+    # num_heads query heads against the (shared) KV.
+    ctx = max(1, cfg.max_seq_len // 2)
+    attn = 2 * 2 * h * d * ctx
+    if cfg.num_experts > 0:
+        # Routed experts: each token pays top_k expert FFNs + the router.
+        mlp = cfg.expert_top_k * 2 * 2 * e * cfg.mlp_dim
+        mlp += 2 * e * cfg.num_experts
+    else:
+        # Gated (SwiGLU) FFN runs three matmuls; ungated two.
+        mats = 3 if cfg.mlp_gated else 2
+        mlp = mats * 2 * e * cfg.mlp_dim
+    layer = proj + attn + mlp
+    body = cfg.num_layers * layer
+    head = 2 * e * cfg.vocab_size
+    per_layer_params = (proj + (mlp if cfg.num_experts == 0
+                                else mlp - 2 * e * cfg.num_experts)) // 2
+    params = (cfg.num_layers * per_layer_params
+              + e * cfg.vocab_size)  # embed (tied head counted once)
+    return {
+        "prefill": float(body),
+        "decode": float(body + head),
+        "verify": float(body + head),
+        "train": 3.0 * (body + head),
+        "params": float(params),
+    }
+
+
+def kv_bytes_per_token(cfg: ModelConfig, kv_quant: bool = False) -> int:
+    """Bytes of KV cache one token position occupies across every
+    layer: K + V at the GQA head count, in the compute dtype — or one
+    byte per element plus a per-head float32 scale pair when the cache
+    is int8-quantized. The HBM-live-bytes gauge and the swap-cost model
+    (``serve_preempt_cost{arm=swap_est}``) both price block residency
+    with this."""
+    per_pos = cfg.kv_heads * cfg.head_dim
+    if kv_quant:
+        # int8 payload + float32 scale per (head, position) for K and V.
+        per_layer = 2 * (per_pos + 4 * cfg.kv_heads)
+    else:
+        per_layer = 2 * per_pos * jnp.dtype(cfg.compute_dtype).itemsize
+    return cfg.num_layers * per_layer
+
+
 def _rms_norm(x: jax.Array, scale: jax.Array) -> jax.Array:
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
     return (x * jax.lax.rsqrt(var + 1e-6).astype(x.dtype)) * scale.astype(x.dtype)

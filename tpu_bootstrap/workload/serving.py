@@ -129,7 +129,8 @@ from tpu_bootstrap.workload.decode import (
     paged_decode_step,
     prefill,
 )
-from tpu_bootstrap.workload.model import ModelConfig, Params
+from tpu_bootstrap.workload.model import (ModelConfig, Params, flops_model,
+                                          kv_bytes_per_token)
 
 
 @dataclasses.dataclass
@@ -197,6 +198,18 @@ def _majority_chunk(active, max_seq_len: int) -> int:
 
 
 REQUEST_EVENTS_ENV = "TPUBC_REQUEST_EVENTS"
+DEVICE_LEDGER_ENV = "TPUBC_DEVICE_LEDGER"
+
+
+def device_ledger_enabled() -> bool:
+    """The device-time attribution ledger's master switch: on by
+    default, off with ``TPUBC_DEVICE_LEDGER=0``. Off means the
+    Scheduler never attaches a token dict to the pool, every pool-side
+    recording site no-ops on a single attribute read, and token streams
+    are byte-identical to a ledger-enabled run (the ledger only
+    observes)."""
+    return os.environ.get(DEVICE_LEDGER_ENV, "1").lower() not in (
+        "0", "false")
 
 
 def request_events_enabled() -> bool:
@@ -235,6 +248,11 @@ class RequestRecord:
     generated: int = 0
     footprint_blocks: int = 0
     cached_tokens: int = 0
+    # Device-time attribution (the round ledger): engine busy ms this
+    # request was billed for, split by work kind. Wall-clock phases
+    # above say where the request WAITED; this says what it CONSUMED.
+    device_ms: float = 0.0
+    device_by_kind: dict = dataclasses.field(default_factory=dict)
 
 
 # Phase in effect AFTER each event kind — the gap between consecutive
@@ -377,6 +395,23 @@ class RequestLog:
                 rec.preemptions += 1
             self._recs.move_to_end(rid)
 
+    def add_device(self, rid: int, ms: float,
+                   by_kind: dict | None = None) -> None:
+        """Bill ``ms`` of engine busy time to a request (round-ledger
+        attribution). Tolerates unknown/evicted rids — the ledger's
+        conservation invariant lives in the Scheduler, not here."""
+        if not self.enabled or ms <= 0:
+            return
+        with self._lock:
+            rec = self._recs.get(rid)
+            if rec is None:
+                return
+            rec.device_ms += ms
+            if by_kind:
+                for k, v in by_kind.items():
+                    rec.device_by_kind[k] = (
+                        rec.device_by_kind.get(k, 0.0) + v)
+
     def retire(self, rid: int) -> None:
         """Finalize a record: fold the retired event's summary in, emit
         the span tree, and roll its phase durations into the cumulative
@@ -439,6 +474,10 @@ class RequestLog:
             (rec.events[-1]["t_us"] - rec.submit_us) / 1e3, 3)
         out["preemptions"] = rec.preemptions
         out["legs"] = rec.legs
+        out["device_ms"] = round(rec.device_ms, 3)
+        if rec.device_by_kind:
+            out["device_ms_by_kind"] = {
+                k: round(v, 3) for k, v in rec.device_by_kind.items()}
         return out
 
     def phases(self, rid: int) -> dict | None:
@@ -504,11 +543,28 @@ class _PoolBase:
     # read per would-be event.
     request_log: RequestLog | None = None
 
+    # Round-ledger scratch: {rid: {"prefill"|"decode"|"verify": tokens}}
+    # advanced THIS round. The Scheduler resets it at the top of every
+    # step() and harvests it after the round to split the round's
+    # device time across the rows that consumed it; pools driven bare
+    # keep None and the recording sites no-op (one attribute read, the
+    # request_log discipline — token streams are identical either way,
+    # the ledger only observes).
+    ledger_tokens: dict | None = None
+
     def _levent(self, rid: int, kind: str, **attrs) -> None:
         """Append one lifecycle event for ``rid`` (no-op without a log)."""
         log = self.request_log
         if log is not None:
             log.event(rid, kind, **attrs)
+
+    def _ledger_add(self, rid: int, kind: str, n: int) -> None:
+        """Count ``n`` tokens of ``kind`` work for ``rid`` this round
+        (no-op without an attached Scheduler ledger)."""
+        led = self.ledger_tokens
+        if led is not None and n > 0:
+            row = led.setdefault(rid, {})
+            row[kind] = row.get(kind, 0) + n
 
     def _slot_json(self, i: int, s) -> dict:
         return {"slot": i, "rid": s.rid, "priority": s.priority,
@@ -694,7 +750,8 @@ class _PoolBase:
                 return i
         raise RuntimeError("no free slot (check free_slots before admit)")
 
-    def _emit_events(self, out, chunk: int, counts=None) -> dict:
+    def _emit_events(self, out, chunk: int, counts=None,
+                     kind: str = "decode") -> dict:
         """Fold one round's (B, >=chunk) outputs into slot state:
         extends histories, truncates at eos (a row may decode past its
         eos inside a chunk — the output is cut, the extra steps are the
@@ -706,7 +763,9 @@ class _PoolBase:
         token counts) overrides the uniform ``chunk`` for engines whose
         rows advance at different rates (per-row speculative commits;
         the paged pool's still-prefilling rows ride a round as count-0
-        dummies and must not consume it)."""
+        dummies and must not consume it). ``kind`` names the ledger
+        weight class these tokens advance under (decode, or verify for
+        the speculative commit paths)."""
         events = {}
         for i, s in enumerate(self.slots):
             if s is None:
@@ -715,6 +774,10 @@ class _PoolBase:
             keep = min(keep, s.remaining)
             if keep <= 0:
                 continue
+            # Ledger weight is the EXECUTED work: eos may cut the
+            # delivered tokens below ``keep``, but the device ran (and
+            # must be billed for) every kept step.
+            self._ledger_add(s.rid, kind, keep)
             got = out[i, :keep].tolist()
             s.generated += got
             s.history += got
@@ -914,11 +977,19 @@ class SlotPool(_PoolBase):
         # accounting deliberately excludes) — replayed_tokens makes the
         # total-work model checkable instead of a docstring claim.
         self.stats["replayed_tokens"] += sum(len(s.history) for s in active)
+        # Ledger: the replay IS this engine's prefill cost — each round
+        # re-prefills every active history, so a long row's share of the
+        # round's device time must scale with its history, not just its
+        # chunk of fresh tokens.
+        for s in active:
+            self._ledger_add(s.rid, "prefill", len(s.history))
         self.stats["slot_steps"] += self.batch_size * chunk
         # chunk <= every active row's remaining by construction, so each
         # active slot consumes exactly chunk steps this round.
         self.stats["active_slot_steps"] += len(active) * chunk
-        return self._emit_events(out, chunk)
+        return self._emit_events(
+            out, chunk,
+            kind="verify" if self.draft_params is not None else "decode")
 
 
 @partial(jax.jit, static_argnames=("cfg", "kv_quant"))
@@ -1194,6 +1265,7 @@ class ResidentPool(_PoolBase):
                                   kv_quant=self.kv_quant)
             self.dcaches = _paste_row(self.dcaches, dtemp, jnp.int32(i))
         self.stats["prefill_tokens"] += len(r.tokens)
+        self._ledger_add(r.rid, "prefill", len(r.tokens))
         self._levent(r.rid, "prefill_chunk", tokens=len(r.tokens),
                      prefilled=len(r.tokens))
         # frontier = the LAST prompt token's position: the first decode
@@ -1323,7 +1395,7 @@ class ResidentPool(_PoolBase):
         self.stats["committed_tokens"] += sum(kept)
         self.stats["slot_steps"] += sum(kept)
         self.stats["active_slot_steps"] += sum(kept)
-        events = self._emit_events(greedy, 0, counts=kept)
+        events = self._emit_events(greedy, 0, counts=kept, kind="verify")
         # Host commit: device->host transfer + the python event fold —
         # the per-round sync cost the phase timers exist to expose.
         reg.observe("serve_spec_commit_ms",
@@ -1978,6 +2050,16 @@ class PagedPool(_PoolBase):
             self.stats.update({"verify_rounds": 0, "committed_tokens": 0,
                                "draft_steps": 0, "draft_proposed": 0,
                                "draft_accepted": 0})
+        # Measured prefill throughput (EMA over _prefill_phase), priced
+        # against each preemption as the recompute arm of
+        # serve_preempt_cost; None until the first prefill chunk runs.
+        self._prefill_ms_per_tok: float | None = None  # guarded-by: <engine-thread>
+        # KV bytes one token pins across all layers (target + draft
+        # share block tables, so a preempted row's swap cost covers
+        # both pools) — the swap_est arm's numerator.
+        self._kv_bytes_per_tok = kv_bytes_per_token(cfg, kv_quant) + (
+            kv_bytes_per_token(draft_cfg, kv_quant)
+            if draft_params is not None else 0)
         self._record_stream_gauges()
         self._record_block_gauges()
 
@@ -2207,6 +2289,13 @@ class PagedPool(_PoolBase):
                 "serve_prefix_hit_rate",
                 round(self.stats["prefix_hit_tokens"]
                       / self.stats["prompt_tokens"], 4))
+        # HBM the KV pool actually pins right now (used blocks at full
+        # block granularity, target + draft pools) — rides the ring, so
+        # /metrics.json?window=N shows recent live-bytes history.
+        telemetry.metrics().set_gauge(
+            "serve_kv_live_bytes",
+            self.allocator.used() * self.block_size
+            * self._kv_bytes_per_tok)
         self.stats["blocks_peak"] = self.allocator.stats["peak_used"]
 
     # ---- admission --------------------------------------------------------
@@ -2281,9 +2370,18 @@ class PagedPool(_PoolBase):
             # events, not cost): the tokens the resume must actually
             # re-prefill — whatever the prefix cache didn't retain from
             # the victim's registered blocks.
+            recomp = max(0, prompt_len - 1 - hit_tokens)
             telemetry.metrics().inc(
-                "serve_preempt_recompute_tokens_total",
-                max(0, prompt_len - 1 - hit_tokens))
+                "serve_preempt_recompute_tokens_total", recomp)
+            if self._prefill_ms_per_tok is not None:
+                # The measured arm of the swap-vs-recompute decision:
+                # what THIS resume's re-prefill costs at the engine's
+                # observed prefill throughput, published next to the
+                # modeled swap_est the eviction stamped.
+                telemetry.metrics().set_gauge(
+                    "serve_preempt_cost",
+                    round(recomp * self._prefill_ms_per_tok, 3),
+                    labels={"arm": "recompute"})
         self._levent(
             r.rid, "resumed" if preload else "admitted",
             blocks=len(blocks), shared_blocks=len(shared),
@@ -2329,6 +2427,15 @@ class PagedPool(_PoolBase):
         self.slots[i] = None
         self.stats["preemptions"] += 1
         telemetry.metrics().inc("serve_preempt_total")
+        # The modeled arm: what swapping this row's KV to host memory
+        # WOULD have cost instead of recomputing it — bytes over the
+        # host-transfer link (TPUBC_HOST_XFER_GBPS). ROADMAP item 2's
+        # host tier consumes both arms to pick per-victim.
+        telemetry.metrics().set_gauge(
+            "serve_preempt_cost",
+            round(len(s.history) * self._kv_bytes_per_tok
+                  / (telemetry.host_xfer_gbps() * 1e9) * 1e3, 3),
+            labels={"arm": "swap_est"})
         prompt = s.history[:len(s.history) - len(s.generated)]
         rec = {"request": Request(rid=s.rid, tokens=prompt,
                                   max_new=len(s.generated) + s.remaining,
@@ -2445,6 +2552,8 @@ class PagedPool(_PoolBase):
                if s is not None and self._prefilling(s)]
         if not pre:
             return
+        t_phase = time.perf_counter()
+        toks_phase = 0
         # Round-robin start so one huge prompt cannot starve later
         # arrivals of the budget forever.
         start = self._pre_rr % len(pre)
@@ -2467,8 +2576,10 @@ class PagedPool(_PoolBase):
                 s.prefilled += w
                 s.prefill_chunks += 1
                 budget -= w
+                toks_phase += w
                 self.stats["prefill_tokens"] += w
                 self.stats["prefill_chunks"] += 1
+                self._ledger_add(s.rid, "prefill", w)
                 self._levent(s.rid, "prefill_chunk", tokens=w,
                              prefilled=s.prefilled,
                              round=self.stats["rounds"])
@@ -2488,6 +2599,15 @@ class PagedPool(_PoolBase):
                     buckets=(1, 2, 4, 8, 16, 32, 64))
             if budget <= 0:
                 break
+        if toks_phase > 0:
+            # Measured prefill price per token (dispatch-timed EMA, the
+            # serve_spec_* seams' clock): the recompute arm of
+            # serve_preempt_cost prices a resume's re-prefilled tokens
+            # with this instead of a modeled constant.
+            ms_per_tok = (time.perf_counter() - t_phase) * 1e3 / toks_phase
+            self._prefill_ms_per_tok = (
+                ms_per_tok if self._prefill_ms_per_tok is None
+                else 0.8 * self._prefill_ms_per_tok + 0.2 * ms_per_tok)
 
     def step_round(self) -> dict:
         active = [s for s in self.slots if s is not None]
@@ -2631,7 +2751,7 @@ class PagedPool(_PoolBase):
         self.stats["committed_tokens"] += sum(kept)
         self.stats["slot_steps"] += sum(kept)
         self.stats["active_slot_steps"] += sum(kept)
-        events = self._emit_events(greedy, 0, counts=kept)
+        events = self._emit_events(greedy, 0, counts=kept, kind="verify")
         reg.observe("serve_spec_commit_ms",
                     (time.perf_counter() - t2) * 1e3)
         self._register_phase()
@@ -2814,6 +2934,27 @@ class Scheduler:
         # through the request_log backref, /requestz serves snapshot().
         self.log = RequestLog()
         pool.request_log = self.log if self.log.enabled else None
+        # Device-time attribution (the round ledger): enabled, step()
+        # attaches a fresh {rid: {kind: tokens}} dict to the pool
+        # before its round and folds it after — busy time splits across
+        # the rows the round advanced, FLOPs-weighted. Disabled
+        # (TPUBC_DEVICE_LEDGER=0), pool.ledger_tokens stays None and
+        # every recording site no-ops on one attribute read.
+        self.ledger_enabled = device_ledger_enabled()
+        # The price list weighting prefill/decode/verify tokens against
+        # each other (and the numerator of serve_mfu).
+        self._flops = flops_model(pool.cfg)
+        self._prio: dict = {}  # rid -> priority class  # guarded-by: _lock
+        # Per-request attributed busy ms, live rows only (retirement
+        # pops — bounded); the conservation tests read it alongside the
+        # cumulative ledger dict below (engine-thread state).
+        self.device_ms_by_rid: dict = {}  # guarded-by: <engine-thread>
+        self.ledger = {"rounds": 0, "busy_ms": 0.0, "idle_ms": 0.0,  # guarded-by: <engine-thread>
+                       "wall_ms": 0.0, "attributed_ms": 0.0,
+                       "unattributed_ms": 0.0,
+                       "retired_device_ms": 0.0, "flops": 0.0}
+        self._last_step_end: float | None = None  # guarded-by: <engine-thread>
+        telemetry.record_peak_provenance()
 
     # ---- queue ------------------------------------------------------------
 
@@ -2843,6 +2984,7 @@ class Scheduler:
             self._seq += 1
             self.stats["submitted"] += 1
             self._qstart[r.rid] = time.monotonic()
+            self._prio[r.rid] = r.priority
         self._record_gauges()
 
     def _push_locked(self, r: Request, preload, seq: int) -> None:
@@ -3040,6 +3182,11 @@ class Scheduler:
         up to TPUBC_ENGINE_MAX_RESTARTS consecutive times; slot engines
         (no quarantine — a resumed sampled stream could not keep its
         key offsets) re-raise to the caller's abort-all path."""
+        t_start = time.perf_counter()
+        led: dict | None = None
+        if self.ledger_enabled:
+            led = {}
+            self.pool.ledger_tokens = led
         shed: dict = {}
         try:
             shed = self._shed_expired()
@@ -3052,6 +3199,9 @@ class Scheduler:
             events = self.pool.step_round()
             self._fail_streak = 0
         except Exception as e:  # noqa: BLE001 - the recovery boundary
+            if led is not None:
+                self._ledger_fold(led, t_start, time.perf_counter())
+                led = None
             if (not hasattr(self.pool, "quarantine")
                     or self._fail_streak >= self._max_restarts):
                 raise
@@ -3059,6 +3209,8 @@ class Scheduler:
             events = {}
         events.update(shed)
         self._drain_preempted()
+        if led is not None:
+            self._ledger_fold(led, t_start, time.perf_counter())
         retired = [rid for rid, ev in events.items() if ev["done"]]
         if retired:
             self._retire_window.add(len(retired))
@@ -3075,8 +3227,76 @@ class Scheduler:
                 # Finalize the lifecycle record: emits the request span
                 # + phase-child spans and updates the share gauges.
                 self.log.retire(rid)
+                # Retired rows leave the live attribution map (bounded)
+                # but keep their total in the cumulative ledger.
+                self.ledger["retired_device_ms"] += (
+                    self.device_ms_by_rid.pop(rid, 0.0))
+                with self._lock:
+                    self._prio.pop(rid, None)
         self._record_gauges()
         return events
+
+    def _ledger_fold(self, led: dict, t_start: float, t_end: float) -> None:
+        """Close one round's device-time ledger. Busy is the work
+        section's wall time (shed + admit + pool round + preempt
+        drain); round wall is end-of-previous-step to end-of-this-step
+        (first round: the work section itself), so idle = wall - busy
+        and busy + idle == wall by construction. Busy splits across the
+        rows the round advanced proportionally to their FLOPs-weighted
+        tokens — summed per-request device_ms equals engine busy time
+        (the conservation invariant the tests pin); a round that
+        advanced no tokens bills serve_device_unattributed_ms_total."""
+        self.pool.ledger_tokens = None
+        busy_ms = (t_end - t_start) * 1e3
+        wall_ms = (busy_ms if self._last_step_end is None
+                   else max(busy_ms, (t_end - self._last_step_end) * 1e3))
+        self._last_step_end = t_end
+        idle_ms = wall_ms - busy_ms
+        prices = self._flops
+        weights: dict = {}
+        flops = 0.0
+        for rid, kinds in led.items():
+            w = 0.0
+            for kind, n in kinds.items():
+                w += n * prices.get(kind, prices["decode"])
+            if w > 0:
+                weights[rid] = w
+                flops += w
+        reg = telemetry.metrics()
+        attributed = 0.0
+        if flops > 0:
+            with self._lock:
+                prio = {rid: self._prio.get(rid, 0) for rid in weights}
+            for rid, w in weights.items():
+                ms = busy_ms * w / flops
+                attributed += ms
+                self.device_ms_by_rid[rid] = (
+                    self.device_ms_by_rid.get(rid, 0.0) + ms)
+                self.log.add_device(rid, ms, {
+                    f"{kind}_ms": busy_ms * n * prices.get(
+                        kind, prices["decode"]) / flops
+                    for kind, n in led[rid].items()})
+                reg.inc("serve_device_ms_total", ms,
+                        labels={"priority": str(prio[rid])})
+            reg.inc("serve_model_flops_total", flops)
+        elif busy_ms > 0:
+            reg.inc("serve_device_unattributed_ms_total", busy_ms)
+        l = self.ledger
+        l["rounds"] += 1
+        l["busy_ms"] += busy_ms
+        l["idle_ms"] += idle_ms
+        l["wall_ms"] += wall_ms
+        l["attributed_ms"] += attributed
+        l["unattributed_ms"] += busy_ms if flops <= 0 else 0.0
+        l["flops"] += flops
+        if wall_ms > 0:
+            # Riding the metric ring: /metrics.json?window=N shows the
+            # engine's RECENT utilization, not lifetime blend.
+            reg.set_gauge("serve_engine_busy_frac",
+                          round(busy_ms / wall_ms, 4))
+            reg.set_gauge("serve_mfu", round(
+                flops / (wall_ms * 1e-3
+                         * telemetry.peak_tflops() * 1e12), 9))
 
     def request_timing(self, rid: int) -> dict | None:
         """The response ``timing`` block: per-phase ms breakdown for one
@@ -3101,6 +3321,9 @@ class Scheduler:
                     "waiting": waiting,
                     "queue_wait_p50_ms": round(
                         self._queue_wait_p50_locked(), 2),
+                    "ledger": {k: (round(v, 3) if isinstance(v, float)
+                                   else v)
+                               for k, v in self.ledger.items()},
                     "stats": dict(self.stats)}
 
     def reset(self, reason: str = "error") -> None:
@@ -3113,6 +3336,7 @@ class Scheduler:
             self._waiting.clear()
             self._qstart.clear()
             self._preempt_t.clear()
+            self._prio.clear()
         # The flight recorder keeps its history but must not show the
         # failed round's victims running forever. (Outside the lock:
         # RequestLog takes its own, and holding both here would impose
@@ -3397,5 +3621,6 @@ def static_schedule_slot_steps(requests: list, batch_size: int) -> int:
 
 __all__ = ["BlockAllocator", "PagedPool", "Request", "RequestLog",
            "RequestRecord", "ResidentPool", "Scheduler", "SlotPool",
-           "block_hash", "ngram_lookup_drafts", "request_events_enabled",
-           "serve", "static_schedule_slot_steps"]
+           "block_hash", "device_ledger_enabled", "ngram_lookup_drafts",
+           "request_events_enabled", "serve",
+           "static_schedule_slot_steps"]

@@ -19,7 +19,12 @@ import optax
 
 from tpu_bootstrap.workload.ring_attention import shard_map
 from tpu_bootstrap import telemetry
-from tpu_bootstrap.workload.model import ModelConfig, init_params, loss_from_inputs
+from tpu_bootstrap.workload.model import (
+    ModelConfig,
+    flops_model,
+    init_params,
+    loss_from_inputs,
+)
 from tpu_bootstrap.workload.sharding import (
     BATCH_AXES,
     MeshConfig,
@@ -359,6 +364,9 @@ def train_loop(cfg: TrainConfig, steps: int, *, checkpoint_dir: str | None = Non
     losses = []
     profiling = False
     tokens_per_step = global_batch_size(cfg) * (cfg.model.max_seq_len - 1)
+    # Shared MFU definition with the serving ledger: tokens priced by
+    # flops_model() over peak_tflops(). One pricing model, two planes.
+    flops_per_step = flops_model(cfg.model)["train"] * tokens_per_step
     t_log = _time.time()
     last_logged = start  # count ACTUAL steps per interval: a resume from
     # a step that is not a log_every multiple makes the first interval
@@ -401,6 +409,9 @@ def train_loop(cfg: TrainConfig, steps: int, *, checkpoint_dir: str | None = Non
                       round(tokens_per_step / max(step_ms / 1e3, 1e-9), 1))
         reg.set_gauge("workload_goodput_frac",
                       round(busy_s / max(_time.monotonic() - t_loop, 1e-9), 4))
+        reg.set_gauge("workload_train_mfu", round(
+            flops_per_step
+            / (max(step_ms, 1e-6) * 1e-3 * telemetry.peak_tflops() * 1e12), 9))
         # Liveness stamp for the metrics server's /healthz freshness
         # check (and the fleet aggregator's staleness view): a wedged
         # step loop goes 503 after TPUBC_WATCHDOG_STALL_MS.
