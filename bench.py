@@ -2595,7 +2595,7 @@ def slo_report(out_path: str, n_crs: int = 30):
                 f"http://127.0.0.1:{port}/statusz?name=slo-000",
                 timeout=5) as r:
             statusz = json.loads(r.read())
-        outcomes = statusz["objects"]["slo-000"]
+        outcomes = statusz["objects"]["slo-000"]  # lint: allow(endpoint-ghost-read) — dynamic object name, not a schema key
         reconcile_outcomes = [o for o in outcomes if o["op"] == "reconcile"]
         serve_json = telemetry.metrics().to_json()
 

@@ -6,8 +6,9 @@ Two halves:
   tools/lint/fixtures/ — an allowlist entry or a checker regression
   that silently blinds a pass fails here, not in some future race.
 * CLEAN TREE: ``python -m tools.lint`` reports ZERO findings on the
-  repo — the CI gate in test form (lock discipline, jit purity, and
-  the env/bench/metric registries hold as annotated).
+  repo — the CI gate in test form (lock discipline, jit purity, the
+  env/bench/metric registries, and the endpoint/JSON contract hold as
+  annotated).
 
 Pure AST work: no jax import, runs in seconds.
 """
@@ -17,13 +18,16 @@ import sys
 from pathlib import Path
 
 import tools.lint as lint
-from tools.lint import SourceFile, hotpath, locks, registry
+from tools.lint import Allowlist, SourceFile, contracts, hotpath, locks
+from tools.lint.endpoint_catalog import Consumer, Endpoint, Producer
 from tools.lint.env_catalog import render
 from tools.lint.registry import (
     check_bench_keys,
     check_env_vars,
+    check_metric_labels,
     check_metrics,
     scan_env_vars,
+    _native_metric_sites,
     _python_metric_sites,
 )
 
@@ -59,6 +63,26 @@ def test_lock_guard_fixture_fires():
     assert set(by) == {"lock-guard", "lock-helper-unheld"}
 
 
+def test_lock_closure_fixture_fires():
+    """Handler classes capturing ``outer = self`` run on request
+    threads: the closure re-run must flag guarded reads through the
+    alias, keep locked accesses and inline allows silent, and report
+    under the nested qualname."""
+    by = _by_rule(locks.run([_src("lock_closure.py")]))
+    guards = by.get("lock-guard", [])
+    assert len(guards) == 1, guards
+    assert "Exporter.rows" in guards[0].message
+    assert "outer._lock" in guards[0].message
+    # The do_POST locked path, the inline-allowed do_DELETE, and the
+    # outer push() must all stay silent.
+    assert set(by) == {"lock-guard"}
+    # The nested qualname is the allowlist target.
+    allow = {("lock-guard",
+              "tools/lint/fixtures/lock_closure.py"
+              "::Exporter.__init__.<locals>.Handler.do_GET")}
+    assert locks.run([_src("lock_closure.py")], allow) == []
+
+
 def test_lock_order_fixture_fires():
     by = _by_rule(locks.run([_src("lock_order.py")]))
     orders = by.get("lock-order", [])
@@ -78,6 +102,7 @@ def test_lock_annotations_exist_on_concurrent_classes():
     for name, wants_lock in [("Scheduler", True), ("RequestLog", True),
                              ("MetricsRegistry", True), ("Tracer", True),
                              ("IngressServer", True), ("RateWindow", True),
+                             ("FleetAggregator", True),
                              ("PagedPool", False), ("BlockAllocator", False)]:
         cls = classes.get(name)
         assert cls is not None and cls.guarded, f"{name} lost its " \
@@ -174,6 +199,144 @@ def test_env_docs_are_generated_and_current():
     from tools.lint.env_catalog import CATALOG
     assert set(seen) == set(CATALOG), (
         sorted(set(seen) ^ set(CATALOG)))
+
+
+def test_metric_label_drift_fixture_fires():
+    sites = _python_metric_sites([_src("registry_drift.py")])
+    by = _by_rule(check_metric_labels(sites))
+    drift = by.get("metric-label-drift", [])
+    assert len(drift) == 1 and "fixture_drift_total" in drift[0].message
+    assert "(unlabeled)" in drift[0].message and "zone" in drift[0].message
+    # Same-schema sites and the allowlisted blend stay silent.
+    assert "fixture_label_ok_ms" not in drift[0].message
+    allow = {("metric-label-drift",
+              "tools/lint/fixtures/registry_drift.py::fixture_drift_total")}
+    assert check_metric_labels(sites, allow) == []
+
+
+def test_native_metric_sites_parse_labels_and_set():
+    """The native scan must see native/bin, treat ``.set(`` as a gauge,
+    follow multiline calls, and parse concat-label name literals —
+    while never mistaking the Json builder's ``out.set("key"...)`` for
+    a metric."""
+    sites = _native_metric_sites(REPO)
+    by_name = {}
+    for name, _pat, kind, rel, _line, labels in sites:
+        by_name.setdefault(name, []).append((kind, rel, labels))
+    backoff = by_name.get("tpubc_scrape_backoff_seconds", [])
+    assert any(lbl == frozenset({"replica"}) for _k, _r, lbl in backoff)
+    assert any(lbl == frozenset() for _k, _r, lbl in backoff)
+    assert all(k == "gauge" for k, _r, _l in backoff)
+    assert "workqueue_depth" in by_name          # native/bin gauge
+    assert "reconciles_total" in by_name
+    # Json payload keys must NOT appear as metric families.
+    for payload_key in ("spans", "objects", "state", "process"):
+        assert payload_key not in by_name
+
+
+# ---------------------------------------------------------------------------
+# contracts pass
+# ---------------------------------------------------------------------------
+
+_FIX_REL = "tools/lint/fixtures/contract_drift.py"
+_FIX_GET = "FixtureServer.__init__.<locals>.Handler.do_GET"
+
+
+def _fixture_catalog():
+    entries = (
+        Endpoint("fix", "/itemz", (), "json",
+                 producers=(Producer(_FIX_REL, _FIX_GET,
+                                     route="/itemz"),),
+                 consumers=(Consumer(_FIX_REL, "read_itemz", "doc"),
+                            Consumer(_FIX_REL, "read_retired",
+                                     "payload")),
+                 # The producer renamed `total` -> `renamed_total`.
+                 keys=("error", "items", "total")),
+    )
+    servers = {"fix": ((_FIX_REL, _FIX_GET),)}
+    return {(e.server, e.path): e for e in entries}, servers
+
+
+def test_contract_fixture_fires():
+    cat, servers = _fixture_catalog()
+    by = _by_rule(contracts.run(REPO, set(), catalog=cat,
+                                servers=servers))
+    undoc = by.get("endpoint-undocumented", [])
+    assert len(undoc) == 1 and "/ghostz" in undoc[0].message
+    # Renamed producer key: documented name stale, new name undocumented.
+    stale = by.get("endpoint-key-stale", [])
+    assert len(stale) == 1 and "'total'" in stale[0].message
+    new = by.get("endpoint-key-undocumented", [])
+    assert len(new) == 1 and "renamed_total" in new[0].message
+    ghosts = by.get("endpoint-ghost-read", [])
+    assert len(ghosts) == 1 and "'count'" in ghosts[0].message
+    assert ghosts[0].path == _FIX_REL
+    dead = by.get("endpoint-consumer-stale", [])
+    assert len(dead) == 1 and "read_retired" in dead[0].message
+    assert set(by) == {"endpoint-undocumented", "endpoint-key-stale",
+                       "endpoint-key-undocumented", "endpoint-ghost-read",
+                       "endpoint-consumer-stale"}
+
+
+def test_contract_catalog_route_stale_fires():
+    cat, servers = _fixture_catalog()
+    cat[("fix", "/gonez")] = Endpoint(
+        "fix", "/gonez", (), "json",
+        producers=(Producer(_FIX_REL, _FIX_GET, route="/gonez"),),
+        keys=("error",))
+    by = _by_rule(contracts.run(REPO, set(), catalog=cat,
+                                servers=servers))
+    stale = by.get("endpoint-stale", [])
+    assert any("/gonez" in f.message for f in stale)
+
+
+def test_metrics_endpoint_reads_are_gated():
+    """A consumer read of a /metrics.json key must name a REAL emitted
+    family — bench's controller reads (histogram suffixes included)
+    pass, a fabricated family fails."""
+    names, labels = contracts.metric_universe(REPO)
+    for read in ("reconciles_total", "workqueue_depth",
+                 "tpubc_time_to_running_ms_p99",
+                 "tpubc_time_to_running_ms_count",
+                 "serve_ttft_ms_p50", "serve_engine_busy_frac"):
+        assert contracts._match_metric(read, names, labels), read
+    for read in ("fabricated_family_total", "serve_ttft_ms_p75",
+                 "reconciles_total_p50"):
+        assert not contracts._match_metric(read, names, labels), read
+
+
+def test_endpoint_docs_are_generated_and_current():
+    from tools.lint.endpoint_catalog import render as render_endpoints
+    doc = REPO / "docs" / "ENDPOINTS.md"
+    assert doc.exists(), "docs/ENDPOINTS.md missing — run " \
+        "`python -m tools.lint --write-endpoint-docs`"
+    assert doc.read_text() == render_endpoints()
+
+
+# ---------------------------------------------------------------------------
+# dead-allowlist gate
+# ---------------------------------------------------------------------------
+
+def test_allowlist_hit_tracking():
+    al = Allowlist({("rule-a", "x.py::f"), ("rule-b", "y.py")},
+                   {("rule-a", "x.py::f"): 3, ("rule-b", "y.py"): 9})
+    assert lint.allowed(al, "rule-a", "x.py", "f")
+    assert not lint.allowed(al, "rule-a", "x.py", "g")
+    assert al.hits == {("rule-a", "x.py::f")}
+    assert al.lines[("rule-b", "y.py")] == 9
+
+
+def test_stale_allowlist_entry_fires(monkeypatch):
+    real = lint.load_allowlist()
+    bogus = ("lock-guard", "tpu_bootstrap/nonexistent.py::Ghost.read")
+    crafted = Allowlist(set(real) | {bogus},
+                        {**real.lines, bogus: 999})
+    monkeypatch.setattr(lint, "load_allowlist", lambda: crafted)
+    findings = lint.run_all(REPO)
+    stale = [f for f in findings if f.rule == "allowlist-stale"]
+    assert len(stale) == 1 and "Ghost.read" in stale[0].message
+    assert stale[0].line == 999
+    assert [f for f in findings if f.rule != "allowlist-stale"] == []
 
 
 # ---------------------------------------------------------------------------

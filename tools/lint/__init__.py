@@ -1,24 +1,33 @@
 """tpubc-lint: repo-native static analysis (AST-based, stdlib-only).
 
-Three pass families, run over the whole tree by ``python -m tools.lint``
+Four pass families, run over the whole tree by ``python -m tools.lint``
 and gated in CI:
 
 * ``locks``    — lock-discipline / race checking driven by the
   ``# guarded-by: <lock>`` annotation convention, plus lock-ordering and
-  non-reentrant-reacquire analysis across the scanned classes.
+  non-reentrant-reacquire analysis across the scanned classes (including
+  the HTTP-handler closures that capture ``outer = self``).
 * ``hotpath``  — host-device sync and recompilation hazards inside
   ``@jax.jit``-reachable functions and the serving decode/step/verify
   hot loops.
 * ``registry`` — drift between the code and its registries: every
   ``TPUBC_*`` env var documented in docs/ENV_VARS.md, every bench
   ``--check`` key emitted and direction-classified exactly once, every
-  metric name consistently typed (counter vs gauge vs histogram).
+  metric name consistently typed (counter vs gauge vs histogram) and
+  labeled with ONE label-key set per family.
+* ``contracts`` — the cross-plane endpoint/JSON contract: every HTTP
+  endpoint's statically-extracted produced key set and every consumer's
+  key-access paths are gated against the curated catalog
+  (tools/lint/endpoint_catalog.py), and docs/ENDPOINTS.md must be
+  byte-equal to its rendering.
 
 Deliberate exceptions live in ``tools/lint/allowlist.txt`` (one
 ``rule path::qualname`` per line) or inline as a trailing
-``# lint: allow(rule)`` comment on the offending line.  Seeded-violation
-fixtures under ``tools/lint/fixtures/`` prove each pass fires; they are
-excluded from the default scan and exercised by tests/test_lint.py.
+``# lint: allow(rule)`` comment on the offending line.  Every allowlist
+entry must still shield a live site: entries no pass consults any more
+fail as ``allowlist-stale``.  Seeded-violation fixtures under
+``tools/lint/fixtures/`` prove each pass fires; they are excluded from
+the default scan and exercised by tests/test_lint.py.
 """
 
 from __future__ import annotations
@@ -77,25 +86,45 @@ class SourceFile:
         return f"lint: allow({rule})" in c or "lint: allow-all" in c
 
 
-def load_allowlist(path: os.PathLike | None = None) -> set:
+class Allowlist(set):
+    """The allowlist entries plus per-entry source lines and hit
+    tracking.  ``allowed()`` marks the entry it matched; after a full
+    default run, any entry no lookup ever matched shields nothing and
+    fails as ``allowlist-stale`` — the dead-exception gate."""
+
+    def __init__(self, entries=(), lines: dict | None = None):
+        super().__init__(entries)
+        self.lines: dict = dict(lines or {})
+        self.hits: set = set()
+
+
+def load_allowlist(path: os.PathLike | None = None) -> Allowlist:
     """``rule path::qualname`` entries; '#' comments and blanks skipped."""
     p = Path(path or ALLOWLIST_PATH)
-    entries = set()
+    entries, lines = set(), {}
     if not p.exists():
-        return entries
-    for raw in p.read_text().splitlines():
+        return Allowlist()
+    for i, raw in enumerate(p.read_text().splitlines(), 1):
         line = raw.split("#", 1)[0].strip()
         if not line:
             continue
         parts = line.split(None, 1)
         if len(parts) == 2:
-            entries.add((parts[0], parts[1].strip()))
-    return entries
+            entry = (parts[0], parts[1].strip())
+            entries.add(entry)
+            lines.setdefault(entry, i)
+    return Allowlist(entries, lines)
 
 
 def allowed(allowlist: set, rule: str, rel: str, qualname: str) -> bool:
-    return ((rule, f"{rel}::{qualname}") in allowlist
-            or (rule, rel) in allowlist)
+    hit = None
+    if (rule, f"{rel}::{qualname}") in allowlist:
+        hit = (rule, f"{rel}::{qualname}")
+    elif (rule, rel) in allowlist:
+        hit = (rule, rel)
+    if hit is not None and isinstance(allowlist, Allowlist):
+        allowlist.hits.add(hit)
+    return hit is not None
 
 
 def python_targets(root: os.PathLike | None = None) -> list:
@@ -108,10 +137,13 @@ def python_targets(root: os.PathLike | None = None) -> list:
             if "__pycache__" not in f.parts and "fixtures" not in f.parts]
 
 
+DEFAULT_PASSES = ("locks", "hotpath", "registry", "contracts")
+
+
 def run_all(root: os.PathLike | None = None,
-            passes: tuple = ("locks", "hotpath", "registry")) -> list:
+            passes: tuple = DEFAULT_PASSES) -> list:
     """Run the requested pass families over the tree; returns findings."""
-    from . import hotpath, locks, registry
+    from . import contracts, hotpath, locks, registry
     root = Path(root or REPO_ROOT)
     allowlist = load_allowlist()
     findings: list = []
@@ -121,5 +153,17 @@ def run_all(root: os.PathLike | None = None,
     if "hotpath" in passes:
         findings += hotpath.run(files, allowlist)
     if "registry" in passes:
-        findings += registry.run(root, allowlist)
+        findings += registry.run(root, allowlist, files)
+    if "contracts" in passes:
+        findings += contracts.run(root, allowlist, files=files)
+    # Dead-entry gate: only sound when every family that can hit an
+    # entry actually ran this invocation.
+    if set(DEFAULT_PASSES) <= set(passes) and isinstance(allowlist,
+                                                         Allowlist):
+        for entry in sorted(allowlist - allowlist.hits):
+            findings.append(Finding(
+                "allowlist-stale", "tools/lint/allowlist.txt",
+                allowlist.lines.get(entry, 1),
+                f"allowlist entry '{entry[0]} {entry[1]}' shields no "
+                f"live site any more — prune it"))
     return findings

@@ -2,10 +2,13 @@
 exit nonzero when any survive the allowlist.
 
 Options:
-    --only locks|hotpath|registry    run one pass family
+    --only locks|hotpath|registry|contracts   run one pass family
     --json                           machine-readable findings
     --write-env-docs                 regenerate docs/ENV_VARS.md from
                                      tools/lint/env_catalog.py and exit
+    --write-endpoint-docs            regenerate docs/ENDPOINTS.md from
+                                     tools/lint/endpoint_catalog.py and
+                                     exit
 """
 
 from __future__ import annotations
@@ -15,14 +18,14 @@ import json
 import sys
 from pathlib import Path
 
-from . import REPO_ROOT, run_all
+from . import DEFAULT_PASSES, REPO_ROOT, run_all
 from .env_catalog import render
 from .registry import ENV_DOC_PATH
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="python -m tools.lint")
-    ap.add_argument("--only", choices=("locks", "hotpath", "registry"),
+    ap.add_argument("--only", choices=DEFAULT_PASSES,
                     action="append",
                     help="run only the named pass family (repeatable)")
     ap.add_argument("--json", action="store_true",
@@ -31,6 +34,8 @@ def main(argv=None) -> int:
                     help="tree to scan (default: this repo)")
     ap.add_argument("--write-env-docs", action="store_true",
                     help="regenerate docs/ENV_VARS.md and exit")
+    ap.add_argument("--write-endpoint-docs", action="store_true",
+                    help="regenerate docs/ENDPOINTS.md and exit")
     args = ap.parse_args(argv)
     root = Path(args.root) if args.root else REPO_ROOT
 
@@ -41,8 +46,16 @@ def main(argv=None) -> int:
         print(f"wrote {out}")
         return 0
 
-    passes = tuple(args.only) if args.only else ("locks", "hotpath",
-                                                 "registry")
+    if args.write_endpoint_docs:
+        from .contracts import ENDPOINT_DOC_PATH
+        from .endpoint_catalog import render as render_endpoints
+        out = root / ENDPOINT_DOC_PATH
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(render_endpoints())
+        print(f"wrote {out}")
+        return 0
+
+    passes = tuple(args.only) if args.only else DEFAULT_PASSES
     findings = run_all(root, passes)
     if args.json:
         print(json.dumps([f.__dict__ for f in findings], indent=2))

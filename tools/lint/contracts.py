@@ -1,0 +1,585 @@
+"""Endpoint-contract drift pass: producers, consumers, and the catalog.
+
+PRs 9-12 made the replica's HTTP JSON shapes cross-process interfaces:
+the fleetz aggregator polls ``/healthz``/``/metrics.json``/
+``/traces.json``, bench ``--slo-report`` assembles from ``/requestz``/
+``/poolz``/``/metrics.json``, and the native controller scrapes
+``/metrics.json`` and serves its own ``/statusz``.  Nothing gated
+producer/consumer drift on those shapes — a renamed ``snapshot()`` key
+silently zeroed a fleet column.  This pass closes the loop against the
+curated ``tools/lint/endpoint_catalog.py``:
+
+* endpoint discovery — every route a server dispatches on must have a
+  catalog entry (``endpoint-undocumented``) and every catalog entry a
+  live route (``endpoint-stale``);
+* producer keys — the flat key universe each endpoint's producer chain
+  emits (AST: dict literals, ``var[k] =`` stores, ``.update({...})``;
+  native: ``Json::object({{"k", ...}})`` / ``.set("k", ...)``) must
+  match the catalog exactly (``endpoint-key-undocumented`` /
+  ``endpoint-key-stale``);
+* consumer reads — every key a registered consumer reads off an
+  endpoint's payload (``var["k"]`` chains, ``var.get("k")``,
+  ``"k" in var``; native ``var.get("k")``) must exist in the catalog
+  (``endpoint-ghost-read``), and registered consumers must still read
+  something (``endpoint-consumer-stale``);
+* metrics endpoints — ``/metrics.json`` payload keys are dynamic, so
+  consumer reads are gated against the REAL emission sites (Python
+  registry calls + native ``Metrics::instance()`` sites) with the
+  histogram suffix and ``name{label="v"}`` grammar applied;
+* docs — ``docs/ENDPOINTS.md`` must be byte-identical to
+  ``endpoint_catalog.render()`` (``--write-endpoint-docs``
+  regenerates).
+
+Route scoping: the three Python servers multiplex one ``do_GET`` over
+many routes, so producer extraction attributes statements to routes via
+the handler's own dispatch tests — positive ``path == "/x"`` /
+``path in (...)`` / ``path.startswith("/x")`` branches scope their
+bodies, and a negative ``if path not in (...): return`` narrows every
+following statement.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from . import Finding, SourceFile, allowed
+from . import endpoint_catalog as ec
+
+ENDPOINT_DOC_PATH = Path("docs") / "ENDPOINTS.md"
+
+# ---------------------------------------------------------------------------
+# qualname resolution (classes nested in functions included)
+
+
+def _functions(tree: ast.AST) -> dict:
+    """{qualname: FunctionDef} with the runtime qualname convention —
+    ``Outer.meth.<locals>.Handler.do_GET`` for handler classes defined
+    inside server methods."""
+    out: dict = {}
+
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = prefix + child.name
+                out[q] = child
+                walk(child, q + ".<locals>.")
+            elif isinstance(child, ast.ClassDef):
+                walk(child, prefix + child.name + ".")
+            else:
+                walk(child, prefix)
+
+    walk(tree, "")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# route dispatch recognition
+
+
+def _path_expr(node: ast.AST) -> bool:
+    """Is this expression the request path? ``path``/``route`` names or
+    ``self.path``."""
+    if isinstance(node, ast.Name) and node.id in ("path", "route"):
+        return True
+    return isinstance(node, ast.Attribute) and node.attr == "path"
+
+
+def _str_elts(node: ast.AST) -> list | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)):
+                return None
+            vals.append(e.value)
+        return vals
+    return None
+
+
+def _route_test(test: ast.AST):
+    """Classify a dispatch test -> ("pos"|"neg", [route literals]) or
+    None. ``startswith`` counts as positive for its literal prefix."""
+    if isinstance(test, ast.Compare) and len(test.ops) == 1:
+        if not _path_expr(test.left):
+            return None
+        routes = _str_elts(test.comparators[0])
+        if routes is None:
+            return None
+        op = test.ops[0]
+        if isinstance(op, (ast.Eq, ast.In)):
+            return ("pos", routes)
+        if isinstance(op, (ast.NotEq, ast.NotIn)):
+            return ("neg", routes)
+        return None
+    if (isinstance(test, ast.Call) and isinstance(test.func, ast.Attribute)
+            and test.func.attr == "startswith"
+            and _path_expr(test.func.value) and test.args):
+        routes = _str_elts(test.args[0])
+        if routes is not None:
+            return ("pos", routes)
+    return None
+
+
+def served_routes(func: ast.FunctionDef) -> set:
+    """Every route literal a handler function dispatches on."""
+    routes: set = set()
+    for node in ast.walk(func):
+        if isinstance(node, ast.If):
+            m = _route_test(node.test)
+            if m:
+                routes.update(m[1])
+    return routes
+
+
+# ---------------------------------------------------------------------------
+# producer key extraction
+
+
+def _dict_keys(node: ast.AST) -> set:
+    """Every string key of every dict literal under ``node`` — the flat
+    key universe (nested payload dicts contribute their keys too)."""
+    keys: set = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Dict):
+            for k in n.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    keys.add(k.value)
+    return keys
+
+
+def _stmt_keys(stmt: ast.stmt, var: str | None) -> set:
+    """Producer keys introduced by one statement: dict literals (any,
+    or only those flowing into ``var`` when given), ``v["k"] = ...``
+    stores, and ``v.update({...})``."""
+    keys: set = set()
+    if var is None:
+        keys |= _dict_keys(stmt)
+    elif isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            if isinstance(t, ast.Name) and t.id == var:
+                keys |= _dict_keys(stmt.value)
+    for n in ast.walk(stmt):
+        if (isinstance(n, ast.Assign) and len(n.targets) == 1
+                and isinstance(n.targets[0], ast.Subscript)):
+            sub = n.targets[0]
+            if (isinstance(sub.value, ast.Name)
+                    and (var is None or sub.value.id == var)
+                    and isinstance(sub.slice, ast.Constant)
+                    and isinstance(sub.slice.value, str)):
+                keys.add(sub.slice.value)
+        if (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "update"
+                and isinstance(n.func.value, ast.Name)
+                and (var is None or n.func.value.id == var)):
+            for a in n.args:
+                keys |= _dict_keys(a)
+    return keys
+
+
+def _scoped_keys(stmts: list, scope, var: str | None, buckets: dict):
+    """Attribute producer keys to routes. ``scope`` is None before any
+    narrowing (keys land in the ``None`` bucket) or a tuple of route
+    literals afterwards."""
+    for stmt in stmts:
+        if isinstance(stmt, ast.If):
+            m = _route_test(stmt.test)
+            if m and m[0] == "pos":
+                _scoped_keys(stmt.body, tuple(m[1]), var, buckets)
+                _scoped_keys(stmt.orelse, scope, var, buckets)
+                continue
+            if m and m[0] == "neg" and stmt.body and isinstance(
+                    stmt.body[-1], (ast.Return, ast.Raise)):
+                # ``if path not in (...): return`` — the body answers
+                # OTHER routes (the 404); everything after is narrowed.
+                _scoped_keys(stmt.body, ("*fallthrough*",), var, buckets)
+                scope = tuple(m[1])
+                continue
+            _scoped_keys(stmt.body, scope, var, buckets)
+            _scoped_keys(stmt.orelse, scope, var, buckets)
+            continue
+        if isinstance(stmt, (ast.Try, ast.With, ast.For, ast.While)):
+            _scoped_keys(stmt.body, scope, var, buckets)
+            for h in getattr(stmt, "handlers", ()):
+                _scoped_keys(h.body, scope, var, buckets)
+            _scoped_keys(getattr(stmt, "orelse", []), scope, var, buckets)
+            _scoped_keys(getattr(stmt, "finalbody", []), scope, var,
+                         buckets)
+            continue
+        for r in (scope if scope is not None else (None,)):
+            buckets.setdefault(r, set()).update(_stmt_keys(stmt, var))
+
+
+def producer_keys(func: ast.FunctionDef, var: str | None,
+                  route: str | None) -> set:
+    """The key universe one Producer spec contributes."""
+    if route is None:
+        keys: set = set()
+        for stmt in func.body:
+            keys |= _stmt_keys(stmt, var)
+        return keys
+    buckets: dict = {}
+    _scoped_keys(func.body, None, var, buckets)
+    keys = set(buckets.get(route, set()))
+    # Shared prologue statements (before any narrowing) belong to every
+    # route of the handler.
+    keys |= buckets.get(None, set())
+    return keys
+
+
+# ---------------------------------------------------------------------------
+# consumer read extraction
+
+
+def _chain_keys(node: ast.AST, var: str):
+    """Keys read through a subscript/.get chain rooted at ``var``:
+    ``v["a"]["b"]`` and ``v.get("a", {}).get("b")`` yield a and b."""
+    keys: list = []
+    cur = node
+    while True:
+        if (isinstance(cur, ast.Subscript)
+                and isinstance(cur.slice, ast.Constant)
+                and isinstance(cur.slice.value, str)):
+            keys.append((cur.slice.value, cur.lineno))
+            cur = cur.value
+            continue
+        if (isinstance(cur, ast.Subscript)
+                and isinstance(cur.slice, ast.Constant)
+                and isinstance(cur.slice.value, int)):
+            # list indexing inside a chain (requests[0]["rid"]) — step
+            # through without contributing a key
+            cur = cur.value
+            continue
+        if (isinstance(cur, ast.Call)
+                and isinstance(cur.func, ast.Attribute)
+                and cur.func.attr == "get" and cur.args
+                and isinstance(cur.args[0], ast.Constant)
+                and isinstance(cur.args[0].value, str)):
+            keys.append((cur.args[0].value, cur.lineno))
+            cur = cur.func.value
+            continue
+        break
+    if isinstance(cur, ast.Name) and cur.id == var:
+        return keys
+    return []
+
+
+def consumer_reads(func: ast.FunctionDef, var: str) -> list:
+    """Every (key, line) the function reads off ``var``'s payload."""
+    reads: list = []
+    seen: set = set()
+    for node in ast.walk(func):
+        for key, line in _chain_keys(node, var):
+            if (key, line) not in seen:
+                seen.add((key, line))
+                reads.append((key, line))
+        if (isinstance(node, ast.Compare) and len(node.ops) == 1
+                and isinstance(node.ops[0], (ast.In, ast.NotIn))
+                and isinstance(node.left, ast.Constant)
+                and isinstance(node.left.value, str)
+                and isinstance(node.comparators[0], ast.Name)
+                and node.comparators[0].id == var):
+            mark = (node.left.value, node.lineno)
+            if mark not in seen:
+                seen.add(mark)
+                reads.append(mark)
+    return reads
+
+
+# ---------------------------------------------------------------------------
+# native (.cc) extraction
+
+
+def _cc_function_body(text: str, name: str) -> str | None:
+    """Brace-matched body of the first definition of ``name`` — good
+    enough for the repo's clang-format style."""
+    m = re.search(rf"^[A-Za-z_][\w:<>&*\s]*\b{re.escape(name)}\s*\(",
+                  text, re.M)
+    if not m:
+        return None
+    brace = text.find("{", m.end())
+    if brace < 0:
+        return None
+    depth = 0
+    for i in range(brace, len(text)):
+        if text[i] == "{":
+            depth += 1
+        elif text[i] == "}":
+            depth -= 1
+            if depth == 0:
+                return text[brace:i + 1]
+    return None
+
+
+_CC_OBJECT_KEY = re.compile(r'\{"([A-Za-z0-9_.]+)",')
+_CC_SET_KEY = re.compile(r'\.set\("([A-Za-z0-9_.]+)"')
+_CC_ROUTE = re.compile(r'path\s*==\s*"(/[^"]*)"')
+
+
+def cc_producer_keys(text: str, func: str) -> set:
+    body = _cc_function_body(text, func)
+    if body is None:
+        return set()
+    return set(_CC_OBJECT_KEY.findall(body)) | set(
+        _CC_SET_KEY.findall(body))
+
+
+def cc_consumer_reads(text: str, func: str, var: str) -> list:
+    body = _cc_function_body(text, func)
+    if body is None:
+        return []
+    start = text.find(body)
+    base = text.count("\n", 0, start) + 1
+    reads = []
+    for m in re.finditer(
+            rf'\b{re.escape(var)}\s*\.\s*get\(\s*"([A-Za-z0-9_.{{}}="]+)"',
+            body):
+        reads.append((m.group(1), base + body.count("\n", 0, m.start())))
+    return reads
+
+
+def cc_served_routes(text: str) -> set:
+    return set(_CC_ROUTE.findall(text))
+
+
+# ---------------------------------------------------------------------------
+# the metrics key universe (dynamic endpoints)
+
+_HIST_SUFFIXES = ("_count", "_sum", "_p50", "_p99", "_overflow")
+_LABELED = re.compile(r'^([a-z0-9_]+)\{([^}]*)\}(_count|_sum|_p50|_p99'
+                      r'|_overflow)?$')
+
+
+def metric_universe(root: Path, files=None) -> tuple:
+    """(names, label_keysets): every metric family either side emits,
+    plus the label-key sets seen per family — the grammar consumer
+    reads of a metrics endpoint are checked against."""
+    from . import python_targets
+    from .registry import _native_metric_sites, _python_metric_sites
+
+    files = files if files is not None else python_targets(root)
+    names: dict = {}
+    labels: dict = {}
+    for (pattern, is_pattern, kind, _rel, _line, lbls) in (
+            _python_metric_sites(files) + _native_metric_sites(root)):
+        names.setdefault(pattern, set()).add(
+            ("pattern" if is_pattern else "exact", kind))
+        labels.setdefault(pattern, set()).add(frozenset(lbls or ()))
+    return names, labels
+
+
+def _match_metric(read: str, names: dict, labels: dict) -> bool:
+    """Does a consumer's metric-key read match any emission site? The
+    read grammar: family[{k="v",...}][histogram suffix], where the
+    family must be emitted and, when labeled, with that label-key
+    set."""
+    m = _LABELED.match(read)
+    if m:
+        base = m.group(1)
+        label_keys = frozenset(
+            p.split("=", 1)[0].strip()
+            for p in m.group(2).split(",") if "=" in p)
+        return (_family_emitted(base, names, hist=bool(m.group(3)))
+                and label_keys in labels.get(base, set()))
+    for suf in _HIST_SUFFIXES:
+        if read.endswith(suf):
+            fam = read[:-len(suf)]
+            if _family_emitted(fam, names, hist=True):
+                return True
+    return _family_emitted(read, names)
+
+
+def _family_emitted(family: str, names: dict, hist: bool = False) -> bool:
+    forms = names.get(family)
+    if forms and (not hist or any(kind == "histogram"
+                                  for _f, kind in forms)):
+        return True
+    # f-string emission sites were folded to regexes by the registry
+    # scan; a family matches if any pattern fullmatches it.
+    for pattern, pforms in names.items():
+        for form, kind in pforms:
+            if (form == "pattern" and re.fullmatch(pattern, family)
+                    and (not hist or kind == "histogram")):
+                return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# the pass
+
+
+def _load_funcs(root: Path, cache: dict, rel: str) -> tuple:
+    """(SourceFile, {qualname: FunctionDef}) for a scanned file — the
+    SourceFile rides along so inline ``# lint: allow(...)`` comments
+    can shield individual consumer-read lines."""
+    if rel not in cache:
+        path = root / rel
+        if not path.exists():
+            cache[rel] = (None, None)
+        else:
+            sf = SourceFile(path, root)
+            cache[rel] = (sf, _functions(sf.tree))
+    return cache[rel]
+
+
+def extracted_producer_keys(root: Path, ep, cache: dict,
+                            findings: list | None = None) -> set:
+    """Union of every producer source's extracted key set."""
+    keys: set = set()
+    for p in ep.producers:
+        if p.file.endswith(".cc"):
+            path = root / p.file
+            if not path.exists():
+                if findings is not None:
+                    findings.append(Finding(
+                        "endpoint-producer-stale", str(ENDPOINT_CAT_REL),
+                        1, f"{ep.server} {ep.path}: producer {p.file} "
+                        f"does not exist"))
+                continue
+            got = cc_producer_keys(path.read_text(), p.func)
+        else:
+            _sf, funcs = _load_funcs(root, cache, p.file)
+            func = funcs.get(p.func) if funcs else None
+            if func is None:
+                if findings is not None:
+                    findings.append(Finding(
+                        "endpoint-producer-stale", str(ENDPOINT_CAT_REL),
+                        1, f"{ep.server} {ep.path}: producer "
+                        f"{p.file}::{p.func} does not exist"))
+                continue
+            got = producer_keys(func, p.var, p.route)
+        keys |= got
+    return keys
+
+
+ENDPOINT_CAT_REL = Path("tools") / "lint" / "endpoint_catalog.py"
+
+
+def run(root, allowlist, catalog=None, servers=None, files=None) -> list:
+    root = Path(root)
+    cat = catalog if catalog is not None else ec.CATALOG
+    servers = servers if servers is not None else ec.SERVERS
+    findings: list = []
+    cache: dict = {}
+    cat_rel = str(ENDPOINT_CAT_REL)
+
+    # -- 1. route discovery: served routes <-> catalog ----------------------
+    by_server: dict = {}
+    for ep in cat.values():
+        by_server.setdefault(ep.server, set()).update(
+            (ep.path, *ep.aliases))
+    for server, handlers in servers.items():
+        served: set = set()
+        for (file, func) in handlers:
+            if file.endswith(".cc"):
+                path = root / file
+                if path.exists():
+                    served |= cc_served_routes(path.read_text())
+                continue
+            _sf, funcs = _load_funcs(root, cache, file)
+            f = funcs.get(func) if funcs else None
+            if f is None:
+                findings.append(Finding(
+                    "endpoint-stale", cat_rel, 1,
+                    f"server {server}: handler {file}::{func} "
+                    f"does not exist"))
+                continue
+            served |= served_routes(f)
+        served = {r for r in served if r.startswith("/")}
+        documented = by_server.get(server, set())
+        for r in sorted(served - documented):
+            findings.append(Finding(
+                "endpoint-undocumented", cat_rel, 1,
+                f"server {server} serves {r} but endpoint_catalog.py "
+                f"has no entry (document it and its key set)"))
+        for r in sorted(documented - served):
+            findings.append(Finding(
+                "endpoint-stale", cat_rel, 1,
+                f"catalog documents {server} {r} but no handler "
+                f"dispatches on it"))
+
+    # -- 2+3. per-endpoint producer/consumer checks -------------------------
+    met_names = met_labels = None
+    for ep in cat.values():
+        if ep.kind == "prom":
+            continue  # Prometheus text: no JSON key contract
+        if ep.kind == "metrics":
+            if met_names is None:
+                met_names, met_labels = metric_universe(root, files)
+            for c in ep.consumers:
+                _check_consumer_reads(
+                    root, ep, c, cache, findings,
+                    lambda k: _match_metric(k, met_names, met_labels),
+                    "no emission site produces this metric")
+            continue
+        produced = extracted_producer_keys(root, ep, cache, findings)
+        documented = set(ep.keys)
+        for k in sorted(produced - documented):
+            findings.append(Finding(
+                "endpoint-key-undocumented", cat_rel, 1,
+                f"{ep.server} {ep.path}: producers emit key "
+                f"'{k}' missing from the catalog entry"))
+        for k in sorted(documented - produced):
+            findings.append(Finding(
+                "endpoint-key-stale", cat_rel, 1,
+                f"{ep.server} {ep.path}: catalog key '{k}' is emitted "
+                f"by no producer (renamed or removed?)"))
+        for c in ep.consumers:
+            _check_consumer_reads(
+                root, ep, c, cache, findings,
+                lambda k: k in documented,
+                "no producer of this endpoint emits it")
+
+    # -- 4. docs drift -------------------------------------------------------
+    if catalog is None:
+        doc = root / ENDPOINT_DOC_PATH
+        if not doc.exists():
+            findings.append(Finding(
+                "endpoint-docs-drift", str(ENDPOINT_DOC_PATH), 1,
+                "docs/ENDPOINTS.md missing - run python -m tools.lint "
+                "--write-endpoint-docs"))
+        elif doc.read_text() != ec.render():
+            findings.append(Finding(
+                "endpoint-docs-drift", str(ENDPOINT_DOC_PATH), 1,
+                "docs/ENDPOINTS.md is stale - run python -m tools.lint "
+                "--write-endpoint-docs"))
+
+    # Findings on allowlisted endpoints drop here (rule, path) pairs.
+    return [f for f in findings
+            if not allowed(allowlist, f.rule, f.path, "")]
+
+
+def _check_consumer_reads(root, ep, c, cache, findings, ok, why):
+    cat_rel = str(ENDPOINT_CAT_REL)
+    sf = None
+    if c.file.endswith(".cc"):
+        path = root / c.file
+        reads = (cc_consumer_reads(path.read_text(), c.func, c.var)
+                 if path.exists() else [])
+    else:
+        sf, funcs = _load_funcs(root, cache, c.file)
+        func = funcs.get(c.func) if funcs else None
+        if func is None:
+            findings.append(Finding(
+                "endpoint-consumer-stale", cat_rel, 1,
+                f"{ep.server} {ep.path}: consumer {c.file}::{c.func} "
+                f"does not exist"))
+            return
+        reads = consumer_reads(func, c.var)
+    if not reads:
+        findings.append(Finding(
+            "endpoint-consumer-stale", cat_rel, 1,
+            f"{ep.server} {ep.path}: consumer {c.file}::{c.func} "
+            f"var '{c.var}' reads nothing (stale entry?)"))
+        return
+    for key, line in reads:
+        if not ok(key):
+            if sf is not None and sf.allows(line, "endpoint-ghost-read"):
+                continue
+            findings.append(Finding(
+                "endpoint-ghost-read", c.file, line,
+                f"{c.func} reads '{key}' from {ep.server} {ep.path} "
+                f"but {why}"))

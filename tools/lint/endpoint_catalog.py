@@ -1,0 +1,355 @@
+"""The curated endpoint/JSON contract catalog — tools.lint.contracts
+gates the tree against THIS file, and docs/ENDPOINTS.md is generated
+from it (``python -m tools.lint --write-endpoint-docs``).
+
+One ``Endpoint`` per (server, path): which handler serves it, which
+functions assemble its payload (producers), who reads it across process
+boundaries (consumers), and — for static-JSON endpoints — the exact
+flat key universe the payload may carry.  Changing a snapshot key
+WITHOUT updating this catalog (and the docs) fails CI from both sides:
+the producer diff fires ``endpoint-key-undocumented`` /
+``endpoint-key-stale`` and any stranded reader fires
+``endpoint-ghost-read``.
+
+Kinds:
+
+* ``json``    — static JSON shape; ``keys`` is the exact flat universe
+  (nested payload dict keys included, list element dicts too).
+* ``metrics`` — dynamic metric-name keyed JSON (``/metrics.json``);
+  consumer reads are gated against the real emission sites instead,
+  with the histogram-suffix and ``family{label="v"}`` grammar applied.
+* ``prom``    — Prometheus/plain text; no JSON key contract.
+"""
+
+from __future__ import annotations
+
+from collections import namedtuple
+
+# file: repo-relative source ('.cc' files use the native extractor).
+# func: Python qualname (``Cls.meth.<locals>.Handler.do_GET``) or the
+#       C++ qualified name.  var: restrict extraction to dicts flowing
+#       through that local (None = the whole function).  route: scope a
+#       multiplexed handler's keys to one dispatch branch.
+Producer = namedtuple("Producer", "file func var route",
+                      defaults=(None, None))
+Consumer = namedtuple("Consumer", "file func var")
+Endpoint = namedtuple(
+    "Endpoint", "server path aliases kind producers consumers keys desc",
+    defaults=((), "json", (), (), (), ""))
+
+# Every HTTP server in the tree and the handler(s) whose dispatch tests
+# define its route set (func=None for native files: routes are scanned
+# whole-file).
+SERVERS = {
+    "ingress": (
+        ("tpu_bootstrap/workload/ingress.py",
+         "IngressServer.__init__.<locals>.Handler.do_GET"),
+        ("tpu_bootstrap/workload/ingress.py",
+         "IngressServer.__init__.<locals>.Handler.do_POST"),
+    ),
+    "worker": (
+        ("tpu_bootstrap/telemetry.py",
+         "start_metrics_server.<locals>.Handler.do_GET"),
+    ),
+    "fleetz": (
+        ("tpu_bootstrap/workload/fleetz.py",
+         "FleetAggregator.__init__.<locals>.Handler.do_GET"),
+    ),
+    "controller": (("native/bin/controller.cc", None),),
+    "synchronizer": (("native/bin/synchronizer.cc", None),),
+}
+
+_ING = "tpu_bootstrap/workload/ingress.py"
+_SRV = "tpu_bootstrap/workload/serving.py"
+_TEL = "tpu_bootstrap/telemetry.py"
+_FLZ = "tpu_bootstrap/workload/fleetz.py"
+_ING_GET = "IngressServer.__init__.<locals>.Handler.do_GET"
+_ING_POST = "IngressServer.__init__.<locals>.Handler.do_POST"
+_TEL_GET = "start_metrics_server.<locals>.Handler.do_GET"
+_FLZ_GET = "FleetAggregator.__init__.<locals>.Handler.do_GET"
+
+# Both in-process tracers (Python telemetry.Tracer, native trace.cc)
+# publish the same span document shape — the stitcher depends on it.
+_TRACE_KEYS = ("attrs", "dropped", "dur_us", "name", "parent_id",
+               "process", "span_id", "spans", "start_us", "trace_id")
+_PY_TRACE_PRODUCERS = (Producer(_TEL, "Tracer.to_json"),
+                       Producer(_TEL, "Span.to_dict"))
+_STITCH_CONSUMERS = (Consumer(_FLZ, "stitch", "doc"),)
+
+_ENTRIES = (
+    # ---- ingress (per-replica serving front end) ------------------------
+    Endpoint(
+        "ingress", "/v1/generate", (), "json",
+        producers=(Producer(_ING, _ING_POST, route="/v1/generate"),),
+        consumers=(Consumer("bench.py", "slo_report", "out"),),
+        keys=("Retry-After", "cached_tokens", "deadline_exceeded", "done",
+              "draining", "error", "queue_position", "queued", "timing",
+              "tokens", "trace_id"),
+        desc="Blocking generation API. `Retry-After` is the 429 "
+             "admission-backpressure response's header literal; the "
+             "rest is the completion/queue-position body."),
+    Endpoint(
+        "ingress", "/healthz", ("/health",), "json",
+        producers=(Producer(_ING, _ING_GET, route="/healthz"),),
+        consumers=(Consumer(_FLZ, "FleetAggregator._fold", "hz"),),
+        keys=("active", "draining", "last_error", "ok", "p50_total_ms",
+              "p50_ttft_ms", "queued", "served", "stalled_ms"),
+        desc="Replica liveness + drain state; the fleet poller's "
+             "required scrape (`ok` feeds the healthy count)."),
+    Endpoint(
+        "ingress", "/metrics", (), "prom",
+        desc="Prometheus text exposition of the serving registry."),
+    Endpoint(
+        "ingress", "/metrics.json", (), "metrics",
+        consumers=(Consumer(_FLZ, "FleetAggregator.fleetz_json", "m"),
+                   Consumer("bench.py", "slo_report", "serve_json")),
+        desc="Instant JSON snapshot of the serving metric registry "
+             "(`?window=N` serves the time-series ring). Series names "
+             "carry `{label=\"v\"}` and histogram suffixes."),
+    Endpoint(
+        "ingress", "/requestz", (), "json",
+        producers=(Producer(_ING, _ING_GET, route="/requestz"),
+                   Producer(_SRV, "RequestLog.snapshot"),
+                   Producer(_SRV, "RequestLog._phases_locked", var="out")),
+        consumers=(Consumer("bench.py", "slo_report", "requestz"),),
+        keys=("cached_tokens", "capacity", "deadline", "device_ms",
+              "device_ms_by_kind", "dropped_events", "enabled", "error",
+              "events", "footprint_blocks", "generated", "legs",
+              "phases", "preemptions", "priority", "reason", "requests",
+              "rid", "state", "submit_us", "total_ms", "trace_id"),
+        desc="Per-request lifecycle log: states, preemption legs, "
+             "phase timings, device-time attribution."),
+    Endpoint(
+        "ingress", "/poolz", (), "json",
+        producers=(Producer(_ING, _ING_GET, route="/poolz"),
+                   Producer(_ING, "IngressServer._publish_poolz"),
+                   Producer(_SRV, "_PoolBase.snapshot"),
+                   Producer(_SRV, "_PoolBase._slot_json"),
+                   Producer(_SRV, "PagedPool.snapshot"),
+                   Producer(_SRV, "PagedPool._slot_json"),
+                   Producer(_SRV, "Scheduler.snapshot")),
+        consumers=(Consumer("bench.py", "slo_report", "poolz"),
+                   Consumer(_FLZ, "FleetAggregator.fleetz_json", "pool")),
+        keys=("active", "as_of_us", "available", "batch_size",
+              "block_size", "blocks", "cache_digest", "cached",
+              "cached_tokens", "compactness", "deadline", "engine",
+              "evictions", "expected_new_ema", "free", "free_slots",
+              "generated", "hash_hits", "history_tokens",
+              "imminent_growth_blocks", "ledger", "live", "overcommit",
+              "paged_kernel", "peak_used", "pool", "prefilled",
+              "prefilling", "prefix_cache", "priority", "prompt_len",
+              "queue_depth", "queue_wait_p50_ms", "registered_blocks",
+              "remaining", "resume", "rid", "scheduler", "seq",
+              "shared_blocks", "slot", "slots", "stats", "total",
+              "waiting", "watermark_headroom_blocks"),
+        desc="Engine pool + scheduler snapshot: slots, block-allocator "
+             "gauges, prefix-cache stats, admission queue, the "
+             "busy/idle ledger."),
+    Endpoint(
+        "ingress", "/cachez", (), "json",
+        producers=(Producer(_ING, _ING_GET, route="/cachez"),
+                   Producer(_SRV, "BlockAllocator.digest_json")),
+        consumers=(Consumer(_FLZ, "FleetAggregator.fleetz_json",
+                            "digest"),),
+        keys=("as_of_us", "block_size", "blocks", "digest", "fps",
+              "version"),
+        desc="Prefix-cache content digest (block fingerprints) for "
+             "cross-replica cache comparison."),
+    Endpoint(
+        "ingress", "/traces.json", (), "json",
+        producers=_PY_TRACE_PRODUCERS,
+        consumers=_STITCH_CONSUMERS,
+        keys=_TRACE_KEYS,
+        desc="The replica's span ring buffer; the fleetz stitcher joins "
+             "these across replicas by trace id."),
+    Endpoint(
+        "ingress", "/profilez", (), "json",
+        producers=(Producer(
+                       _ING,
+                       "IngressServer.__init__.<locals>.Handler._profilez"),
+                   Producer(_ING, "IngressServer._profile_tick",
+                            var="result")),
+        keys=("artifact_dir", "busy_frac", "deadline", "dir", "error",
+              "event", "ledger", "measured_ms", "mfu", "mode", "ms",
+              "profiler_error", "requested_ms", "result"),
+        desc="On-demand device-profile capture (POST): arms a "
+             "bounded-duration capture on the engine thread and blocks "
+             "for the result."),
+
+    # ---- worker (bare telemetry server, no ingress) ---------------------
+    Endpoint(
+        "worker", "/metrics", (), "prom",
+        desc="Prometheus text exposition of the worker registry."),
+    Endpoint(
+        "worker", "/metrics.json", (), "metrics",
+        consumers=(Consumer("native/src/reconcile_core.cc",
+                            "workload_summary", "metrics"),),
+        desc="Instant JSON metric snapshot; the controller's workload "
+             "scrape reads progress/throughput series off it."),
+    Endpoint(
+        "worker", "/healthz", ("/health",), "json",
+        producers=(Producer(_TEL, _TEL_GET, route="/healthz"),),
+        consumers=(Consumer(_FLZ, "FleetAggregator._fold", "hz"),),
+        keys=("error", "heartbeat_age_ms", "last_step", "ok",
+              "stalled_ms"),
+        desc="Training-loop heartbeat health: stall detection drives "
+             "`ok`."),
+    Endpoint(
+        "worker", "/statusz", (), "json",
+        producers=(Producer(_TEL, _TEL_GET, route="/statusz"),),
+        keys=("dropped", "error", "heartbeat_age_ms", "last_step",
+              "metrics_series", "process", "spans", "tracer"),
+        desc="Single-page worker debug snapshot (heartbeat + registry "
+             "size + tracer occupancy)."),
+    Endpoint(
+        "worker", "/traces.json", (), "json",
+        producers=_PY_TRACE_PRODUCERS,
+        consumers=_STITCH_CONSUMERS,
+        keys=_TRACE_KEYS,
+        desc="The worker's span ring buffer (same shape as ingress)."),
+
+    # ---- fleetz (fleet aggregator pane) ---------------------------------
+    Endpoint(
+        "fleetz", "/fleetz", (), "json",
+        producers=(Producer(_FLZ, "FleetAggregator.fleetz_json"),
+                   Producer(_FLZ, "SloEngine.evaluate"),
+                   Producer(_FLZ, "SloEngine.alerts"),
+                   Producer(_FLZ, _FLZ_GET, route="/fleetz")),
+        consumers=(Consumer(_FLZ, _FLZ_GET, "snap"),),
+        keys=("alerts", "as_of_us", "backoff_s", "blocks", "burn",
+              "burn_threshold", "busy_frac", "cache_digest", "cached",
+              "digest_blocks", "error", "event", "failures", "firing",
+              "fleet", "health", "healthy", "last_err",
+              "last_ok_age_ms", "live", "mfu", "objectives", "poll_ms",
+              "qps", "queue_depth", "replica", "replicas", "scrape_ms",
+              "scrapes", "serve_qps", "serve_tokens_per_sec",
+              "since_us", "slo", "state", "t_us", "tokens_per_sec",
+              "total", "transitions", "window", "window_secs",
+              "windows", "windows_s"),
+        desc="The merged fleet pane: per-replica health/queue/cache "
+             "columns, fleet rollups, SLO burn rates, firing alerts. "
+             "Per-objective fields under `objectives` come from "
+             "`dataclasses.asdict(SloObjective)` and are not part of "
+             "the static key contract."),
+    Endpoint(
+        "fleetz", "/metrics", (), "prom",
+        desc="Federated Prometheus text: every replica's series "
+             "re-labeled with `replica=\"host:port\"`."),
+    Endpoint(
+        "fleetz", "/metrics.json", (), "metrics",
+        desc="The aggregator's own registry (scrape counters, poll "
+             "latencies, fleet gauges)."),
+    Endpoint(
+        "fleetz", "/traces.json", (), "json",
+        producers=(Producer(_FLZ, "stitch"),
+                   Producer(_FLZ, "stitch_chrome"),
+                   Producer(_FLZ, _FLZ_GET, route="/traces.json")),
+        keys=("args", "attrs", "cat", "displayTimeUnit", "dropped",
+              "dur", "error", "name", "parent_id", "ph", "pid",
+              "process", "replicas", "span_id", "spans", "stitched",
+              "tid", "traceEvents", "trace_id", "traces", "ts"),
+        desc="Cross-replica stitched timeline (`?chrome=1` renders "
+             "Chrome trace-event JSON instead)."),
+    Endpoint(
+        "fleetz", "/healthz", (), "json",
+        producers=(Producer(_FLZ, _FLZ_GET, route="/healthz"),),
+        keys=("error", "healthy", "ok", "replicas"),
+        desc="The aggregator's own liveness + how many replicas it "
+             "currently sees healthy."),
+
+    # ---- controller (native) --------------------------------------------
+    Endpoint(
+        "controller", "/health", (), "prom",
+        desc="Plain-text liveness."),
+    Endpoint(
+        "controller", "/metrics", (), "prom",
+        desc="Prometheus text exposition of the native registry."),
+    Endpoint(
+        "controller", "/metrics.json", (), "metrics",
+        consumers=(Consumer("bench.py", "slo_report", "m"),),
+        desc="Instant JSON snapshot of the native metric registry "
+             "(reconcile latencies, workqueue depth, scrape "
+             "counters)."),
+    Endpoint(
+        "controller", "/statusz", (), "json",
+        producers=(Producer("native/src/statusz.cc", "Statusz::to_json"),),
+        consumers=(Consumer("bench.py", "slo_report", "statusz"),),
+        keys=("evicted_objects", "generated_at_ms", "objects", "process",
+              "ring_capacity", "state", "tracked_objects"),
+        desc="Per-object reconcile state ring (`?object=` filters). "
+             "Object names under `objects` are dynamic."),
+    Endpoint(
+        "controller", "/traces.json", (), "json",
+        producers=(Producer("native/src/trace.cc", "Tracer::to_json"),),
+        keys=_TRACE_KEYS,
+        desc="The native tracer's span ring (same shape as the Python "
+             "tracers — the stitcher depends on it)."),
+
+    # ---- synchronizer (native) ------------------------------------------
+    Endpoint(
+        "synchronizer", "/health", (), "prom",
+        desc="Plain-text liveness."),
+    Endpoint(
+        "synchronizer", "/metrics", (), "prom",
+        desc="Prometheus text exposition of the native registry."),
+    Endpoint(
+        "synchronizer", "/metrics.json", (), "metrics",
+        desc="Instant JSON snapshot of the native metric registry "
+             "(pool capacity gauges, sync/conflict counters)."),
+    Endpoint(
+        "synchronizer", "/statusz", (), "json",
+        producers=(Producer("native/src/statusz.cc", "Statusz::to_json"),),
+        keys=("evicted_objects", "generated_at_ms", "objects", "process",
+              "ring_capacity", "state", "tracked_objects"),
+        desc="Per-object sync state ring."),
+    Endpoint(
+        "synchronizer", "/traces.json", (), "json",
+        producers=(Producer("native/src/trace.cc", "Tracer::to_json"),),
+        keys=_TRACE_KEYS,
+        desc="The native tracer's span ring."),
+)
+
+CATALOG = {(e.server, e.path): e for e in _ENTRIES}
+
+_HEADER = """\
+# HTTP endpoint contracts
+
+GENERATED FILE — do not edit by hand.  Source of truth:
+`tools/lint/endpoint_catalog.py`; regenerate with
+`python -m tools.lint --write-endpoint-docs`.  CI fails when this file
+drifts from the catalog, when a handler serves an undocumented route,
+when a producer's key set diverges from the documented one, or when a
+consumer reads a key no producer emits (`python -m tools.lint --only
+contracts`).
+"""
+
+
+def render() -> str:
+    out = [_HEADER]
+    for server in SERVERS:
+        eps = sorted((e for e in _ENTRIES if e.server == server),
+                     key=lambda e: e.path)
+        if not eps:
+            continue
+        out.append(f"\n## `{server}`\n")
+        for e in eps:
+            alias = "".join(f", `{a}`" for a in e.aliases)
+            out.append(f"\n### `{e.path}`{alias} ({e.kind})\n")
+            if e.desc:
+                out.append(f"\n{e.desc}\n")
+            if e.kind == "json" and e.keys:
+                keyline = ", ".join(f"`{k}`" for k in sorted(e.keys))
+                out.append(f"\nKeys: {keyline}\n")
+            elif e.kind == "metrics":
+                out.append("\nKeys: dynamic — the metric registry's "
+                           "series names (consumer reads are gated "
+                           "against the emission sites).\n")
+            if e.producers:
+                out.append("\nProducers: "
+                           + ", ".join(f"`{p.file}::{p.func}`"
+                                       for p in e.producers) + "\n")
+            if e.consumers:
+                out.append("\nConsumers: "
+                           + ", ".join(f"`{c.file}::{c.func}`"
+                                       for c in e.consumers) + "\n")
+    return "".join(out)

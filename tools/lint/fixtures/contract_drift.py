@@ -1,0 +1,49 @@
+"""Seeded endpoint-contract violations — tests/test_lint.py runs the
+contracts pass over THIS file with an injected catalog and asserts each
+drift class fires:
+
+* ``/itemz``   — the producer renamed ``total`` to ``renamed_total``
+  without updating the catalog: ``endpoint-key-stale`` (the documented
+  ``total``) + ``endpoint-key-undocumented`` (the new name).
+* ``/ghostz``  — served by the handler but absent from the catalog:
+  ``endpoint-undocumented``.
+* ``read_itemz`` — reads ``count`` which no producer emits:
+  ``endpoint-ghost-read``; the ``items`` read is fine.
+* ``read_retired`` — registered consumer whose variable reads nothing:
+  ``endpoint-consumer-stale``.
+
+NOT scanned by the default ``python -m tools.lint`` run (fixtures are
+excluded from python_targets); nothing here executes.
+"""
+
+
+class FixtureServer:
+    def __init__(self):
+        outer = self
+
+        class Handler:
+            def do_GET(self):
+                path = self.path
+                if path == "/itemz":
+                    payload = {
+                        "items": list(outer.items),
+                        "renamed_total": len(outer.items),
+                    }
+                    return payload
+                if path == "/ghostz":
+                    return {"boo": True}
+                return {"error": "not found"}
+
+        self.handler = Handler
+        self.items = []
+
+
+def read_itemz(doc):
+    """Fixture consumer of /itemz."""
+    n = doc.get("count") or 0          # ghost: producer renamed it away
+    return n + len(doc["items"])       # fine: still produced
+
+
+def read_retired(doc):
+    """Registered against var ``payload`` which it never touches."""
+    return doc

@@ -1,0 +1,33 @@
+"""Seeded handler-closure lock violations: a nested Handler class that
+captures ``outer = self`` and touches guarded outer state from request
+threads.  NOT scanned by the default run; tests/test_lint.py pins that
+the closure re-run of the ``locks`` pass catches the bare read."""
+
+import threading
+
+
+class Exporter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.rows: list = []  # guarded-by: _lock
+        outer = self
+
+        class Handler:
+            def do_GET(self):
+                # VIOLATION lock-guard: request-thread read of guarded
+                # outer state without holding outer._lock.
+                return list(outer.rows)
+
+            def do_POST(self):
+                # Clean: append under the outer lock.
+                with outer._lock:
+                    outer.rows.append(1)
+
+            def do_DELETE(self):
+                return len(outer.rows)  # lint: allow(lock-guard) — demo
+
+        self.handler = Handler
+
+    def push(self, row):
+        with self._lock:
+            self.rows.append(row)

@@ -21,6 +21,12 @@ def emit_metrics(reg):
     # Clean: typed exactly once, suffix matches kind.
     reg.inc("fixture_retries_total")
     reg.observe("fixture_wait_ms", 3.0)
+    # VIOLATION metric-label-drift: one family, two label-key sets.
+    reg.inc("fixture_drift_total", labels={"zone": "a"})
+    reg.inc("fixture_drift_total")
+    # Clean: labeled the same way at every site.
+    reg.observe("fixture_label_ok_ms", 1.0, labels={"arm": "x"})
+    reg.observe("fixture_label_ok_ms", 2.0, labels={"arm": "y"})
 
 
 # A miniature bench with an orphan hard key and an ambiguous family

@@ -34,9 +34,16 @@ Checking
   lock is held, resolved through inferred attribute/return types); any
   cycle in the resulting order graph is reported.
 
+* Handler closures: an HTTP handler class nested inside a method and
+  capturing ``outer = self`` runs its methods on the server's request
+  threads — every guarded outer attribute it touches through the alias
+  (``outer._draining``) is checked against ``with outer.<lock>:`` just
+  like a method body, with the same alias resolution
+  (``outer._work`` -> ``Condition(self._lock)`` -> ``_lock``).
+
 Escapes: a trailing ``# lint: allow(lock-guard)`` comment, or an
-allowlist entry.  Nested functions and classes (handler closures) are
-not descended into — they run on other threads with other conventions.
+allowlist entry.  Plain nested functions are still not descended into —
+they may execute inline under the caller's locks.
 """
 
 from __future__ import annotations
@@ -50,10 +57,10 @@ GUARD_RE = re.compile(r"guarded-by:\s*(<[^>]+>|\w+)")
 LOCK_FACTORIES = {"Lock", "RLock"}
 
 
-def _is_self_attr(node: ast.AST) -> str | None:
+def _is_self_attr(node: ast.AST, base: str = "self") -> str | None:
     if (isinstance(node, ast.Attribute)
             and isinstance(node.value, ast.Name)
-            and node.value.id == "self"):
+            and node.value.id == base):
         return node.attr
     return None
 
@@ -154,12 +161,13 @@ def _return_types(files) -> dict:
 
 
 def _receiver_class(call_func: ast.Attribute, cls: ClassInfo,
-                    classes: dict, returns: dict) -> list:
+                    classes: dict, returns: dict,
+                    base: str = "self") -> list:
     """Classes a ``<recv>.method(...)`` call may dispatch to."""
     recv = call_func.value
-    if isinstance(recv, ast.Name) and recv.id == "self":
+    if isinstance(recv, ast.Name) and recv.id == base:
         return [cls.name]
-    attr = _is_self_attr(recv)
+    attr = _is_self_attr(recv, base)
     if attr is not None:
         return sorted(t for t in cls.attr_types.get(attr, ())
                       if t in classes)
@@ -215,11 +223,14 @@ class _MethodChecker(ast.NodeVisitor):
     """Walks one method body tracking the lexically-held lock set."""
 
     def __init__(self, pass_ctx, cls: ClassInfo, meth: ast.FunctionDef,
-                 held: frozenset):
+                 held: frozenset, base: str = "self",
+                 qual: str | None = None):
         self.ctx = pass_ctx
         self.cls = cls
         self.meth = meth
         self.held = set(held)
+        self.base = base            # "self", or the closure alias
+        self.qual = qual or meth.name
 
     # Different execution contexts: do not descend.
     def visit_FunctionDef(self, node):
@@ -237,7 +248,7 @@ class _MethodChecker(ast.NodeVisitor):
     def visit_With(self, node: ast.With):
         acquired = []
         for item in node.items:
-            attr = _is_self_attr(item.context_expr)
+            attr = _is_self_attr(item.context_expr, self.base)
             if attr is None:
                 continue
             lock = self.cls.canonical(attr)
@@ -247,9 +258,9 @@ class _MethodChecker(ast.NodeVisitor):
             if me in self.held:
                 self.ctx.finding(
                     "lock-reacquire", self.cls, item.context_expr.lineno,
-                    f"{self.cls.name}.{self.meth.name} re-enters "
-                    f"self.{lock} it already holds (threading.Lock is "
-                    f"not reentrant)", self.meth.name)
+                    f"{self.cls.name}.{self.qual} re-enters "
+                    f"{self.base}.{lock} it already holds "
+                    f"(threading.Lock is not reentrant)", self.qual)
             for h in self.held:
                 self.ctx.edge(h, me, self.cls, item.context_expr.lineno)
             acquired.append(me)
@@ -262,7 +273,7 @@ class _MethodChecker(ast.NodeVisitor):
             self.held.discard(me)
 
     def visit_Attribute(self, node: ast.Attribute):
-        attr = _is_self_attr(node)
+        attr = _is_self_attr(node, self.base)
         if attr is not None and attr in self.cls.guarded:
             guard = self.cls.guarded[attr]
             if not guard.startswith("<"):
@@ -271,15 +282,15 @@ class _MethodChecker(ast.NodeVisitor):
                     self.ctx.finding(
                         "lock-guard", self.cls, node.lineno,
                         f"{self.cls.name}.{attr} accessed without "
-                        f"holding self.{guard} (guarded-by: {guard})",
-                        self.meth.name)
+                        f"holding {self.base}.{guard} "
+                        f"(guarded-by: {guard})", self.qual)
         self.generic_visit(node)
 
     def visit_Call(self, node: ast.Call):
         if isinstance(node.func, ast.Attribute):
             callee_name = node.func.attr
             # _locked-suffix helpers assume the caller holds the lock.
-            if (_is_self_attr(node.func) is not None
+            if (_is_self_attr(node.func, self.base) is not None
                     and callee_name.endswith("_locked")
                     and callee_name in self.cls.methods):
                 need = {(self.cls.name, lk)
@@ -288,23 +299,24 @@ class _MethodChecker(ast.NodeVisitor):
                     self.ctx.finding(
                         "lock-helper-unheld", self.cls, node.lineno,
                         f"{self.cls.name}.{callee_name} is a caller-"
-                        f"holds helper but {self.meth.name} calls it "
-                        f"without the lock", self.meth.name)
+                        f"holds helper but {self.qual} calls it "
+                        f"without the lock", self.qual)
             if self.held:
                 for tgt in _receiver_class(node.func, self.cls,
                                            self.ctx.classes,
-                                           self.ctx.returns):
+                                           self.ctx.returns,
+                                           self.base):
                     summary = self.ctx.summaries.get(
                         (tgt, callee_name), set())
                     for lk in summary:
                         if lk in self.held:
                             self.ctx.finding(
                                 "lock-reacquire", self.cls, node.lineno,
-                                f"{self.cls.name}.{self.meth.name} holds "
+                                f"{self.cls.name}.{self.qual} holds "
                                 f"{lk[0]}.{lk[1]} and calls "
                                 f"{tgt}.{callee_name} which may acquire "
                                 f"it again (self-deadlock)",
-                                self.meth.name)
+                                self.qual)
                         else:
                             for h in self.held:
                                 self.ctx.edge(h, lk, self.cls, node.lineno)
@@ -360,6 +372,33 @@ def _find_cycles(edges: dict) -> list:
     return cycles
 
 
+def _check_closures(ctx, cls: ClassInfo) -> None:
+    """Nested handler classes that capture ``alias = self``: their
+    methods run on the HTTP server's request threads, so every guarded
+    outer attribute reached through the alias needs the outer lock —
+    the checker re-runs per handler method with the alias as base."""
+    for mname, meth in cls.methods.items():
+        aliases = [
+            node.targets[0].id
+            for node in ast.walk(meth)
+            if isinstance(node, ast.Assign) and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"]
+        if not aliases:
+            continue
+        for node in ast.walk(meth):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for sub in node.body:
+                if not isinstance(sub, ast.FunctionDef):
+                    continue
+                for alias in aliases:
+                    qual = (f"{mname}.<locals>.{node.name}.{sub.name}")
+                    _MethodChecker(ctx, cls, sub, frozenset(),
+                                   base=alias, qual=qual).visit(sub)
+
+
 def run(files, allowlist: set | None = None) -> list:
     allowlist = allowlist or set()
     classes = _classes(files)
@@ -376,6 +415,7 @@ def run(files, allowlist: set | None = None) -> list:
             held = (frozenset((cls.name, lk) for lk in locked_names)
                     if mname.endswith("_locked") else frozenset())
             _MethodChecker(ctx, cls, meth, held).visit(meth)
+        _check_closures(ctx, cls)
     for cyc in _find_cycles(ctx.edges):
         pretty = " -> ".join(f"{c}.{lk}" for c, lk in cyc)
         rel, line = ctx.edges.get((cyc[0], cyc[1]), ("", 0))

@@ -1,6 +1,6 @@
 """Registry-drift pass: env vars, bench --check keys, metric names.
 
-Three registries whose silent divergence has already cost this repo
+Four registries whose silent divergence has already cost this repo
 debugging rounds (the stale int8 roofline, the duplicated gauge names):
 
 * ENV VARS — every ``TPUBC_*`` identifier read anywhere (Python and C++
@@ -19,6 +19,13 @@ debugging rounds (the stale int8 roofline, the duplicated gauge names):
   vs gauge), and the ``_total`` suffix must match countership exactly —
   the Prometheus exposition renders types from that suffix, so a gauge
   named ``*_total`` lies to every scraper.
+* METRIC LABELS — every metric family must use one consistent
+  label-key set across all its Python and native call sites (Python
+  ``labels={...}`` kwargs; native ``family{key="..."}`` name literals).
+  A family observed both as ``serve_ttft_ms{priority=...}`` and as a
+  bare ``serve_ttft_ms`` splits one series into two that no dashboard
+  joins back; the deliberate blended+per-class pairs are allowlisted
+  (``metric-label-drift <file>::<family>``).
 """
 
 from __future__ import annotations
@@ -45,10 +52,17 @@ ENV_CODE_GLOBS = (
 # Prose docs checked for stale knob mentions.
 ENV_DOC_GLOBS = ("ARCHITECTURE.md", "README.md", "MIGRATION.md")
 
+# Native emission sites: anchored to the Metrics::instance() receiver so
+# the Json builder's ``out.set("key", ...)`` never reads as a gauge, and
+# multiline (the controller's .observe() calls wrap).  The name literal
+# may carry a concat-label prefix: ``"family{key=\"" + value + "\"}"``.
 NATIVE_METRIC_RE = re.compile(
-    r"\.(inc|observe|set_gauge)\(\s*\"([a-z0-9_]+)\"")
+    r"Metrics::instance\(\)\s*\.\s*(inc|observe|set|set_gauge)"
+    r"\s*\(\s*\"((?:[^\"\\]|\\.)*)\"")
+NATIVE_METRIC_GLOBS = ("native/src/*.cc", "native/bin/*.cc")
 
-_KIND = {"inc": "counter", "observe": "histogram", "set_gauge": "gauge"}
+_KIND = {"inc": "counter", "observe": "histogram", "set_gauge": "gauge",
+         "set": "gauge"}
 
 
 # ---------------------------------------------------------------------------
@@ -261,20 +275,37 @@ def check_bench_keys(bench_path: Path, rel: str = "bench.py") -> list:
 # metric names
 # ---------------------------------------------------------------------------
 
+def _call_labels(node: ast.Call):
+    """frozenset of label keys for a registry call: the ``labels={...}``
+    kwarg's literal keys, empty when absent, None when the kwarg exists
+    but is not a string-keyed dict literal (dynamic — not judged)."""
+    for kw in node.keywords:
+        if kw.arg != "labels":
+            continue
+        if isinstance(kw.value, ast.Dict) and all(
+                isinstance(k, ast.Constant) and isinstance(k.value, str)
+                for k in kw.value.keys):
+            return frozenset(k.value for k in kw.value.keys)
+        return None
+    return frozenset()
+
+
 def _python_metric_sites(files) -> list:
-    """(pattern, is_pattern, kind, rel, line) for registry call sites."""
+    """(pattern, is_pattern, kind, rel, line, labels) per call site."""
     sites = []
     for src in files:
         for node in ast.walk(src.tree):
             if not (isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Attribute)
-                    and node.func.attr in _KIND and node.args):
+                    and node.func.attr in ("inc", "observe", "set_gauge")
+                    and node.args):
                 continue
             arg = node.args[0]
             kind = _KIND[node.func.attr]
+            labels = _call_labels(node)
             if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
                 sites.append((arg.value, False, kind, src.rel,
-                              node.lineno))
+                              node.lineno, labels))
             elif isinstance(arg, ast.JoinedStr):
                 rx = ""
                 for part in arg.values:
@@ -282,17 +313,30 @@ def _python_metric_sites(files) -> list:
                         rx += re.escape(str(part.value))
                     else:
                         rx += r"[A-Za-z0-9_]+"
-                sites.append((rx, True, kind, src.rel, node.lineno))
+                sites.append((rx, True, kind, src.rel, node.lineno,
+                              labels))
     return sites
 
 
 def _native_metric_sites(root: Path) -> list:
+    """(name, is_pattern, kind, rel, line, labels) per native call site;
+    label keys are parsed out of concat-labeled name literals like
+    ``"tpubc_scrape_backoff_seconds{replica=\\""``."""
     sites = []
-    for path in sorted(root.glob("native/src/*.cc")):
-        for i, line in enumerate(path.read_text().splitlines(), 1):
-            for m in NATIVE_METRIC_RE.finditer(line):
-                sites.append((m.group(2), False, _KIND[m.group(1)],
-                              str(path.relative_to(root)), i))
+    for pattern in NATIVE_METRIC_GLOBS:
+        for path in sorted(root.glob(pattern)):
+            text = path.read_text()
+            rel = str(path.relative_to(root))
+            for m in NATIVE_METRIC_RE.finditer(text):
+                literal = m.group(2)
+                family, _, label_part = literal.partition("{")
+                if not re.fullmatch(r"[a-z0-9_]+", family):
+                    continue
+                labels = frozenset(
+                    re.findall(r"([A-Za-z0-9_]+)=", label_part))
+                line = text.count("\n", 0, m.start()) + 1
+                sites.append((family, False, _KIND[m.group(1)], rel,
+                              line, labels))
     return sites
 
 
@@ -301,7 +345,7 @@ def check_metrics(sites, allowlist: set | None = None) -> list:
     findings: list = []
     concrete: dict = {}   # name -> (kind, rel, line)
     patterns = []
-    for name, is_pat, kind, rel, line in sites:
+    for name, is_pat, kind, rel, line, _labels in sites:
         if is_pat:
             patterns.append((name, kind, rel, line))
             continue
@@ -336,6 +380,44 @@ def check_metrics(sites, allowlist: set | None = None) -> list:
     return findings
 
 
+def check_metric_labels(sites, allowlist: set | None = None) -> list:
+    """One family, one label schema: every concrete call site of a
+    metric family must use the same label-key set.  The deliberate
+    blended-aggregate + per-class pairs carry an allowlist entry
+    (``metric-label-drift <file>::<family>``) so NEW drift still
+    fails."""
+    allowlist = allowlist or set()
+    findings: list = []
+    fams: dict = {}   # family -> {frozenset(label keys): (rel, line)}
+    for name, is_pat, kind, rel, line, labels in sites:
+        if is_pat or labels is None:
+            continue   # dynamic names/labels are not judged
+        fams.setdefault(name, {}).setdefault(labels, (rel, line))
+    for name in sorted(fams):
+        variants = fams[name]
+        if len(variants) <= 1:
+            continue
+        if any(allowed(allowlist, "metric-label-drift", rel, name)
+               for rel, _ in variants.values()):
+            continue
+
+        def fmt(keys):
+            return "{" + ",".join(sorted(keys)) + "}" if keys \
+                else "(unlabeled)"
+
+        where = "; ".join(
+            f"{fmt(keys)} at {rel}:{line}"
+            for keys, (rel, line) in sorted(
+                variants.items(), key=lambda kv: sorted(kv[0])))
+        rel, line = min(variants.values())
+        findings.append(Finding(
+            "metric-label-drift", rel, line,
+            f"metric family {name!r} is recorded with {len(variants)} "
+            f"different label-key sets: {where} — one family, one "
+            f"label schema (allowlist the deliberate blend)"))
+    return findings
+
+
 # ---------------------------------------------------------------------------
 
 def run(root: Path, allowlist: set | None = None, files=None) -> list:
@@ -347,4 +429,5 @@ def run(root: Path, allowlist: set | None = None, files=None) -> list:
         findings += check_bench_keys(bench)
     sites = _python_metric_sites(files) + _native_metric_sites(root)
     findings += check_metrics(sites, allowlist)
+    findings += check_metric_labels(sites, allowlist)
     return findings

@@ -1,0 +1,27 @@
+"""tools.mc -- systematic-interleaving model checker for the serving core.
+
+Runs the REAL ``serving.Scheduler`` + ``serving.BlockAllocator`` (plus
+the real PagedPool host-side admission / preemption / prefix-cache /
+quarantine policy -- see ``harness.MCPool``) through every bounded-depth
+interleaving of the six-action alphabet {submit, step, preempt, crash,
+drain, snap}, asserting the test-pinned invariants (refcount
+conservation, block-partition soundness, busy+idle==wall ledger
+conservation, snapshot coherence, scheduling-independent token streams,
+progress) after every action of every interleaving.
+
+``python -m tools.mc`` explores; ``python -m tools.mc --replay <seed>``
+re-runs one schedule verbosely.  A violating schedule IS its replay
+seed: the checker prints it and exits nonzero.
+"""
+
+from .harness import (  # noqa: F401
+    ACTIONS,
+    InvariantViolation,
+    MCPool,
+    MCSystem,
+    Violation,
+    default_spec,
+    explore,
+    expected_stream,
+    run_schedule,
+)

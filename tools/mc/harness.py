@@ -1,0 +1,679 @@
+"""The systematic-interleaving harness: real Scheduler + real allocator.
+
+The model under check is NOT a mock.  ``MCPool`` subclasses the real
+``PagedPool`` and inherits its entire host-side policy surface verbatim
+— admission capacity math (``admits``/``_reserve_blocks``), prefix-cache
+planning and COW pinning (``_prefix_plan``/``admit``), refcounted block
+registration (``_register_full``), the preemption victim policy
+(``preempt_one``/``_preempt``), lazy overcommit growth
+(``_capacity_fold``), crash quarantine (``quarantine`` →
+``BlockAllocator.quarantine_to_cache``) and retirement
+(``_on_retire``) — all running against a real ``BlockAllocator``.  Only
+the device dispatch is replaced: ``step_round`` advances slots with a
+deterministic token oracle (a pure function of ``(rid, position)``,
+exactly the independence contract the real greedy engine pins), so one
+scheduling round costs microseconds instead of a jit dispatch and the
+explorer can afford tens of thousands of interleavings.
+
+``MCSystem`` wraps one ``Scheduler(MCPool)`` pair and exposes the
+six-action alphabet as atomic transitions at the code's real round
+boundaries:
+
+- ``submit``  — ``Scheduler.submit`` of the next workload request
+- ``step``    — one full ``Scheduler.step`` (shed → admit → round →
+  preempt-drain → ledger fold)
+- ``preempt`` — an external ``pool.preempt_one()`` between rounds (the
+  capacity/priority eviction seam, fired at an adversarial point)
+- ``crash``   — arm ``MCPool`` to raise inside the next round, then
+  step: the failure flows through ``Scheduler.step``'s REAL recovery
+  boundary (``_recover`` → ``quarantine`` → requeue)
+- ``drain``   — graceful drain: quarantine residents, requeue, then
+  ``Scheduler.reset("drain")``
+- ``snap``    — a handler-thread observation: ``Scheduler.snapshot()``
+  + ``pool.snapshot()`` coherence checks
+
+After EVERY action ``check_invariants`` asserts the pinned global
+invariants; a failed one raises ``InvariantViolation`` and the action
+trace so far IS the replay seed (``run_schedule`` re-executes it).
+
+States are rebuilt by replay rather than copied: ``Scheduler`` owns a
+``threading.Lock`` (not deep-copyable), and replay-from-scratch keeps
+the checked object the production class, not a fork of it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+from tpu_bootstrap.workload.model import ModelConfig
+from tpu_bootstrap.workload.serving import (
+    BlockAllocator,
+    PagedPool,
+    Request,
+    Scheduler,
+    _majority_chunk,
+    _bucket_down,
+)
+
+ACTIONS = ("submit", "step", "preempt", "crash", "drain", "snap")
+
+# Params-free config: the oracle never runs the model, but the real
+# Scheduler prices ledger tokens through flops_model(cfg) and the real
+# validate() gates against max_seq_len, so a real config is required.
+_MC_CFG = ModelConfig(vocab_size=32, num_layers=1, num_heads=2, head_dim=8,
+                      embed_dim=16, mlp_dim=32, max_seq_len=64)
+
+
+def _oracle(rid: int, position: int, vocab: int) -> int:
+    """Deterministic next token for ``rid`` at stream ``position`` —
+    the model stand-in. Pure in (rid, position): a preempted row's
+    resume MUST reproduce the same continuation, which is exactly the
+    byte-identical-streams invariant the checker asserts."""
+    return (rid * 1000003 + position * 7919) % vocab
+
+
+class InvariantViolation(AssertionError):
+    def __init__(self, invariant: str, detail: str):
+        super().__init__(f"{invariant}: {detail}")
+        self.invariant = invariant
+        self.detail = detail
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadItem:
+    rid: int
+    tokens: tuple
+    max_new: int
+    priority: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class MCSpec:
+    """One model-checking configuration: the workload plus the pool
+    shape. Small on purpose — state-space size is exponential in all of
+    it."""
+    workload: tuple
+    batch_size: int = 2
+    kv_blocks: int = 5
+    block_size: int = 4
+    prefill_budget: int = 4
+    expected_new: int = 2
+    overcommit: bool = True
+    max_crashes: int = 1
+    bug: str | None = None
+
+
+def default_spec(bug: str | None = None) -> MCSpec:
+    """The checked-in workload: three requests over two slots and five
+    blocks — a shared first block (prefix-cache refcount sharing), a
+    higher-priority late arrival (priority-admission preemption), and a
+    prompt whose plan COWs the shared block (the pin/unpin seam)."""
+    return MCSpec(
+        workload=(
+            WorkloadItem(rid=1, tokens=(1, 2, 3, 4, 5, 6), max_new=3),
+            WorkloadItem(rid=2, tokens=(1, 2, 3, 4, 9, 10), max_new=2,
+                         priority=1),
+            WorkloadItem(rid=3, tokens=(1, 2, 3, 4), max_new=2),
+        ),
+        bug=bug,
+    )
+
+
+def expected_stream(spec: MCSpec, rid: int) -> list:
+    """The one continuation ``rid`` may ever produce, independent of
+    scheduling (admission order, chunking, preemption, crash-resume)."""
+    w = next(w for w in spec.workload if w.rid == rid)
+    return [_oracle(rid, len(w.tokens) + k, _MC_CFG.vocab_size)
+            for k in range(w.max_new)]
+
+
+class MCPool(PagedPool):
+    """PagedPool with the device replaced by the token oracle. Every
+    allocator/cache/preemption/quarantine code path is the inherited
+    real one; only ``__init__`` (no params/arrays), ``step_round`` (no
+    jit dispatch) and ``_record_block_gauges`` (no registry churn per
+    explored state) are overridden.
+
+    ``bug="leak"`` arms the seeded invariant violation the tests and
+    ``--seed-bug`` reproduce: the first retirement drops one table
+    reference before freeing, leaking a live block (refcount 1, no
+    owner) — the refcount-conservation invariant must catch it."""
+
+    def __init__(self, cfg: ModelConfig, batch_size: int, kv_blocks: int,
+                 block_size: int, *, prefill_budget: int = 4,
+                 bug: str | None = None):
+        self.cfg = cfg
+        self.batch_size = batch_size
+        self.block_size = block_size
+        self.kv_quant = False
+        self.eos_id = None
+        self.temperature, self.top_k, self.top_p = 0.0, 0, 1.0
+        self.key = None
+        self.params = None
+        self.draft_params = None
+        self.draft_cfg = None
+        self.gamma = 0
+        self._spec = False
+        self.paged_kernel = False
+        self.prefix_cache = True
+        self.prefill_budget = prefill_budget
+        self.chunk_hint = None
+        self.pools = ()       # no device arrays: quarantine sees "alive"
+        self.dpools = None
+        self.allocator = BlockAllocator(kv_blocks, block_size)
+        self.slots = [None] * batch_size
+        self.preempted = []
+        self.request_cached_tokens = {}
+        self._pre_rr = 0
+        self._kv_bytes_per_tok = 1.0
+        self._prefill_ms_per_tok = None
+        self.stats = {"rounds": 0, "slot_steps": 0, "active_slot_steps": 0,
+                      "preemptions": 0, "grown_blocks": 0, "cow_copies": 0,
+                      "prompt_tokens": 0, "prefix_hit_tokens": 0,
+                      "prefix_hit_requests": 0, "blocks_peak": 0,
+                      "defrags": 0}
+        self.crash_next_round = False
+        self._bug = bug
+        self._bug_armed = bug is not None
+
+    # -- the only mocked seam: token generation -----------------------------
+
+    def step_round(self) -> dict:
+        active = [s for s in self.slots if s is not None]
+        if not active:
+            return {}
+        if self.crash_next_round:
+            # The injected engine failure: raised where the real
+            # pool.device fault site sits (before dispatch, arrays
+            # survive), unwinding into Scheduler.step's recovery path.
+            self.crash_next_round = False
+            raise RuntimeError("mc: injected device failure")
+        self.stats["rounds"] += 1
+        self._mc_prefill_phase()
+        dec = [s for s in self.slots
+               if s is not None and not self._prefilling(s)
+               and s.remaining > 0]
+        chunk = 0
+        if dec:
+            chunk = _majority_chunk(dec, self.cfg.max_seq_len)
+            if any(self._prefilling(s)
+                   for s in self.slots if s is not None):
+                chunk = min(chunk, _bucket_down(self.prefill_budget))
+            if self.chunk_hint is not None:
+                chunk = min(chunk, _bucket_down(max(1, self.chunk_hint)))
+            dec = self._capacity_fold(
+                dec, lambda s: len(s.history) + min(chunk, s.remaining) - 1)
+        if not dec:
+            self._register_phase()
+            self._record_block_gauges()
+            return {}
+        decoding = {id(s) for s in dec}
+        out = _OracleOut(self.slots, decoding, chunk,
+                         self.cfg.vocab_size)
+        self.stats["slot_steps"] += self.batch_size * chunk
+        self.stats["active_slot_steps"] += sum(
+            min(chunk, s.remaining) for s in dec)
+        counts = [chunk if (s is not None and id(s) in decoding) else 0
+                  for s in self.slots]
+        events = self._emit_events(out, 0, counts=counts)
+        self._register_phase()
+        self._record_block_gauges()
+        return events
+
+    def _mc_prefill_phase(self) -> None:
+        # PagedPool._prefill_phase minus the device: same budget, same
+        # round-robin fairness cursor, same ledger attribution.
+        budget = self.prefill_budget
+        pre = [(i, s) for i, s in enumerate(self.slots)
+               if s is not None and self._prefilling(s)]
+        if not pre:
+            return
+        start = self._pre_rr % len(pre)
+        self._pre_rr += 1
+        for _i, s in pre[start:] + pre[:start]:
+            while budget > 0 and self._prefilling(s):
+                w = _bucket_down(
+                    min(s.prompt_len - 1 - s.prefilled, budget))
+                s.prefilled += w
+                s.prefill_chunks += 1
+                budget -= w
+                self._ledger_add(s.rid, "prefill", w)
+
+    def _on_retire(self, i: int, s) -> None:
+        if self._bug_armed and s.blocks:
+            # Seeded violation: one table reference vanishes before the
+            # free — the block stays live in the allocator with nobody
+            # owning it (the classic leaked-decref bug).
+            self._bug_armed = False
+            s.blocks = s.blocks[:-1]
+        super()._on_retire(i, s)
+
+    def _record_block_gauges(self) -> None:
+        # Exploration runs thousands of states: skip the global metric
+        # registry churn, keep the stat the invariants read.
+        self.stats["blocks_peak"] = self.allocator.stats["peak_used"]
+
+
+class _OracleOut:
+    """Duck-typed (B, chunk) round output: out[i, :keep].tolist() is
+    what ``_emit_events`` reads — served lazily from the oracle."""
+
+    def __init__(self, slots, decoding, chunk, vocab):
+        self._rows = {}
+        for i, s in enumerate(slots):
+            if s is not None and id(s) in decoding:
+                self._rows[i] = (s.rid, len(s.history))
+        self._chunk = chunk
+        self._vocab = vocab
+
+    def __getitem__(self, key):
+        i, sl = key
+        rid, base = self._rows[i]
+        toks = [_oracle(rid, base + j, self._vocab)
+                for j in range(self._chunk)][sl]
+        return _TokList(toks)
+
+
+class _TokList(list):
+    def tolist(self):
+        return list(self)
+
+
+class MCSystem:
+    """One explorable execution: real Scheduler over an MCPool, the
+    action alphabet, and the per-action invariant checks."""
+
+    def __init__(self, spec: MCSpec):
+        self.spec = spec
+        self.pool = MCPool(_MC_CFG, spec.batch_size, spec.kv_blocks,
+                           spec.block_size,
+                           prefill_budget=spec.prefill_budget,
+                           bug=spec.bug)
+        self.sched = Scheduler(self.pool, overcommit=spec.overcommit,
+                               expected_new=spec.expected_new,
+                               ema_alpha=0.5)
+        self.requests = [Request(rid=w.rid, tokens=list(w.tokens),
+                                 max_new=w.max_new, priority=w.priority)
+                         for w in spec.workload]
+        self.next_submit = 0
+        self.streams: dict = {}      # rid -> generated tokens at retire
+        self.retired: set = set()
+        self.crashes = 0
+        self.drained = False
+        self.last_action: str | None = None
+        self.trace: list = []
+
+    # -- transitions --------------------------------------------------------
+
+    def enabled(self) -> list:
+        acts = []
+        if self.drained:
+            return ["snap"] if self.last_action != "snap" else []
+        if self.next_submit < len(self.requests):
+            acts.append("submit")
+        busy = (self.sched.pending() or self.pool.has_active()
+                or bool(self.pool.preempted))
+        if busy:
+            acts.append("step")
+        if self.pool.has_active():
+            acts.append("preempt")
+            if self.crashes < self.spec.max_crashes:
+                acts.append("crash")
+        if busy:
+            acts.append("drain")
+        if self.last_action != "snap":
+            # Two consecutive snapshots observe the identical state —
+            # a sound reduction for a read-only action.
+            acts.append("snap")
+        return acts
+
+    def apply(self, name: str) -> None:
+        self.trace.append(name)
+        if name == "submit":
+            self.sched.submit(self.requests[self.next_submit])
+            self.next_submit += 1
+        elif name == "step":
+            self._fold_events(self.sched.step())
+        elif name == "preempt":
+            self.pool.preempt_one()
+        elif name == "crash":
+            self.crashes += 1
+            self.pool.crash_next_round = True
+            self._fold_events(self.sched.step())
+        elif name == "drain":
+            self.drained = True
+            self.sched.requeue(self.pool.quarantine(reason="drain"))
+            self.sched.reset(reason="drain")
+            if self.pool.allocator.used() != 0:
+                raise InvariantViolation(
+                    "drain-leak",
+                    f"{self.pool.allocator.used()} live blocks survived "
+                    "quarantine_to_cache")
+        elif name == "snap":
+            self._check_snapshots()
+        else:
+            raise ValueError(f"unknown action {name!r} "
+                             f"(alphabet: {', '.join(ACTIONS)})")
+        self.last_action = name
+        check_invariants(self)
+
+    def _fold_events(self, events: dict) -> None:
+        for rid, ev in events.items():
+            gen = list(ev["generated"])
+            exp = expected_stream(self.spec, rid)
+            if gen != exp[:len(gen)]:
+                raise InvariantViolation(
+                    "stream-determinism",
+                    f"rid {rid} diverged: got {gen}, expected prefix "
+                    f"of {exp} — a resume replayed different tokens")
+            if ev.get("done"):
+                if rid in self.retired:
+                    raise InvariantViolation(
+                        "stream-once",
+                        f"rid {rid} retired twice — a crash or preempt "
+                        "resurrected a finished stream")
+                self.retired.add(rid)
+                self.streams[rid] = gen
+
+    # -- observations -------------------------------------------------------
+
+    def _check_snapshots(self) -> None:
+        snap = self.sched.snapshot()
+        if snap["queue_depth"] != len(snap["waiting"]):
+            raise InvariantViolation(
+                "snapshot-coherence",
+                f"queue_depth {snap['queue_depth']} != "
+                f"len(waiting) {len(snap['waiting'])}")
+        prios = [w["priority"] for w in snap["waiting"]]
+        if prios != sorted(prios, reverse=True):
+            raise InvariantViolation(
+                "snapshot-coherence",
+                f"waiting not in admission order: priorities {prios}")
+        led = snap["ledger"]
+        if abs(led["busy_ms"] + led["idle_ms"] - led["wall_ms"]) > 5e-3:
+            raise InvariantViolation(
+                "ledger-conservation",
+                f"snapshot ledger: busy {led['busy_ms']} + idle "
+                f"{led['idle_ms']} != wall {led['wall_ms']}")
+        ps = self.pool.snapshot()
+        b = ps["blocks"]
+        if b["live"] + b["cached"] + b["free"] != b["total"]:
+            raise InvariantViolation(
+                "snapshot-coherence",
+                f"blocks live {b['live']} + cached {b['cached']} + free "
+                f"{b['free']} != total {b['total']}")
+        if b["available"] != b["free"] + b["cached"]:
+            raise InvariantViolation(
+                "snapshot-coherence",
+                f"blocks available {b['available']} != free + cached")
+        if ps["active"] != len(ps["slots"]) or (
+                ps["free_slots"] != ps["batch_size"] - ps["active"]):
+            raise InvariantViolation(
+                "snapshot-coherence",
+                f"active {ps['active']} / free_slots {ps['free_slots']} "
+                f"inconsistent with {len(ps['slots'])} slot rows")
+        d = ps["cache_digest"]
+        if d["blocks"] != len(d["fps"]):
+            raise InvariantViolation(
+                "snapshot-coherence",
+                f"cache digest blocks {d['blocks']} != {len(d['fps'])} "
+                "fingerprints")
+
+    def fingerprint(self) -> tuple:
+        """Scheduling-relevant state only (no wall-clock values): equal
+        fingerprints make equal futures, so the explorer may prune."""
+        al = self.pool.allocator
+        with self.sched._lock:
+            waiting = tuple(sorted(
+                (e[2], e[3].rid, e[0], len(e[4] or ()))
+                for e in self.sched._waiting))
+            ema = round(self.sched._ema, 6)
+        return (
+            self.next_submit, self.crashes, self.drained,
+            self.sched._fail_streak, waiting, ema,
+            tuple((s.rid, s.prefilled, len(s.history), s.remaining,
+                   tuple(s.blocks), s.registered, s.n_shared)
+                  if s is not None else None for s in self.pool.slots),
+            tuple(sorted(al._free)),
+            tuple(sorted(al._ref.items())),
+            tuple(al._cached),
+            tuple(sorted(al._index)),
+            tuple((r["request"].rid, len(r["preload"]))
+                  for r in self.pool.preempted),
+            tuple(sorted(self.retired)),
+        )
+
+
+# -- invariants --------------------------------------------------------------
+
+
+def check_invariants(sys_: MCSystem) -> None:
+    al = sys_.pool.allocator
+    free = list(al._free)
+    live = dict(al._ref)
+    cached = list(al._cached)
+    ids = free + list(live) + cached
+    if len(set(ids)) != len(ids):
+        raise InvariantViolation(
+            "block-partition",
+            f"a block sits in two allocator sets: free={sorted(free)} "
+            f"live={sorted(live)} cached={sorted(cached)}")
+    if set(ids) != set(range(1, al.num_blocks + 1)):
+        raise InvariantViolation(
+            "block-partition",
+            f"free+live+cached is not the id space 1..{al.num_blocks}: "
+            f"{sorted(ids)}")
+    # Refcount conservation: block-table references are the ONLY
+    # legitimate owners between actions.
+    refs: dict = {}
+    for s in sys_.pool.slots:
+        if s is None:
+            continue
+        own = list(s.blocks)
+        if len(set(own)) != len(own):
+            raise InvariantViolation(
+                "block-uniqueness",
+                f"rid {s.rid} table holds a duplicate block: {own}")
+        for b in own:
+            refs[b] = refs.get(b, 0) + 1
+    if refs != live:
+        raise InvariantViolation(
+            "refcount-conservation",
+            f"table references {refs} != allocator refcounts {live}")
+    # Index maps stay inverse bijections; cached blocks are exactly the
+    # registered-but-unowned ones.
+    if {al._index[k]: k for k in al._index} != dict(al._key_of.items()):
+        raise InvariantViolation(
+            "cache-index", "_index and _key_of are not inverse maps")
+    for bid in cached:
+        if bid not in al._key_of:
+            raise InvariantViolation(
+                "cache-index", f"cached block {bid} has no content key")
+    # Slot sanity: coverage + monotone budgets.
+    bs = sys_.pool.block_size
+    for s in sys_.pool.slots:
+        if s is None:
+            continue
+        written = (s.prefilled if sys_.pool._prefilling(s)
+                   else len(s.history) - 1)
+        if len(s.blocks) * bs < written:
+            raise InvariantViolation(
+                "block-coverage",
+                f"rid {s.rid}: {len(s.blocks)} blocks cover "
+                f"{len(s.blocks) * bs} positions < {written} written")
+        if s.remaining < 0 or s.registered > len(s.blocks):
+            raise InvariantViolation(
+                "slot-sanity",
+                f"rid {s.rid}: remaining={s.remaining} "
+                f"registered={s.registered} blocks={len(s.blocks)}")
+    # Ledger conservation on the raw (unrounded) ledger.
+    led = sys_.sched.ledger
+    if not math.isclose(led["busy_ms"] + led["idle_ms"], led["wall_ms"],
+                        rel_tol=1e-9, abs_tol=1e-6):
+        raise InvariantViolation(
+            "ledger-conservation",
+            f"busy {led['busy_ms']} + idle {led['idle_ms']} != wall "
+            f"{led['wall_ms']}")
+    attributed = (sum(sys_.sched.device_ms_by_rid.values())
+                  + led["retired_device_ms"])
+    if not math.isclose(attributed, led["attributed_ms"],
+                        rel_tol=1e-9, abs_tol=1e-6):
+        raise InvariantViolation(
+            "ledger-conservation",
+            f"per-rid device ms {attributed} != attributed "
+            f"{led['attributed_ms']}")
+    # Request conservation: one home per rid, and retirement is final.
+    with sys_.sched._lock:
+        queued = [e[3].rid for e in sys_.sched._waiting]
+    resident = [s.rid for s in sys_.pool.slots if s is not None]
+    parked = [r["request"].rid for r in sys_.pool.preempted]
+    homes = queued + resident + parked
+    if len(set(homes)) != len(homes):
+        raise InvariantViolation(
+            "request-conservation",
+            f"a request lives in two places: queued={queued} "
+            f"resident={resident} preempted={parked}")
+    twice = sys_.retired.intersection(homes)
+    if twice:
+        raise InvariantViolation(
+            "request-conservation",
+            f"retired requests re-entered the system: {sorted(twice)}")
+
+
+# -- exploration -------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Violation:
+    schedule: tuple
+    invariant: str
+    detail: str
+
+    def seed(self) -> str:
+        return ",".join(self.schedule)
+
+
+@dataclasses.dataclass
+class ExploreResult:
+    interleavings: int      # complete interleavings fully executed
+    violations: list
+    deduped: int            # subtrees pruned at revisited states
+    actions_applied: int
+    depth: int
+
+
+def _progress_bound(spec: MCSpec) -> int:
+    return 32 + 8 * len(spec.workload) + 2 * sum(
+        w.max_new + len(w.tokens) for w in spec.workload)
+
+
+def _finish(sys_: MCSystem) -> Violation | None:
+    """Close out one complete interleaving: drive the system to
+    quiescence with plain submits/steps (no more adversarial actions)
+    and require every request to retire with its oracle stream — the
+    no-deadlock/no-livelock and scheduling-independence checks. The
+    tail actions append to the trace, so a violation found here still
+    replays from its printed seed."""
+    if sys_.drained:
+        return None  # drained executions legitimately abort streams
+    bound = _progress_bound(sys_.spec)
+    steps = 0
+    while (sys_.next_submit < len(sys_.requests) or sys_.sched.pending()
+           or sys_.pool.has_active() or sys_.pool.preempted):
+        if steps > bound:
+            return Violation(
+                tuple(sys_.trace), "progress",
+                f"not quiescent after {bound} extra steps: "
+                f"queue={sys_.sched.queue_depth()} "
+                f"active={sys_.pool.has_active()}")
+        try:
+            sys_.apply("submit" if sys_.next_submit < len(sys_.requests)
+                       else "step")
+        except InvariantViolation as e:
+            return Violation(tuple(sys_.trace), e.invariant, e.detail)
+        steps += 1
+    for w in sys_.spec.workload:
+        if w.rid not in sys_.retired:
+            return Violation(
+                tuple(sys_.trace), "progress",
+                f"rid {w.rid} never retired (lost request)")
+        if sys_.streams[w.rid] != expected_stream(sys_.spec, w.rid):
+            return Violation(
+                tuple(sys_.trace), "stream-determinism",
+                f"rid {w.rid} final stream {sys_.streams[w.rid]} != "
+                f"{expected_stream(sys_.spec, w.rid)}")
+    return None
+
+
+def run_schedule(schedule, spec: MCSpec,
+                 observer=None) -> tuple:
+    """Execute one action sequence from scratch. Returns
+    (system, Violation | None).  ``observer(sys_, action)`` runs after
+    every successful action (the verbose replay hook)."""
+    sys_ = MCSystem(spec)
+    for a in schedule:
+        try:
+            sys_.apply(a)
+        except InvariantViolation as e:
+            return sys_, Violation(tuple(sys_.trace), e.invariant,
+                                   e.detail)
+        if observer is not None:
+            observer(sys_, a)
+    return sys_, None
+
+
+def explore(spec: MCSpec, *, depth: int = 8,
+            max_interleavings: int | None = None, dedupe: bool = False,
+            stop_at_first: bool = True,
+            progress=None) -> ExploreResult:
+    """Bounded-depth DFS over the enabled-action tree. Each node is
+    rebuilt by replaying its prefix (states are not copyable — see the
+    module docstring); every complete interleaving additionally runs
+    the ``_finish`` progress/determinism checks. With ``dedupe``,
+    subtrees rooted at an already-visited state fingerprint are pruned
+    (counted, not explored)."""
+    stack: list = [()]
+    seen: set = set()
+    count = deduped = applied = 0
+    violations: list = []
+    while stack:
+        prefix = stack.pop()
+        sys_ = MCSystem(spec)
+        bad = None
+        try:
+            for a in prefix:
+                sys_.apply(a)
+                applied += 1
+        except InvariantViolation as e:
+            bad = Violation(tuple(sys_.trace), e.invariant, e.detail)
+        if bad is not None:
+            count += 1
+            violations.append(bad)
+            if stop_at_first:
+                break
+            continue
+        acts = sys_.enabled()
+        if len(prefix) >= depth or not acts:
+            count += 1
+            if progress is not None and count % 1000 == 0:
+                progress(count)
+            v = _finish(sys_)
+            if v is not None:
+                violations.append(v)
+                if stop_at_first:
+                    break
+            if max_interleavings and count >= max_interleavings:
+                break
+            continue
+        if dedupe:
+            fp = (sys_.fingerprint(), depth - len(prefix))
+            if fp in seen:
+                deduped += 1
+                continue
+            seen.add(fp)
+        for a in reversed(acts):
+            stack.append(prefix + (a,))
+    return ExploreResult(interleavings=count, violations=violations,
+                         deduped=deduped, actions_applied=applied,
+                         depth=depth)
