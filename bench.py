@@ -926,6 +926,137 @@ except Exception as e:  # noqa: BLE001
     out["serve_prefix_bench_error"] = f"{type(e).__name__}: {e}"[:400]
 emit()
 
+# Host-memory KV tier (serving.HostBlockPool): the long-tail shape the
+# tier exists for — a working set of DISTINCT multi-block prefixes
+# re-arriving after the HBM cache let them go. Phase A fills the cache,
+# a forced demotion sweep parks every cached block on host, phase B
+# replays the same prompts: every prefix plan is then a host-tier hit
+# served by one batched host->device promotion instead of re-prefill.
+# serve_host_hit_rate (--check HARD alongside the prefix pair) is the
+# fraction of phase-B prompt tokens the tier returned; the
+# restore-vs-recompute p50 pair prices the swap arm against
+# evict-and-recompute on the SAME preempting burst (tier off vs on at
+# equal KV memory) — the measured inequality the per-victim cost-model
+# decision rides on, and serve_effective_cache_blocks is the hittable
+# capacity the DRAM tier adds on top of HBM.
+try:
+    from tpu_bootstrap.workload.serving import (
+        PagedPool as _HtPool,
+        Scheduler as _HtSched,
+    )
+
+    from tpu_bootstrap import telemetry as _httel
+    import numpy as _nph
+
+    _hbs = 16  # same finer-than-default granularity story as overcommit
+
+    def lt_prompts(n=10, seed=37):
+        # Fixed seed, fresh rng per call (the serving comparator rule):
+        # 40 tokens = two FULL 16-token blocks (only whole blocks are
+        # content-addressable) + an 8-token tail that stays cold.
+        rng = _nph.random.default_rng(seed)
+        return [rng.integers(1, dcfg.vocab_size, 40).tolist()
+                for _ in range(n)]
+
+    def _ht_drive(pool, reqs):
+        queue = list(reqs)
+        while queue or pool.has_active():
+            while queue and pool.admits(queue[0]):
+                pool.admit(queue.pop(0))
+            pool.step_round()
+
+    _restore_ms: list = []
+
+    def _time_restores(pool):
+        real = pool._host_restore
+
+        def timed(ids, entries):
+            t0 = time.time()
+            moved = real(ids, entries)
+            _restore_ms.append((time.time() - t0) * 1e3)
+            return moved
+
+        pool._host_restore = timed
+
+    lt_pool = _HtPool(dparams, dcfg, 8, block_size=_hbs, kv_blocks=64,
+                      host_blocks=64)
+    _ht_drive(lt_pool, [Request(rid=i, tokens=p, max_new=8)
+                        for i, p in enumerate(lt_prompts())])
+    lt_pool.demote_lru(lt_pool.allocator.cached())  # the eviction sweep
+    _time_restores(lt_pool)
+    _hh0 = lt_pool.stats.get("host_hit_tokens", 0)
+    _pt0 = lt_pool.stats["prompt_tokens"]
+    _ht_drive(lt_pool, [Request(rid=100 + i, tokens=p, max_new=8)
+                        for i, p in enumerate(lt_prompts())])
+    out.update({
+        "serve_host_hit_rate": round(
+            (lt_pool.stats.get("host_hit_tokens", 0) - _hh0)
+            / max(lt_pool.stats["prompt_tokens"] - _pt0, 1), 4),
+        "serve_effective_cache_blocks":
+            lt_pool.allocator.cached() + len(lt_pool.host),
+    })
+    emit()
+
+    def ht_burst(seed=43):
+        rng = _nph.random.default_rng(seed)
+        return [Request(rid=200 + i,
+                        tokens=rng.integers(1, dcfg.vocab_size,
+                                            8).tolist(),
+                        max_new=24)
+                for i in range(12)]
+
+    def _ht_preempt_run(host_blocks):
+        # Tight pool + low EMA seed: the burst MUST preempt, and every
+        # resume is either a measured promotion transfer (tier on) or a
+        # re-prefill priced at the engine's own observed prefill
+        # throughput (tier off) — the same numbers the engine feeds the
+        # serve_preempt_cost arms.
+        pool = _HtPool(dparams, dcfg, 8, block_size=_hbs, kv_blocks=12,
+                       host_blocks=host_blocks)
+        sched = _HtSched(pool, overcommit=True, expected_new=2)
+        rec_ms: list = []
+        real_admit = pool.admit
+
+        def admit(r, **kw):
+            pre = _httel.metrics().to_json().get(
+                "serve_preempt_recompute_tokens_total", 0)
+            real_admit(r, **kw)
+            d = _httel.metrics().to_json().get(
+                "serve_preempt_recompute_tokens_total", 0) - pre
+            if d and pool._prefill_ms_per_tok is not None:
+                rec_ms.append(d * pool._prefill_ms_per_tok)
+
+        pool.admit = admit
+        if host_blocks:
+            _time_restores(pool)
+        for r in ht_burst():
+            sched.submit(r)
+        while sched.pending() or pool.has_active():
+            sched.step()
+        return pool, rec_ms
+
+    _off_pool, _rec_ms = _ht_preempt_run(0)
+    _on_pool, _ = _ht_preempt_run(64)
+
+    def _ht_p50(xs):
+        xs = sorted(xs)
+        return xs[len(xs) // 2]
+
+    out.update({
+        "serve_preempt_recompute_ms_p50":
+            round(_ht_p50(_rec_ms), 3) if _rec_ms else -1.0,
+        "serve_swap_restore_ms_p50":
+            round(_ht_p50(_restore_ms), 3) if _restore_ms else -1.0,
+        "serve_swap_probe_preempts":
+            _on_pool.stats.get("swap_preempts", 0),
+    })
+    if _rec_ms and _restore_ms:
+        out["serve_swap_restore_speedup"] = round(
+            _ht_p50(_rec_ms) / max(_ht_p50(_restore_ms), 1e-9), 3)
+except Exception as e:  # noqa: BLE001
+    out["serve_host_tier_bench_error"] = f"{type(e).__name__}: {e}"[:400]
+emit()
+
 # Overcommit scheduler (serving.Scheduler): an overcommitted burst —
 # mixed budgets whose WHOLE footprints structurally over-subscribe a
 # tight block pool — through expected-footprint admission vs PR 5's
@@ -1754,8 +1885,14 @@ def check_results(results: dict | None = None, threshold: float = 0.15):
     # tight burst — the ledger drifting idle-heavy, flops-poor, or
     # expensive-per-token is exactly the "who is eating my TPU"
     # regression this plane exists to catch.
+    # ... plus the host-tier pair: the long-tail host hit rate (the
+    # capacity the DRAM tier returns once HBM evicts) and — via the
+    # speedup ratio — swap-restore staying cheaper than the
+    # evict-and-recompute it replaces, the inequality the per-victim
+    # cost model is premised on.
     _HARD_KEYS = ("serve_paged_tokens_per_sec", "serve_ttft_p99_ms",
                   "serve_prefix_hit_rate", "serve_cached_ttft_p50_ms",
+                  "serve_host_hit_rate", "serve_swap_restore_speedup",
                   "serve_admit_ratio", "serve_chaos_goodput_frac",
                   "fleet_digest_match_uplift",
                   "fleet_scrape_staleness_p99_ms",

@@ -12,7 +12,7 @@ never leak KV blocks, and never deadlock, SIGTERM drains with a final
 flips /healthz on a stalled heartbeat and restarts a dead engine thread
 with every in-flight stream completing exactly.
 
-The three ``test_chaos_*`` tests are CI's pinned chaos schedules (the
+The four ``test_chaos_*`` tests are CI's pinned chaos schedules (the
 ``chaos`` job runs them by node id); each dumps its observed timeline to
 ``TPUBC_CHAOS_ARTIFACT`` when that is set so a failing run uploads the
 evidence.
@@ -730,3 +730,58 @@ def test_chaos_sigterm_mid_burst():
         if proc.poll() is None:
             proc.kill()
         proc.stdout.close()
+
+
+@pytest.mark.slow
+def test_chaos_crash_during_swap(monkeypatch):
+    """Pinned schedule #4: a device abort lands while the host KV tier
+    is mid-churn AND a swap transfer itself fails. Crash-is-preemption
+    recovery and the swap.xfer degrade path compose: every stream
+    completes byte-identically, and the tier's byte ledger stays
+    coherent — a failed transfer drops content, it never corrupts it."""
+    monkeypatch.setenv("TPUBC_HOST_XFER_GBPS", "1000")
+    monkeypatch.setenv("TPUBC_KV_HOST_BLOCKS", "64")
+    monkeypatch.setenv("TPUBC_EXPECTED_NEW", "2")
+    srv = IngressServer(TPARAMS, TINY, port=0, batch_size=2, paged=True,
+                        kv_blocks=8, block_size=8,
+                        host="127.0.0.1").start()
+    artifact = {"schedule": "swap.xfer:1:1,pool.device:1:4"}
+    try:
+        assert srv.pool.host is not None
+        with _post(srv.port, {"tokens": [2, 3], "max_new": 2}) as r:
+            [ln for ln in r]
+        jobs = [([3, 5, 7], 30), ([9, 2], 24), ([4, 4, 4, 4], 26)]
+        inj = faults.install(artifact["schedule"])
+        outs = [[] for _ in jobs]
+        threads = [threading.Thread(target=_stream_lines, args=(
+            srv.port, {"tokens": t, "max_new": m}, out))
+            for (t, m), out in zip(jobs, outs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        artifact["streams"] = outs
+        artifact["injector"] = inj.stats()
+        faults.install(None)
+        code, rz = _get_json(srv.port, "/requestz")
+        artifact["requestz"] = rz
+        code, pz = _get_json(srv.port, "/poolz")
+        artifact["poolz_host"] = pz["pool"].get("host")
+        _write_chaos_artifact(artifact)
+        assert inj.stats()["fired"].get("pool.device") == 1
+        assert inj.stats()["fired"].get("swap.xfer") == 1
+        for (tokens, max_new), out in zip(jobs, outs):
+            assert out[-1].get("done") and not out[-1].get("error"), out[-1]
+            got = [t for ln in out for t in ln.get("tokens", [])]
+            assert got == _solo(tokens, max_new), tokens
+        _check_allocator_invariants(srv.pool)
+        host = srv.pool.host
+        assert len(host) <= host.capacity
+        assert host.bytes == sum(
+            e["bytes"] for e in host._entries.values())
+        assert pz["pool"]["host"]["blocks"] == len(host)
+    except BaseException:
+        _write_chaos_artifact(artifact)
+        raise
+    finally:
+        srv.stop()

@@ -1,0 +1,452 @@
+"""The host-DRAM KV tier (serving.HostBlockPool + the hierarchical
+prefix cache): preempt-to-swap byte-identity, host-hit promotion
+exactness, tier-off parity with the pre-tier engine, cost-model arm
+selection under forced bandwidths, allocator churn with demotion /
+promotion / defrag / quarantine, the HBM -> host -> gone eviction
+cascade, and swap.xfer fault degradation.
+
+The exactness spine everywhere: KV is a pure function of (token,
+position) and a device_get/device_put round trip is lossless (int8
+payloads and their scales included), so a swapped-and-restored stream
+must equal the never-preempted one byte for byte — any drift is a
+transfer or table bug, never acceptable noise."""
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_bootstrap.workload import faults
+from tpu_bootstrap.workload.model import ModelConfig, init_params
+from tpu_bootstrap.workload.serving import (
+    HostBlockPool,
+    PagedPool,
+    Request,
+    Scheduler,
+    block_hash,
+    digest_match_len,
+    serve,
+)
+
+TINY = ModelConfig(vocab_size=32, num_layers=1, num_heads=2, head_dim=8,
+                   embed_dim=16, mlp_dim=32, max_seq_len=64)
+TPARAMS = init_params(TINY, jax.random.PRNGKey(1))
+
+CFG = ModelConfig(vocab_size=64, num_layers=2, num_heads=4, head_dim=8,
+                  embed_dim=32, mlp_dim=64, max_seq_len=64)
+PARAMS = init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _requests(n, seed=0, vocab=32, lo_new=8, hi_new=24):
+    """The preempting shape: short varied prompts, generated lengths
+    far past the overcommit reserve — growth forces victims whose
+    histories span full blocks (swappable KV)."""
+    rng = np.random.default_rng(seed)
+    return [Request(rid=i,
+                    tokens=rng.integers(1, vocab,
+                                        int(rng.integers(2, 10))).tolist(),
+                    max_new=int(rng.integers(lo_new, hi_new)))
+            for i in range(n)]
+
+
+def _drive(pool, sched, requests):
+    done = {}
+    for r in requests:
+        sched.submit(r)
+    rounds = 0
+    while sched.pending() or pool.has_active():
+        rounds += 1
+        assert rounds < 5000, "scheduler stopped making progress"
+        for rid, ev in sched.step().items():
+            if ev["done"]:
+                done[rid] = ev["generated"]
+    return done
+
+
+def _drain(pool):
+    got = {}
+    while pool.has_active():
+        for rid, ev in pool.step_round().items():
+            if ev["done"]:
+                got[rid] = ev["generated"]
+    return got
+
+
+def _check_allocator(pool):
+    """The mc partition/index invariants, inline: free + live + cached
+    is exactly the id space, and the content index maps stay inverse."""
+    a = pool.allocator
+    ids = list(a._free) + list(a._ref) + list(a._cached)
+    assert len(set(ids)) == len(ids)
+    assert set(ids) == set(range(1, a.num_blocks + 1))
+    assert {a._index[k]: k for k in a._index} == dict(a._key_of)
+    if pool.host is not None:
+        assert len(pool.host) <= pool.host.capacity
+        assert pool.host.bytes == sum(
+            e["bytes"] for e in pool.host._entries.values())
+
+
+# ---- tier-off parity (the acceptance pin) ---------------------------------
+
+
+def test_tier_off_env_disables_and_matches(monkeypatch):
+    """TPUBC_KV_HOST_BLOCKS=0 must stream byte-identically to the tier
+    never having existed — on a preemption-heavy overcommit shape whose
+    resumes would otherwise promote."""
+    reqs = _requests(8, seed=7)
+    monkeypatch.setenv("TPUBC_EXPECTED_NEW", "2")
+    monkeypatch.setenv("TPUBC_KV_HOST_BLOCKS", "0")
+    s_off: dict = {}
+    off = serve(TPARAMS, TINY, reqs, batch_size=8, paged=True,
+                block_size=8, kv_blocks=8, prefill_budget=4,
+                overcommit=True, stats=s_off)
+    monkeypatch.setenv("TPUBC_KV_HOST_BLOCKS", "64")
+    s_on: dict = {}
+    on = serve(TPARAMS, TINY, reqs, batch_size=8, paged=True,
+               block_size=8, kv_blocks=8, prefill_budget=4,
+               overcommit=True, stats=s_on)
+    assert off == on
+    assert s_off["preemptions"] > 0 and s_on["preemptions"] > 0
+    assert "swap_preempts" not in s_off  # tier off: recompute only
+    # And both equal the never-preempted engine.
+    ref = serve(TPARAMS, TINY, reqs, batch_size=8, paged=True,
+                block_size=8, prefill_budget=8)
+    assert off == ref
+
+
+# ---- swapped-and-restored byte identity -----------------------------------
+
+
+@pytest.mark.parametrize("kv_quant", [False, True])
+def test_swap_restore_streams_byte_identical(kv_quant, monkeypatch):
+    """Force swaps (tiny pool, overcommit, generous link) and pin the
+    streams against the tier-off run: restored KV behaves exactly like
+    KV that never left the device — quantized payloads round-trip their
+    int8 blocks and scales losslessly."""
+    monkeypatch.setenv("TPUBC_HOST_XFER_GBPS", "1000")
+    monkeypatch.setenv("TPUBC_EXPECTED_NEW", "2")
+    reqs = _requests(8, seed=11)
+    swapped: dict = {}
+    monkeypatch.setenv("TPUBC_KV_HOST_BLOCKS", "64")
+    on = serve(TPARAMS, TINY, reqs, batch_size=8, paged=True,
+               block_size=8, kv_blocks=8, prefill_budget=4,
+               overcommit=True, kv_quant=kv_quant, stats=swapped)
+    monkeypatch.setenv("TPUBC_KV_HOST_BLOCKS", "0")
+    off = serve(TPARAMS, TINY, reqs, batch_size=8, paged=True,
+                block_size=8, kv_blocks=8, prefill_budget=4,
+                overcommit=True, kv_quant=kv_quant)
+    assert on == off
+    assert swapped["preemptions"] > 0
+
+
+@pytest.mark.parametrize("temperature,spec_lookup", [(0.9, False),
+                                                     (0.0, True)])
+def test_swap_restore_sampled_and_spec_lookup(temperature, spec_lookup,
+                                              monkeypatch):
+    """Sampled draws key off (rid, stream position) and prompt-lookup
+    drafting reads host history — neither may observe whether a row's
+    KV took a round trip through host memory."""
+    monkeypatch.setenv("TPUBC_HOST_XFER_GBPS", "1000")
+    monkeypatch.setenv("TPUBC_EXPECTED_NEW", "2")
+    key = jax.random.PRNGKey(5) if temperature > 0 else None
+    reqs = _requests(6, seed=13)
+    monkeypatch.setenv("TPUBC_KV_HOST_BLOCKS", "64")
+    on = serve(TPARAMS, TINY, reqs, batch_size=6, paged=True,
+               block_size=8, kv_blocks=8, prefill_budget=4,
+               overcommit=True, temperature=temperature, top_k=8,
+               key=key, spec_lookup=spec_lookup)
+    monkeypatch.setenv("TPUBC_KV_HOST_BLOCKS", "0")
+    off = serve(TPARAMS, TINY, reqs, batch_size=6, paged=True,
+                block_size=8, kv_blocks=8, prefill_budget=4,
+                overcommit=True, temperature=temperature, top_k=8,
+                key=key, spec_lookup=spec_lookup)
+    assert on == off
+
+
+@pytest.mark.slow
+def test_swap_restore_two_layer_quant_matrix(monkeypatch):
+    monkeypatch.setenv("TPUBC_HOST_XFER_GBPS", "1000")
+    monkeypatch.setenv("TPUBC_EXPECTED_NEW", "2")
+    rng = np.random.default_rng(3)
+    sys = rng.integers(1, 64, 24).tolist()
+    reqs = [Request(rid=i, tokens=sys + rng.integers(1, 64, 5).tolist(),
+                    max_new=8) for i in range(8)]
+    for kv_quant in (False, True):
+        monkeypatch.setenv("TPUBC_KV_HOST_BLOCKS", "64")
+        on = serve(PARAMS, CFG, reqs, batch_size=8, paged=True,
+                   block_size=8, kv_blocks=12, prefill_budget=8,
+                   overcommit=True, kv_quant=kv_quant)
+        monkeypatch.setenv("TPUBC_KV_HOST_BLOCKS", "0")
+        off = serve(PARAMS, CFG, reqs, batch_size=8, paged=True,
+                    block_size=8, kv_blocks=12, prefill_budget=8,
+                    overcommit=True, kv_quant=kv_quant)
+        assert on == off, f"kv_quant={kv_quant}"
+
+
+# ---- host-hit promotion == cold exactness ---------------------------------
+
+
+def test_demoted_prefix_promotes_bit_exact(monkeypatch):
+    """Fill the cache, force-demote EVERYTHING to host, then re-admit
+    the same prompt: the plan must be host-tier hits, admission must
+    promote by transfer, and the stream must equal the cold engine's."""
+    monkeypatch.setenv("TPUBC_HOST_XFER_GBPS", "1000")
+    rng = np.random.default_rng(17)
+    prompt = rng.integers(1, 32, 20).tolist()
+    pool = PagedPool(TPARAMS, TINY, 2, block_size=8, kv_blocks=16,
+                     prefill_budget=8, host_blocks=16)
+    pool.admit(Request(rid=1, tokens=prompt, max_new=6))
+    first = _drain(pool)[1]
+    # Everything retired parks in the HBM cached set; push it to host.
+    assert pool.allocator.cached() > 0
+    demoted = pool.demote_lru(pool.allocator.cached())
+    assert demoted > 0 and len(pool.host) > 0
+    assert pool.allocator.cached() == 0  # HBM tier empty now
+    # The hierarchical plan sees host-tier coverage.
+    plan, _cow, _ = pool._prefix_plan(prompt)
+    assert plan and all(tier == "host" for tier, _b, _k in plan)
+    pool.admit(Request(rid=2, tokens=prompt, max_new=6))
+    assert pool.stats.get("host_hit_tokens", 0) > 0
+    assert pool.host.stats["promotions"] > 0
+    second = _drain(pool)[2]
+    assert second == first
+    # Cold oracle: a fresh pool with no cache at all.
+    cold_pool = PagedPool(TPARAMS, TINY, 2, block_size=8, kv_blocks=16,
+                          prefill_budget=8, host_blocks=0)
+    cold_pool.admit(Request(rid=3, tokens=prompt, max_new=6))
+    assert _drain(cold_pool)[3] == first
+    _check_allocator(pool)
+
+
+def test_promoted_block_rejoins_hbm_index(monkeypatch):
+    """A promoted block re-registers under its chain key: the NEXT
+    sharer hits it in HBM (refcount share), not on host again."""
+    monkeypatch.setenv("TPUBC_HOST_XFER_GBPS", "1000")
+    prompt = list(range(1, 17))  # two full blocks at block_size 8
+    pool = PagedPool(TPARAMS, TINY, 3, block_size=8, kv_blocks=16,
+                     prefill_budget=8, host_blocks=16)
+    pool.admit(Request(rid=1, tokens=prompt + [20], max_new=4))
+    _drain(pool)
+    pool.demote_lru(pool.allocator.cached())
+    pool.admit(Request(rid=2, tokens=prompt + [21], max_new=4))
+    swap_ins = pool.host.stats["promotions"]
+    assert swap_ins > 0
+    plan, _cow, _ = pool._prefix_plan(prompt + [22])
+    assert plan and all(tier == "hbm" for tier, _b, _k in plan)
+    pool.admit(Request(rid=3, tokens=prompt + [22], max_new=4))
+    assert pool.host.stats["promotions"] == swap_ins  # no second trip
+    _drain(pool)
+    _check_allocator(pool)
+
+
+# ---- cost model -----------------------------------------------------------
+
+
+def test_arm_selection_under_forced_bandwidths(monkeypatch):
+    pool = PagedPool(TPARAMS, TINY, 2, block_size=8, kv_blocks=16,
+                     prefill_budget=8, host_blocks=16)
+    pool.admit(Request(rid=1, tokens=list(range(1, 18)), max_new=4))
+    s = next(s for s in pool.slots if s is not None)
+    # A fast measured link makes swapping win ...
+    pool._host_gbps_ema = 1e6
+    pool._prefill_ms_per_tok = 0.5
+    arm, swap_ms, recomp_ms = pool._preempt_arm(s)
+    assert arm == "swap" and swap_ms < recomp_ms
+    # ... a glacial one forces recompute ...
+    pool._host_gbps_ema = 1e-9
+    arm, swap_ms, recomp_ms = pool._preempt_arm(s)
+    assert arm == "recompute" and swap_ms > recomp_ms
+    # ... and with no EMA yet, the env seed prices the link.
+    pool._host_gbps_ema = None
+    monkeypatch.setenv("TPUBC_HOST_XFER_GBPS", "1e-9")
+    assert pool._preempt_arm(s)[0] == "recompute"
+    monkeypatch.setenv("TPUBC_HOST_XFER_GBPS", "1e6")
+    assert pool._preempt_arm(s)[0] == "swap"
+    # Tier off: always recompute, regardless of the link price.
+    pool.host = None
+    assert pool._preempt_arm(s)[0] == "recompute"
+
+
+def test_measured_bandwidth_ema_feeds_the_model():
+    pool = PagedPool(TPARAMS, TINY, 2, block_size=8, kv_blocks=8,
+                     host_blocks=8)
+    assert pool._host_gbps_ema is None
+    pool._note_bw(8e9, 1.0)   # 8 GB/s observed
+    assert pool._host_gbps() == pytest.approx(8.0)
+    pool._note_bw(16e9, 1.0)  # EMA blends, not replaces
+    assert pool._host_gbps() == pytest.approx(0.8 * 8.0 + 0.2 * 16.0)
+    pool._note_bw(0, 0.0)     # degenerate samples are ignored
+    assert pool._host_gbps() == pytest.approx(0.8 * 8.0 + 0.2 * 16.0)
+
+
+# ---- churn: demotion/promotion/defrag/quarantine --------------------------
+
+
+def test_allocator_churn_demote_promote_defrag_quarantine(monkeypatch):
+    """Randomized lifecycle churn with every maintenance path thrown
+    in: the allocator partition, index bijection, and host accounting
+    hold after every step, and every stream stays oracle-exact."""
+    monkeypatch.setenv("TPUBC_HOST_XFER_GBPS", "1000")
+    rng = np.random.default_rng(23)
+    sys = rng.integers(1, 32, 16).tolist()
+    pool = PagedPool(TPARAMS, TINY, 4, block_size=8, kv_blocks=12,
+                     prefill_budget=8, host_blocks=10)
+    sched = Scheduler(pool, overcommit=True, expected_new=2)
+    expected: dict = {}
+    got: dict = {}
+    for i in range(10):
+        tail = rng.integers(1, 32, 3).tolist()
+        r = Request(rid=i, tokens=sys + tail, max_new=4)
+        solo = serve(TPARAMS, TINY, [r], batch_size=1, paged=True,
+                     block_size=8)
+        expected[i] = solo[i]
+        sched.submit(r)
+        for _ in range(int(rng.integers(1, 4))):
+            for rid, ev in sched.step().items():
+                if ev["done"]:
+                    got[rid] = ev["generated"]
+            op = rng.integers(0, 4)
+            if op == 0 and pool.allocator.cached():
+                pool.demote_lru(int(rng.integers(1, 3)))
+            elif op == 1:
+                pool.defrag()
+            elif op == 2 and pool.has_active():
+                pool.preempt_one()
+            elif op == 3:
+                sched.requeue(pool.quarantine(reason="drill"))
+            _check_allocator(pool)
+    while sched.pending() or pool.has_active():
+        for rid, ev in sched.step().items():
+            if ev["done"]:
+                got[rid] = ev["generated"]
+        _check_allocator(pool)
+    assert got == expected
+
+
+def test_host_tier_survives_reset_and_rehooks():
+    pool = PagedPool(TPARAMS, TINY, 2, block_size=8, kv_blocks=16,
+                     prefill_budget=8, host_blocks=16)
+    pool.admit(Request(rid=1, tokens=list(range(1, 18)), max_new=4))
+    _drain(pool)
+    pool.demote_lru(pool.allocator.cached())
+    parked = len(pool.host)
+    assert parked > 0
+    pool.reset()
+    # Content is device-independent: the tier keeps its entries and the
+    # REBUILT allocator gets the demotion seam re-installed.
+    assert len(pool.host) == parked
+    assert pool.allocator.evict_hook is not None
+    plan, _cow, _ = pool._prefix_plan(list(range(1, 18)))
+    assert plan and all(tier == "host" for tier, _b, _k in plan)
+
+
+# ---- eviction cascade: HBM -> host -> gone --------------------------------
+
+
+def test_eviction_cascade_order():
+    """The tier chain in isolation: HBM LRU evictions land on host in
+    eviction order, and host's own LRU drops the OLDEST parked key
+    once capacity overflows — two strikes before content is gone."""
+    host = HostBlockPool(2, block_size=8)
+    k1, k2, k3 = (block_hash(b"", [i] * 8) for i in (1, 2, 3))
+    host.put(k1, {"t": None, "d": None, "bytes": 10})
+    host.put(k2, {"t": None, "d": None, "bytes": 20})
+    assert list(host.keys()) == [k1, k2] and host.bytes == 30
+    # Re-parking refreshes recency, no double count.
+    host.put(k1, {"t": None, "d": None, "bytes": 10})
+    assert list(host.keys()) == [k2, k1] and host.bytes == 30
+    host.put(k3, {"t": None, "d": None, "bytes": 5})  # drops k2 (oldest)
+    assert list(host.keys()) == [k1, k3]
+    assert host.bytes == 15 and host.stats["drops"] == 1
+    assert k2 not in host
+    snap = host.snapshot_json()
+    assert snap["blocks"] == 2 and snap["dropped"] == 1
+    d = host.digest_json()
+    assert d["blocks"] == len(d["fps"]) == 2
+
+
+def test_pool_demotion_follows_hbm_lru_order():
+    pool = PagedPool(TPARAMS, TINY, 2, block_size=8, kv_blocks=16,
+                     prefill_budget=8, host_blocks=3)
+    for rid, base in ((1, 0), (2, 40)):
+        pool.admit(Request(
+            rid=rid,
+            tokens=[(base + t) % 31 + 1 for t in range(17)], max_new=4))
+        _drain(pool)
+    lru = [pool.allocator._cached[b] for b in pool.allocator._cached]
+    pool.demote_lru(len(lru))
+    # Host holds the LAST `capacity` demoted keys, in demotion order —
+    # the earliest demotions were themselves LRU-dropped (the cascade).
+    assert list(pool.host.keys()) == lru[-3:]
+    assert pool.host.stats["drops"] == len(lru) - 3
+
+
+# ---- digest: hierarchical routing score -----------------------------------
+
+
+def test_digest_match_len_scores_host_tier(monkeypatch):
+    pool = PagedPool(TPARAMS, TINY, 2, block_size=8, kv_blocks=16,
+                     prefill_budget=8, host_blocks=16)
+    prompt = list(range(1, 18))
+    pool.admit(Request(rid=1, tokens=prompt, max_new=4))
+    _drain(pool)
+    hbm_score = digest_match_len(prompt, pool._cache_digest_json())
+    assert hbm_score == 2
+    pool.demote_lru(pool.allocator.cached())
+    d = pool._cache_digest_json()
+    assert d["blocks"] == 0 and d["host"]["blocks"] > 0
+    # Parked content scores identically: the router may still place
+    # this prefix here — admission promotes instead of recomputing.
+    assert digest_match_len(prompt, d) == hbm_score
+
+
+# ---- swap.xfer fault: degrade, never corrupt ------------------------------
+
+
+def test_swap_xfer_fault_degrades_to_recompute(monkeypatch):
+    """Every transfer failing (demotion, swap-out, AND promotion claim)
+    must leave streams oracle-exact with an intact allocator — the
+    tier silently degrades to the recompute-only engine."""
+    monkeypatch.setenv("TPUBC_HOST_XFER_GBPS", "1000")
+    reqs = _requests(8, seed=29)
+    faults.install(",".join(f"swap.xfer:1:{i}" for i in range(500)))
+    try:
+        broken: dict = {}
+        pool = PagedPool(TPARAMS, TINY, 8, block_size=8, kv_blocks=8,
+                         prefill_budget=4, host_blocks=64)
+        sched = Scheduler(pool, overcommit=True, expected_new=2)
+        got = _drive(pool, sched, reqs)
+        broken.update(pool.stats)
+        assert len(pool.host) == 0  # nothing ever landed on host
+        _check_allocator(pool)
+    finally:
+        faults.install(None)
+    assert broken["preemptions"] > 0
+    off = serve(TPARAMS, TINY, reqs, batch_size=8, paged=True,
+                block_size=8, kv_blocks=8, prefill_budget=4,
+                overcommit=True, prefix_cache=True)
+    assert got == off
+
+
+def test_promotion_claim_fault_truncates_plan(monkeypatch):
+    """A transfer failure at the promotion CLAIM truncates the plan at
+    the failed block — the prefix already claimed still serves, the
+    tail recomputes, and the stream stays exact."""
+    monkeypatch.setenv("TPUBC_HOST_XFER_GBPS", "1000")
+    prompt = list(range(1, 26))  # three full blocks at block_size 8
+    pool = PagedPool(TPARAMS, TINY, 2, block_size=8, kv_blocks=16,
+                     prefill_budget=8, host_blocks=16)
+    pool.admit(Request(rid=1, tokens=prompt, max_new=6))
+    first = _drain(pool)[1]
+    pool.demote_lru(pool.allocator.cached())
+    parked = len(pool.host)
+    assert parked >= 3
+    # Fail the SECOND claim: block 0 promotes, the rest recompute.
+    faults.install("swap.xfer:1:1")
+    try:
+        pool.admit(Request(rid=2, tokens=prompt, max_new=6))
+    finally:
+        faults.install(None)
+    s = next(s for s in pool.slots if s is not None)
+    assert s.prefilled == pool.block_size  # exactly one promoted block
+    assert _drain(pool)[2] == first
+    _check_allocator(pool)

@@ -229,23 +229,64 @@ def test_flops_model_is_the_shared_price_list():
     assert f["decode"] > 2 * f["params"] * 0.5
 
 
-def test_preempt_cost_publishes_both_arms():
+def test_preempt_cost_publishes_both_arms(monkeypatch):
+    # Host tier OFF: every preemption must take the recompute arm and
+    # price the not-taken swap (the pre-tier behavior, parity-pinned).
+    monkeypatch.setenv("TPUBC_KV_HOST_BLOCKS", "0")
+    mj0 = telemetry.metrics().to_json()
+
+    def cnt(snap, arm):
+        return snap.get(f'serve_preempt_cost{{arm="{arm}"}}_count', 0)
+
     reqs = _requests(8, seed=7)
     pool = PagedPool(TPARAMS, TINY, 8, block_size=8, kv_blocks=8,
                      prefill_budget=4)
+    assert pool.host is None
     sched = Scheduler(pool, overcommit=True, expected_new=2)
     _drive(pool, sched, reqs)
     assert pool.stats["preemptions"] > 0
     mj = telemetry.metrics().to_json()
-    # Every preemption prices the modeled swap arm from the victim's
-    # history x kv_bytes_per_token over the host link...
-    swap = mj.get('serve_preempt_cost{arm="swap_est"}')
-    assert swap is not None and swap >= 0
+    # Every preemption prices the modeled swap arm (histogram since the
+    # host tier shipped: a real swap would fill the measured arm=swap
+    # twin instead) from the victim's history x kv_bytes_per_token over
+    # the host link...
+    assert cnt(mj, "swap_est") - cnt(mj0, "swap_est") > 0
+    assert mj['serve_preempt_cost{arm="swap_est"}_p50'] >= 0
     assert kv_bytes_per_token(TINY) > 0
     # ... and each resume prices the measured-recompute arm from the
     # observed prefill throughput.
-    rec = mj.get('serve_preempt_cost{arm="recompute"}')
-    assert rec is not None and rec >= 0
+    assert cnt(mj, "recompute") - cnt(mj0, "recompute") > 0
+    assert mj['serve_preempt_cost{arm="recompute"}_p50'] >= 0
+    # Tier off means NO measured swaps happened in this run.
+    assert cnt(mj, "swap") == cnt(mj0, "swap")
+
+
+def test_preempt_to_swap_measures_the_taken_arm(monkeypatch):
+    # Host tier ON with a generous bandwidth seed: victims swap out,
+    # resumes promote, and the measured arm=swap histogram fills.
+    monkeypatch.setenv("TPUBC_HOST_XFER_GBPS", "1000")
+    mj0 = telemetry.metrics().to_json()
+    reqs = _requests(8, seed=7)
+    pool = PagedPool(TPARAMS, TINY, 8, block_size=8, kv_blocks=8,
+                     prefill_budget=4, host_blocks=64)
+    assert pool.host is not None
+    sched = Scheduler(pool, overcommit=True, expected_new=2)
+    _drive(pool, sched, reqs)
+    assert pool.stats["preemptions"] > 0
+    assert pool.stats.get("swap_preempts", 0) > 0
+    mj = telemetry.metrics().to_json()
+    d = {k: mj.get(k, 0) - mj0.get(k, 0)
+         for k in ('serve_preempt_cost{arm="swap"}_count',
+                   "serve_swap_out_bytes_total",
+                   "serve_swap_in_bytes_total",
+                   "serve_host_hit_tokens_total")}
+    assert d['serve_preempt_cost{arm="swap"}_count'] > 0
+    assert d["serve_swap_out_bytes_total"] > 0
+    # Resumes promoted parked blocks back on-device by transfer.
+    assert d["serve_swap_in_bytes_total"] > 0
+    assert d["serve_host_hit_tokens_total"] > 0
+    # The measured link bandwidth EMA is live once real swaps ran.
+    assert mj.get("serve_swap_bandwidth_gbps", 0) > 0
 
 
 # ---- /profilez ------------------------------------------------------------

@@ -127,34 +127,40 @@ _ENTRIES = (
                    Producer(_SRV, "_PoolBase._slot_json"),
                    Producer(_SRV, "PagedPool.snapshot"),
                    Producer(_SRV, "PagedPool._slot_json"),
+                   Producer(_SRV, "HostBlockPool.snapshot_json"),
                    Producer(_SRV, "Scheduler.snapshot")),
         consumers=(Consumer("bench.py", "slo_report", "poolz"),
                    Consumer(_FLZ, "FleetAggregator.fleetz_json", "pool")),
         keys=("active", "as_of_us", "available", "batch_size",
-              "block_size", "blocks", "cache_digest", "cached",
-              "cached_tokens", "compactness", "deadline", "engine",
-              "evictions", "expected_new_ema", "free", "free_slots",
-              "generated", "hash_hits", "history_tokens",
+              "block_size", "blocks", "bytes", "cache_digest", "cached",
+              "cached_tokens", "capacity", "compactness", "deadline",
+              "dropped", "engine", "evictions", "expected_new_ema",
+              "free", "free_slots", "generated", "hash_hits",
+              "history_tokens", "hit_tokens", "host",
               "imminent_growth_blocks", "ledger", "live", "overcommit",
               "paged_kernel", "peak_used", "pool", "prefilled",
               "prefilling", "prefix_cache", "priority", "prompt_len",
               "queue_depth", "queue_wait_p50_ms", "registered_blocks",
               "remaining", "resume", "rid", "scheduler", "seq",
-              "shared_blocks", "slot", "slots", "stats", "total",
-              "waiting", "watermark_headroom_blocks"),
+              "shared_blocks", "slot", "slots", "stats", "swap_ins",
+              "swap_outs", "total", "waiting",
+              "watermark_headroom_blocks"),
         desc="Engine pool + scheduler snapshot: slots, block-allocator "
-             "gauges, prefix-cache stats, admission queue, the "
-             "busy/idle ledger."),
+             "gauges, prefix-cache stats, host-tier accounting, "
+             "admission queue, the busy/idle ledger."),
     Endpoint(
         "ingress", "/cachez", (), "json",
         producers=(Producer(_ING, _ING_GET, route="/cachez"),
-                   Producer(_SRV, "BlockAllocator.digest_json")),
+                   Producer(_SRV, "BlockAllocator.digest_json"),
+                   Producer(_SRV, "PagedPool._cache_digest_json"),
+                   Producer(_SRV, "HostBlockPool.digest_json")),
         consumers=(Consumer(_FLZ, "FleetAggregator.fleetz_json",
                             "digest"),),
-        keys=("as_of_us", "block_size", "blocks", "digest", "fps",
-              "version"),
-        desc="Prefix-cache content digest (block fingerprints) for "
-             "cross-replica cache comparison."),
+        keys=("as_of_us", "block_size", "blocks", "bytes", "digest",
+              "fps", "host", "version"),
+        desc="Prefix-cache content digest (block fingerprints), "
+             "HBM tier plus parked host tier, for cross-replica cache "
+             "comparison."),
     Endpoint(
         "ingress", "/traces.json", (), "json",
         producers=_PY_TRACE_PRODUCERS,

@@ -96,7 +96,7 @@ CATALOG = {
         "-", "serving",
         "Deterministic fault schedule `site[:prob][:after_n][:seed],...` "
         "(sites: pool.device, alloc, sched.admit, ingress.write, "
-        "ckpt.save, scrape). Unset = zero-overhead no-op."),
+        "ckpt.save, scrape, swap.xfer). Unset = zero-overhead no-op."),
     "TPUBC_DRAIN_TIMEOUT_MS": (
         "5000", "serving",
         "Graceful-drain window: residents finish or checkpoint-preempt "
@@ -107,8 +107,15 @@ CATALOG = {
         "(attribution gauges stop; token streams byte-identical)."),
     "TPUBC_HOST_XFER_GBPS": (
         "16", "serving",
-        "Host<->device transfer GB/s — prices the modeled swap arm of "
-        "`serve_preempt_cost` next to the measured recompute arm."),
+        "Host<->device transfer GB/s — seeds the swap-arm cost model "
+        "until real transfers feed the measured bandwidth EMA "
+        "(`serve_swap_bandwidth_gbps`)."),
+    "TPUBC_KV_HOST_BLOCKS": (
+        "auto", "serving",
+        "Host-DRAM KV tier capacity in blocks: `auto` sizes it at the "
+        "HBM pool's own block count, `0` disables the tier (eviction "
+        "discards and preemption recomputes — the pre-tier behavior, "
+        "byte-identical)."),
     "TPUBC_PROFILEZ": (
         "-", "serving",
         "Enables `POST /profilez` on-demand capture: `1` writes traces "

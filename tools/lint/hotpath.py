@@ -51,6 +51,7 @@ HOT_LOOPS = {
         "ResidentPool.step_round", "ResidentPool._spec_round",
         "PagedPool.step_round", "PagedPool._spec_round",
         "PagedPool._prefill_phase",
+        "PagedPool._host_fetch", "PagedPool._host_restore",
         "Scheduler.step",
     ),
 }

@@ -16,7 +16,7 @@ scheduling round costs microseconds instead of a jit dispatch and the
 explorer can afford tens of thousands of interleavings.
 
 ``MCSystem`` wraps one ``Scheduler(MCPool)`` pair and exposes the
-six-action alphabet as atomic transitions at the code's real round
+seven-action alphabet as atomic transitions at the code's real round
 boundaries:
 
 - ``submit``  — ``Scheduler.submit`` of the next workload request
@@ -27,6 +27,10 @@ boundaries:
 - ``crash``   — arm ``MCPool`` to raise inside the next round, then
   step: the failure flows through ``Scheduler.step``'s REAL recovery
   boundary (``_recover`` → ``quarantine`` → requeue)
+- ``swap``    — force one HBM-cached block through the demotion seam
+  (``pool.demote_lru(1)``): the host-tier eviction path fired at an
+  adversarial point, so promotion/demotion races with admission,
+  preemption, and crash recovery are all explored
 - ``drain``   — graceful drain: quarantine residents, requeue, then
   ``Scheduler.reset("drain")``
 - ``snap``    — a handler-thread observation: ``Scheduler.snapshot()``
@@ -54,9 +58,10 @@ from tpu_bootstrap.workload.serving import (
     Scheduler,
     _majority_chunk,
     _bucket_down,
+    key_fingerprint,
 )
 
-ACTIONS = ("submit", "step", "preempt", "crash", "drain", "snap")
+ACTIONS = ("submit", "step", "preempt", "swap", "crash", "drain", "snap")
 
 # Params-free config: the oracle never runs the model, but the real
 # Scheduler prices ledger tokens through flops_model(cfg) and the real
@@ -101,6 +106,7 @@ class MCSpec:
     expected_new: int = 2
     overcommit: bool = True
     max_crashes: int = 1
+    host_blocks: int = 2
     bug: str | None = None
 
 
@@ -142,7 +148,7 @@ class MCPool(PagedPool):
 
     def __init__(self, cfg: ModelConfig, batch_size: int, kv_blocks: int,
                  block_size: int, *, prefill_budget: int = 4,
-                 bug: str | None = None):
+                 host_blocks: int = 0, bug: str | None = None):
         self.cfg = cfg
         self.batch_size = batch_size
         self.block_size = block_size
@@ -173,6 +179,7 @@ class MCPool(PagedPool):
                       "prompt_tokens": 0, "prefix_hit_tokens": 0,
                       "prefix_hit_requests": 0, "blocks_peak": 0,
                       "defrags": 0}
+        self._host_init(host_blocks)
         self.crash_next_round = False
         self._bug = bug
         self._bug_armed = bug is not None
@@ -254,6 +261,24 @@ class MCPool(PagedPool):
         # registry churn, keep the stat the invariants read.
         self.stats["blocks_peak"] = self.allocator.stats["peak_used"]
 
+    # -- host-tier seams: no device arrays, so transfers are stubs ----------
+
+    def _host_fetch(self, bid: int) -> dict:
+        # Block CONTENT is the oracle's business; only the accounting
+        # shape (one entry, its byte ledger) matters to the invariants.
+        return {"t": None, "d": None,
+                "bytes": self.block_size * self._kv_bytes_per_tok}
+
+    def _host_restore(self, ids: list, entries: list) -> int:
+        return 0
+
+    def _note_bw(self, nbytes: float, secs: float) -> None:
+        # Wall-clock bandwidth would make the swap-vs-recompute arm —
+        # and therefore explored state — nondeterministic across runs;
+        # the env-seeded constant keeps every interleaving's future a
+        # pure function of its fingerprint.
+        return
+
 
 class _OracleOut:
     """Duck-typed (B, chunk) round output: out[i, :keep].tolist() is
@@ -289,6 +314,7 @@ class MCSystem:
         self.pool = MCPool(_MC_CFG, spec.batch_size, spec.kv_blocks,
                            spec.block_size,
                            prefill_budget=spec.prefill_budget,
+                           host_blocks=spec.host_blocks,
                            bug=spec.bug)
         self.sched = Scheduler(self.pool, overcommit=spec.overcommit,
                                expected_new=spec.expected_new,
@@ -320,6 +346,11 @@ class MCSystem:
             acts.append("preempt")
             if self.crashes < self.spec.max_crashes:
                 acts.append("crash")
+        if (self.pool.host is not None
+                and self.pool.allocator.cached() > 0):
+            # Adversarial demotion: evict an HBM-cached block through
+            # the host-tier seam between any two other actions.
+            acts.append("swap")
         if busy:
             acts.append("drain")
         if self.last_action != "snap":
@@ -337,6 +368,8 @@ class MCSystem:
             self._fold_events(self.sched.step())
         elif name == "preempt":
             self.pool.preempt_one()
+        elif name == "swap":
+            self.pool.demote_lru(1)
         elif name == "crash":
             self.crashes += 1
             self.pool.crash_next_round = True
@@ -419,6 +452,23 @@ class MCSystem:
                 "snapshot-coherence",
                 f"cache digest blocks {d['blocks']} != {len(d['fps'])} "
                 "fingerprints")
+        hp = self.pool.host
+        h = ps["host"]
+        if hp is not None:
+            if h["blocks"] != len(hp) or h["bytes"] != hp.bytes:
+                raise InvariantViolation(
+                    "snapshot-coherence",
+                    f"host snapshot blocks/bytes {h['blocks']}/"
+                    f"{h['bytes']} != live tier {len(hp)}/{hp.bytes}")
+            hd = d.get("host")
+            if hd is None or hd["blocks"] != len(hd["fps"]):
+                raise InvariantViolation(
+                    "snapshot-coherence",
+                    f"host digest incoherent: {hd}")
+        elif h["blocks"] or h["capacity"]:
+            raise InvariantViolation(
+                "snapshot-coherence",
+                f"tier-off snapshot advertises host blocks: {h}")
 
     def fingerprint(self) -> tuple:
         """Scheduling-relevant state only (no wall-clock values): equal
@@ -442,6 +492,11 @@ class MCSystem:
             tuple((r["request"].rid, len(r["preload"]))
                   for r in self.pool.preempted),
             tuple(sorted(self.retired)),
+            # Host tier in LRU ORDER: which keys are parked AND their
+            # eviction order both shape future promotions and drops.
+            tuple(key_fingerprint(k)
+                  for k in (self.pool.host.keys()
+                            if self.pool.host is not None else ())),
         )
 
 
@@ -507,6 +562,30 @@ def check_invariants(sys_: MCSystem) -> None:
                 "slot-sanity",
                 f"rid {s.rid}: remaining={s.remaining} "
                 f"registered={s.registered} blocks={len(s.blocks)}")
+    # Host-tier soundness: the tier is bounded, its byte ledger matches
+    # its entries, and every entry is a well-formed serialized block
+    # under a full-strength chain key. The HBM partition check above is
+    # unaffected by the tier — host entries are content COPIES keyed by
+    # chain key, never aliases of an allocator block id, so dual
+    # residency (same key cached on HBM and parked on host) is legal
+    # and the tiers can never disagree about ownership.
+    host = sys_.pool.host
+    if host is not None:
+        if len(host) > host.capacity:
+            raise InvariantViolation(
+                "host-capacity",
+                f"host tier holds {len(host)} blocks > capacity "
+                f"{host.capacity}")
+        total = sum(e["bytes"] for e in host._entries.values())
+        if total != host.bytes:
+            raise InvariantViolation(
+                "host-accounting",
+                f"host byte ledger {host.bytes} != entry sum {total}")
+        for k, e in host._entries.items():
+            if len(k) != 32 or "bytes" not in e:
+                raise InvariantViolation(
+                    "host-entry",
+                    f"malformed host entry under key {k!r}: {e}")
     # Ledger conservation on the raw (unrounded) ledger.
     led = sys_.sched.ledger
     if not math.isclose(led["busy_ms"] + led["idle_ms"], led["wall_ms"],

@@ -46,8 +46,12 @@ FAULT_ENV = "TPUBC_FAULT"
 #   ckpt.save     checkpoint write failure
 #   scrape        the /metrics(.json) seam the controller scrapes (the
 #                 handler answers 500 instead of raising)
+#   swap.xfer     host<->device KV block transfer dying mid-swap
+#                 (demotion, preempt-to-swap, or promotion claim);
+#                 every consumer must DEGRADE to recompute — drop the
+#                 content, never corrupt a table or the allocator
 SITES = ("pool.device", "alloc", "sched.admit", "ingress.write",
-         "ckpt.save", "scrape")
+         "ckpt.save", "scrape", "swap.xfer")
 
 
 class InjectedFault(RuntimeError):

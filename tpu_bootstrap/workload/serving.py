@@ -1473,10 +1473,18 @@ def digest_match_len(tokens, digest) -> int:
     if not isinstance(digest, dict):
         return 0
     bs = int(digest.get("block_size") or 0)
-    fps = digest.get("fps") or ()
-    if bs < 1 or not fps:
+    if bs < 1:
         return 0
-    fpset = set(fps)
+    fpset = set(digest.get("fps") or ())
+    # Hierarchical scoring: a chain key parked on the replica's host
+    # tier is as routable as an HBM-resident one — admission promotes
+    # it back with a transfer instead of recomputing, which is exactly
+    # the work the router is trying to land on the right replica.
+    host = digest.get("host")
+    if isinstance(host, dict):
+        fpset |= set(host.get("fps") or ())
+    if not fpset:
+        return 0
     key = b""
     n = 0
     for j in range(len(tokens) // bs):
@@ -1542,6 +1550,14 @@ class BlockAllocator:
         # way — the digest is observability, not data path).
         self.digest_enabled = _digest_enabled()
         self._digest: set = set()  # guarded-by: <engine-thread>
+        # Demotion seam: called with (bid, key) for every cached block
+        # the eviction pass reclaims, BEFORE the index entry dies and
+        # the id returns to the heap — the block's content is still
+        # intact on device, so the host tier (PagedPool._demote_block)
+        # can serialize it out instead of letting it vanish. None (the
+        # default, and always with the host tier off) keeps eviction
+        # exactly the pre-tier discard.
+        self.evict_hook = None  # guarded-by: <engine-thread>
 
     # ---- accounting -------------------------------------------------------
 
@@ -1583,16 +1599,7 @@ class BlockAllocator:
                 "available first — refusing is the contract, not "
                 "corrupting a live row's blocks)")
         while len(self._free) < n:
-            # Reclaim oldest-cached first: LRU preserves the prefixes
-            # most recently shared/retired, the ones a shared-system-
-            # prompt workload will hit again next.
-            bid, key = self._cached.popitem(last=False)
-            del self._index[key]
-            del self._key_of[bid]
-            if self.digest_enabled:
-                self._digest.discard(key_fingerprint(key))
-            heapq.heappush(self._free, bid)
-            self.stats["evictions"] += 1
+            self.evict_one()
         ids = [heapq.heappop(self._free) for _ in range(n)]
         for i in ids:
             self._ref[i] = 1
@@ -1600,6 +1607,25 @@ class BlockAllocator:
         self.stats["peak_used"] = max(self.stats["peak_used"],
                                       len(self._ref))
         return ids
+
+    def evict_one(self) -> int:
+        """Reclaim the single oldest-cached block: LRU preserves the
+        prefixes most recently shared/retired, the ones a shared-
+        system-prompt workload will hit again next. The ``evict_hook``
+        demotion seam runs while the block's identity (and its on-device
+        content) is still intact; whatever the hook does, the block then
+        leaves the index and returns to the heap. Returns the evicted
+        block id; raises KeyError when nothing is cached."""
+        bid, key = self._cached.popitem(last=False)
+        if self.evict_hook is not None:
+            self.evict_hook(bid, key)
+        del self._index[key]
+        del self._key_of[bid]
+        if self.digest_enabled:
+            self._digest.discard(key_fingerprint(key))
+        heapq.heappush(self._free, bid)
+        self.stats["evictions"] += 1
+        return bid
 
     def incref(self, bid: int) -> None:
         """Add a table reference to a live or cached block (a prefix
@@ -1731,6 +1757,95 @@ class BlockAllocator:
         return len(self._ref) / max(self._ref)
 
 
+class HostBlockPool:
+    """The host-DRAM tier under the paged KV cache: serialized KV
+    blocks (numpy, off-device) keyed by the SAME radix chain keys the
+    allocator's content-hash index uses, so the block lifecycle is
+    hierarchical — HBM CACHED -> host -> gone. Fed by preemption
+    victims (preempt-to-swap, the vLLM paper's second pressure-relief
+    arm) and by prefix-cache LRU evictions (demotion instead of
+    discard); drained by admission promoting host hits back on-device
+    with a transfer (debited like a revival) and by its own LRU when
+    ``capacity`` overflows.
+
+    Entries are pure host state ({"t": per-layer numpy KV, "d": draft
+    pools or None, "bytes": payload size}) — device-independent, which
+    is why the tier survives pool reset()/quarantine() and is the
+    serialized-block seam ROADMAP item 1's cross-replica cache
+    migration needs. Content-addressed means dual residency (same key
+    on HBM and host) is legal and never stale: a chain key names token
+    content, not a storage location."""
+
+    def __init__(self, capacity: int, block_size: int):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity, self.block_size = capacity, block_size
+        self._entries = OrderedDict()  # chain key -> entry, LRU order  # guarded-by: <engine-thread>
+        self.bytes = 0  # guarded-by: <engine-thread>
+        self.stats = {"puts": 0, "drops": 0, "promotions": 0,  # guarded-by: <engine-thread>
+                      "hit_tokens": 0}
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key: bytes) -> bool:
+        return key in self._entries
+
+    def keys(self):
+        """Chain keys in LRU order (oldest first) — deterministic, so
+        the model checker can fold them into its state fingerprint."""
+        return self._entries.keys()
+
+    def put(self, key: bytes, entry: dict) -> None:
+        """Park one serialized block. Re-parking a resident key just
+        refreshes its LRU position (content-addressed — the payloads
+        are identical by construction). Past capacity the OLDEST entry
+        drops: the cascade's final tier is still "gone", it is just two
+        evictions away instead of one."""
+        if key in self._entries:
+            self._entries.move_to_end(key)
+            return
+        self._entries[key] = entry
+        self.bytes += entry["bytes"]
+        self.stats["puts"] += 1
+        while len(self._entries) > self.capacity:
+            _k, dropped = self._entries.popitem(last=False)
+            self.bytes -= dropped["bytes"]
+            self.stats["drops"] += 1
+
+    def get(self, key: bytes):
+        return self._entries.get(key)
+
+    def pop(self, key: bytes) -> dict:
+        """Claim a parked block for promotion back on-device. The entry
+        leaves the tier — the promoted HBM block re-enters the content
+        index under the same key, so the content stays hittable."""
+        entry = self._entries.pop(key)
+        self.bytes -= entry["bytes"]
+        self.stats["promotions"] += 1
+        return entry
+
+    def clear(self) -> None:
+        self._entries.clear()
+        self.bytes = 0
+
+    def snapshot_json(self) -> dict:
+        """The /poolz ``host`` block (round-boundary publish)."""
+        return {"blocks": len(self._entries), "capacity": self.capacity,
+                "bytes": self.bytes,
+                "hit_tokens": self.stats["hit_tokens"],
+                "swap_ins": self.stats["promotions"],
+                "swap_outs": self.stats["puts"],
+                "dropped": self.stats["drops"]}
+
+    def digest_json(self) -> dict:
+        """The /cachez ``host`` tier: fingerprints of every parked
+        chain key, same 64-bit unit as the HBM digest, so
+        ``digest_match_len`` scores hierarchical hits."""
+        return {"blocks": len(self._entries), "bytes": self.bytes,
+                "fps": sorted(key_fingerprint(k) for k in self._entries)}
+
+
 @dataclasses.dataclass
 class _PagedSlot(_Slot):
     prompt_len: int = 0
@@ -1738,11 +1853,13 @@ class _PagedSlot(_Slot):
     prefill_chunks: int = 0
     admit_round: int = 0
     blocks: list = dataclasses.field(default_factory=list)
-    # Prefix-cache bookkeeping: the first n_shared blocks are refcounted
-    # references to the content-hash index (this row never writes them);
-    # registered counts leading blocks whose chain key has been computed
-    # and entered into (or matched against) the index, and chain_key is
-    # that prefix's rolling hash — the parent for the next full block.
+    # Prefix-cache bookkeeping: n_shared counts the refcounted
+    # references into the content-hash index (HBM-tier prefix hits —
+    # this row never writes them; host-tier promotions are privately
+    # owned copies and not counted); registered counts leading blocks
+    # whose chain key has been computed and entered into (or matched
+    # against) the index, and chain_key is that prefix's rolling hash —
+    # the parent for the next full block.
     n_shared: int = 0
     registered: int = 0
     chain_key: bytes = b""
@@ -1880,6 +1997,16 @@ def _copy_block(pools, src, dst):
             for layer in pools]
 
 
+@partial(jax.jit, donate_argnums=(0,))
+def _restore_blocks(pools, ids, payload):
+    """Swap-in scatter (host-tier promotion): row ``ids[i]`` of every
+    pool array takes the i-th stacked block of ``payload`` — one
+    compiled scatter per (batch, dtype) shape restores a whole
+    promotion batch, quantized KV and scales included, bit-exactly."""
+    return [{n: a.at[ids].set(payload[li][n]) for n, a in layer.items()}
+            for li, layer in enumerate(pools)]
+
+
 class PagedPool(_PoolBase):
     """Block-paged continuous batching: ONE shared physical pool of
     fixed-size KV blocks per layer, per-row block tables, and chunked
@@ -1946,7 +2073,8 @@ class PagedPool(_PoolBase):
                  draft_cfg: ModelConfig | None = None, gamma: int = 4,
                  paged_kernel: bool | None = None,
                  prefix_cache: bool | None = None,
-                 spec_lookup: bool | None = None):
+                 spec_lookup: bool | None = None,
+                 host_blocks: int | None = None):
         if spec_lookup is None:
             spec_lookup = os.environ.get(
                 "TPUBC_SPEC_LOOKUP", "").lower() in ("1", "true")
@@ -2060,6 +2188,7 @@ class PagedPool(_PoolBase):
         self._kv_bytes_per_tok = kv_bytes_per_token(cfg, kv_quant) + (
             kv_bytes_per_token(draft_cfg, kv_quant)
             if draft_params is not None else 0)
+        self._host_init(host_blocks)
         self._record_stream_gauges()
         self._record_block_gauges()
 
@@ -2092,31 +2221,228 @@ class PagedPool(_PoolBase):
                else max(1, min(remaining, reserve_new)))
         return -(-(history_len + new + self._over()) // self.block_size)
 
+    # ---- host-DRAM tier ---------------------------------------------------
+
+    def _host_init(self, host_blocks: int | None = None) -> None:
+        """Build (or disable) the host-DRAM KV tier. ``host_blocks``
+        None reads TPUBC_KV_HOST_BLOCKS: unset/"auto" sizes the tier at
+        the HBM pool's own block count (a DRAM:HBM ratio >= 1 is the
+        tier's premise), 0 disables it — with ``self.host`` None every
+        path below short-circuits and the engine behaves byte-
+        identically to the pre-tier code (parity-pinned)."""
+        if host_blocks is None:
+            env = os.environ.get("TPUBC_KV_HOST_BLOCKS", "auto").lower()
+            host_blocks = (self.allocator.num_blocks
+                           if env in ("", "auto") else int(env))
+        if host_blocks < 0:
+            raise ValueError(
+                f"host_blocks must be >= 0, got {host_blocks}")
+        self.host = (HostBlockPool(host_blocks, self.block_size)
+                     if host_blocks and self.prefix_cache else None)
+        # Measured host-link bandwidth (EMA over observed transfers);
+        # None until the first real swap — the cost model then falls
+        # back to the TPUBC_HOST_XFER_GBPS seed.
+        self._host_gbps_ema: float | None = None  # guarded-by: <engine-thread>
+        if self.host is not None:
+            self.allocator.evict_hook = self._demote_block
+
+    def _host_gbps(self) -> float:
+        """Bandwidth the cost model prices transfers with: the measured
+        EMA once real swaps have run, the published seed before."""
+        return self._host_gbps_ema or telemetry.host_xfer_gbps()
+
+    def _note_bw(self, nbytes: float, secs: float) -> None:
+        """Fold one observed host<->device transfer into the bandwidth
+        EMA (same 0.8/0.2 blend as the prefill-throughput EMA) and
+        publish it — the measured side of the swap-vs-recompute
+        decision."""
+        if nbytes <= 0 or secs <= 0:
+            return
+        gbps = nbytes / secs / 1e9
+        self._host_gbps_ema = (
+            gbps if self._host_gbps_ema is None
+            else 0.8 * self._host_gbps_ema + 0.2 * gbps)
+        telemetry.metrics().set_gauge(
+            "serve_swap_bandwidth_gbps", round(self._host_gbps_ema, 4))
+
+    def _host_fetch(self, bid: int) -> dict:
+        """Serialize ONE physical block — every layer, K/V and scales,
+        target and draft pools — to host numpy: the demotion / swap-out
+        transfer. Deliberate device sync (hotpath-allowlisted): this
+        runs only at round boundaries (admission's eviction pass,
+        preemption), never inside a decode dispatch. The swap.xfer
+        fault seam fires BEFORE the device is touched, so an injected
+        transfer failure leaves nothing half-copied."""
+        faults.fire("swap.xfer")
+        t = [{n: np.asarray(jax.device_get(a[bid]))
+              for n, a in layer.items()} for layer in self.pools]
+        d = ([{n: np.asarray(jax.device_get(a[bid]))
+               for n, a in layer.items()} for layer in self.dpools]
+             if self.dpools is not None else None)
+        nbytes = sum(x.nbytes for layer in t + (d or [])
+                     for x in layer.values())
+        return {"t": t, "d": d, "bytes": nbytes}
+
+    def _host_restore(self, ids: list, entries: list) -> int:
+        """Batched host->device restore of promoted blocks: ONE stacked
+        device transfer + compiled scatter per pool, not a put per
+        block. Returns bytes moved. The block_until_ready makes the
+        measured wall time an honest transfer cost (the swap arm's
+        histogram sample), exactly like the draft/verify phase timers.
+        Deliberate sync, round-boundary only (hotpath-allowlisted)."""
+        idx = jnp.asarray(ids, jnp.int32)
+        payload = [{n: jnp.asarray(np.stack([e["t"][li][n]
+                                             for e in entries]))
+                    for n in layer}
+                   for li, layer in enumerate(self.pools)]
+        self.pools = _restore_blocks(self.pools, idx, payload)
+        if self.dpools is not None:
+            dpay = [{n: jnp.asarray(np.stack([e["d"][li][n]
+                                              for e in entries]))
+                     for n in layer}
+                    for li, layer in enumerate(self.dpools)]
+            self.dpools = _restore_blocks(self.dpools, idx, dpay)
+            jax.block_until_ready(self.dpools)
+        jax.block_until_ready(self.pools)
+        return sum(e["bytes"] for e in entries)
+
+    def _demote_block(self, bid: int, key: bytes) -> None:
+        """allocator.evict_hook: a prefix-cache LRU eviction demotes
+        the block to the host tier instead of discarding it (HBM ->
+        host -> gone). Runs inside alloc()'s eviction pass — a round-
+        boundary path (admission / capacity fold), never the decode hot
+        loop. A transfer fault degrades to the pre-tier eviction (the
+        content simply drops); a key already parked on host needs no
+        second copy (content-addressed, never stale)."""
+        if key in self.host:
+            return
+        t0 = time.perf_counter()
+        try:
+            entry = self._host_fetch(bid)
+        except faults.InjectedFault:
+            return
+        self.host.put(key, entry)
+        self._note_bw(entry["bytes"], time.perf_counter() - t0)
+        telemetry.metrics().inc("serve_swap_out_bytes_total",
+                                entry["bytes"])
+
+    def demote_lru(self, n: int = 1) -> int:
+        """Force-demote up to ``n`` oldest-cached HBM blocks through
+        the eviction seam (maintenance, tests, the model checker's
+        ``swap`` action); production demotion rides alloc()'s own
+        eviction pass. Returns the number of blocks evicted."""
+        done = 0
+        while done < n and self.allocator.cached():
+            self.allocator.evict_one()
+            done += 1
+        return done
+
+    def _preempt_arm(self, s) -> tuple:
+        """Per-victim swap-vs-recompute decision: modeled swap cost
+        (the victim's KV bytes over the measured host-link bandwidth,
+        seeded by TPUBC_HOST_XFER_GBPS) against modeled re-prefill cost
+        (history tokens at the measured prefill throughput; the
+        flops_model price at the published peak until a prefill has
+        been timed). Returns (arm, swap_ms, recompute_ms); recompute is
+        forced when the tier is off — both estimates stay priced so the
+        decision is auditable either way."""
+        swap_ms = (len(s.history) * self._kv_bytes_per_tok
+                   / (self._host_gbps() * 1e9) * 1e3)
+        per_tok = self._prefill_ms_per_tok
+        if per_tok is None:
+            per_tok = (flops_model(self.cfg)["prefill"]
+                       / (telemetry.peak_tflops() * 1e12) * 1e3)
+        recomp_ms = max(len(s.history) - 1, 0) * per_tok
+        if self.host is None:
+            return "recompute", swap_ms, recomp_ms
+        return (("swap" if swap_ms < recomp_ms else "recompute"),
+                swap_ms, recomp_ms)
+
+    def _swap_out(self, s) -> None:
+        """Preempt-to-swap: park the victim's REGISTERED full blocks on
+        the host tier so its resume promotes them back by transfer
+        instead of re-prefilling. Walks the radix chain over the
+        victim's history (the same keys _register_full just entered),
+        skips content already parked, and observes the measured
+        ``arm=swap`` preemption cost. An injected transfer failure
+        stops the walk — the blocks parked so far still serve the
+        resume, the rest degrade to recompute; nothing corrupts."""
+        t0 = time.perf_counter()
+        bs = self.block_size
+        moved = blocks_moved = 0
+        key = b""
+        for j in range(s.registered):
+            key = block_hash(key, s.history[j * bs:(j + 1) * bs])
+            if key in self.host:
+                continue
+            try:
+                entry = self._host_fetch(s.blocks[j])
+            except faults.InjectedFault:
+                break
+            self.host.put(key, entry)
+            moved += entry["bytes"]
+            blocks_moved += 1
+        secs = time.perf_counter() - t0
+        if moved:
+            self._note_bw(moved, secs)
+            telemetry.metrics().inc("serve_swap_out_bytes_total", moved)
+        self.stats["swap_preempts"] = (
+            self.stats.get("swap_preempts", 0) + 1)
+        self.stats["swap_out_blocks"] = (
+            self.stats.get("swap_out_blocks", 0) + blocks_moved)
+        telemetry.metrics().observe(
+            "serve_preempt_cost", round(secs * 1e3, 3),
+            labels={"arm": "swap"})
+
+    def _cache_digest_json(self) -> dict:
+        """The /cachez wire dict: the allocator's HBM digest plus the
+        ``host`` tier block when the tier exists (gated with the same
+        digest switch — the host digest is observability, not data
+        path)."""
+        base = self.allocator.digest_json()
+        if self.host is None:
+            return base
+        return {**base,
+                "host": (self.host.digest_json()
+                         if self.allocator.digest_enabled
+                         else {"blocks": 0, "bytes": 0, "fps": []})}
+
     def _prefix_plan(self, tokens: list):
         """Longest cached full-block chain covering ``tokens`` (a
         prompt — or, resuming a preempted row, prompt + generated):
-        returns (shared block ids, cow source id or None, chain key of
-        the shared prefix). Shared blocks must sit strictly below the
+        returns (plan, cow source id or None, chain key of the covered
+        prefix). The plan is HIERARCHICAL: each entry is ("hbm", block
+        id, key) for an HBM-resident hit or ("host", None, key) for
+        content parked on the host tier (admission promotes those back
+        with a transfer — a revival that costs a fresh block). The
+        chain walks through either tier: a host block extends an HBM
+        run and vice versa. Plan blocks must sit strictly below the
         row's first write position (the last token, re-fed at decode) —
-        the one matched block that would contain it is returned as the
-        COW source instead, to be privately copied. Read-only:
-        refcounts move in admit()."""
+        an HBM match that would contain it is returned as the COW
+        source instead, to be privately copied (a host match there is
+        simply ignored: copying through host would cost a round trip
+        for one partial block). Read-only: refcounts and host claims
+        move in admit()."""
         if not self.prefix_cache:
             return [], None, b""
         bs = self.block_size
         prompt_len = len(tokens)
         key = b""
-        hits = []  # (block id, chain key through this block)
+        hits = []  # (tier, block id | None, chain key through this block)
         for j in range(prompt_len // bs):
             key = block_hash(key, tokens[j * bs:(j + 1) * bs])
             bid = self.allocator.lookup(key)
-            if bid is None:
+            if bid is not None:
+                hits.append(("hbm", bid, key))
+            elif self.host is not None and key in self.host:
+                hits.append(("host", None, key))
+            else:
                 break
-            hits.append((bid, key))
         n_sh = min(len(hits), (prompt_len - 1) // bs)
-        cow = hits[n_sh][0] if len(hits) > n_sh else None
-        chain = hits[n_sh - 1][1] if n_sh else b""
-        return [b for b, _ in hits[:n_sh]], cow, chain
+        cow = (hits[n_sh][1]
+               if len(hits) > n_sh and hits[n_sh][0] == "hbm" else None)
+        chain = hits[n_sh - 1][2] if n_sh else b""
+        return hits[:n_sh], cow, chain
 
     def admits(self, r: Request, *, extra_slots: int = 0,
                extra_blocks: int = 0, reserve_new: int | None = None,
@@ -2125,18 +2451,22 @@ class PagedPool(_PoolBase):
             return False
         history = list(r.tokens) + list(preload or [])
         remaining = r.max_new - len(preload or [])
-        shared, cow, _ = self._prefix_plan(history)
-        # Cache-aware capacity math: shared blocks cost nothing fresh,
-        # but a hit on a CACHED block revives it out of the reclaimable
-        # set, so it must be debited from available() alongside the
-        # fresh allocation (the COW source is pinned across the copy —
-        # same debit, conservatively).
-        pinned = sum(1 for b in shared if self.allocator.is_cached(b))
+        plan, cow, _ = self._prefix_plan(history)
+        # Cache-aware capacity math: HBM-shared blocks cost nothing
+        # fresh, but a hit on a CACHED block revives it out of the
+        # reclaimable set, so it must be debited from available()
+        # alongside the fresh allocation (the COW source is pinned
+        # across the copy — same debit, conservatively). Host-tier hits
+        # get NO discount: each consumes a fresh block as its promotion
+        # target — what they save is prefill compute, not HBM.
+        n_hbm = sum(1 for tier, _b, _k in plan if tier == "hbm")
+        pinned = sum(1 for tier, b, _k in plan
+                     if tier == "hbm" and self.allocator.is_cached(b))
         if cow is not None and self.allocator.is_cached(cow):
             pinned += 1
         return (self.allocator.available() - extra_blocks - pinned
                 >= self._reserve_blocks(len(history), remaining,
-                                        reserve_new) - len(shared))
+                                        reserve_new) - n_hbm)
 
     def validate(self, r: Request, cfg: ModelConfig) -> None:
         _PoolBase.validate(r, cfg)
@@ -2174,6 +2504,13 @@ class PagedPool(_PoolBase):
                                            self.allocator.num_blocks + 1,
                                            self.block_size,
                                            quantized=self.kv_quant)
+        if self.host is not None:
+            # The host tier SURVIVES the reset — its serialized content
+            # is device-independent (a chain key names token content,
+            # not an array), so resumed rows promote instead of
+            # recomputing. Only the rebuilt allocator needs the
+            # demotion seam re-installed.
+            self.allocator.evict_hook = self._demote_block
         self._record_block_gauges()
 
     def quarantine(self, reason: str = "crash") -> list:
@@ -2296,6 +2633,9 @@ class PagedPool(_PoolBase):
             "serve_kv_live_bytes",
             self.allocator.used() * self.block_size
             * self._kv_bytes_per_tok)
+        telemetry.metrics().set_gauge(
+            "serve_host_blocks",
+            len(self.host) if self.host is not None else 0)
         self.stats["blocks_peak"] = self.allocator.stats["peak_used"]
 
     # ---- admission --------------------------------------------------------
@@ -2332,7 +2672,25 @@ class PagedPool(_PoolBase):
                 "refusal, not corruption)")
         history = list(r.tokens) + list(preload or [])
         remaining = r.max_new - len(preload or [])
-        shared, cow, chain = self._prefix_plan(history)
+        plan, cow, chain = self._prefix_plan(history)
+        # Claim host-tier payloads FIRST: the swap.xfer fault seam
+        # fires before any refcount or heap mutation, so a transfer
+        # failure truncates the plan at the failed position (the tail
+        # degrades to recompute, the COW above it dies with it) and the
+        # allocator is untouched — degrade, never corrupt.
+        host_pay: dict = {}
+        for pi, (tier, _b, k) in enumerate(plan):
+            if tier != "host":
+                continue
+            try:
+                faults.fire("swap.xfer")
+            except faults.InjectedFault:
+                plan = plan[:pi]
+                cow = None
+                chain = plan[-1][2] if plan else b""
+                break
+            host_pay[pi] = self.host.pop(k)
+        shared = [b for tier, b, _k in plan if tier == "hbm"]
         for b in shared:
             self.allocator.incref(b)
         if cow is not None:
@@ -2343,11 +2701,47 @@ class PagedPool(_PoolBase):
         fresh = self.allocator.alloc(
             self._reserve_blocks(len(history), remaining, reserve_new)
             - len(shared))
-        blocks = list(shared) + fresh
+        # Assemble the table in chain order: HBM hits keep their shared
+        # block, host hits consume fresh blocks as promotion targets,
+        # and the remaining fresh blocks cover the uncovered footprint.
+        blocks = []
+        fi = 0
+        promote = []  # (dest block id, chain key, host payload)
+        for pi, (tier, b, k) in enumerate(plan):
+            if tier == "hbm":
+                blocks.append(b)
+            else:
+                dest = fresh[fi]
+                fi += 1
+                blocks.append(dest)
+                promote.append((dest, k, host_pay[pi]))
+        blocks += fresh[fi:]
         prompt_len = len(history)
-        hit_tokens = len(shared) * self.block_size
+        if promote:
+            t0 = time.perf_counter()
+            moved = self._host_restore([d for d, _k, _e in promote],
+                                       [e for _d, _k, e in promote])
+            secs = time.perf_counter() - t0
+            self._note_bw(moved, secs)
+            reg = telemetry.metrics()
+            reg.observe("serve_swap_restore_ms", round(secs * 1e3, 3))
+            reg.inc("serve_swap_in_bytes_total", moved)
+            reg.inc("serve_host_hit_tokens_total",
+                    len(promote) * self.block_size)
+            self.host.stats["hit_tokens"] += len(promote) * self.block_size
+            self.stats["host_hit_tokens"] = (
+                self.stats.get("host_hit_tokens", 0)
+                + len(promote) * self.block_size)
+            self.stats["swap_in_blocks"] = (
+                self.stats.get("swap_in_blocks", 0) + len(promote))
+            for dest, k, _e in promote:
+                # Promoted blocks re-enter the content-hash index under
+                # their chain keys: LIVE (this row's reference) and
+                # immediately hittable again for the next sharer.
+                self.allocator.register(dest, k)
+        hit_tokens = len(plan) * self.block_size
         if cow is not None:
-            dest = fresh[0]
+            dest = fresh[fi]
             self.pools = _copy_block(self.pools, jnp.int32(cow),
                                      jnp.int32(dest))
             if self.dpools is not None:
@@ -2374,18 +2768,18 @@ class PagedPool(_PoolBase):
             telemetry.metrics().inc(
                 "serve_preempt_recompute_tokens_total", recomp)
             if self._prefill_ms_per_tok is not None:
-                # The measured arm of the swap-vs-recompute decision:
-                # what THIS resume's re-prefill costs at the engine's
-                # observed prefill throughput, published next to the
-                # modeled swap_est the eviction stamped.
-                telemetry.metrics().set_gauge(
+                # The recompute arm, measured: what THIS resume's
+                # re-prefill costs at the engine's observed prefill
+                # throughput — the histogram twin of the swap arm's
+                # measured transfer time.
+                telemetry.metrics().observe(
                     "serve_preempt_cost",
                     round(recomp * self._prefill_ms_per_tok, 3),
                     labels={"arm": "recompute"})
         self._levent(
             r.rid, "resumed" if preload else "admitted",
             blocks=len(blocks), shared_blocks=len(shared),
-            fresh_blocks=len(fresh),
+            fresh_blocks=len(fresh), promoted_blocks=len(promote),
             expected_new=reserve_new, remaining=remaining,
             cached_tokens=hit_tokens, cow=int(cow is not None),
             prompt=prompt_len)
@@ -2398,7 +2792,7 @@ class PagedPool(_PoolBase):
             priority=r.priority, seq=seq, deadline=r.deadline,
             prompt_len=prompt_len, prefilled=hit_tokens,
             admit_round=self.stats["rounds"], blocks=blocks,
-            n_shared=len(shared), registered=len(shared), chain_key=chain,
+            n_shared=len(shared), registered=len(plan), chain_key=chain,
             cached_tokens=hit_tokens)
         self._record_block_gauges()
 
@@ -2415,27 +2809,32 @@ class PagedPool(_PoolBase):
         pure function of (token, position), and sampled draws key off
         (rid, stream position), never scheduling."""
         s = self.slots[i]
-        self._levent(s.rid, "preempted", reason=reason,
+        if self.prefix_cache:
+            self._register_full(s)
+        # Swap-vs-recompute, decided per victim from the measured cost
+        # model: the swap arm parks the victim's registered blocks on
+        # the host tier NOW (resume promotes them back by transfer);
+        # the recompute arm keeps the pre-tier evict-and-recompute and
+        # still prices the not-taken swap (modeled, arm=swap_est) so
+        # the decision stays auditable next to the measured recompute
+        # the resume will observe.
+        arm, swap_ms, _recomp_ms = self._preempt_arm(s)
+        if arm == "swap":
+            self._swap_out(s)
+        else:
+            telemetry.metrics().observe(
+                "serve_preempt_cost", round(swap_ms, 3),
+                labels={"arm": "swap_est"})
+        self._levent(s.rid, "preempted", reason=reason, arm=arm,
                      phase=("prefill" if self._prefilling(s)
                             else "decode"),
                      generated=len(s.generated),
                      blocks_freed=len(s.blocks))
-        if self.prefix_cache:
-            self._register_full(s)
         self.allocator.free(s.blocks)
         s.blocks = []
         self.slots[i] = None
         self.stats["preemptions"] += 1
         telemetry.metrics().inc("serve_preempt_total")
-        # The modeled arm: what swapping this row's KV to host memory
-        # WOULD have cost instead of recomputing it — bytes over the
-        # host-transfer link (TPUBC_HOST_XFER_GBPS). ROADMAP item 2's
-        # host tier consumes both arms to pick per-victim.
-        telemetry.metrics().set_gauge(
-            "serve_preempt_cost",
-            round(len(s.history) * self._kv_bytes_per_tok
-                  / (telemetry.host_xfer_gbps() * 1e9) * 1e3, 3),
-            labels={"arm": "swap_est"})
         prompt = s.history[:len(s.history) - len(s.generated)]
         rec = {"request": Request(rid=s.rid, tokens=prompt,
                                   max_new=len(s.generated) + s.remaining,
@@ -2792,7 +3191,11 @@ class PagedPool(_PoolBase):
                        "compactness": round(a.compactness(), 4)},
             "imminent_growth_blocks": imminent,
             "watermark_headroom_blocks": a.available() - imminent,
-            "cache_digest": a.digest_json(),
+            "cache_digest": self._cache_digest_json(),
+            "host": (self.host.snapshot_json() if self.host is not None
+                     else {"blocks": 0, "capacity": 0, "bytes": 0,
+                           "hit_tokens": 0, "swap_ins": 0,
+                           "swap_outs": 0, "dropped": 0}),
         })
         return snap
 
@@ -3619,8 +4022,8 @@ def static_schedule_slot_steps(requests: list, batch_size: int) -> int:
     return total
 
 
-__all__ = ["BlockAllocator", "PagedPool", "Request", "RequestLog",
-           "RequestRecord", "ResidentPool", "Scheduler", "SlotPool",
-           "block_hash", "device_ledger_enabled", "ngram_lookup_drafts",
-           "request_events_enabled", "serve",
+__all__ = ["BlockAllocator", "HostBlockPool", "PagedPool", "Request",
+           "RequestLog", "RequestRecord", "ResidentPool", "Scheduler",
+           "SlotPool", "block_hash", "device_ledger_enabled",
+           "ngram_lookup_drafts", "request_events_enabled", "serve",
            "static_schedule_slot_steps"]
