@@ -1427,6 +1427,221 @@ except Exception as e:  # noqa: BLE001
     out["fleet_bench_error"] = f"{type(e).__name__}: {e}"[:400]
 emit()
 
+# Fleet router (router ISSUE): the front door's two shipped numbers.
+# fleet_route_hit_uplift is cached prompt tokens served under the
+# router's digest placement over the same burst dealt round-robin —
+# the entire reason cache-aware placement exists, and it must beat 1.0
+# or the router is a load balancer with extra steps.
+# fleet_chaos_goodput_frac is the survivor-fleet goodput after a
+# SIGKILL takes a subprocess replica out mid-burst: every in-flight
+# request must still reach exactly one terminal outcome and the next
+# wave must complete clean — the bounded-goodput-dip contract.
+# fleet_scale_up_reaction_ms and the dip/recovery numbers ride along
+# as soft telemetry.
+try:
+    import json as _json8
+    import signal as _sig8
+    import subprocess as _sub8
+    import threading as _th8
+    import urllib.request as _url8
+
+    from tpu_bootstrap.workload.ingress import IngressServer as _RtIngress
+    from tpu_bootstrap.workload.router import (
+        AutoscaleController as _RtCtl, FleetRouter as _RtRouter)
+
+    def _rt_req(port, body, timeout=300):
+        rq = _url8.Request(
+            f"http://127.0.0.1:{port}/v1/generate",
+            data=_json8.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        if not body.get("stream"):
+            with _url8.urlopen(rq, timeout=timeout) as resp:
+                return _json8.loads(resp.read())
+        with _url8.urlopen(rq, timeout=timeout) as resp:
+            return [_json8.loads(ln) for ln in resp if ln.strip()]
+
+    _rt_a = _RtIngress(dparams, dcfg, port=0, batch_size=4, paged=True,
+                       block_size=16, kv_blocks=64,
+                       host="127.0.0.1").start()
+    _rt_b = _RtIngress(dparams, dcfg, port=0, batch_size=4, paged=True,
+                       block_size=16, kv_blocks=64,
+                       host="127.0.0.1").start()
+    _rt = None
+    try:
+        _rt_prompt = list(range(5, 53))  # 3 full 16-token blocks
+        # Pay both engines' jit, then warm ONLY A with the prefix.
+        _rt_req(_rt_a.port, {"tokens": [2, 3], "max_new": 2,
+                             "stream": False})
+        _rt_req(_rt_b.port, {"tokens": [2, 3], "max_new": 2,
+                             "stream": False})
+        _rt_req(_rt_a.port, {"tokens": _rt_prompt, "max_new": 4,
+                             "stream": False})
+
+        # Round-robin baseline: the same warm-prompt burst dealt
+        # blindly across the pair pays B's cold prefill.
+        _rr_ports = [_rt_a.port, _rt_b.port]
+        _rr_cached = sum(
+            _rt_req(_rr_ports[i % 2],
+                    {"tokens": _rt_prompt, "max_new": 4,
+                     "stream": False}).get("cached_tokens") or 0
+            for i in range(6))
+
+        _rt = _RtRouter([f"127.0.0.1:{_rt_a.port}",
+                         f"127.0.0.1:{_rt_b.port}"],
+                        port=0, host="127.0.0.1", scrape_s=0.1,
+                        stale_s=10.0).start()
+        _rt_t0 = time.time()
+        while time.time() - _rt_t0 < 30:
+            rz = _rt.routerz_json()
+            if all(e["digest_age_ms"] is not None
+                   for e in rz["replicas"].values()):
+                break
+            time.sleep(0.05)
+        _route_cached = sum(
+            _rt_req(_rt.port, {"tokens": _rt_prompt, "max_new": 4,
+                               "stream": False}).get("cached_tokens")
+            or 0 for i in range(6))
+        out.update({
+            "fleet_route_hit_uplift": round(
+                _route_cached / max(_rr_cached, 1), 3),
+            "fleet_route_cached_tokens": _route_cached,
+            "fleet_rr_cached_tokens": _rr_cached,
+        })
+
+        # Scale-up reaction at the bench cadence: canned firing burn
+        # through the real controller tick until the driver is told to
+        # grow the fleet.
+        class _RecDrv:
+            at = None
+
+            def scale_to(self, n):
+                self.at = time.time()
+
+        _rt.autoscaler = _RtCtl(1, 3, up_ticks=2, cooldown_s=0.0)
+        _rt.driver = _drv = _RecDrv()
+        _burn = {"r": {"ttft_p99": {"burn": 9.0, "firing": True,
+                                    "windows": {"300s": 9.0}}}}
+        _sc_t0 = time.time()
+        while _drv.at is None and time.time() - _sc_t0 < 10:
+            _rt.autoscale_once(burn=_burn)
+            time.sleep(0.05)
+        if _drv.at is not None:
+            out["fleet_scale_up_reaction_ms"] = round(
+                (_drv.at - _sc_t0) * 1e3, 1)
+        _rt.driver = _rt.autoscaler = None
+
+        # Kill-a-replica: a SIGKILL-able subprocess victim joins the
+        # fleet (pinned to CPU — it is there to die, not to compute),
+        # a burst straddles the kill, and the next wave must run clean
+        # on the survivor.
+        _victim = _sub8.Popen(
+            [sys.executable, "-c", (
+                "import os\n"
+                "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+                "import jax\n"
+                "from tpu_bootstrap.workload.ingress import "
+                "IngressServer\n"
+                "from tpu_bootstrap.workload.model import "
+                "ModelConfig, init_params\n"
+                "cfg = ModelConfig(vocab_size=32, num_layers=1, "
+                "num_heads=2, head_dim=8, embed_dim=16, mlp_dim=32, "
+                "max_seq_len=64)\n"
+                "srv = IngressServer(init_params(cfg, "
+                "jax.random.PRNGKey(1)), cfg, port=0, batch_size=2, "
+                "paged=True, kv_blocks=24, block_size=8, "
+                "host='127.0.0.1')\n"
+                "srv.serve_forever()\n")],
+            stdout=_sub8.PIPE, stderr=_sub8.STDOUT, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        _v_port = None
+        _v_t0 = time.time()
+        while time.time() - _v_t0 < 240:
+            ln = _victim.stdout.readline()
+            if not ln:
+                break
+            if "ingress: serving on :" in ln:
+                _v_port = int(ln.split(":")[-1].split()[0].rstrip(")"))
+                break
+        if _v_port is None:
+            raise RuntimeError("chaos victim replica never came up")
+        _rt_req(_v_port, {"tokens": [2, 3], "max_new": 2,
+                          "stream": False})  # pay the victim's jit
+        _rt.add_replica(f"127.0.0.1:{_v_port}")
+        while time.time() - _v_t0 < 270:
+            rz = _rt.routerz_json()["replicas"]
+            if rz[f"127.0.0.1:{_v_port}"]["digest_age_ms"] is not None:
+                break
+            time.sleep(0.05)
+
+        def _rt_burst(n, tag):
+            res = [None] * n
+            ts = []
+            for i in range(n):
+                def run(i=i):
+                    try:
+                        res[i] = _rt_req(
+                            _rt.port,
+                            {"tokens": [1, 2, 3 + i % 5],
+                             "max_new": 16, "stream": True,
+                             "request_id": f"bench-{tag}-{i}"})
+                    except Exception as e:  # noqa: BLE001
+                        res[i] = [{"client_error": repr(e)}]
+                ts.append(_th8.Thread(target=run))
+            for t in ts:
+                t.start()
+            return ts, res
+
+        def _clean_frac(res):
+            ok = sum(1 for lines in res
+                     if lines and lines[-1].get("done")
+                     and not lines[-1].get("error"))
+            return ok / max(len(res), 1)
+
+        ts, pre = _rt_burst(6, "pre")
+        for t in ts:
+            t.join(timeout=300)
+        _pre_goodput = _clean_frac(pre)
+
+        ts, mid = _rt_burst(6, "kill")
+        while not any(r and any(ln.get("tokens") for ln in r)
+                      for r in mid if r is not None):
+            time.sleep(0.005)
+        _victim.send_signal(_sig8.SIGKILL)
+        _kill_t = time.time()
+        for t in ts:
+            t.join(timeout=300)
+        # Exactly one terminal outcome each — a dropped socket here is
+        # a contract breach, not a benchmark data point.
+        _no_terminal = sum(
+            1 for lines in mid
+            if not lines or "client_error" in lines[-1]
+            or sum(1 for ln in lines if ln.get("done")) != 1)
+
+        ts, post = _rt_burst(6, "post")
+        for t in ts:
+            t.join(timeout=300)
+        _rec_goodput = _clean_frac(post)
+        out.update({
+            "fleet_chaos_goodput_frac": round(
+                0.0 if _no_terminal else
+                _rec_goodput / max(_pre_goodput, 1e-9), 3),
+            "fleet_chaos_dip_goodput_frac": round(_clean_frac(mid), 3),
+            "fleet_chaos_recovery_window_ms": round(
+                (time.time() - _kill_t) * 1e3, 1),
+            "fleet_chaos_missing_terminals": _no_terminal,
+        })
+        if _victim.poll() is None:
+            _victim.kill()
+        _victim.stdout.close()
+    finally:
+        if _rt is not None:
+            _rt.stop()
+        _rt_a.stop()
+        _rt_b.stop()
+except Exception as e:  # noqa: BLE001
+    out["fleet_router_bench_error"] = f"{type(e).__name__}: {e}"[:400]
+emit()
+
 # Speculative decoding (VERDICT r3 item 5): committed-tokens/s for int8
 # SELF-speculation — the target's own int8 copy drafts gamma tokens, the
 # bf16 target verifies the chunk in one weight stream. The only reason
@@ -1890,12 +2105,18 @@ def check_results(results: dict | None = None, threshold: float = 0.15):
     # speedup ratio — swap-restore staying cheaper than the
     # evict-and-recompute it replaces, the inequality the per-victim
     # cost model is premised on.
+    # ... plus the fleet-router pair: cache-aware placement must keep
+    # beating round-robin on served cached tokens (the router's reason
+    # to exist), and the kill-a-replica recovery goodput must stay at
+    # pre-kill levels — a silent drop in either means failover or
+    # placement quietly broke.
     _HARD_KEYS = ("serve_paged_tokens_per_sec", "serve_ttft_p99_ms",
                   "serve_prefix_hit_rate", "serve_cached_ttft_p50_ms",
                   "serve_host_hit_rate", "serve_swap_restore_speedup",
                   "serve_admit_ratio", "serve_chaos_goodput_frac",
                   "fleet_digest_match_uplift",
                   "fleet_scrape_staleness_p99_ms",
+                  "fleet_route_hit_uplift", "fleet_chaos_goodput_frac",
                   "serve_engine_busy_frac", "serve_mfu",
                   "serve_device_ms_per_token")
     hard = {k: v for k, v in regressions.items()

@@ -486,6 +486,61 @@ def test_aggregator_backoff_on_500ing_replica():
         f.stop()
 
 
+def test_fleetz_replica_filter_and_breaker_view():
+    """`/fleetz?replica=` narrows the per-replica maps to one member
+    (404 on unknown names), and every entry carries a `breaker` block
+    whose state grammar matches the router's circuit snapshot — closed
+    while scrapes succeed, open with a positive retry_in_s while the
+    backoff holds, half-open once the next attempt is due."""
+    a = _FakeReplica(_payloads("a", 1, 2, []))
+    b = _FakeReplica(_payloads("b", 3, 0, []))
+    agg = FleetAggregator([a.addr, b.addr], poll_s=0.5,
+                          stale_after_s=1e9)
+    # HTTP only — no poll loop, so the fake clock below stays the sole
+    # driver of breaker state (a wall-clock poll would re-probe b).
+    threading.Thread(target=agg.httpd.serve_forever, daemon=True).start()
+    try:
+        t0 = 500.0
+        agg.poll_once(now=t0)
+
+        def get(path):
+            return json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{agg.port}{path}",
+                timeout=30).read())
+
+        doc = get(f"/fleetz?replica={a.addr}")
+        assert list(doc["replicas"]) == [a.addr]
+        assert list(doc["slo"]["burn"]) == [a.addr]
+        # The rollup stays fleet-wide — the filter narrows maps only.
+        assert doc["fleet"]["replicas"] == 2
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get("/fleetz?replica=nope:1")
+        assert e.value.code == 404
+
+        doc = agg.fleetz_json(now=t0)
+        brk = doc["replicas"][a.addr]["breaker"]
+        assert brk == {"state": "closed", "failures": 0,
+                       "backoff_s": 0.0, "retry_in_s": 0.0}
+
+        b.fail = True
+        agg.poll_once(now=t0 + 0.6)
+        entry = agg.fleetz_json(now=t0 + 0.7)["replicas"][b.addr]
+        assert entry["breaker"]["state"] == "open"
+        assert entry["breaker"]["failures"] == 1
+        assert 0 < entry["breaker"]["retry_in_s"] <= entry["backoff_s"]
+        # Past the backoff horizon the view reads half-open: the next
+        # poll is the probe (exactly the router's grammar).
+        entry = agg.fleetz_json(
+            now=t0 + 0.6 + entry["backoff_s"] + 0.01)[
+            "replicas"][b.addr]
+        assert entry["breaker"]["state"] == "half-open"
+        assert entry["breaker"]["retry_in_s"] == 0.0
+    finally:
+        agg.stop()
+        a.stop()
+        b.stop()
+
+
 # ---- trace stitching (pure) ----------------------------------------------
 
 

@@ -67,6 +67,8 @@ def test_healthz(server):
             f"http://127.0.0.1:{server.port}/healthz", timeout=30) as r:
         h = json.loads(r.read())
     assert h["ok"] is True and h["active"] >= 0 and h["queued"] >= 0
+    # The heartbeat age the fleet router's hedging decision reads.
+    assert h["beat_age_ms"] >= 0
 
 
 def test_concurrent_streams_bit_match_solo(server):
@@ -343,3 +345,61 @@ def test_latency_telemetry_surfaces_in_healthz():
         assert h["active"] == 0 and h["queued"] == 0
     finally:
         srv.stop()
+
+
+def _healthz(port):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=30) as r:
+        return json.loads(r.read())
+
+
+def test_request_id_echoed_and_replay_deduped(server):
+    """The idempotency contract the fleet router's failover retries
+    lean on: a `request_id` is echoed on every chunk, and re-submitting
+    the same id returns the SAME result from the completed record —
+    the engine never executes twice."""
+    body = {"tokens": [3, 4, 5], "max_new": 6, "stream": True,
+            "request_id": "idem-echo-1"}
+    with _post(server.port, body) as resp:
+        lines = [json.loads(ln) for ln in resp if ln.strip()]
+    assert lines[-1]["done"] is True
+    assert all(ln["request_id"] == "idem-echo-1" for ln in lines)
+    first = [t for ln in lines for t in ln["tokens"]]
+    assert len(first) == 6
+
+    served = _healthz(server.port)["served"]
+    # Streamed replay: identical tokens, and the engine saw nothing.
+    with _post(server.port, body) as resp:
+        replay = [json.loads(ln) for ln in resp if ln.strip()]
+    assert [t for ln in replay for t in ln["tokens"]] == first
+    assert replay[-1]["done"] is True
+    # Cross-mode replay: a non-stream retry of a streamed original
+    # still finds the record and answers with the full result.
+    with _post(server.port, {**body, "stream": False}) as resp:
+        out = json.loads(resp.read())
+    assert out["done"] is True and out["tokens"] == first
+    assert out["request_id"] == "idem-echo-1"
+    assert _healthz(server.port)["served"] == served
+
+
+def test_requests_without_id_are_never_deduped(server):
+    """No request_id, no idempotency: identical bodies execute
+    independently (the pre-PR behavior, byte-identical)."""
+    served = _healthz(server.port)["served"]
+    body = {"tokens": [7, 8], "max_new": 3, "stream": False}
+    out1 = json.loads(_post(server.port, body).read())
+    out2 = json.loads(_post(server.port, body).read())
+    assert out1["done"] and out2["done"]
+    assert "request_id" not in out1
+    assert _healthz(server.port)["served"] == served + 2
+
+
+def test_request_id_rejected_when_malformed(server):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server.port, {"tokens": [1], "max_new": 1,
+                            "request_id": ["not", "a", "string"]})
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server.port, {"tokens": [1], "max_new": 1,
+                            "request_id": "x" * 200})
+    assert e.value.code == 400

@@ -1,0 +1,755 @@
+"""Fleet router (ROADMAP item 1): cache-aware placement beats
+round-robin, failover is exactly-once under `router.dispatch` faults,
+breaker schedules are deterministic, draining replicas are routed
+around, misrouted placements degrade softly, autoscale hysteresis
+holds on canned burn series — all against scriptable fake replicas
+(fast), plus a real 3-subprocess-replica kill-a-replica chaos pin
+(slow; the CI chaos job runs it by name)."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from tpu_bootstrap.workload import faults
+from tpu_bootstrap.workload.router import (AutoscaleController,
+                                           CircuitBreaker, FleetRouter,
+                                           LocalFleetDriver,
+                                           breaker_view)
+from tpu_bootstrap.workload.serving import block_hash, key_fingerprint
+
+BS = 4
+
+
+def _digest_for(tokens, bs=BS):
+    """The digest a replica holding ``tokens``' full prefix chain would
+    publish (the real radix-chained fingerprints, so the router's
+    digest_match_len scores it exactly as it would a live /cachez)."""
+    fps, key = [], b""
+    for j in range(len(tokens) // bs):
+        key = block_hash(key, tokens[j * bs:(j + 1) * bs])
+        fps.append(key_fingerprint(key))
+    return {"version": 1, "block_size": bs, "blocks": len(fps),
+            "fps": fps}
+
+
+_COLD = {"version": 1, "block_size": BS, "blocks": 0, "fps": []}
+
+
+class _FakeServe:
+    """A scriptable serving replica: canned scrape endpoints plus a
+    streaming /v1/generate whose failure mode is chosen per instance —
+    "ok", "die_before_token" (socket death after the queued ack),
+    "die_mid_stream" (death after the first token chunk), "http_503",
+    "http_429"."""
+
+    def __init__(self, *, digest=None, queued=0, mode="ok",
+                 gen=(7, 8, 9), cached_tokens=None, token_delay_s=0.0,
+                 beat_age_ms=5.0, draining=False, scrape_fail=False):
+        self.digest = digest or dict(_COLD)
+        self.scrape_fail = scrape_fail
+        self.queued = queued
+        self.mode = mode
+        self.gen = list(gen)
+        self.cached_tokens = cached_tokens
+        self.token_delay_s = token_delay_s
+        self.beat_age_ms = beat_age_ms
+        self.draining = draining
+        self.posts: list = []
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _json(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/healthz":
+                    return self._json(200, {
+                        "ok": True, "active": 0, "queued": outer.queued,
+                        "served": 0, "beat_age_ms": outer.beat_age_ms,
+                        **({"draining": True} if outer.draining
+                           else {})})
+                if path == "/cachez":
+                    if outer.scrape_fail:
+                        # Fails the scrape leg hard (a /healthz 500 is
+                        # body-salvaged by the router, /cachez is not).
+                        return self._json(500, {"error": "boom"})
+                    return self._json(
+                        200, {"as_of_us": 1, "digest": outer.digest})
+                if path == "/poolz":
+                    return self._json(200, {
+                        "as_of_us": 1, "pool": {"active": 0},
+                        "scheduler": {"queue_depth": outer.queued}})
+                return self._json(404, {"error": "no such path"})
+
+            def _chunk(self, obj):
+                line = json.dumps(obj).encode() + b"\n"
+                self.wfile.write(
+                    f"{len(line):x}\r\n".encode() + line + b"\r\n")
+                self.wfile.flush()
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                outer.posts.append(body)
+                rid = body.get("request_id", "")
+                if outer.mode == "http_503":
+                    return self._json(503, {"error": "draining",
+                                            "draining": True})
+                if outer.mode == "http_429":
+                    return self._json(429, {"error": "full"})
+                self.send_response(200)
+                self.send_header("Content-Type", "application/jsonl")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                try:
+                    self._chunk({"tokens": [], "queued": True,
+                                 "queue_position": outer.queued,
+                                 "request_id": rid})
+                    if outer.mode == "die_before_token":
+                        self.connection.close()
+                        return
+                    time.sleep(outer.token_delay_s)
+                    self._chunk({"tokens": outer.gen[:1],
+                                 "request_id": rid})
+                    if outer.mode == "die_mid_stream":
+                        self.connection.close()
+                        return
+                    final = {"tokens": outer.gen[1:], "done": True,
+                             "request_id": rid}
+                    if outer.cached_tokens is not None:
+                        final["cached_tokens"] = outer.cached_tokens
+                    self._chunk(final)
+                    self.wfile.write(b"0\r\n\r\n")
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass  # router hung up (cancelled hedge leg)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.addr = f"127.0.0.1:{self.httpd.server_address[1]}"
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _router(replicas, **kw):
+    kw.setdefault("scrape_s", 0.05)
+    kw.setdefault("stale_s", 5.0)
+    kw.setdefault("breaker_s", 0.2)
+    kw.setdefault("hedge_s", 0.0)  # hedging off unless a test wants it
+    kw.setdefault("timeout_s", 10.0)
+    kw.setdefault("connect_timeout_s", 2.0)
+    return FleetRouter([r.addr for r in replicas], port=0,
+                       host="127.0.0.1", **kw).start()
+
+
+def _wait(pred, timeout=5.0, msg="condition never held"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(msg)
+
+
+def _wait_scraped(router, n, timeout=5.0):
+    _wait(lambda: sum(
+        1 for e in router.routerz_json()["replicas"].values()
+        if e["digest_age_ms"] is not None) >= n,
+        timeout, "scrape never landed")
+
+
+def _stream(port, body, timeout=15):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/generate",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    lines = []
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        for ln in resp:
+            if not ln.strip():
+                continue
+            lines.append(json.loads(ln))
+            if lines[-1].get("done"):
+                break
+    return lines
+
+
+def _post_json(port, body, timeout=15):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/generate",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+PROMPT = list(range(1, 17))  # 4 full blocks at block_size 4
+
+
+# ---- placement -----------------------------------------------------------
+
+
+def test_placement_beats_round_robin_on_warm_cold_pair():
+    """Every request for a warm prefix lands on the replica whose
+    digest covers it — even with the deeper queue — where round-robin
+    would split the pair 50/50 and recompute half the prefills."""
+    warm = _FakeServe(digest=_digest_for(PROMPT), queued=5,
+                      cached_tokens=len(PROMPT))
+    cold = _FakeServe(digest=dict(_COLD), queued=0)
+    router = _router([cold, warm])  # cold listed first: order ≠ choice
+    try:
+        _wait_scraped(router, 2)
+        for _ in range(4):
+            out = _post_json(router.port,
+                             {"tokens": PROMPT, "max_new": 3,
+                              "stream": False})
+            assert out["done"] is True and out["tokens"] == [7, 8, 9]
+        assert len(warm.posts) == 4 and len(cold.posts) == 0
+    finally:
+        router.stop()
+        warm.stop()
+        cold.stop()
+
+
+def test_stale_digests_degrade_to_least_queue():
+    """A digest older than the staleness window stops being a
+    placement signal: routing falls back to least queue depth instead
+    of trusting a cache view that may no longer exist."""
+    warm = _FakeServe(digest=_digest_for(PROMPT), queued=5)
+    cold = _FakeServe(digest=dict(_COLD), queued=0)
+    # One scrape, then a long gap: digests age past stale_s.
+    router = _router([warm, cold], scrape_s=30.0, stale_s=0.1)
+    try:
+        _wait_scraped(router, 2)
+        time.sleep(0.3)  # both digests now stale
+        out = _post_json(router.port, {"tokens": PROMPT, "max_new": 2,
+                                       "stream": False})
+        assert out["done"] is True
+        assert len(cold.posts) == 1 and len(warm.posts) == 0
+        assert router.reg.to_json().get(
+            "fleet_route_degraded_total", 0) >= 1
+    finally:
+        router.stop()
+        warm.stop()
+        cold.stop()
+
+
+def test_drain_aware_routing_routes_around_draining_replica():
+    """A replica advertising ``draining`` stops receiving placements
+    (its in-flight streams are its own business) — even when its
+    digest is the better match."""
+    draining = _FakeServe(digest=_digest_for(PROMPT), draining=True)
+    survivor = _FakeServe(digest=dict(_COLD))
+    router = _router([draining, survivor])
+    try:
+        _wait_scraped(router, 2)
+        out = _post_json(router.port, {"tokens": PROMPT, "max_new": 2,
+                                       "stream": False})
+        assert out["done"] is True
+        assert len(survivor.posts) == 1 and len(draining.posts) == 0
+        assert router.routerz_json()[
+            "replicas"][draining.addr]["draining"] is True
+    finally:
+        router.stop()
+        draining.stop()
+        survivor.stop()
+
+
+def test_misroute_is_a_soft_signal():
+    """Satellite bugfix pin: a digest scraped before an eviction
+    promises blocks the replica no longer holds. The request must
+    still complete (the replica recomputes) — the router logs and
+    counts ``fleet_route_misroutes_total``, never errors."""
+    # Digest promises the full prefix; the replica reports 0 cached.
+    liar = _FakeServe(digest=_digest_for(PROMPT), cached_tokens=0)
+    router = _router([liar])
+    try:
+        _wait_scraped(router, 1)
+        lines = _stream(router.port, {"tokens": PROMPT, "max_new": 3})
+        final = lines[-1]
+        assert final.get("done") is True and not final.get("error")
+        assert [t for ln in lines for t in ln["tokens"]] == [7, 8, 9]
+        # The misroute check runs on the dispatch thread after the
+        # final chunk is already on the wire — poll, don't race it.
+        _wait(lambda: router.reg.to_json().get(
+                  "fleet_route_misroutes_total", 0) == 1,
+              timeout=5, msg="misroute counter never fired")
+    finally:
+        router.stop()
+        liar.stop()
+
+
+# ---- failover ------------------------------------------------------------
+
+
+def test_failover_exactly_once_under_dispatch_fault():
+    """`router.dispatch` one-shot fault: the first dispatch leg dies
+    before reaching any replica; the request re-places on a survivor
+    carrying the SAME idempotency key, completes exactly once, and no
+    replica ever sees a duplicate execution."""
+    a = _FakeServe(digest=_digest_for(PROMPT), cached_tokens=16)
+    b = _FakeServe(digest=_digest_for(PROMPT), cached_tokens=16)
+    router = _router([a, b])
+    faults.install("router.dispatch:1:0")
+    try:
+        _wait_scraped(router, 2)
+        lines = _stream(router.port, {"tokens": PROMPT, "max_new": 3,
+                                      "request_id": "idem-f1"})
+        assert lines[-1].get("done") is True
+        assert not lines[-1].get("error")
+        assert [t for ln in lines for t in ln["tokens"]] == [7, 8, 9]
+        posts = a.posts + b.posts
+        assert len(posts) == 1, "retry must not double-execute"
+        assert posts[0]["request_id"] == "idem-f1"
+        assert router.reg.to_json().get(
+            "fleet_route_failovers_total", 0) == 1
+    finally:
+        faults.install(None)
+        router.stop()
+        a.stop()
+        b.stop()
+
+
+def test_pre_token_death_fails_over_to_survivor():
+    """A replica that dies after the queued ack but before its first
+    token re-places silently: the client sees one complete stream (no
+    error, no failover marker), both dispatches carried the same
+    request_id."""
+    dying = _FakeServe(digest=_digest_for(PROMPT),
+                       mode="die_before_token")
+    survivor = _FakeServe(digest=dict(_COLD), gen=(11, 12))
+    router = _router([dying, survivor])
+    try:
+        _wait_scraped(router, 2)
+        lines = _stream(router.port, {"tokens": PROMPT, "max_new": 2})
+        final = lines[-1]
+        assert final.get("done") is True and not final.get("error")
+        assert [t for ln in lines for t in ln["tokens"]] == [11, 12]
+        assert len(dying.posts) == 1 and len(survivor.posts) == 1
+        assert (dying.posts[0]["request_id"]
+                == survivor.posts[0]["request_id"] != "")
+    finally:
+        router.stop()
+        dying.stop()
+        survivor.stop()
+
+
+def test_midstream_death_surfaces_terminal_failover_chunk():
+    """After the first token reached the client a restart would
+    duplicate tokens, so a replica death surfaces an explicit terminal
+    ``{"failover": true, "error": ..., "done": true}`` chunk — never a
+    dropped socket, never a silent re-dispatch."""
+    dying = _FakeServe(digest=_digest_for(PROMPT),
+                       mode="die_mid_stream")
+    bystander = _FakeServe(digest=dict(_COLD))
+    router = _router([dying, bystander])
+    try:
+        _wait_scraped(router, 2)
+        lines = _stream(router.port, {"tokens": PROMPT, "max_new": 3})
+        final = lines[-1]
+        assert final.get("done") is True
+        assert final.get("failover") is True and final.get("error")
+        assert sum(1 for ln in lines if ln.get("done")) == 1
+        assert len(bystander.posts) == 0, \
+            "commit means no re-dispatch"
+    finally:
+        router.stop()
+        dying.stop()
+        bystander.stop()
+
+
+def test_hedge_commits_first_token_winner():
+    """A placed replica whose heartbeat is stalled and whose first
+    token does not arrive within the hedge window gets raced by one
+    hedge leg on the next-best survivor; the client's stream comes
+    entirely from whichever leg produced a token first."""
+    slow = _FakeServe(digest=_digest_for(PROMPT), token_delay_s=2.0,
+                      beat_age_ms=60000.0)
+    fast = _FakeServe(digest=dict(_COLD), gen=(21, 22))
+    router = _router([slow, fast], hedge_s=0.15)
+    try:
+        _wait_scraped(router, 2)
+        lines = _stream(router.port, {"tokens": PROMPT, "max_new": 2})
+        assert [t for ln in lines for t in ln["tokens"]] == [21, 22]
+        assert len(slow.posts) == 1 and len(fast.posts) == 1
+        assert (slow.posts[0]["request_id"]
+                == fast.posts[0]["request_id"])
+        assert router.reg.to_json().get(
+            "fleet_route_hedges_total", 0) == 1
+    finally:
+        router.stop()
+        slow.stop()
+        fast.stop()
+
+
+def test_scrape_failure_opens_breaker_and_routes_around():
+    """Sustained scrape loss on one replica opens its breaker; traffic
+    keeps flowing to the survivor."""
+    a = _FakeServe(digest=_digest_for(PROMPT), scrape_fail=True)
+    b = _FakeServe(digest=dict(_COLD), gen=(31,))
+    router = _router([a, b], breaker_s=60.0)
+    try:
+        _wait(lambda: router.routerz_json()["replicas"][a.addr]
+              ["breaker"]["state"] == "open", msg="breaker never opened")
+        _wait_scraped(router, 1)
+        out = _post_json(router.port, {"tokens": PROMPT, "max_new": 1,
+                                       "stream": False})
+        assert out["done"] is True and out["tokens"] == [31]
+        assert len(a.posts) == 0 and len(b.posts) == 1
+    finally:
+        router.stop()
+        a.stop()
+        b.stop()
+
+
+def test_router_scrape_fault_seam_recovers():
+    """The `router.scrape` injection seam: a one-shot fault costs one
+    breaker failure, then the next probe closes it and the digest
+    lands — the router self-heals without restart."""
+    a = _FakeServe(digest=_digest_for(PROMPT))
+    faults.install("router.scrape:1:0")
+    router = _router([a], breaker_s=0.05)
+    try:
+        _wait(lambda: router.routerz_json()["replicas"][a.addr]
+              ["failures"] >= 1, msg="fault never charged the breaker")
+        _wait_scraped(router, 1)
+        doc = router.routerz_json()["replicas"][a.addr]
+        assert doc["breaker"]["state"] == "closed"
+        assert doc["digest_blocks"] == len(PROMPT) // BS
+    finally:
+        faults.install(None)
+        router.stop()
+        a.stop()
+
+
+def test_all_breakers_open_answers_503_with_retry_after():
+    """Total outage degrades honestly: 503 plus a dynamic Retry-After
+    derived from the soonest breaker probe — not a hang, not a 200."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead = f"127.0.0.1:{s.getsockname()[1]}"
+    s.close()  # nothing listens there
+    router = FleetRouter([dead], port=0, host="127.0.0.1",
+                         scrape_s=0.05, breaker_s=30.0,
+                         connect_timeout_s=0.5, retries=1).start()
+    try:
+        _wait(lambda: router.routerz_json()["replicas"][dead]
+              ["breaker"]["state"] == "open", msg="breaker never opened")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post_json(router.port, {"tokens": [1], "max_new": 1,
+                                     "stream": False})
+        assert exc.value.code == 503
+        retry_after = int(exc.value.headers["Retry-After"])
+        assert 1 <= retry_after <= 30
+        body = json.loads(exc.value.read())
+        assert "no replica available" in body["error"]
+    finally:
+        router.stop()
+
+
+# ---- breaker determinism -------------------------------------------------
+
+
+def test_breaker_schedule_is_deterministic():
+    """Same seed, same failure sequence -> byte-identical backoff
+    schedule (base x 2^(k-1), capped, +-20% seeded jitter), and the
+    open -> half-open -> closed walk admits exactly one probe."""
+    import random as _random
+    seq1 = []
+    b1 = CircuitBreaker(1.0, seed=42)
+    b2 = CircuitBreaker(1.0, seed=42)
+    for k in range(6):
+        b1.record_failure(0.0)
+        b2.record_failure(0.0)
+        assert b1.backoff_s == b2.backoff_s
+        seq1.append(b1.backoff_s)
+    rng = _random.Random(42)
+    expected = [round(min(1.0 * 2 ** k, 300.0)
+                      * rng.uniform(0.8, 1.2), 3) for k in range(6)]
+    assert seq1 == expected
+    # Monotone doubling (jitter never reorders the schedule).
+    assert all(b > a for a, b in zip(seq1, seq1[1:]))
+
+    b = CircuitBreaker(1.0, seed=7)
+    b.record_failure(100.0)
+    assert b.state == "open" and not b.allow(100.0)
+    assert not b.allow(100.0 + b.backoff_s - 0.01)
+    assert b.allow(100.0 + b.backoff_s + 0.01)  # THE probe
+    assert b.state == "half-open"
+    assert not b.allow(100.0 + b.backoff_s + 0.02)  # only one
+    b.record_failure(101.0)  # probe failed: reopen, doubled
+    assert b.state == "open" and b.failures == 2
+    assert b.allow(101.0 + b.backoff_s + 0.01)
+    b.record_success()  # probe succeeded: closed, clean slate
+    assert b.state == "closed" and b.failures == 0
+
+
+def test_breaker_view_matches_breaker_snapshot_shape():
+    """fleetz derives a breaker-shaped view from scrape-backoff state;
+    the keys and state grammar must match the router's own snapshot so
+    the two panes tell one story."""
+    b = CircuitBreaker(1.0, seed=3)
+    b.record_failure(50.0)
+    snap = b.snapshot(50.0)
+    view = breaker_view(1, b.backoff_s, 50.0 + b.backoff_s, 50.0)
+    assert set(snap) == set(view)
+    assert view["state"] == "open" and snap["state"] == "open"
+    assert breaker_view(0, 0.0, 0.0, 60.0)["state"] == "closed"
+    assert breaker_view(2, 4.0, 55.0, 60.0)["state"] == "half-open"
+
+
+# ---- autoscale hysteresis ------------------------------------------------
+
+
+def _burn(firing, burn=None):
+    if burn is None:
+        burn = 9.0 if firing else 0.0
+    return {"replica": {"ttft_p99": {
+        "burn": burn, "firing": firing,
+        "windows": {"300s": burn, "3600s": burn}}}}
+
+
+def test_autoscale_hysteresis_on_canned_burn_series():
+    """The canned series the ISSUE pins: scale-up needs up_ticks
+    CONSECUTIVE firing evaluations, scale-down needs down_ticks quiet
+    ones, cooldown gates both, the middle zone resets streaks, and
+    min/max clamp everything."""
+    c = AutoscaleController(1, 3, up_ticks=2, down_ticks=3,
+                            cooldown_s=10.0, burn_threshold=1.0)
+    # One firing tick is a spike, not a trend.
+    assert c.step(1, _burn(True), now=0.0) is None
+    # Middle zone (burning but not firing) resets the streak.
+    assert c.step(1, _burn(False, burn=0.9), now=1.0) is None
+    assert c.step(1, _burn(True), now=2.0) is None
+    assert c.step(1, _burn(True), now=3.0) == 2        # streak met
+    # Cooldown holds even with the page condition still firing.
+    assert c.step(2, _burn(True), now=4.0) is None
+    assert c.step(2, _burn(True), now=5.0) is None
+    # Sustained firing through the cooldown keeps its streak: the
+    # first post-cooldown tick scales again, to the cap.
+    assert c.step(2, _burn(True), now=14.0) == 3
+    assert c.step(3, _burn(True), now=30.0) is None    # at max: hold
+    assert c.step(3, _burn(True), now=31.0) is None
+    # Quiet ticks build the down-streak; three in a row shrink.
+    assert c.step(3, _burn(False), now=40.0) is None
+    assert c.step(3, _burn(False), now=41.0) is None
+    assert c.step(3, _burn(False), now=42.0) == 2
+    # At the floor nothing shrinks further.
+    c2 = AutoscaleController(1, 3, up_ticks=2, down_ticks=1,
+                             cooldown_s=0.0)
+    assert c2.step(1, _burn(False), now=0.0) is None
+
+
+def test_autoscale_empty_burn_doc_holds():
+    """No samples -> no action in either direction (an empty fleet
+    view must not trigger a scale-down spiral)."""
+    c = AutoscaleController(1, 3, up_ticks=1, down_ticks=1,
+                            cooldown_s=0.0)
+    assert c.step(2, {}, now=0.0) is None
+    assert c.step(2, {"r": {}}, now=1.0) is None
+
+
+# ---- the kill-a-replica chaos pin (CI chaos job) -------------------------
+
+
+_REPLICA_CHILD = r"""
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+from tpu_bootstrap.workload.ingress import IngressServer
+from tpu_bootstrap.workload.model import ModelConfig, init_params
+cfg = ModelConfig(vocab_size=32, num_layers=1, num_heads=2, head_dim=8,
+                  embed_dim=16, mlp_dim=32, max_seq_len=64)
+srv = IngressServer(init_params(cfg, jax.random.PRNGKey(1)), cfg, port=0,
+                    batch_size=2, paged=True, kv_blocks=24, block_size=8,
+                    host="127.0.0.1")
+srv.serve_forever()
+"""
+
+
+def _spawn_replica():
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _REPLICA_CHILD],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+        text=True)
+    deadline = time.monotonic() + 240
+    port = None
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        if "ingress: serving on :" in line:
+            port = int(line.split(":")[-1].split()[0].rstrip(")"))
+            break
+    assert port, "replica child never came up"
+    return proc, port
+
+
+def _write_chaos_artifact(payload) -> None:
+    path = os.environ.get("TPUBC_CHAOS_ARTIFACT")
+    if path:
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=2, default=str)
+
+
+@pytest.mark.slow
+def test_fleet_chaos_kill_replica_recovers_goodput():
+    """The fleet scenario the chaos job pins: 3 real subprocess
+    replicas behind the router, a SIGKILL takes one out mid-burst,
+    and (a) every in-flight request reaches exactly one terminal
+    outcome — token-complete, failover-resumed, or an explicit
+    failover error chunk — with zero dropped sockets, and (b) a
+    post-kill wave completes at >= 90% goodput on the survivors."""
+    procs = []
+    artifact: dict = {"scenario": "fleet-kill-replica"}
+    router = None
+    try:
+        pairs = [_spawn_replica() for _ in range(3)]
+        procs = [p for p, _ in pairs]
+        replicas = [f"127.0.0.1:{port}" for _, port in pairs]
+        router = FleetRouter(replicas, port=0, host="127.0.0.1",
+                             scrape_s=0.1, stale_s=5.0, breaker_s=0.3,
+                             hedge_s=0.0, retries=3,
+                             timeout_s=120.0).start()
+        _wait(lambda: sum(
+            1 for e in router.routerz_json()["replicas"].values()
+            if e["digest_age_ms"] is not None) == 3, timeout=60,
+            msg="router never scraped all replicas")
+        # Pay every replica's jit before the timed part.
+        for r in replicas:
+            req = urllib.request.Request(
+                f"http://{r}/v1/generate",
+                data=json.dumps({"tokens": [2, 3], "max_new": 2,
+                                 "stream": False}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=240) as resp:
+                resp.read()
+
+        def burst(n, tag):
+            outs = [None] * n
+            threads = []
+            for i in range(n):
+                def run(i=i):
+                    try:
+                        outs[i] = _stream(
+                            router.port,
+                            {"tokens": [1, 2, 3 + i % 5],
+                             "max_new": 24,
+                             "request_id": f"{tag}-{i}"},
+                            timeout=240)
+                    except Exception as e:  # noqa: BLE001
+                        outs[i] = [{"client_error": repr(e)}]
+                threads.append(threading.Thread(target=run))
+            for t in threads:
+                t.start()
+            return threads, outs
+
+        threads, outs = burst(6, "burst")
+        # Kill the busiest replica once tokens are flowing.
+        _wait(lambda: any(
+            o and any(ln.get("tokens") for ln in o) for o in outs
+            if o is not None) or all(t.is_alive() is False
+                                     for t in threads),
+            timeout=120, msg="burst never started streaming")
+        rz = router.routerz_json()["replicas"]
+        victim_i = max(range(3),
+                       key=lambda i: rz[replicas[i]]["inflight"])
+        procs[victim_i].send_signal(signal.SIGKILL)
+        for t in threads:
+            t.join(timeout=240)
+        artifact["burst"] = outs
+        # Exactly one terminal outcome each, no dropped sockets.
+        for i, lines in enumerate(outs):
+            assert lines, f"request {i} got nothing"
+            assert not any("client_error" in ln for ln in lines), \
+                f"request {i} saw a dropped socket: {lines[-1]}"
+            terminals = [ln for ln in lines if ln.get("done")]
+            assert len(terminals) == 1, f"request {i}: {terminals}"
+        # Goodput recovers: a fresh wave on the survivors completes.
+        threads, outs = burst(6, "recovery")
+        for t in threads:
+            t.join(timeout=240)
+        artifact["recovery"] = outs
+        ok = sum(1 for lines in outs
+                 if lines and lines[-1].get("done")
+                 and not lines[-1].get("error"))
+        artifact["recovery_goodput_frac"] = ok / 6
+        assert ok / 6 >= 0.9, f"goodput only {ok}/6 after the kill"
+        _write_chaos_artifact(artifact)
+    except BaseException:
+        artifact["routerz"] = (router.routerz_json()
+                               if router is not None else None)
+        _write_chaos_artifact(artifact)
+        raise
+    finally:
+        if router is not None:
+            router.stop()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            p.stdout.close()
+
+
+# ---- local fleet driver --------------------------------------------------
+
+
+def test_local_fleet_driver_drains_before_kill():
+    """Scale-down marks the victim draining at the router BEFORE any
+    signal reaches it — placements route around it while its streams
+    finish."""
+    a = _FakeServe(digest=dict(_COLD))
+    router = _router([a])
+    calls = []
+    driver = LocalFleetDriver(
+        f"{sys.executable} -c 'import time; time.sleep(60)'", router,
+        drain_grace_s=5.0)
+    real_mark = router.mark_draining
+
+    def spy(r):
+        # _drain_one calls this BEFORE it signals the victim, so the
+        # flag read here is the drain-before-kill ordering itself (a
+        # quick-dying sleeper can be reaped out of the table before
+        # the main thread would get another look).
+        real_mark(r)
+        calls.append(
+            ("drain", r,
+             router.routerz_json()["replicas"][r]["draining"]))
+
+    router.mark_draining = spy
+    try:
+        driver.scale_to(2)  # two sleeper "replicas" join the table
+        assert len(router.routerz_json()["replicas"]) == 3
+        driver.scale_to(1)
+        assert calls and calls[0][0] == "drain" and calls[0][2] is True
+        victim = calls[0][1]
+        _wait(lambda: victim not in router.routerz_json()["replicas"],
+              timeout=10, msg="victim never reaped")
+    finally:
+        driver.stop()
+        router.stop()
+        a.stop()

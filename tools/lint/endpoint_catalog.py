@@ -55,6 +55,12 @@ SERVERS = {
         ("tpu_bootstrap/workload/fleetz.py",
          "FleetAggregator.__init__.<locals>.Handler.do_GET"),
     ),
+    "router": (
+        ("tpu_bootstrap/workload/router.py",
+         "FleetRouter.__init__.<locals>.Handler.do_GET"),
+        ("tpu_bootstrap/workload/router.py",
+         "FleetRouter.__init__.<locals>.Handler.do_POST"),
+    ),
     "controller": (("native/bin/controller.cc", None),),
     "synchronizer": (("native/bin/synchronizer.cc", None),),
 }
@@ -63,10 +69,13 @@ _ING = "tpu_bootstrap/workload/ingress.py"
 _SRV = "tpu_bootstrap/workload/serving.py"
 _TEL = "tpu_bootstrap/telemetry.py"
 _FLZ = "tpu_bootstrap/workload/fleetz.py"
+_RTR = "tpu_bootstrap/workload/router.py"
 _ING_GET = "IngressServer.__init__.<locals>.Handler.do_GET"
 _ING_POST = "IngressServer.__init__.<locals>.Handler.do_POST"
 _TEL_GET = "start_metrics_server.<locals>.Handler.do_GET"
 _FLZ_GET = "FleetAggregator.__init__.<locals>.Handler.do_GET"
+_RTR_GET = "FleetRouter.__init__.<locals>.Handler.do_GET"
+_RTR_POST = "FleetRouter.__init__.<locals>.Handler.do_POST"
 
 # Both in-process tracers (Python telemetry.Tracer, native trace.cc)
 # publish the same span document shape — the stitcher depends on it.
@@ -80,22 +89,33 @@ _ENTRIES = (
     # ---- ingress (per-replica serving front end) ------------------------
     Endpoint(
         "ingress", "/v1/generate", (), "json",
-        producers=(Producer(_ING, _ING_POST, route="/v1/generate"),),
-        consumers=(Consumer("bench.py", "slo_report", "out"),),
+        producers=(Producer(_ING, _ING_POST, route="/v1/generate"),
+                   Producer(
+                       _ING,
+                       "IngressServer.__init__.<locals>.Handler._pump"),),
+        consumers=(Consumer("bench.py", "slo_report", "out"),
+                   Consumer(_RTR, "FleetRouter._on_event", "ev"),),
         keys=("Retry-After", "cached_tokens", "deadline_exceeded", "done",
-              "draining", "error", "queue_position", "queued", "timing",
-              "tokens", "trace_id"),
+              "draining", "error", "queue_position", "queued",
+              "request_id", "timing", "tokens", "trace_id"),
         desc="Blocking generation API. `Retry-After` is the 429 "
              "admission-backpressure response's header literal; the "
-             "rest is the completion/queue-position body."),
+             "rest is the completion/queue-position body. A client "
+             "`request_id` idempotency key is echoed everywhere, and a "
+             "re-submitted id attaches to the existing stream/result "
+             "instead of executing twice."),
     Endpoint(
         "ingress", "/healthz", ("/health",), "json",
         producers=(Producer(_ING, _ING_GET, route="/healthz"),),
-        consumers=(Consumer(_FLZ, "FleetAggregator._fold", "hz"),),
-        keys=("active", "draining", "last_error", "ok", "p50_total_ms",
-              "p50_ttft_ms", "queued", "served", "stalled_ms"),
+        consumers=(Consumer(_FLZ, "FleetAggregator._fold", "hz"),
+                   Consumer(_RTR, "FleetRouter._fold_scrape", "hz"),),
+        keys=("active", "beat_age_ms", "draining", "last_error", "ok",
+              "p50_total_ms", "p50_ttft_ms", "queued", "served",
+              "stalled_ms"),
         desc="Replica liveness + drain state; the fleet poller's "
-             "required scrape (`ok` feeds the healthy count)."),
+             "required scrape (`ok` feeds the healthy count). "
+             "`beat_age_ms` is the always-on engine heartbeat age the "
+             "router's hedge trigger watches."),
     Endpoint(
         "ingress", "/metrics", (), "prom",
         desc="Prometheus text exposition of the serving registry."),
@@ -130,7 +150,8 @@ _ENTRIES = (
                    Producer(_SRV, "HostBlockPool.snapshot_json"),
                    Producer(_SRV, "Scheduler.snapshot")),
         consumers=(Consumer("bench.py", "slo_report", "poolz"),
-                   Consumer(_FLZ, "FleetAggregator.fleetz_json", "pool")),
+                   Consumer(_FLZ, "FleetAggregator.fleetz_json", "pool"),
+                   Consumer(_RTR, "FleetRouter._fold_scrape", "pz")),
         keys=("active", "as_of_us", "available", "batch_size",
               "block_size", "blocks", "bytes", "cache_digest", "cached",
               "cached_tokens", "capacity", "compactness", "deadline",
@@ -220,21 +241,29 @@ _ENTRIES = (
         producers=(Producer(_FLZ, "FleetAggregator.fleetz_json"),
                    Producer(_FLZ, "SloEngine.evaluate"),
                    Producer(_FLZ, "SloEngine.alerts"),
+                   Producer(_RTR, "breaker_view"),
                    Producer(_FLZ, _FLZ_GET, route="/fleetz")),
-        consumers=(Consumer(_FLZ, _FLZ_GET, "snap"),),
-        keys=("alerts", "as_of_us", "backoff_s", "blocks", "burn",
-              "burn_threshold", "busy_frac", "cache_digest", "cached",
-              "digest_blocks", "error", "event", "failures", "firing",
-              "fleet", "health", "healthy", "last_err",
+        consumers=(Consumer(_FLZ, _FLZ_GET, "snap"),
+                   Consumer(_RTR, "FleetRouter._fetch_burn", "doc"),
+                   Consumer(_RTR, "FleetRouter._discover_from_fleetz",
+                            "doc"),),
+        keys=("alerts", "as_of_us", "backoff_s", "blocks", "breaker",
+              "burn", "burn_threshold", "busy_frac", "cache_digest",
+              "cached", "digest_blocks", "error", "event", "failures",
+              "firing", "fleet", "health", "healthy", "last_err",
               "last_ok_age_ms", "live", "mfu", "objectives", "poll_ms",
-              "qps", "queue_depth", "replica", "replicas", "scrape_ms",
-              "scrapes", "serve_qps", "serve_tokens_per_sec",
-              "since_us", "slo", "state", "t_us", "tokens_per_sec",
-              "total", "transitions", "window", "window_secs",
-              "windows", "windows_s"),
+              "qps", "queue_depth", "replica", "replicas",
+              "retry_in_s", "scrape_ms", "scrapes", "serve_qps",
+              "serve_tokens_per_sec", "since_us", "slo", "state",
+              "t_us", "tokens_per_sec", "total", "transitions",
+              "window", "window_secs", "windows", "windows_s"),
         desc="The merged fleet pane: per-replica health/queue/cache "
-             "columns, fleet rollups, SLO burn rates, firing alerts. "
-             "Per-objective fields under `objectives` come from "
+             "columns plus a router-consistent `breaker` circuit view "
+             "derived from scrape-backoff state, fleet rollups, SLO "
+             "burn rates, firing alerts. `?replica=host:port` narrows "
+             "the per-replica maps to one member (404 on unknown "
+             "names); the fleet rollup stays fleet-wide. Per-objective "
+             "fields under `objectives` come from "
              "`dataclasses.asdict(SloObjective)` and are not part of "
              "the static key contract."),
     Endpoint(
@@ -262,6 +291,57 @@ _ENTRIES = (
         keys=("error", "healthy", "ok", "replicas"),
         desc="The aggregator's own liveness + how many replicas it "
              "currently sees healthy."),
+
+    # ---- router (fleet front door) --------------------------------------
+    Endpoint(
+        "router", "/v1/generate", (), "json",
+        producers=(Producer(_RTR, "_ClientWriter._line"),
+                   Producer(_RTR, "FleetRouter._route"),),
+        keys=("Retry-After", "cached_tokens", "deadline_exceeded",
+              "done", "draining", "error", "failover", "queue_position",
+              "queued", "request_id", "timing", "tokens", "trace_id"),
+        desc="The fleet front door: the full per-replica /v1/generate "
+             "contract (stream and non-stream), placed on the longest "
+             "fresh cache-digest match, least queue on ties. Every "
+             "request carries a `request_id` idempotency key (minted "
+             "if absent) and gets exactly one terminal outcome: "
+             "pre-first-token deaths re-place on survivors silently, "
+             "mid-stream deaths close with a terminal "
+             "`\"failover\": true` error chunk, and an unroutable "
+             "fleet answers 503 with the dynamic `Retry-After` header "
+             "literal."),
+    Endpoint(
+        "router", "/routerz", (), "json",
+        producers=(Producer(_RTR, _RTR_GET, route="/routerz"),
+                   Producer(_RTR, "FleetRouter.routerz_json"),
+                   Producer(_RTR, "CircuitBreaker.snapshot"),
+                   Producer(_RTR, "AutoscaleController.snapshot"),),
+        keys=("active", "as_of_us", "autoscale", "backoff_s",
+              "beat_age_ms", "breaker", "cooldown_s", "digest_age_ms",
+              "digest_blocks", "digest_stale_ms", "dispatches",
+              "down_streak", "draining", "error", "failures",
+              "hedge_ms", "inflight", "last", "last_err", "max", "min",
+              "queue_depth", "replicas", "retries", "retry_in_s",
+              "scrape_ms", "state", "up_streak"),
+        desc="The router's placement table: per-replica breaker state, "
+             "digest freshness, scraped queue/active, in-flight "
+             "dispatch counts, drain flags, plus the autoscale "
+             "controller's streaks and cooldown when one is armed."),
+    Endpoint(
+        "router", "/healthz", (), "json",
+        producers=(Producer(_RTR, _RTR_GET, route="/healthz"),),
+        keys=("as_of_us", "error", "ok", "replicas", "routable"),
+        desc="Router liveness: `ok` while at least one replica is "
+             "routable (closed breaker, not draining); 503 otherwise."),
+    Endpoint(
+        "router", "/metrics", (), "prom",
+        desc="Prometheus text exposition of the router registry "
+             "(placement, failover, breaker, hedge, autoscale "
+             "counters)."),
+    Endpoint(
+        "router", "/metrics.json", (), "metrics",
+        desc="Instant JSON snapshot of the router registry "
+             "(`?window=N` serves the time-series ring)."),
 
     # ---- controller (native) --------------------------------------------
     Endpoint(

@@ -80,6 +80,11 @@ CATALOG = {
         "256", "serving",
         "Waiting-queue bound beyond which /v1/generate answers 429 + "
         "Retry-After."),
+    "TPUBC_INGRESS_IDEM_CACHE": (
+        "256", "serving",
+        "Completed request_id idempotency records retained for replay "
+        "(in-flight records never evict; a retry always finds its "
+        "stream)."),
     "TPUBC_REQUESTZ_RING": (
         "256", "serving",
         "/requestz flight-recorder ring capacity (retired records "
@@ -96,7 +101,8 @@ CATALOG = {
         "-", "serving",
         "Deterministic fault schedule `site[:prob][:after_n][:seed],...` "
         "(sites: pool.device, alloc, sched.admit, ingress.write, "
-        "ckpt.save, scrape, swap.xfer). Unset = zero-overhead no-op."),
+        "ckpt.save, scrape, swap.xfer, router.dispatch, router.scrape). "
+        "Unset = zero-overhead no-op."),
     "TPUBC_DRAIN_TIMEOUT_MS": (
         "5000", "serving",
         "Graceful-drain window: residents finish or checkpoint-preempt "
@@ -133,6 +139,31 @@ CATALOG = {
         "1", "serving",
         "`0` disables prefix-cache digest maintenance (/cachez and "
         "/poolz publish empty digests; token streams byte-identical)."),
+    # -- fleet router -------------------------------------------------------
+    "TPUBC_ROUTER_SCRAPE_MS": (
+        "500", "router",
+        "Cadence of the router's own /healthz+/cachez+/poolz scrape "
+        "of every replica (breaker-gated; open replicas are probed on "
+        "their backoff schedule instead)."),
+    "TPUBC_ROUTER_DIGEST_STALE_MS": (
+        "3000", "router",
+        "Digest freshness window: past it a replica's cache digest "
+        "stops being a placement signal and routing degrades to least "
+        "queue depth."),
+    "TPUBC_ROUTER_BREAKER_MS": (
+        "1000", "router",
+        "Base backoff of the per-replica circuit breaker (doubles per "
+        "consecutive failure, +-20% seeded jitter, capped at 300s — "
+        "the PR 9 fleetz schedule)."),
+    "TPUBC_ROUTER_HEDGE_MS": (
+        "2000", "router",
+        "First-token wait before a stalled-heartbeat replica's request "
+        "is hedged onto the next-best survivor (`0` disables "
+        "hedging)."),
+    "TPUBC_ROUTER_RETRIES": (
+        "3", "router",
+        "Max placement attempts per request before the router gives "
+        "an honest 503/terminal failover chunk."),
     # -- telemetry / fleet --------------------------------------------------
     "TPUBC_TS_RING": (
         "256", "telemetry",

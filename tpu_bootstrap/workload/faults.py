@@ -50,8 +50,15 @@ FAULT_ENV = "TPUBC_FAULT"
 #                 (demotion, preempt-to-swap, or promotion claim);
 #                 every consumer must DEGRADE to recompute — drop the
 #                 content, never corrupt a table or the allocator
+#   router.dispatch  the fleet router's replica-bound /v1/generate leg
+#                 dying (connect refused, 5xx, socket death mid-read) —
+#                 failover must re-place, never double-execute
+#   router.scrape    the router's own /cachez+/poolz+/healthz poll leg
+#                 failing — placement must degrade to queue depth, the
+#                 breaker must open on sustained loss
 SITES = ("pool.device", "alloc", "sched.admit", "ingress.write",
-         "ckpt.save", "scrape", "swap.xfer")
+         "ckpt.save", "scrape", "swap.xfer", "router.dispatch",
+         "router.scrape")
 
 
 class InjectedFault(RuntimeError):

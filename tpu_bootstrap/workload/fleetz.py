@@ -421,8 +421,15 @@ class FleetAggregator:
                         return self._json(
                             400, {"error": "window must be a number"})
                 if path == "/fleetz":
-                    return self._json(200,
-                                      outer.fleetz_json(window=window))
+                    replica = parse_qs(url.query).get(
+                        "replica", [None])[0]
+                    if replica is not None and replica not in \
+                            outer.replicas:
+                        return self._json(404, {
+                            "error": f"unknown replica {replica!r}",
+                            "replicas": list(outer.replicas)})
+                    return self._json(200, outer.fleetz_json(
+                        window=window, replica=replica))
                 if path == "/metrics":
                     body = outer.federated_metrics(window=window).encode()
                     self.send_response(200)
@@ -630,7 +637,11 @@ class FleetAggregator:
         return out
 
     def fleetz_json(self, now: float | None = None,
-                    window: float | None = None) -> dict:
+                    window: float | None = None,
+                    replica: str | None = None) -> dict:
+        # Deferred: router imports this module for BACKOFF_CAP_S, so the
+        # shared breaker-view shape is fetched at call time, not import.
+        from .router import breaker_view
         now = time.monotonic() if now is None else now
         windowed = (self._windowed_metrics(window)
                     if window is not None else {})
@@ -654,6 +665,13 @@ class FleetAggregator:
                 "state": eff,
                 "failures": st["failures"],
                 "backoff_s": st["backoff_s"],
+                # The router-consistent circuit view derived from this
+                # poll loop's own backoff state: same state grammar
+                # (closed / open / half-open) and keys as the router's
+                # per-replica breaker snapshot, so the two panes never
+                # disagree about what "open" means.
+                "breaker": breaker_view(st["failures"], st["backoff_s"],
+                                        st["next_attempt"], now),
                 "last_ok_age_ms": None if st["last_ok_t"] is None
                 else round((now - st["last_ok_t"]) * 1e3, 1),
                 "last_err": st["last_err"],
@@ -700,6 +718,12 @@ class FleetAggregator:
             fleet[key] = (round(sum(vals) / len(vals), 6)
                           if vals else None)
         burn = self.slo.evaluate(now=now)
+        if replica is not None:
+            # ?replica= narrows the per-replica maps to one member;
+            # the fleet rollup stays fleet-wide (it is labeled so).
+            replicas = {r: e for r, e in replicas.items()
+                        if r == replica}
+            burn = {r: b for r, b in burn.items() if r == replica}
         out_window = None if window is None else float(window)
         return {
             "as_of_us": telemetry.now_us(),

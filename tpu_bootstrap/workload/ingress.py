@@ -89,6 +89,54 @@ from tpu_bootstrap.workload.serving import (
 )
 
 
+class _StreamFan:
+    """One request's event stream when the client supplied a
+    ``request_id`` idempotency key: the primary client queue plus any
+    re-subscribers, with the full event history buffered so a
+    re-submitted id replays the stream it missed and then rides along
+    live. Every call runs under the ingress lock (the engine loop puts
+    events holding it; handlers attach holding it), so the fan needs no
+    lock of its own — it only has to quack like the plain queue.Queue
+    the non-idempotent path keeps using."""
+
+    __slots__ = ("events", "subs", "done")
+
+    def __init__(self, q):
+        self.events: list = []
+        self.subs: list = [q]
+        self.done = False
+
+    def put(self, ev) -> None:
+        self.events.append(ev)
+        if ev.get("done"):
+            self.done = True
+        for q in self.subs:
+            q.put(ev)
+
+    def attach(self) -> queue.Queue:
+        """A fresh queue pre-loaded with everything already delivered;
+        live events keep arriving unless the stream already finished.
+        This is the dedupe contract: the retry gets the SAME stream,
+        never a second execution."""
+        q: queue.Queue = queue.Queue()
+        for ev in self.events:
+            q.put(ev)
+        if not self.done:
+            self.subs.append(q)
+        return q
+
+
+def idem_cache_cap() -> int:
+    """Completed idempotency records retained for replay
+    (TPUBC_INGRESS_IDEM_CACHE, default 256; in-flight records are never
+    evicted — a live retry must always find its stream)."""
+    try:
+        return max(0, int(os.environ.get("TPUBC_INGRESS_IDEM_CACHE",
+                                         "256")))
+    except ValueError:
+        return 256
+
+
 class IngressServer:
     """Own the pool, the engine thread, and the HTTP server. `start()`
     runs in the background (tests); `serve_forever()` blocks (the
@@ -204,6 +252,14 @@ class IngressServer:
         # client's own id when it sent one, else the process root the
         # span tree actually rooted under).
         self._req_meta: dict = {}  # guarded-by: _lock
+        # Idempotency keys (the primitive router failover rides on): a
+        # client ``request_id`` maps to its _StreamFan for the life of
+        # the request and — bounded by TPUBC_INGRESS_IDEM_CACHE, oldest
+        # completed evicted first — beyond it, so a re-submitted id
+        # attaches to the existing stream/result instead of executing
+        # twice.
+        self._idem = collections.OrderedDict()  # request_id -> _StreamFan  # guarded-by: _lock
+        self._idem_cap = idem_cache_cap()
         self._qps_window = telemetry.RateWindow()
         self._tps_window = telemetry.RateWindow()
         # /poolz + /healthz occupancy: pool and scheduler internals are
@@ -375,7 +431,11 @@ class IngressServer:
                 health = {"ok": (outer._engine.is_alive() and not stalled
                                  and not draining),
                           "active": active,
-                          "queued": queued, "served": served}
+                          "queued": queued, "served": served,
+                          # Always-on heartbeat age: the router's hedge
+                          # trigger watches this climb BEFORE the
+                          # watchdog's stall verdict flips ok to False.
+                          "beat_age_ms": round(stalled_ms, 1)}
                 if draining:
                     health["draining"] = True
                 if stalled:
@@ -412,6 +472,15 @@ class IngressServer:
                     if not isinstance(trace_id, str) or len(trace_id) > 128:
                         raise ValueError(
                             "trace_id must be a string (<= 128 chars)")
+                    # Client idempotency key: a re-submitted id attaches
+                    # to the existing stream/result instead of running
+                    # the request again — what lets a front-door router
+                    # retry a dispatch it cannot prove was never seen.
+                    request_id = body.get("request_id") or ""
+                    if (not isinstance(request_id, str)
+                            or len(request_id) > 128):
+                        raise ValueError(
+                            "request_id must be a string (<= 128 chars)")
                     deadline_ms = body.get("deadline_ms")
                     if deadline_ms is not None:
                         deadline_ms = float(deadline_ms)
@@ -444,6 +513,14 @@ class IngressServer:
                     outer.pool.validate(req, outer.cfg)
                 except ValueError as e:
                     return self._json(400, {"error": str(e)})
+                # Dedupe BEFORE the drain gate: a known id's work
+                # already exists (or existed), and handing back its
+                # stream is strictly more honest than a 503 — the
+                # router's failover depends on the retry never being
+                # refused once the original was accepted.
+                attached = outer._attach_idem(request_id)
+                if attached is not None:
+                    return self._pump(attached, stream, None, request_id)
                 with outer._lock:
                     draining = outer._draining
                 if draining:
@@ -458,7 +535,7 @@ class IngressServer:
                               "draining": True},
                         headers={"Retry-After":
                                  str(outer._drain_retry_after_s())})
-                submitted = outer._submit(req)
+                submitted = outer._submit(req, request_id=request_id)
                 if submitted is None:
                     # Server pressure, not a client error: the waiting
                     # queue is at its bound. Retry-After is the
@@ -473,6 +550,13 @@ class IngressServer:
                         headers={"Retry-After": str(
                             outer.sched.retry_after_s(outer.max_queue))})
                 out_q, qpos = submitted
+                return self._pump(out_q, stream, qpos, request_id)
+
+            def _pump(self, out_q, stream, qpos, request_id):
+                """Render one request's event stream to the client —
+                shared by a fresh submission and an idempotent re-attach
+                (where ``qpos`` is None: the position belongs to the
+                original submission's ack, which the replay carries)."""
                 if stream:
                     self.send_response(200)
                     self.send_header("Content-Type", "application/jsonl")
@@ -493,6 +577,8 @@ class IngressServer:
                                     if ev.get("timing") else {}),
                                  **({"trace_id": ev["trace_id"]}
                                     if ev.get("trace_id") else {}),
+                                 **({"request_id": request_id}
+                                    if request_id else {}),
                                  **({"draining": True}
                                     if ev.get("draining") else {}),
                                  **({"deadline_exceeded": True}
@@ -517,14 +603,17 @@ class IngressServer:
                     while True:
                         ev = out_q.get()
                         if ev["done"]:
-                            out = {"tokens": ev["generated"], "done": True,
-                                   "queue_position": qpos}
+                            out = {"tokens": ev["generated"], "done": True}
+                            if qpos is not None:
+                                out["queue_position"] = qpos
                             if "cached_tokens" in ev:
                                 out["cached_tokens"] = ev["cached_tokens"]
                             if ev.get("timing"):
                                 out["timing"] = ev["timing"]
                             if ev.get("trace_id"):
                                 out["trace_id"] = ev["trace_id"]
+                            if request_id:
+                                out["request_id"] = request_id
                             if ev.get("error"):
                                 out["error"] = ev["error"]
                             # Deadline shed/cancel is a GATEWAY TIMEOUT
@@ -600,18 +689,55 @@ class IngressServer:
 
     # ---- engine ----------------------------------------------------------
 
-    def _submit(self, req: Request):
+    def _attach_idem(self, request_id: str):
+        """A known in-flight/completed ``request_id`` returns a fresh
+        queue replaying (and, live, following) the EXISTING stream;
+        an unknown id returns None and the caller submits normally."""
+        if not request_id:
+            return None
+        with self._work:
+            fan = self._idem.get(request_id)
+            if fan is None:
+                return None
+            telemetry.metrics().inc("serve_idem_dedup_total")
+            return fan.attach()
+
+    def _idem_gc_locked(self) -> None:
+        """Evict oldest COMPLETED idempotency records beyond the cap
+        (caller holds the lock). In-flight fans always survive — a
+        retry racing its original must find the stream."""
+        done = sum(1 for f in self._idem.values() if f.done)
+        if done <= self._idem_cap:
+            return
+        for key in [k for k, f in self._idem.items() if f.done]:
+            del self._idem[key]
+            done -= 1
+            if done <= self._idem_cap:
+                break
+
+    def _submit(self, req: Request, request_id: str = ""):
         """Assign a rid, hand the request to the engine, and ACK the
         queueing to the client. Returns (out_queue, queue position at
         submit) — or None when the waiting queue is at its bound (the
         handler answers 429: server pressure is not a client error)."""
-        out_q: queue.Queue = queue.Queue()
+        client_q: queue.Queue = queue.Queue()
+        out_q = client_q
         with self._work:
             depth = len(self._pending) + self.sched.queue_depth()
             if depth >= self.max_queue:
                 return None
             req.rid = self._next_rid
             self._next_rid += 1
+            if request_id:
+                # The engine writes through the fan (it quacks like the
+                # plain queue and its .put runs under this lock wherever
+                # the engine publishes); the handler reads the primary
+                # client_q; the fan outlives the stream in _idem so a
+                # re-submitted id replays it.
+                fan = _StreamFan(client_q)
+                self._idem[request_id] = fan
+                self._idem_gc_locked()
+                out_q = fan
             self._pending.append((req, out_q))
             self._submit_t[req.rid] = (time.monotonic(), None)
             self._req_meta[req.rid] = (
@@ -629,7 +755,7 @@ class IngressServer:
             # the wrong waiter would leave the engine asleep with this
             # request stranded in _pending.
             self._work.notify_all()
-        return out_q, depth
+        return client_q, depth
 
     def _engine_loop(self):
         while True:
