@@ -1930,7 +1930,8 @@ _HIGHER_BETTER = ("per_sec", "speedup", "mfu", "gbps",
                   "roofline_frac", "mean_committed", "committed_per_stream",
                   "slot_utilization", "temp_reduction", "agreement_pct",
                   "hit_rate", "admit_ratio", "accept_rate", "goodput_frac",
-                  "busy_frac", "uplift")
+                  "busy_frac", "uplift", "slo_attainment",
+                  "route_hit_frac")
 # "_ms" must stay an endswith match (as a substring it would grab
 # unrelated keys); the rest are distinctive enough to match anywhere —
 # quality deltas carry format suffixes (quant_xent_delta_int8).
@@ -1982,6 +1983,28 @@ def _flag_regressions(parsed: dict, prev_results: dict,
         parsed["workload_regressions"] = dict(list(regressions.items())[:20])
 
 
+def sim_bench() -> dict:
+    """The digital-twin gate triple: one pinned deterministic scenario
+    (``tools.sim``, virtual clock, CPU-pure, ~1s wall) driving the REAL
+    router/autoscaler/SLO objects. The three keys are exact for a fixed
+    seed, so any movement is a behavior change in the policy code the
+    twin observes — which is precisely what the gate exists to catch."""
+    try:
+        from tools.sim import SimSpec
+        from tools.sim import run as sim_run
+        spec = SimSpec(scenario="diurnal", replicas=128, seed=1702)
+        report, violations, _sim = sim_run(spec)
+        return {
+            "sim_scenario": spec.seed_str(),
+            "sim_slo_attainment": report["slo_attainment"],
+            "sim_goodput_frac": report["goodput_frac"],
+            "sim_route_hit_frac": report["route_hit_frac"],
+            "sim_violations": len(violations),
+        }
+    except Exception as e:  # noqa: BLE001 - the twin must not sink the bench
+        return {"sim_bench_error": str(e)[:200]}
+
+
 def _finish_workload(parsed: dict) -> dict:
     """Cache the fresh results, then judge them against the cache they
     replaced."""
@@ -1990,6 +2013,10 @@ def _finish_workload(parsed: dict) -> dict:
         prev = json.loads(WORKLOAD_CACHE.read_text()).get("results", {})
     except (OSError, json.JSONDecodeError):
         pass
+    # The digital-twin triple rides the workload cache (it needs the
+    # same per-key commit provenance and --check judgment), but is
+    # measured here in the parent — it is chip-independent.
+    parsed.update(sim_bench())
     _cache_workload(parsed)
     _flag_regressions(parsed, prev)
     return parsed
@@ -2110,6 +2137,10 @@ def check_results(results: dict | None = None, threshold: float = 0.15):
     # to exist), and the kill-a-replica recovery goodput must stay at
     # pre-kill levels — a silent drop in either means failover or
     # placement quietly broke.
+    # ... plus the digital-twin triple: the pinned tools.sim scenario's
+    # SLO attainment, goodput fraction, and placement hit rate. Exact
+    # for a fixed seed, so ANY wrong-way move is a real behavior change
+    # in the router/autoscaler/SLO code the twin drives.
     _HARD_KEYS = ("serve_paged_tokens_per_sec", "serve_ttft_p99_ms",
                   "serve_prefix_hit_rate", "serve_cached_ttft_p50_ms",
                   "serve_host_hit_rate", "serve_swap_restore_speedup",
@@ -2118,7 +2149,9 @@ def check_results(results: dict | None = None, threshold: float = 0.15):
                   "fleet_scrape_staleness_p99_ms",
                   "fleet_route_hit_uplift", "fleet_chaos_goodput_frac",
                   "serve_engine_busy_frac", "serve_mfu",
-                  "serve_device_ms_per_token")
+                  "serve_device_ms_per_token",
+                  "sim_slo_attainment", "sim_goodput_frac",
+                  "sim_route_hit_frac")
     hard = {k: v for k, v in regressions.items()
             if "hbm_roofline_frac" in k or "achieved_gbps" in k
             or k in _HARD_KEYS}
@@ -2804,6 +2837,55 @@ def fleet_trace_capture(out_path: str):
     return summary
 
 
+def record_trace(out_path: str, n_requests: int = 24):
+    """--record-trace: drive a short live burst through the real paged
+    ingress and write its ``/requestz?format=jsonl`` arrival capture to
+    ``out_path`` — the file ``python -m tools.sim --scenario replay
+    --replay-trace PATH`` replays against the fleet digital twin. The
+    burst mixes priorities, prompt lengths, and decode budgets so the
+    capture exercises every field the replay loader reads."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    from tpu_bootstrap.workload.ingress import IngressServer
+    from tpu_bootstrap.workload.model import ModelConfig, init_params
+
+    cfg = ModelConfig(vocab_size=128, num_layers=2, num_heads=2,
+                      head_dim=8, embed_dim=16, mlp_dim=32,
+                      max_seq_len=64)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    ingress = IngressServer(params, cfg, port=0, batch_size=4,
+                            paged=True, block_size=16).start()
+    try:
+        for i in range(n_requests):
+            body = json.dumps({
+                "tokens": [1 + (i % 7)] * (3 + (i % 4) * 5),
+                "max_new": 4 + (i % 3) * 4, "stream": False,
+                "priority": i % 2,
+                "trace_id": f"rectrace{i:08x}"}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{ingress.port}/v1/generate",
+                data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=300) as r:
+                out = json.loads(r.read())
+            assert out["done"]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{ingress.port}/requestz?format=jsonl",
+                timeout=30) as r:
+            data = r.read()
+    finally:
+        ingress.stop()
+    Path(out_path).write_bytes(data)
+    summary = {"record_trace": out_path,
+               "records": data.count(b"\n"),
+               "replay_with": f"python -m tools.sim --scenario replay "
+                              f"--replay-trace {out_path}"}
+    print(json.dumps(summary))
+    return summary
+
+
 def slo_report(out_path: str, n_crs: int = 30):
     """--slo-report: the operator-facing SLO summary for one bench
     trajectory. Two legs share one process:
@@ -3060,6 +3142,13 @@ def main():
                              "fleet instead — separate replica processes, "
                              "one shared trace id, Chrome timeline stitched "
                              "by the fleetz aggregator")
+    parser.add_argument("--record-trace", metavar="PATH",
+                        help="drive a short live burst through the paged "
+                             "ingress and write its /requestz?format=jsonl "
+                             "arrival capture to PATH (replayable via "
+                             "python -m tools.sim --scenario replay "
+                             "--replay-trace PATH) instead of running the "
+                             "full bench")
     parser.add_argument("--slo-report", metavar="PATH",
                         help="drive a serve run + CR trajectory and write a "
                              "JSON SLO summary (time-to-Running p50/p99, "
@@ -3079,6 +3168,10 @@ def main():
                    else json.loads(Path(args.check).read_text()))
         sys.exit(check_results(results))
 
+    if args.record_trace:
+        # Pure-Python serve leg: no native daemons, no build needed.
+        record_trace(args.record_trace)
+        return
     if args.trace_out and args.fleet:
         # Pure-Python fleet: no native daemons involved, no build needed.
         fleet_trace_capture(args.trace_out)

@@ -97,7 +97,13 @@ def test_workload_bench_paths(tmp_path, monkeypatch):
     monkeypatch.setattr(
         bench, "WORKLOAD_BENCH_SCRIPT",
         'import json; print(json.dumps({"chip_alive": True, "x": 1}))')
-    assert bench.workload_bench() == {"chip_alive": True, "x": 1}
+    out = bench.workload_bench()
+    # The digital-twin triple (sim_*) rides every workload result —
+    # merged in _finish_workload so it shares the cache's per-key
+    # provenance; it is CPU-deterministic, no chip involved.
+    assert out["sim_violations"] == 0 and out["sim_slo_attainment"] > 0
+    assert {k: v for k, v in out.items() if not k.startswith("sim_")} \
+        == {"chip_alive": True, "x": 1}
     assert json.loads((tmp_path / "cache.json").read_text())["results"]["x"] == 1
 
     monkeypatch.setattr(

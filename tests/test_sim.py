@@ -1,0 +1,216 @@
+"""tools.sim — the fleet digital twin (PR 17).
+
+Small-fleet smoke of the big CI run (`python -m tools.sim --replicas
+1000`): the simulator is a pure function of its seed string (byte-
+identical reports), the clock seam restores the real clock, every
+scenario retires every arrival, and the seeded autoscaler flap bug is
+FOUND by the churn invariant and REPRODUCED from the printed seed
+alone — the find → seed → replay loop CI relies on.
+
+Plus SloEngine edge cases the sim leans on: the zero-error-budget
+denominator guard, empty/sparse windows, out-of-order sample
+timestamps, and firing→resolved transitions stamped by the injected
+virtual clock.
+"""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from tools.sim import (
+    SCENARIOS,
+    SimSpec,
+    parse_seed,
+    report_bytes,
+    run,
+)
+from tpu_bootstrap import telemetry
+from tpu_bootstrap.workload.fleetz import SloEngine, SloObjective
+
+# ---- seed grammar --------------------------------------------------------
+
+
+def test_seed_grammar_roundtrips():
+    for spec in (SimSpec(),
+                 SimSpec("hot-prefix", replicas=32, seed=9),
+                 SimSpec("limit-cycle", replicas=8, seed=3,
+                         bug="limit-cycle", duration_s=120.0)):
+        assert parse_seed(spec.seed_str()) == spec
+
+
+def test_seed_grammar_rejects_garbage():
+    for bad in ("nope:r8:s1", "diurnal:x9", "diurnal:bug=typo", ""):
+        with pytest.raises(ValueError):
+            parse_seed(bad)
+
+
+# ---- determinism and the clock seam --------------------------------------
+
+
+def test_same_seed_byte_identical_report():
+    """The acceptance bar: the report is a pure function of the seed
+    string, down to the byte (alert timestamps included — they ride the
+    virtual clock, not the wall)."""
+    spec = SimSpec("diurnal", replicas=8, seed=7, duration_s=120.0)
+    first, v1, _ = run(spec)
+    second, v2, _ = run(spec)
+    assert not v1 and not v2
+    assert report_bytes(first) == report_bytes(second)
+
+
+def test_virtual_clock_restored_after_run():
+    """run() installs the virtual clock for its lifetime only; wall-
+    time users (the router/fleetz daemon tests) must see the real
+    monotonic afterwards."""
+    assert telemetry._CLOCK is None
+    report, _, _ = run(SimSpec("diurnal", replicas=4, seed=1,
+                               duration_s=60.0))
+    assert telemetry._CLOCK is None
+    # The virtual run covered 60 simulated seconds; the real clock is
+    # back and nowhere near the virtual origin.
+    assert report["sim"]["virtual_duration_s"] == 60.0
+    t0 = telemetry.monotonic()
+    assert telemetry.monotonic() >= t0
+
+
+# ---- scenario smoke ------------------------------------------------------
+
+
+@pytest.mark.parametrize("scenario", [s for s in SCENARIOS
+                                      if s != "replay"])
+def test_scenario_retires_every_arrival(scenario):
+    """The request-accounting premise: the event loop drains to empty,
+    so served + failed_midstream + unroutable == arrivals, always."""
+    report, violations, _ = run(SimSpec(scenario, replicas=8, seed=5,
+                                        duration_s=90.0))
+    assert violations == []
+    t = report["traffic"]
+    assert t["arrivals"] > 0 and t["served"] > 0
+    assert t["served"] + t["failed_midstream"] + t["unroutable"] \
+        == t["arrivals"]
+
+
+def test_replay_trace_drives_arrivals(tmp_path):
+    """A /requestz?format=jsonl capture replays 1:1 — each record is
+    one arrival, spaced by its captured inter-arrival gap."""
+    trace = tmp_path / "capture.jsonl"
+    recs = [{"t_arrival_us": 1_000_000 + i * 250_000,
+             "prompt_len": 48 + i, "max_new": 16, "priority": i % 2,
+             "deadline": 8000.0} for i in range(12)]
+    trace.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    spec = SimSpec("replay", replicas=4, seed=2, trace=str(trace))
+    report, violations, _ = run(spec)
+    assert violations == []
+    assert report["traffic"]["arrivals"] == len(recs)
+    assert report["traffic"]["served"] == len(recs)
+
+
+# ---- the seeded bug: find -> seed -> replay ------------------------------
+
+
+def test_seeded_limit_cycle_found_and_seed_replays():
+    """The whole point of the harness: the churn invariant catches the
+    planted flap-damping bug, and its seed string alone — parsed back
+    through the grammar — reproduces it from scratch."""
+    spec = SimSpec("limit-cycle", replicas=8, seed=11,
+                   bug="limit-cycle")
+    _rep, violations, _ = run(spec)
+    churn = [v for v in violations
+             if v.invariant == "autoscale-limit-cycle"]
+    assert churn, "seeded autoscaler flap not caught by the invariant"
+    _rep2, again, _ = run(parse_seed(churn[0].seed()))
+    assert any(v.invariant == "autoscale-limit-cycle" for v in again)
+    # The same scenario WITHOUT the bug is clean: the violation is the
+    # armed controller config, not the harness.
+    _rep3, clean, _ = run(SimSpec("limit-cycle", replicas=8, seed=11))
+    assert clean == []
+
+
+@pytest.mark.slow
+def test_cli_seed_bug_roundtrip(tmp_path):
+    """`python -m tools.sim --seed-bug limit-cycle` exits 1, prints the
+    replay seed, writes the CI artifact, and reports the replay
+    reproduced."""
+    out = tmp_path / "violation.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.sim", "--scenario", "limit-cycle",
+         "--replicas", "8", "--seed", "11", "--seed-bug", "limit-cycle",
+         "--violation-out", str(out)],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "REPRODUCED the violation" in proc.stdout
+    doc = json.loads(out.read_text())
+    assert doc["invariant"] == "autoscale-limit-cycle"
+    assert parse_seed(doc["seed"]).bug == "limit-cycle"
+
+
+# ---- SloEngine edges the sim leans on ------------------------------------
+
+_TTFT = SloObjective("ttft", "p99", "gt", 100.0, target=0.9)
+
+
+def test_zero_error_budget_burn_is_finite():
+    """target=1.0 means NO error budget; the denominator guard turns
+    division-by-zero into a huge-but-finite burn that still fires."""
+    eng = SloEngine(objectives=[
+        SloObjective("strict", "p99", "gt", 100.0, target=1.0)],
+        windows=(60.0,), ring=8)
+    eng.record("r1", {"p99": 500.0}, t=10.0)
+    d = eng.evaluate(now=11.0)["r1"]["strict"]
+    assert d["burn"] is not None and d["burn"] > 1e6
+    assert d["firing"]
+
+
+def test_empty_window_yields_none_not_zero():
+    """Samples entirely outside every window: burn is None (unknown),
+    never 0.0 (which would read as 'healthy') and never firing."""
+    eng = SloEngine(objectives=[_TTFT], windows=(60.0,), ring=8)
+    eng.record("r1", {"p99": 500.0}, t=10.0)
+    d = eng.evaluate(now=500.0)["r1"]["ttft"]
+    assert d["burn"] is None and d["windows"]["60s"] is None
+    assert not d["firing"]
+
+
+def test_single_sample_window():
+    eng = SloEngine(objectives=[_TTFT], windows=(60.0,), ring=8)
+    eng.record("r1", {"p99": 500.0}, t=100.0)
+    d = eng.evaluate(now=101.0)["r1"]["ttft"]
+    # 1 bad of 1, 10% budget -> burn 10.0.
+    assert d["burn"] == pytest.approx(10.0)
+    assert d["firing"]
+
+
+def test_out_of_order_timestamps_still_counted():
+    """record() timestamps arrive unordered (scrape jitter, replays);
+    window membership is by value, not ring position."""
+    eng = SloEngine(objectives=[_TTFT], windows=(60.0,), ring=8)
+    for t in (90.0, 20.0, 95.0, 30.0):     # two in-window, two aged
+        eng.record("r1", {"p99": 500.0 if t > 60 else 10.0}, t=t)
+    d = eng.evaluate(now=100.0)["r1"]["ttft"]
+    # Only the two t>60 samples are in the 60s window; both bad.
+    assert d["burn"] == pytest.approx(10.0)
+
+
+def test_alert_transitions_stamped_by_virtual_clock():
+    """Under an injected clock, firing/resolved transitions carry the
+    VIRTUAL time in microseconds — the property that makes the sim's
+    alert log byte-reproducible."""
+    vt = [1000.0]
+    telemetry.set_clock(lambda: vt[0])
+    try:
+        eng = SloEngine(objectives=[_TTFT], windows=(60.0,), ring=16)
+        for i in range(4):
+            eng.record("r1", {"p99": 500.0}, t=990.0 + i)
+        assert eng.evaluate(now=vt[0])["r1"]["ttft"]["firing"]
+        vt[0] = 1100.0
+        for i in range(8):
+            eng.record("r1", {"p99": 10.0}, t=1090.0 + i)
+        assert not eng.evaluate(now=vt[0])["r1"]["ttft"]["firing"]
+        tr = eng.alerts()["transitions"]
+        assert [e["event"] for e in tr] == ["firing", "resolved"]
+        assert [e["t_us"] for e in tr] == [1_000_000_000,
+                                           1_100_000_000]
+    finally:
+        telemetry.set_clock(None)

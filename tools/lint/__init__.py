@@ -129,9 +129,13 @@ def allowed(allowlist: set, rule: str, rel: str, qualname: str) -> bool:
 
 def python_targets(root: os.PathLike | None = None) -> list:
     """The default scan set for the AST passes: the workload/runtime
-    Python tree plus the bench harness — not tests, not fixtures."""
+    Python tree plus the bench harness and the fleet digital twin
+    (tools/sim reads cataloged TPUBC_* knobs and consumes cataloged
+    endpoint payloads, so it owes the same honesty) — not tests, not
+    fixtures."""
     root = Path(root or REPO_ROOT)
     files = sorted((root / "tpu_bootstrap").rglob("*.py"))
+    files += sorted((root / "tools" / "sim").rglob("*.py"))
     files += [root / "bench.py"]
     return [SourceFile(f, root) for f in files
             if "__pycache__" not in f.parts and "fixtures" not in f.parts]

@@ -130,15 +130,23 @@ _ENTRIES = (
         "ingress", "/requestz", (), "json",
         producers=(Producer(_ING, _ING_GET, route="/requestz"),
                    Producer(_SRV, "RequestLog.snapshot"),
+                   Producer(_SRV, "RequestLog.arrivals"),
                    Producer(_SRV, "RequestLog._phases_locked", var="out")),
-        consumers=(Consumer("bench.py", "slo_report", "requestz"),),
+        consumers=(Consumer("bench.py", "slo_report", "requestz"),
+                   Consumer("tools/sim/harness.py", "load_trace", "rec")),
         keys=("cached_tokens", "capacity", "deadline", "device_ms",
               "device_ms_by_kind", "dropped_events", "enabled", "error",
               "events", "footprint_blocks", "generated", "legs",
-              "phases", "preemptions", "priority", "reason", "requests",
-              "rid", "state", "submit_us", "total_ms", "trace_id"),
+              "max_new", "phases", "preemptions", "priority",
+              "prompt_len", "reason", "requests", "rid", "state",
+              "submit_us", "t_arrival_us", "total_ms", "trace_id"),
         desc="Per-request lifecycle log: states, preemption legs, "
-             "phase timings, device-time attribution."),
+             "phase timings, device-time attribution. "
+             "`?format=jsonl` flips to the flat arrival-record export "
+             "(rid, t_arrival_us, prompt_len, max_new, priority, "
+             "deadline, trace_id — one line per request, arrival "
+             "order), the capture half of the tools.sim "
+             "capture/replay loop."),
     Endpoint(
         "ingress", "/poolz", (), "json",
         producers=(Producer(_ING, _ING_GET, route="/poolz"),
@@ -327,6 +335,22 @@ _ENTRIES = (
              "digest freshness, scraped queue/active, in-flight "
              "dispatch counts, drain flags, plus the autoscale "
              "controller's streaks and cooldown when one is armed."),
+    Endpoint(
+        "router", "/requestz", (), "json",
+        producers=(Producer(_RTR, _RTR_GET, route="/requestz"),
+                   Producer(_RTR, "FleetRouter.arrival_records"),
+                   Producer(_RTR, "FleetRouter._note_arrival",
+                            var="rec")),
+        consumers=(Consumer("tools/sim/harness.py", "load_trace",
+                            "rec"),),
+        keys=("deadline", "error", "max_new", "priority", "prompt_len",
+              "requests", "rid", "t_arrival_us", "trace_id"),
+        desc="Fleet-level arrival capture: every accepted front-door "
+             "request as a replayable arrival record (the router's "
+             "idempotency key stands in for the engine rid). "
+             "`?format=jsonl` streams one record per line — recorded "
+             "production bursts become tools.sim scenarios via "
+             "--replay-trace."),
     Endpoint(
         "router", "/healthz", (), "json",
         producers=(Producer(_RTR, _RTR_GET, route="/healthz"),),

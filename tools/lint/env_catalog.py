@@ -101,8 +101,8 @@ CATALOG = {
         "-", "serving",
         "Deterministic fault schedule `site[:prob][:after_n][:seed],...` "
         "(sites: pool.device, alloc, sched.admit, ingress.write, "
-        "ckpt.save, scrape, swap.xfer, router.dispatch, router.scrape). "
-        "Unset = zero-overhead no-op."),
+        "ckpt.save, scrape, swap.xfer, router.dispatch, router.scrape, "
+        "sim.dispatch). Unset = zero-overhead no-op."),
     "TPUBC_DRAIN_TIMEOUT_MS": (
         "5000", "serving",
         "Graceful-drain window: residents finish or checkpoint-preempt "
@@ -164,6 +164,30 @@ CATALOG = {
         "3", "router",
         "Max placement attempts per request before the router gives "
         "an honest 503/terminal failover chunk."),
+    # -- digital twin (tools.sim) -------------------------------------------
+    "TPUBC_SIM_SLOTS": (
+        "8", "sim",
+        "Concurrent decode slots per synthetic replica in the fleet "
+        "digital twin (`python -m tools.sim`)."),
+    "TPUBC_SIM_BLOCK_SIZE": (
+        "16", "sim",
+        "KV block size (tokens) of the synthetic replicas' two-tier "
+        "prefix cache — the unit of the digests the real router "
+        "scores."),
+    "TPUBC_SIM_DIGEST_BLOCKS": (
+        "256", "sim",
+        "HBM-tier capacity in blocks per synthetic replica; overflow "
+        "parks in a 2x host tier (priced at the swap arm) before "
+        "discard."),
+    "TPUBC_SIM_MFU_PREFILL": (
+        "0.55", "sim",
+        "Assumed prefill MFU pricing the twin's per-token prefill time "
+        "against `flops_model` / TPUBC_PEAK_TFLOPS (compute-bound "
+        "operating point)."),
+    "TPUBC_SIM_MFU_DECODE": (
+        "0.08", "sim",
+        "Assumed decode MFU pricing the twin's per-token decode time "
+        "(memory-bound operating point)."),
     # -- telemetry / fleet --------------------------------------------------
     "TPUBC_TS_RING": (
         "256", "telemetry",

@@ -42,7 +42,7 @@ ENV_DOC_PATH = "docs/ENV_VARS.md"
 
 # Files/dirs scanned for env-var READS (code + deploy surface).
 ENV_CODE_GLOBS = (
-    "tpu_bootstrap/**/*.py", "bench.py",
+    "tpu_bootstrap/**/*.py", "bench.py", "tools/sim/**/*.py",
     "native/src/*.cc", "native/include/**/*.h", "native/bin/*.cc",
     "native/CMakeLists.txt",
     "charts/**/*.yaml", "charts/**/*.tpl",

@@ -1,0 +1,18 @@
+"""tools.sim — the fleet digital twin.
+
+A discrete-event simulator that runs the REAL control-plane policy
+objects (router placement/breakers/hedging/failover, the autoscale
+hysteresis, the SLO burn engine) against synthetic replicas priced by
+the repo's measured cost models, on one injected virtual clock.
+``python -m tools.sim --scenario diurnal --replicas 1000 --seed 42``
+plays a 1000-replica day in CI seconds; every violated invariant
+prints a standalone replay seed. See harness.py for the full story.
+"""
+
+from tools.sim.harness import (BUGS, SCENARIOS, CostModel, SimSpec,
+                               Simulation, Violation, load_trace,
+                               parse_seed, report_bytes, run)
+
+__all__ = ["BUGS", "SCENARIOS", "CostModel", "SimSpec", "Simulation",
+           "Violation", "load_trace", "parse_seed", "report_bytes",
+           "run"]

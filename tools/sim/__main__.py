@@ -1,0 +1,139 @@
+"""CLI: ``python -m tools.sim --scenario diurnal --replicas 1000`` runs
+one scenario, ``--replay '<seed>'`` re-runs a printed violation seed,
+``--seed-bug limit-cycle`` demonstrates the seeded autoscaler bug end
+to end (find it, print the seed, reproduce it from that seed alone).
+
+Exit codes follow tools.mc: 0 clean, 1 violation(s), 2 usage error.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+def _parse_args(argv):
+    p = argparse.ArgumentParser(
+        prog="python -m tools.sim",
+        description="Discrete-event fleet simulator driving the real "
+                    "router/autoscaler/SLO policy objects on a virtual "
+                    "clock.")
+    p.add_argument("--scenario", default="diurnal",
+                   help="one of: diurnal, hot-prefix, crash-cascade, "
+                        "slow-drip, limit-cycle, replay")
+    p.add_argument("--replicas", type=int, default=100,
+                   help="fleet size (default 100; CI pins 1000)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="the run is a pure function of "
+                        "(scenario, replicas, seed)")
+    p.add_argument("--duration", type=float, default=None,
+                   help="override the scenario's virtual duration (s)")
+    p.add_argument("--seed-bug", choices=("limit-cycle",), default=None,
+                   help="arm the seeded autoscaler bug (demo/CI "
+                        "fixture: the run must FIND it and reproduce "
+                        "it from its own printed seed)")
+    p.add_argument("--replay", default=None, metavar="SEED",
+                   help="re-run one printed violation seed "
+                        "(scenario:rN:sN[:dSECS][:bug=NAME]) instead "
+                        "of taking the flags above")
+    p.add_argument("--replay-trace", default=None, metavar="PATH",
+                   help="a /requestz?format=jsonl capture to replay as "
+                        "the arrival process (scenario 'replay')")
+    p.add_argument("--report-out", default=None, metavar="PATH",
+                   help="write the deterministic report JSON to PATH "
+                        "(byte-identical for a fixed seed)")
+    p.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="write a Chrome trace-event timeline to PATH")
+    p.add_argument("--violation-out", default=None, metavar="PATH",
+                   help="write the first violation (with its replay "
+                        "seed) to PATH (CI uploads it as an artifact)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report on stdout")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = _parse_args(argv if argv is not None else sys.argv[1:])
+    from tools.sim import (SCENARIOS, SimSpec, parse_seed, report_bytes,
+                           run)
+
+    if args.replay is not None:
+        try:
+            spec = parse_seed(args.replay)
+        except ValueError as e:
+            print(f"tools.sim: {e}", file=sys.stderr)
+            return 2
+        spec.trace = args.replay_trace
+    else:
+        if args.scenario not in SCENARIOS:
+            print(f"tools.sim: unknown scenario {args.scenario!r} "
+                  f"(known: {', '.join(SCENARIOS)})", file=sys.stderr)
+            return 2
+        spec = SimSpec(scenario=args.scenario, replicas=args.replicas,
+                       seed=args.seed, bug=args.seed_bug,
+                       duration_s=args.duration,
+                       trace=args.replay_trace)
+
+    t0 = time.monotonic()
+    try:
+        report, violations, sim = run(spec)
+    except ValueError as e:
+        print(f"tools.sim: {e}", file=sys.stderr)
+        return 2
+    dt = time.monotonic() - t0
+
+    if args.report_out:
+        with open(args.report_out, "wb") as f:
+            f.write(report_bytes(report))
+    if args.trace_out:
+        with open(args.trace_out, "w", encoding="utf-8") as f:
+            json.dump(sim.chrome_trace(), f)
+            f.write("\n")
+    if args.json:
+        print(json.dumps(report, sort_keys=True))
+    else:
+        t = report["traffic"]
+        print(f"tools.sim: {spec.seed_str()} — "
+              f"{report['sim']['virtual_duration_s']:.0f} virtual s, "
+              f"{t['arrivals']} arrivals over "
+              f"{report['sim']['replicas_final']} replicas in {dt:.1f}s "
+              f"wall")
+        print(f"tools.sim: slo_attainment="
+              f"{report['slo_attainment']:.4f} "
+              f"goodput_frac={report['goodput_frac']:.4f} "
+              f"route_hit_frac={report['route_hit_frac']:.4f} "
+              f"scale_events={len(report['scale_events'])} "
+              f"hedges={t['hedges']} failovers={t['failovers']} "
+              f"unroutable={t['unroutable']}")
+
+    if not violations:
+        if not args.json:
+            print("tools.sim: scenario completed with every invariant "
+                  "intact")
+        return 0
+
+    v = violations[0]
+    seed = v.seed()
+    print(f"tools.sim: VIOLATION [{v.invariant}] {v.detail}")
+    print(f"tools.sim: replay with: python -m tools.sim "
+          f"--replay '{seed}'")
+    if args.violation_out:
+        with open(args.violation_out, "w", encoding="utf-8") as f:
+            f.write(json.dumps({"invariant": v.invariant,
+                                "detail": v.detail, "seed": seed,
+                                "seed_bug": spec.bug}, indent=2) + "\n")
+        print(f"tools.sim: violation written to {args.violation_out}")
+    # The seeded-bug demo must close the loop: the printed seed ALONE
+    # (parsed back through the grammar, not the in-memory spec) must
+    # reproduce the violation from scratch.
+    if args.seed_bug and args.replay is None:
+        _rep2, viols2, _sim2 = run(parse_seed(seed))
+        ok = any(w.invariant == v.invariant for w in viols2)
+        print("tools.sim: seed replay "
+              + ("REPRODUCED the violation" if ok
+                 else "FAILED to reproduce (nondeterminism bug!)"))
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1131 @@
+"""The fleet digital twin: a discrete-event simulator that drives the
+REAL control-plane policy objects at scales no CI fleet can host.
+
+What is real and what is synthetic
+----------------------------------
+
+Real (imported, not reimplemented — the point of the exercise):
+
+* ``FleetRouter`` placement (`_place`: longest fresh digest match via
+  the real ``digest_match_len`` chain walk, least load on ties), its
+  ``CircuitBreaker`` state machines, the scrape/fold plane
+  (``scrape_once(now=)`` with only the HTTP transport stubbed), the
+  hedge gate (``_beat_stalled``) and the ``AutoscaleController``
+  hysteresis driven through the real ``autoscale_once(burn=, now=)``.
+* ``SloEngine`` multi-window burn rates, firing/resolved transitions.
+* ``faults`` injection: the stubbed scrape leg still fires the
+  ``router.scrape`` site and synthetic dispatch fires ``sim.dispatch``,
+  so ``TPUBC_FAULT`` schedules compose with scenarios.
+
+Synthetic: the replicas. Each is a deterministic c-slot server whose
+service times come from the repo's MEASURED cost models — one token's
+prefill/decode priced by ``flops_model`` over ``telemetry.peak_tflops``
+at observed MFUs (``TPUBC_SIM_MFU_PREFILL`` / ``_DECODE``), the
+host-tier swap arm priced at ``telemetry.host_xfer_gbps`` against the
+config's KV bytes/token (the cheaper of swap vs recompute wins, the
+``serve_preempt_cost`` arms) — and whose prefix cache is a real
+radix-chained fingerprint LRU (``block_hash``/``key_fingerprint``), so
+the digests the router scrapes and scores are honest content digests.
+
+Everything runs on ONE virtual monotonic clock injected through
+``telemetry.set_clock`` — zero wall sleeps, and every ``now_us()``
+stamp inside snapshots and alert transitions is virtual time, which is
+what makes a scenario report byte-identical run to run.
+
+The tools.mc contract carries over: a violated invariant prints a
+STANDALONE replay seed (``scenario:rN:sN[:bug=...]``) that reproduces
+the run from scratch, and ``--seed-bug limit-cycle`` plants a
+pathological autoscaler (no cooldown, 1-tick streaks) the run must
+find and then reproduce from its own printed seed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import json
+import math
+import os
+import random
+from collections import OrderedDict, deque
+
+from tpu_bootstrap import telemetry
+from tpu_bootstrap.workload import faults
+from tpu_bootstrap.workload.fleetz import SloEngine
+from tpu_bootstrap.workload.model import ModelConfig, flops_model
+from tpu_bootstrap.workload.router import AutoscaleController, FleetRouter
+from tpu_bootstrap.workload.serving import (block_hash, digest_match_len,
+                                            key_fingerprint)
+
+SCENARIOS = ("diurnal", "hot-prefix", "crash-cascade", "slow-drip",
+             "limit-cycle", "replay")
+BUGS = ("limit-cycle",)
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+# ---- spec + seed grammar ------------------------------------------------
+
+
+@dataclasses.dataclass
+class SimSpec:
+    """One fully-determined run. ``seed_str()`` is the replay seed: the
+    whole simulation is a pure function of this string."""
+
+    scenario: str = "diurnal"
+    replicas: int = 100
+    seed: int = 0
+    bug: str | None = None
+    duration_s: float | None = None  # None = the scenario's default
+    trace: str | None = None         # --replay-trace arrivals file
+
+    def seed_str(self) -> str:
+        s = f"{self.scenario}:r{self.replicas}:s{self.seed}"
+        if self.duration_s is not None:
+            s += f":d{self.duration_s:g}"
+        if self.bug:
+            s += f":bug={self.bug}"
+        return s
+
+
+def parse_seed(seed: str) -> SimSpec:
+    """``scenario:rN:sN[:dSECS][:bug=NAME]`` -> SimSpec (the printed
+    violation seed's grammar; inverse of ``SimSpec.seed_str``)."""
+    parts = seed.split(":")
+    if not parts or parts[0] not in SCENARIOS:
+        raise ValueError(f"bad seed {seed!r}: unknown scenario")
+    spec = SimSpec(scenario=parts[0])
+    for p in parts[1:]:
+        if p.startswith("r"):
+            spec.replicas = int(p[1:])
+        elif p.startswith("s"):
+            spec.seed = int(p[1:])
+        elif p.startswith("d"):
+            spec.duration_s = float(p[1:])
+        elif p.startswith("bug="):
+            if p[4:] not in BUGS:
+                raise ValueError(f"bad seed {seed!r}: unknown bug")
+            spec.bug = p[4:]
+        else:
+            raise ValueError(f"bad seed {seed!r}: unknown part {p!r}")
+    return spec
+
+
+@dataclasses.dataclass
+class Violation:
+    invariant: str
+    detail: str
+    spec: SimSpec
+
+    def seed(self) -> str:
+        return self.spec.seed_str()
+
+
+# ---- virtual clock ------------------------------------------------------
+
+
+class VirtualClock:
+    """The injectable monotonic clock (telemetry.set_clock hook). The
+    event loop owns it; nothing else may move it."""
+
+    def __init__(self, start: float = 0.0):
+        self.t = float(start)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance_to(self, t: float) -> None:
+        if t < self.t - 1e-9:
+            raise RuntimeError(f"virtual clock moved backwards: "
+                               f"{self.t} -> {t}")
+        self.t = max(self.t, t)
+
+
+# ---- cost model ---------------------------------------------------------
+
+# The shape the service times are priced for: a 7B-class decoder
+# (32 x 4096, GQA 8) — big enough that prefill/decode/swap land in the
+# regimes the real engine measures, priced by the SAME flops_model /
+# peak_tflops pair every MFU number in the repo reads.
+_COST_CFG = dict(vocab_size=32000, num_layers=32, num_heads=32,
+                 head_dim=128, embed_dim=4096, mlp_dim=11008,
+                 max_seq_len=4096, num_kv_heads=8)
+
+
+class CostModel:
+    """Per-token service-time price list with provenance. MFUs default
+    to the serving engine's observed operating points (prefill compute
+    bound, decode memory bound) and are operator-overridable the same
+    way the roofline denominators are."""
+
+    def __init__(self):
+        cfg = ModelConfig(**_COST_CFG)
+        fl = flops_model(cfg)
+        peak = telemetry.peak_tflops() * 1e12
+        self.mfu_prefill = _env_float("TPUBC_SIM_MFU_PREFILL", 0.55)
+        self.mfu_decode = _env_float("TPUBC_SIM_MFU_DECODE", 0.08)
+        self.prefill_s_per_tok = fl["prefill"] / (peak * self.mfu_prefill)
+        self.decode_s_per_tok = fl["decode"] / (peak * self.mfu_decode)
+        # KV bytes/token (bf16 k+v over all layers at the GQA width):
+        # the swap arm's numerator, moved at host_xfer_gbps.
+        kv_heads = cfg.num_kv_heads or cfg.num_heads
+        self.kv_bytes_per_tok = 2 * cfg.num_layers * kv_heads \
+            * cfg.head_dim * 2
+        self.swap_s_per_tok = self.kv_bytes_per_tok / (
+            telemetry.host_xfer_gbps() * 1e9)
+        self.params = fl["params"]
+
+    def provenance(self) -> dict:
+        return {
+            "params": self.params,
+            "peak_tflops": telemetry.peak_tflops(),
+            "host_xfer_gbps": telemetry.host_xfer_gbps(),
+            "mfu_prefill": self.mfu_prefill,
+            "mfu_decode": self.mfu_decode,
+            "prefill_ms_per_tok": round(self.prefill_s_per_tok * 1e3, 6),
+            "decode_ms_per_tok": round(self.decode_s_per_tok * 1e3, 6),
+            "swap_ms_per_tok": round(self.swap_s_per_tok * 1e3, 6),
+            "kv_bytes_per_tok": self.kv_bytes_per_tok,
+        }
+
+
+# ---- synthetic replica --------------------------------------------------
+
+
+@dataclasses.dataclass
+class SimRequest:
+    rid: int
+    t_arrival: float
+    tokens: list
+    fps: list            # radix chain fingerprints of the full blocks
+    max_new: int
+    deadline_s: float
+    family: int
+    epoch: int = 0       # bumped on kill/re-place; stale events ignore
+    promised: int = 0    # placement's promised cached tokens
+
+
+class SimReplica:
+    """A deterministic c-slot server with a real two-tier (HBM + host)
+    radix-fingerprint prefix cache. Service times come from the cost
+    model x a per-replica speed factor (hardware heterogeneity);
+    a ``degraded`` replica runs DEGRADE_FACTOR slower with a stalled
+    heartbeat — the slow-drip scenario's brownout, sized so a warm but
+    browned-out replica's first token blows the hedge budget while its
+    health checks keep answering ok."""
+
+    DEGRADE_FACTOR = 20.0
+
+    def __init__(self, name: str, cm: CostModel, *, slots: int,
+                 block_size: int, digest_blocks: int, speed: float):
+        self.name = name
+        self.cm = cm
+        self.block_size = block_size
+        self.digest_cap = digest_blocks
+        self.speed = speed
+        self.slots = [0.0] * max(1, slots)
+        self.hbm: OrderedDict = OrderedDict()   # fp -> None (LRU)
+        self.host: OrderedDict = OrderedDict()  # evicted tier (LRU)
+        self.digest_version = 0
+        self.crashed = False
+        self.draining = False
+        self.degraded = False
+        self.gen = 0          # crash epoch: stale completions ignore
+        self.inflight: list = []  # [start, done, req]
+        self.served = 0
+        # Per-poll observation window (cleared every SLO poll): the
+        # metrics fed to SloEngine come from completions since the
+        # last poll, so burn reacts at poll cadence.
+        self.window_ttft_ms: list = []
+        self.window_good: list = []
+
+    # -- cache ------------------------------------------------------------
+
+    def digest_doc(self) -> dict:
+        return {"version": self.digest_version,
+                "block_size": self.block_size,
+                "blocks": len(self.hbm),
+                "fps": list(self.hbm),
+                "host": {"fps": list(self.host),
+                         "blocks": len(self.host)}}
+
+    def insert_blocks(self, fps: list) -> None:
+        for fp in fps:
+            self.host.pop(fp, None)
+            self.hbm[fp] = None
+            self.hbm.move_to_end(fp)
+        while len(self.hbm) > self.digest_cap:
+            fp, _ = self.hbm.popitem(last=False)
+            self.host[fp] = None
+            self.host.move_to_end(fp)
+        while len(self.host) > 2 * self.digest_cap:
+            self.host.popitem(last=False)
+        self.digest_version += 1
+
+    # -- queue / service --------------------------------------------------
+
+    def _prune(self, now: float) -> None:
+        self.inflight = [e for e in self.inflight if e[1] > now]
+
+    def queue_depth(self, now: float) -> int:
+        self._prune(now)
+        return sum(1 for s, _d, _r in self.inflight if s > now)
+
+    def active(self, now: float) -> int:
+        self._prune(now)
+        return sum(1 for s, d, _r in self.inflight if s <= now < d)
+
+    def beat_age_ms(self, now: float) -> float:
+        return 10_000.0 if self.degraded else 50.0
+
+    def healthz(self, now: float) -> dict:
+        return {"ok": not self.crashed, "draining": self.draining,
+                "beat_age_ms": self.beat_age_ms(now)}
+
+    def price(self, req: SimRequest) -> tuple:
+        """(service_s, first_token_s, cached_tokens): walk the request's
+        chain fingerprints against the two-tier cache — HBM hits are
+        free, host-tier hits pay the cheaper of the swap-in and
+        recompute arms, the first miss ends the usable prefix (the
+        chain rule digest_match_len enforces)."""
+        bs = self.block_size
+        hits = 0
+        swap_blocks = 0
+        for fp in req.fps:
+            if fp in self.hbm:
+                hits += 1
+            elif fp in self.host:
+                hits += 1
+                swap_blocks += 1
+            else:
+                break
+        cached = min(hits * bs, len(req.tokens) - 1)
+        factor = self.speed * (self.DEGRADE_FACTOR if self.degraded
+                               else 1.0)
+        prefill_s = (len(req.tokens) - cached) \
+            * self.cm.prefill_s_per_tok * factor
+        # The preempt-cost arms: promote parked blocks at transfer
+        # speed unless recompute is cheaper on this replica.
+        swap_s = min(self.cm.swap_s_per_tok * factor,
+                     self.cm.prefill_s_per_tok * factor) \
+            * swap_blocks * bs
+        decode_s = req.max_new * self.cm.decode_s_per_tok * factor
+        first_token_s = prefill_s + swap_s
+        return first_token_s + decode_s, first_token_s, cached
+
+    def preview(self, now: float, service_s: float) -> tuple:
+        """Earliest-free-slot admission WITHOUT committing: (slot,
+        start, done, prev_busy_until) — hedging compares two previews
+        and commits exactly one."""
+        i = min(range(len(self.slots)), key=lambda j: (self.slots[j], j))
+        start = max(now, self.slots[i])
+        return i, start, start + service_s, self.slots[i]
+
+    def commit(self, slot: int, done: float, start: float,
+               req: SimRequest) -> None:
+        self.slots[slot] = done
+        self.inflight.append([start, done, req])
+
+    def crash(self, now: float) -> list:
+        """Kill the replica: every in-flight request dies; returns the
+        casualties for the router-level failover classification."""
+        self.crashed = True
+        self.gen += 1
+        self._prune(now)
+        dead = [(s, d, r) for s, d, r in self.inflight]
+        self.inflight = []
+        self.slots = [now] * len(self.slots)
+        return dead
+
+    def recover(self) -> None:
+        """Back, but COLD: the crash wiped HBM and the host tier."""
+        self.crashed = False
+        self.hbm.clear()
+        self.host.clear()
+        self.digest_version += 1
+
+
+# ---- fleet + transport stubs -------------------------------------------
+
+
+class SimFleet:
+    """The synthetic replica set plus the stubbed scrape transport:
+    ``serve_doc`` answers the three scrape endpoints from replica state
+    (raising for a crashed replica — the breaker path's trigger)."""
+
+    def __init__(self, cm: CostModel, clock: VirtualClock, rng, *,
+                 slots: int, block_size: int, digest_blocks: int):
+        self.cm = cm
+        self.clock = clock
+        self.rng = rng
+        self.slots = slots
+        self.block_size = block_size
+        self.digest_blocks = digest_blocks
+        self.replicas: OrderedDict = OrderedDict()
+        self._next_idx = 0
+
+    def spawn(self) -> SimReplica:
+        name = f"sim-{self._next_idx:04d}"
+        self._next_idx += 1
+        rep = SimReplica(
+            name, self.cm, slots=self.slots,
+            block_size=self.block_size, digest_blocks=self.digest_blocks,
+            speed=self.rng.uniform(0.9, 1.15))
+        self.replicas[name] = rep
+        return rep
+
+    def serve_doc(self, replica: str, path: str) -> dict:
+        rep = self.replicas.get(replica)
+        if rep is None or rep.crashed:
+            raise ConnectionError(f"{replica} unreachable")
+        now = self.clock.t
+        if path == "/healthz":
+            return rep.healthz(now)
+        if path == "/cachez":
+            return {"digest": rep.digest_doc()}
+        if path == "/poolz":
+            return {"scheduler": {"queue_depth": rep.queue_depth(now)},
+                    "pool": {"active": rep.active(now)}}
+        raise ValueError(f"unknown scrape path {path}")
+
+
+class SimRouter(FleetRouter):
+    """The real router with ONLY the HTTP transport stubbed (the
+    tools.mc move): scrape_once/_fold_scrape/_place/breakers/autoscale
+    all run the production code paths against SimFleet documents. The
+    constructor's listener socket is never served and is closed by the
+    harness."""
+
+    def __init__(self, fleet: SimFleet, **kwargs):
+        self._fleet = fleet
+        super().__init__(sorted(fleet.replicas), host="127.0.0.1",
+                         port=0, **kwargs)
+
+    def _fetch_json(self, replica: str, path: str):
+        # Keep the production fault site live through the stub:
+        # TPUBC_FAULT=router.scrape:... schedules compose with scenarios.
+        faults.fire("router.scrape")
+        return self._fleet.serve_doc(replica, path)
+
+
+class SimScaleDriver:
+    """The autoscale driver seam: scale-up spawns a cold synthetic
+    replica, scale-down drains the youngest (placements route around
+    it immediately; removal waits for its last in-flight completion —
+    the LocalFleetDriver contract without the subprocess)."""
+
+    def __init__(self, sim: "Simulation"):
+        self.sim = sim
+
+    def scale_to(self, n: int) -> None:
+        sim = self.sim
+        while True:
+            live = [r for r in sim.fleet.replicas.values()
+                    if not r.draining]
+            if len(live) < n:
+                rep = sim.fleet.spawn()
+                sim.router.add_replica(rep.name)
+                sim.note_scale("scale-up", len(live), len(live) + 1)
+            elif len(live) > n:
+                rep = live[-1]
+                rep.draining = True
+                sim.router.mark_draining(rep.name)
+                last = max((d for _s, d, _r in rep.inflight),
+                           default=0.0)
+                sim.schedule(max(last, sim.clock.t) + 1e-6,
+                             "drain-done", {"replica": rep.name})
+                sim.note_scale("scale-down", len(live), len(live) - 1)
+            else:
+                return
+
+    def stop(self) -> None:
+        pass
+
+
+# ---- trace replay -------------------------------------------------------
+
+
+def load_trace(path: str) -> list:
+    """A /requestz?format=jsonl capture -> normalized arrival list for
+    the replay scenario: (dt_from_first_s, prompt_len, max_new,
+    priority, deadline_s)."""
+    out = []
+    t0 = None
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            t_us = int(rec.get("t_arrival_us") or 0)
+            if t0 is None:
+                t0 = t_us
+            deadline = rec.get("deadline")
+            out.append({
+                "dt_s": (t_us - t0) / 1e6,
+                "prompt_len": int(rec.get("prompt_len") or 64),
+                "max_new": int(rec.get("max_new") or 32),
+                "priority": int(rec.get("priority") or 0),
+                "deadline_s": (float(deadline) / 1e3
+                               if isinstance(deadline, (int, float))
+                               and deadline else 10.0),
+            })
+    return out
+
+
+# ---- scenarios ----------------------------------------------------------
+
+
+def _scenario_params(spec: SimSpec) -> dict:
+    """Everything a scenario pins: arrival process, prompt shapes,
+    fault schedule, SLO windows, autoscale config, phases. One place so
+    a seed fully determines the run."""
+    n = spec.replicas
+    p = {
+        "scrape_s": 5.0,
+        "poll_s": 5.0,
+        "stale_s": 15.0,
+        "breaker_s": 2.0,
+        "hedge_s": 0.25,
+        "retries": 2,
+        "windows": (60.0, 300.0),
+        "families": 16,
+        "prefix_blocks": 4,
+        "suffix_tokens": 12,
+        "max_new": 32,
+        "deadline_s": 10.0,
+        "hot_family_share": None,   # (t_from, family, share)
+        "faults": [],               # [(t, kind, payload)]
+        "autoscale": None,          # (min, max) or None
+        "duration_s": 300.0,
+        "rate": None,               # fn(t) -> arrivals/s
+        "phases": [],
+    }
+    if spec.scenario == "diurnal":
+        dur = spec.duration_s or 300.0
+        base, peak = 0.004 * n, 0.02 * n
+
+        def rate(t, _b=base, _p=peak, _d=dur):
+            wave = 0.5 * (1.0 + math.sin(2 * math.pi * t / (_d / 2)
+                                         - math.pi / 2))
+            return _b + _p * wave * wave
+
+        p.update(duration_s=dur, rate=rate,
+                 autoscale=(max(1, n // 2), n),
+                 phases=[("wave-1", 0.0, dur / 2),
+                         ("wave-2", dur / 2, dur)])
+    elif spec.scenario == "hot-prefix":
+        dur = spec.duration_s or 240.0
+        r = max(4.0, 0.01 * n)
+        p.update(duration_s=dur, rate=lambda t, _r=r: _r,
+                 families=32,
+                 hot_family_share=(dur / 2, 0, 0.8),
+                 phases=[("uniform", 0.0, dur / 2),
+                         ("storm", dur / 2, dur)])
+    elif spec.scenario == "crash-cascade":
+        dur = spec.duration_s or 240.0
+        r = max(4.0, 0.01 * n)
+        t_crash = dur / 3
+        k = max(1, n // 5)
+        flts = [(t_crash + 0.2 * i, "crash", {"idx": i})
+                for i in range(k)]
+        flts += [(t_crash + 30.0 + 0.2 * i, "recover", {"idx": i})
+                 for i in range(k)]
+        p.update(duration_s=dur, rate=lambda t, _r=r: _r, faults=flts,
+                 phases=[("steady", 0.0, t_crash),
+                         ("cascade", t_crash, t_crash + 30.0),
+                         ("recovery", t_crash + 30.0, dur)])
+    elif spec.scenario == "slow-drip":
+        dur = spec.duration_s or 240.0
+        r = max(4.0, 0.008 * n)
+        drip = [(20.0 * (i + 1), "degrade", {"idx": i})
+                for i in range(min(n, int(dur // 20) - 1))]
+        # Long shared prefixes: cache affinity keeps sending traffic
+        # to the browned-out replicas it warmed, so the run shows
+        # whether the hedge gate (stalled beat + blown first-token
+        # budget) actually rescues those requests.
+        p.update(duration_s=dur, rate=lambda t, _r=r: _r, faults=drip,
+                 prefix_blocks=16, max_new=128,
+                 phases=[("drip", 0.0, dur)])
+    elif spec.scenario == "limit-cycle":
+        dur = spec.duration_s or 240.0
+        # Pinned at the 2 <-> 3 replica capacity boundary: ~1s service
+        # (decode-heavy), 8 slots/replica, 20 req/s offered = 2.5
+        # replicas' worth. Under-provisioned, queue wait crosses the
+        # ttft objective within a few polls; over-provisioned, the
+        # short burn window goes quiet just as fast. The default
+        # streak/cooldown trio damps that into a slow drift; the
+        # planted bug turns it into a poll-cadence flap the
+        # autoscale-limit-cycle invariant catches.
+        # Cache-NEUTRAL prompts (no shared prefix -> every score is 0
+        # -> pure least-load spread): this scenario studies autoscale
+        # dynamics, and cache-affinity herding would mask them.
+        p.update(duration_s=dur, max_new=1600, deadline_s=30.0,
+                 windows=(10.0,), poll_s=5.0,
+                 rate=lambda t: 20.0,
+                 families=1, prefix_blocks=0, suffix_tokens=12,
+                 autoscale=(1, max(8, min(16, n))),
+                 phases=[("steady", 0.0, dur)])
+    elif spec.scenario == "replay":
+        if not spec.trace:
+            raise ValueError("scenario 'replay' needs --replay-trace")
+        arrivals = load_trace(spec.trace)
+        dur = (arrivals[-1]["dt_s"] + 10.0) if arrivals else 10.0
+        p.update(duration_s=spec.duration_s or dur, trace=arrivals,
+                 phases=[("replay", 0.0, dur)])
+    else:
+        raise ValueError(f"unknown scenario {spec.scenario!r}")
+    return p
+
+
+# ---- the simulation -----------------------------------------------------
+
+
+class Simulation:
+    """One deterministic run: a heap of (t, seq, kind) events driving
+    arrivals, completions, scrapes, SLO polls, faults, and scale
+    actions against the real policy objects on the virtual clock."""
+
+    def __init__(self, spec: SimSpec):
+        self.spec = spec
+        self.params = _scenario_params(spec)
+        self.rng = random.Random(spec.seed)
+        self.clock = VirtualClock()
+        self.cm = CostModel()
+        self.block_size = _env_int("TPUBC_SIM_BLOCK_SIZE", 16)
+        self.fleet = SimFleet(
+            self.cm, self.clock, self.rng,
+            slots=_env_int("TPUBC_SIM_SLOTS", 8),
+            block_size=self.block_size,
+            digest_blocks=_env_int("TPUBC_SIM_DIGEST_BLOCKS", 256))
+        start_n = spec.replicas
+        if spec.scenario == "limit-cycle":
+            start_n = min(spec.replicas, 2)
+        for _ in range(start_n):
+            self.fleet.spawn()
+        autoscaler = None
+        if self.params["autoscale"] is not None:
+            lo, hi = self.params["autoscale"]
+            if spec.bug == "limit-cycle":
+                # The planted bug: the flap-damping trio disabled —
+                # 1-tick streaks, zero cooldown. The limit-cycle
+                # invariant must catch the oscillation this causes.
+                autoscaler = AutoscaleController(
+                    lo, hi, up_ticks=1, down_ticks=1, cooldown_s=0.0)
+            else:
+                autoscaler = AutoscaleController(lo, hi)
+        self.driver = SimScaleDriver(self)
+        self.router = SimRouter(
+            self.fleet,
+            scrape_s=self.params["scrape_s"],
+            stale_s=self.params["stale_s"],
+            breaker_s=self.params["breaker_s"],
+            hedge_s=self.params["hedge_s"],
+            retries=self.params["retries"],
+            autoscaler=autoscaler,
+            driver=self.driver if autoscaler is not None else None)
+        self.engine = SloEngine(windows=self.params["windows"], ring=32)
+        # Prompt families: each a pinned random prefix of full blocks;
+        # the per-family chain fps are memoized (identical prefix ->
+        # identical radix chain, so one hash walk serves every reuse).
+        self._families = []
+        for _ in range(self.params["families"]):
+            toks = [self.rng.randrange(2, 32000)
+                    for _ in range(self.params["prefix_blocks"]
+                                   * self.block_size)]
+            self._families.append(toks)
+        self._family_fps = {}
+        # Event heap + accounting.
+        self._heap: list = []
+        self._seq = 0
+        self._rid = 0
+        self.violations: list = []
+        self.scale_events: list = []
+        self.stats = {
+            "arrivals": 0, "served": 0, "good": 0,
+            "failed_midstream": 0, "unroutable": 0,
+            "failovers": 0, "hedges": 0, "misroutes": 0,
+            "placements": 0, "route_hits": 0, "degraded_placements": 0,
+            "breaker_open_events": 0, "swapin_blocks": 0,
+        }
+        self._open_breakers: set = set()
+        self._phase_stats = {name: {"arrivals": 0, "served": 0,
+                                    "good": 0, "route_hits": 0,
+                                    "placements": 0}
+                             for name, _a, _b in self.params["phases"]}
+        self._trace_events: list = []
+
+    # -- plumbing ---------------------------------------------------------
+
+    def schedule(self, t: float, kind: str, payload: dict) -> None:
+        self._seq += 1
+        heapq.heappush(self._heap, (t, self._seq, kind, payload))
+
+    def note_scale(self, action: str, cur: int, target: int) -> None:
+        self.scale_events.append({"t": round(self.clock.t, 6),
+                                  "action": action,
+                                  "from": cur, "to": target})
+        self._trace_events.append({
+            "name": f"{action} {cur}->{target}", "ph": "i",
+            "ts": int(self.clock.t * 1e6), "pid": 0, "tid": 1,
+            "cat": "autoscale", "s": "g"})
+
+    def _phase_of(self, t: float):
+        for name, a, b in self.params["phases"]:
+            if a <= t < b:
+                return self._phase_stats[name]
+        return None
+
+    def _chain_fps(self, tokens: list, family: int) -> list:
+        """The radix chain for a prompt = memoized family-prefix chain
+        + freshly hashed unique-suffix blocks (same block_hash chain
+        the engine's prefix cache keys on)."""
+        bs = self.block_size
+        pb = self.params["prefix_blocks"]
+        pre = self._family_fps.get(family)
+        if pre is None:
+            key = b""
+            fps = []
+            for j in range(pb):
+                key = block_hash(key, tokens[j * bs:(j + 1) * bs])
+                fps.append(key_fingerprint(key))
+            pre = self._family_fps[family] = (fps, key)
+        fps, key = list(pre[0]), pre[1]
+        for j in range(pb, len(tokens) // bs):
+            key = block_hash(key, tokens[j * bs:(j + 1) * bs])
+            fps.append(key_fingerprint(key))
+        return fps
+
+    def _mk_request(self, now: float) -> SimRequest:
+        hot = self.params["hot_family_share"]
+        nfam = self.params["families"]
+        if hot is not None and now >= hot[0] \
+                and self.rng.random() < hot[2]:
+            family = hot[1]
+        else:
+            family = self.rng.randrange(nfam)
+        tokens = list(self._families[family])
+        tokens += [self.rng.randrange(2, 32000)
+                   for _ in range(self.params["suffix_tokens"])]
+        self._rid += 1
+        return SimRequest(
+            rid=self._rid, t_arrival=now, tokens=tokens,
+            fps=self._chain_fps(tokens, family),
+            max_new=self.params["max_new"],
+            deadline_s=self.params["deadline_s"], family=family)
+
+    # -- dispatch (the synthetic data plane) ------------------------------
+
+    def _dispatch(self, req: SimRequest, exclude: set,
+                  failover_budget: int) -> None:
+        """Place via the REAL _place, admit on the synthetic replica,
+        hedge through the real beat-stall gate, fail over through the
+        real breaker bookkeeping."""
+        now = self.clock.t
+        placement = self.router._place(req.tokens, exclude=exclude)
+        if placement is None:
+            self.stats["unroutable"] += 1
+            return
+        name, promised, degraded = placement
+        st = self.router._replicas.get(name)
+        # Pure read: allow() would transition open -> half-open itself.
+        if st is not None and st["breaker"].state == "open" \
+                and now < st["breaker"].open_until:
+            self._violate("breaker-open-dispatch",
+                          f"placement chose {name} with an open breaker")
+        self.stats["placements"] += 1
+        ph = self._phase_of(req.t_arrival)
+        if ph is not None:
+            ph["placements"] += 1
+        if degraded:
+            self.stats["degraded_placements"] += 1
+        if promised > 0:
+            self.stats["route_hits"] += 1
+            if ph is not None:
+                ph["route_hits"] += 1
+        req.promised = promised
+        rep = self.fleet.replicas.get(name)
+        try:
+            faults.fire("sim.dispatch")
+            if rep is None or rep.crashed:
+                raise ConnectionError(f"{name} unreachable")
+        except Exception as e:  # noqa: BLE001 - dispatch death
+            self.router._breaker_fail(name, f"{type(e).__name__}: {e}")
+            if failover_budget > 0:
+                self.stats["failovers"] += 1
+                self._dispatch(req, exclude | {name},
+                               failover_budget - 1)
+            else:
+                self.stats["unroutable"] += 1
+            return
+        service_s, first_s, cached = rep.price(req)
+        slot, start, done, _prev = rep.preview(now, service_s)
+        # The hedge gate, exactly as the proxy runs it: no first token
+        # within hedge_s AND a stalled heartbeat on the scraped state.
+        est_ttft = (start - now) + first_s
+        if est_ttft > self.router.hedge_s \
+                and self.router._beat_stalled(name):
+            alt = self.router._place(req.tokens, exclude=exclude | {name})
+            if alt is not None:
+                alt_rep = self.fleet.replicas.get(alt[0])
+                if alt_rep is not None and not alt_rep.crashed:
+                    a_service, a_first, a_cached = alt_rep.price(req)
+                    a_slot, a_start, a_done, _p = alt_rep.preview(
+                        now, a_service)
+                    self.stats["hedges"] += 1
+                    if (a_start - now) + a_first < est_ttft:
+                        rep, name = alt_rep, alt[0]
+                        slot, start, done = a_slot, a_start, a_done
+                        service_s, first_s, cached = (a_service, a_first,
+                                                      a_cached)
+                        req.promised = alt[1]
+        rep.commit(slot, done, start, req)
+        # Mirror _route's dispatch bookkeeping: st["inflight"] is the
+        # router's own between-scrapes load correction, and placement
+        # herds onto one replica without it.
+        st = self.router._replicas.get(name)
+        if st is not None:
+            st["inflight"] += 1
+            st["dispatches"] += 1
+        self.schedule(done, "complete", {
+            "replica": name, "rid": req.rid, "req": req,
+            "epoch": req.epoch, "gen": rep.gen,
+            "ttft_s": (start - now) + first_s, "cached": cached})
+
+    def _violate(self, invariant: str, detail: str) -> None:
+        self.violations.append(Violation(invariant, detail, self.spec))
+
+    # -- event handlers ---------------------------------------------------
+
+    def _on_arrive(self, payload: dict) -> None:
+        now = self.clock.t
+        self.stats["arrivals"] += 1
+        ph = self._phase_of(now)
+        if ph is not None:
+            ph["arrivals"] += 1
+        req = payload.get("req") or self._mk_request(now)
+        self._dispatch(req, set(), self.router.retries)
+        # Schedule the next arrival (open-loop arrival process).
+        if "req" not in payload:
+            rate = self.params["rate"](now)
+            if rate > 1e-9:
+                dt = self.rng.expovariate(rate)
+                t_next = now + dt
+                if t_next < self.params["duration_s"]:
+                    self.schedule(t_next, "arrive", {})
+            else:
+                self.schedule(now + 1.0, "arrive", {})
+
+    def _on_complete(self, payload: dict) -> None:
+        req: SimRequest = payload["req"]
+        rep = self.fleet.replicas.get(payload["replica"])
+        if rep is None or payload["gen"] != rep.gen \
+                or payload["epoch"] != req.epoch:
+            return  # killed by a crash, or re-placed: stale event
+        st = self.router._replicas.get(payload["replica"])
+        if st is not None:
+            st["inflight"] = max(0, st["inflight"] - 1)
+        now = self.clock.t
+        rep.served += 1
+        self.stats["served"] += 1
+        rep.insert_blocks(req.fps)
+        total_s = now - req.t_arrival
+        good = total_s <= req.deadline_s
+        if good:
+            self.stats["good"] += 1
+        ph = self._phase_of(req.t_arrival)
+        if ph is not None:
+            ph["served"] += 1
+            if good:
+                ph["good"] += 1
+        rep.window_ttft_ms.append(payload["ttft_s"] * 1e3)
+        rep.window_good.append(good)
+        # The production misroute check: stale digests that promised
+        # blocks the replica no longer held are counted, not errored.
+        self.router._misroute_check(rep.name, req.promised,
+                                    payload["cached"])
+        if req.promised > 0 and payload["cached"] < req.promised:
+            self.stats["misroutes"] += 1
+
+    def _on_scrape(self, _payload: dict) -> None:
+        now = self.clock.t
+        self.router.scrape_once(now=now)
+        open_now = {r for r, st in self.router._replicas.items()
+                    if st["breaker"].state == "open"}
+        self.stats["breaker_open_events"] += len(
+            open_now - self._open_breakers)
+        self._open_breakers = open_now
+        if now + self.params["scrape_s"] < self.params["duration_s"]:
+            self.schedule(now + self.params["scrape_s"], "scrape", {})
+
+    def _on_poll(self, _payload: dict) -> None:
+        """One SLO tick: feed the engine per-replica observations from
+        the window since the last poll, evaluate burn, drive the real
+        autoscale path off the burn document."""
+        now = self.clock.t
+        for rep in self.fleet.replicas.values():
+            if rep.crashed:
+                continue
+            # The engine samples by /metrics.json KEY (obj.key), so the
+            # twin publishes the same metric names a live replica does.
+            m: dict = {"serve_queue_depth": rep.queue_depth(now)}
+            if rep.window_ttft_ms:
+                s = sorted(rep.window_ttft_ms)
+                m["serve_ttft_ms_p99"] = s[min(len(s) - 1,
+                                               int(0.99 * (len(s) - 1)))]
+            if rep.window_good:
+                m["serve_admitted_ratio"] = (
+                    sum(1 for g in rep.window_good if g)
+                    / len(rep.window_good))
+            rep.window_ttft_ms = []
+            rep.window_good = []
+            self.engine.record(rep.name, m, t=now)
+        burn = self.engine.evaluate(now=now)
+        if self.router.autoscaler is not None:
+            self.router.autoscale_once(burn=burn, now=now)
+        if now + self.params["poll_s"] < self.params["duration_s"]:
+            self.schedule(now + self.params["poll_s"], "poll", {})
+
+    def _on_fault(self, payload: dict) -> None:
+        kind = payload["kind"]
+        names = sorted(self.fleet.replicas)
+        idx = payload["idx"] % max(1, len(names))
+        rep = self.fleet.replicas[names[idx]]
+        now = self.clock.t
+        self._trace_events.append({
+            "name": f"{kind} {rep.name}", "ph": "i",
+            "ts": int(now * 1e6), "pid": 0, "tid": 2, "cat": "fault",
+            "s": "g"})
+        if kind == "crash":
+            casualties = rep.crash(now)
+            st = self.router._replicas.get(rep.name)
+            if st is not None:
+                st["inflight"] = max(0, st["inflight"]
+                                     - len(casualties))
+            for start, _done, req in casualties:
+                req.epoch += 1
+                first_s = rep.price(req)[1]
+                if now < start + first_s:
+                    # Pre-first-token: the real state machine re-places
+                    # on survivors silently.
+                    self.router._breaker_fail(rep.name,
+                                              "replica crashed")
+                    self.stats["failovers"] += 1
+                    self._dispatch(req, {rep.name},
+                                   self.router.retries - 1)
+                else:
+                    # Mid-stream: exactly-one-terminal-outcome says a
+                    # terminal failover error, never a re-run.
+                    self.stats["failed_midstream"] += 1
+        elif kind == "recover":
+            rep.recover()
+        elif kind == "degrade":
+            rep.degraded = True
+
+    def _on_drain_done(self, payload: dict) -> None:
+        name = payload["replica"]
+        rep = self.fleet.replicas.get(name)
+        if rep is None or not rep.draining:
+            return
+        self.router.remove_replica(name)
+        del self.fleet.replicas[name]
+
+    # -- run + report -----------------------------------------------------
+
+    def run(self) -> dict:
+        telemetry.set_clock(self.clock)
+        try:
+            self.schedule(0.0, "scrape", {})
+            self.schedule(self.params["poll_s"], "poll", {})
+            self.schedule(0.0, "arrive", {})
+            for t, kind, payload in self.params["faults"]:
+                self.schedule(t, "fault", dict(payload, kind=kind))
+            if self.params.get("trace") is not None:
+                # Replay mode: the recorded arrivals ARE the process.
+                self._heap = [e for e in self._heap if e[2] != "arrive"]
+                heapq.heapify(self._heap)
+                for a in self.params["trace"]:
+                    req_tokens_len = max(self.block_size,
+                                         a["prompt_len"])
+                    fam = a["prompt_len"] % self.params["families"]
+                    tokens = list(self._families[fam])
+                    extra = req_tokens_len - len(tokens)
+                    if extra > 0:
+                        tokens += [self.rng.randrange(2, 32000)
+                                   for _ in range(extra)]
+                    else:
+                        tokens = tokens[:req_tokens_len]
+                    self._rid += 1
+                    req = SimRequest(
+                        rid=self._rid, t_arrival=a["dt_s"],
+                        tokens=tokens,
+                        fps=self._chain_fps_raw(tokens),
+                        max_new=a["max_new"],
+                        deadline_s=a["deadline_s"], family=fam)
+                    self.schedule(a["dt_s"], "arrive", {"req": req})
+            handlers = {"arrive": self._on_arrive,
+                        "complete": self._on_complete,
+                        "scrape": self._on_scrape,
+                        "poll": self._on_poll,
+                        "fault": self._on_fault,
+                        "drain-done": self._on_drain_done}
+            # Arrivals stop at duration_s by construction, so the heap
+            # drains to empty: every admitted request reaches a
+            # terminal outcome (the accounting invariant's premise).
+            # The hard cap only guards against a harness bug looping.
+            hard_stop = self.params["duration_s"] + 86_400.0
+            while self._heap:
+                t, _seq, kind, payload = heapq.heappop(self._heap)
+                if t > hard_stop:
+                    raise RuntimeError(
+                        f"event at t={t:.1f}s past the hard stop — "
+                        f"the event loop is not draining")
+                self.clock.advance_to(t)
+                handlers[kind](payload)
+            self._check_end_invariants()
+            return self._report()
+        finally:
+            telemetry.set_clock(None)
+            self.router.httpd.server_close()
+
+    def _chain_fps_raw(self, tokens: list) -> list:
+        key = b""
+        fps = []
+        for j in range(len(tokens) // self.block_size):
+            key = block_hash(
+                key, tokens[j * self.block_size:
+                            (j + 1) * self.block_size])
+            fps.append(key_fingerprint(key))
+        return fps
+
+    def _check_end_invariants(self) -> None:
+        s = self.stats
+        accounted = (s["served"] + s["failed_midstream"]
+                     + s["unroutable"])
+        if accounted != s["arrivals"]:
+            self._violate(
+                "request-accounting",
+                f"{s['arrivals']} arrivals but {accounted} terminal "
+                f"outcomes (served {s['served']} + midstream "
+                f"{s['failed_midstream']} + unroutable "
+                f"{s['unroutable']})")
+        # Autoscale limit-cycle detector: many scale actions with ~zero
+        # net fleet change inside one sliding window is churn without
+        # progress. The flap-damping trio bounds a healthy controller
+        # to cooldown_s-spaced actions (<= 4 per 120s window), so the
+        # churn threshold below is unreachable unless damping is off —
+        # which is exactly the planted bug.
+        window, min_events, max_net = 120.0, 8, 2
+        ev = self.scale_events
+        for i in range(len(ev)):
+            j = i
+            while j + 1 < len(ev) and ev[j + 1]["t"] - ev[i]["t"] \
+                    <= window:
+                j += 1
+            n_ev = j - i + 1
+            net = ev[j]["to"] - ev[i]["from"]
+            if n_ev >= min_events and abs(net) <= max_net:
+                self._violate(
+                    "autoscale-limit-cycle",
+                    f"{n_ev} scale actions with net fleet change "
+                    f"{net:+d} within {window:.0f}s "
+                    f"(t={ev[i]['t']:.1f}s...{ev[j]['t']:.1f}s) — "
+                    f"the controller is churning in a limit cycle, "
+                    f"not converging")
+                break
+
+    def _report(self) -> dict:
+        s = self.stats
+        served = max(1, s["served"])
+        placements = max(1, s["placements"])
+        per_phase = {}
+        for name, a, b in self.params["phases"]:
+            st = self._phase_stats[name]
+            per_phase[name] = {
+                "window_s": [round(a, 3), round(b, 3)],
+                "arrivals": st["arrivals"],
+                "served": st["served"],
+                "slo_attainment": round(
+                    st["good"] / max(1, st["served"]), 6),
+                "route_hit_frac": round(
+                    st["route_hits"] / max(1, st["placements"]), 6),
+            }
+        report = {
+            "sim": {
+                "scenario": self.spec.scenario,
+                "seed": self.spec.seed,
+                "seed_str": self.spec.seed_str(),
+                "bug": self.spec.bug,
+                "replicas_initial": self.spec.replicas,
+                "replicas_final": len(self.fleet.replicas),
+                "virtual_duration_s": round(
+                    self.params["duration_s"], 3),
+            },
+            "cost_model": self.cm.provenance(),
+            "traffic": {
+                "arrivals": s["arrivals"],
+                "served": s["served"],
+                "good": s["good"],
+                "failed_midstream": s["failed_midstream"],
+                "unroutable": s["unroutable"],
+                "failovers": s["failovers"],
+                "hedges": s["hedges"],
+                "misroutes": s["misroutes"],
+            },
+            "slo_attainment": round(s["good"] / served, 6),
+            "goodput_frac": round(s["good"] / max(1, s["arrivals"]), 6),
+            "route_hit_frac": round(s["route_hits"] / placements, 6),
+            "degraded_placements": s["degraded_placements"],
+            "breaker_open_events": s["breaker_open_events"],
+            "scale_events": self.scale_events,
+            "alerts": self.engine.alerts(),
+            "per_phase": per_phase,
+            "violations": [{"invariant": v.invariant,
+                            "detail": v.detail, "seed": v.seed()}
+                           for v in self.violations],
+        }
+        return report
+
+    def chrome_trace(self) -> dict:
+        """The per-phase timeline of the simulated fleet, Chrome
+        trace-event JSON (Perfetto-loadable): phase spans, scale/fault
+        instants, alert transitions."""
+        events = [{"name": "process_name", "ph": "M", "pid": 0,
+                   "args": {"name": f"tools.sim {self.spec.seed_str()}"}}]
+        for name, a, b in self.params["phases"]:
+            events.append({"name": f"phase:{name}", "ph": "X",
+                           "ts": int(a * 1e6),
+                           "dur": int((b - a) * 1e6),
+                           "pid": 0, "tid": 0, "cat": "phase"})
+        events.extend(self._trace_events)
+        for tr in self.engine.alerts()["transitions"]:
+            events.append({"name": f"{tr['event']}:{tr['slo']}"
+                                   f"@{tr['replica']}",
+                           "ph": "i", "ts": tr["t_us"], "pid": 0,
+                           "tid": 3, "cat": "slo", "s": "g"})
+        return {"displayTimeUnit": "ms", "traceEvents": events}
+
+
+def run(spec: SimSpec) -> tuple:
+    """Run one spec; returns (report, violations, sim)."""
+    sim = Simulation(spec)
+    report = sim.run()
+    return report, sim.violations, sim
+
+
+def report_bytes(report: dict) -> bytes:
+    """THE byte-identity surface: same seed -> same bytes, asserted by
+    the CI determinism check."""
+    return (json.dumps(report, sort_keys=True, indent=1) + "\n").encode()

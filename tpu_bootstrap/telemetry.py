@@ -51,9 +51,37 @@ TRACE_ID_ENV = "TPUBC_TRACE_ID"
 _WALL_BASE_US = int(time.time() * 1e6)
 _MONO_BASE_NS = time.monotonic_ns()
 
+# The ONE injectable monotonic clock every control-plane timing read
+# goes through (router scrape/breaker horizons, fleetz poll/burn
+# windows, ingress heartbeat/drain deadlines). None = the real
+# time.monotonic; tools.sim installs a virtual clock here and the
+# entire control plane — including now_us()-stamped snapshots and
+# alert transitions — runs on simulated time with zero wall sleeps.
+# Deliberately monotonic-only: wall-clock (NTP-steppable) time must
+# never feed backoff or staleness math.
+_CLOCK = None
+
+
+def set_clock(fn) -> None:
+    """Install an injected monotonic clock (a callable returning
+    seconds), or restore the real one with ``set_clock(None)``."""
+    global _CLOCK
+    _CLOCK = fn
+
+
+def monotonic() -> float:
+    """Monotonic seconds from the injectable control-plane clock."""
+    fn = _CLOCK
+    return time.monotonic() if fn is None else fn()
+
 
 def now_us() -> int:
-    """Wall-aligned monotonic microseconds (see module docstring)."""
+    """Wall-aligned monotonic microseconds (see module docstring).
+    Under an injected clock this is the virtual time in microseconds —
+    simulated snapshots and transitions carry deterministic stamps."""
+    fn = _CLOCK
+    if fn is not None:
+        return int(fn() * 1e6)
     return _WALL_BASE_US + (time.monotonic_ns() - _MONO_BASE_NS) // 1000
 
 

@@ -56,9 +56,13 @@ FAULT_ENV = "TPUBC_FAULT"
 #   router.scrape    the router's own /cachez+/poolz+/healthz poll leg
 #                 failing — placement must degrade to queue depth, the
 #                 breaker must open on sustained loss
+#   sim.dispatch  tools.sim's synthetic replica leg (the stand-in for
+#                 router.dispatch inside the digital twin) — lets a
+#                 TPUBC_FAULT schedule compose with a simulated
+#                 scenario without touching the scenario's own seed
 SITES = ("pool.device", "alloc", "sched.admit", "ingress.write",
          "ckpt.save", "scrape", "swap.xfer", "router.dispatch",
-         "router.scrape")
+         "router.scrape", "sim.dispatch")
 
 
 class InjectedFault(RuntimeError):

@@ -143,7 +143,7 @@ class SloEngine:
                t: float | None = None) -> None:
         """Feed one scraped /metrics.json instant: every objective whose
         key is present and numeric gains a sample."""
-        t = time.monotonic() if t is None else t
+        t = telemetry.monotonic() if t is None else t
         with self._lock:
             for obj in self.objectives:
                 v = metrics.get(obj.key)
@@ -175,7 +175,7 @@ class SloEngine:
         combined burn (min across windows with samples — the page
         condition "ALL windows exceed" ⇔ "min exceeds"), and the
         firing flag; updates alert state and records transitions."""
-        now = time.monotonic() if now is None else now
+        now = telemetry.monotonic() if now is None else now
         out: dict = {}
         with self._lock:
             by_obj = {o.name: o for o in self.objectives}
@@ -499,7 +499,7 @@ class FleetAggregator:
     def _scrape(self, replica: str) -> dict:
         """All paths for one replica, outside any aggregator lock (a 5s
         timeout under a lock would freeze every render)."""
-        t0 = time.monotonic()
+        t0 = telemetry.monotonic()
         out = {"ok": True, "error": None}
         for path in SCRAPE_PATHS:
             key = _PATH_KEY[path]
@@ -512,7 +512,7 @@ class FleetAggregator:
                 out["ok"] = False
                 out["error"] = f"{path}: {e}"
                 break
-        out["scrape_ms"] = round((time.monotonic() - t0) * 1e3, 3)
+        out["scrape_ms"] = round((telemetry.monotonic() - t0) * 1e3, 3)
         return out
 
     def poll_once(self, now: float | None = None) -> list:
@@ -520,7 +520,7 @@ class FleetAggregator:
         elapsed, fold results into the per-replica state, feed the SLO
         engine, refresh the fleet gauges. Returns the replicas scraped
         (tests drive this directly; the poll thread just loops it)."""
-        now = time.monotonic() if now is None else now
+        now = telemetry.monotonic() if now is None else now
         with self._lock:
             due = [r for r in self.replicas
                    if self._state[r]["next_attempt"] <= now]
@@ -642,7 +642,7 @@ class FleetAggregator:
         # Deferred: router imports this module for BACKOFF_CAP_S, so the
         # shared breaker-view shape is fetched at call time, not import.
         from .router import breaker_view
-        now = time.monotonic() if now is None else now
+        now = telemetry.monotonic() if now is None else now
         windowed = (self._windowed_metrics(window)
                     if window is not None else {})
         with self._lock:

@@ -287,7 +287,7 @@ class IngressServer:
         # boundary; a stale heartbeat with streams in flight flips
         # /healthz unhealthy (stall), and a DEAD engine thread triggers
         # crash-is-preemption recovery + a fresh engine thread.
-        self._beat = time.monotonic()  # guarded-by: _lock
+        self._beat = telemetry.monotonic()  # guarded-by: _lock
         self._stalled = False  # guarded-by: _lock
         if watchdog_stall_ms is None:
             watchdog_stall_ms = float(os.environ.get(
@@ -358,7 +358,12 @@ class IngressServer:
                     # The data-plane /statusz: recent + in-flight
                     # requests with full phase breakdown; ?rid= filters
                     # to one; trace ids join /traces.json.
+                    # ?format=jsonl flips to the arrival-record export
+                    # (one line per request, arrival order) — the
+                    # capture half of tools.sim's capture/replay loop.
                     q = parse_qs(url.query)
+                    if q.get("format", [None])[0] == "jsonl":
+                        return self._jsonl(outer.sched.log.arrivals())
                     rid = q.get("rid", [None])[0]
                     if rid is not None:
                         try:
@@ -414,7 +419,7 @@ class IngressServer:
                     ttft = sorted(outer._ttft_ms)
                     total = sorted(outer._total_ms)
                     draining = outer._draining
-                    stalled_ms = (time.monotonic() - outer._beat) * 1e3
+                    stalled_ms = (telemetry.monotonic() - outer._beat) * 1e3
                     # Re-validate the watchdog's cached verdict against
                     # the live heartbeat: once a stall resolves, health
                     # must flip back before the next watchdog tick.
@@ -498,7 +503,7 @@ class IngressServer:
                 req = Request(
                     rid=-1, tokens=tokens, max_new=max_new,
                     priority=priority, trace_id=trace_id,
-                    deadline=(time.monotonic() + deadline_ms / 1e3
+                    deadline=(telemetry.monotonic() + deadline_ms / 1e3
                               if deadline_ms is not None else None))
                 try:
                     # Validate BEFORE enqueueing, with the POOL'S OWN
@@ -682,6 +687,15 @@ class IngressServer:
                 self.end_headers()
                 self.wfile.write(payload)
 
+            def _jsonl(self, records):
+                payload = "".join(
+                    json.dumps(r) + "\n" for r in records).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self.httpd.server_address[1]
         self._engine = threading.Thread(target=self._engine_loop, daemon=True)
@@ -739,7 +753,7 @@ class IngressServer:
                 self._idem_gc_locked()
                 out_q = fan
             self._pending.append((req, out_q))
-            self._submit_t[req.rid] = (time.monotonic(), None)
+            self._submit_t[req.rid] = (telemetry.monotonic(), None)
             self._req_meta[req.rid] = (
                 req.priority, req.trace_id or telemetry.root_trace_id())
             telemetry.metrics().set_gauge("serve_queue_depth", depth + 1)
@@ -760,7 +774,7 @@ class IngressServer:
     def _engine_loop(self):
         while True:
             with self._work:
-                self._beat = time.monotonic()
+                self._beat = telemetry.monotonic()
                 while (not self._stop and not self._pending
                        and not self.pool.has_active()
                        and not self.sched.pending()
@@ -769,7 +783,7 @@ class IngressServer:
                     self._work.wait()
                     # Idle waits are not stalls: stamp the heartbeat on
                     # every wakeup so the watchdog only measures rounds.
-                    self._beat = time.monotonic()
+                    self._beat = telemetry.monotonic()
                 if self._stop:
                     return
                 # Take the handoff under the lock; scheduling itself
@@ -858,7 +872,7 @@ class IngressServer:
                     self.sched.reset()
                 self._publish_poolz()
                 continue
-            now = time.monotonic()
+            now = telemetry.monotonic()
             reg = telemetry.metrics()
             with self._work:
                 for rid, ev in events.items():
@@ -965,7 +979,7 @@ class IngressServer:
                     and not self.sched.pending()
                     and not self.pool.has_active())
             expired = (self._drain_deadline is not None
-                       and time.monotonic() >= self._drain_deadline)
+                       and telemetry.monotonic() >= self._drain_deadline)
             if not (idle or expired):
                 return
             if not idle:
@@ -1028,7 +1042,7 @@ class IngressServer:
             prof = self._profile
         if prof is None or prof.get("result") is not None:
             return
-        now = time.monotonic()
+        now = telemetry.monotonic()
         if prof["deadline"] is None:
             prof["mode"] = "profiler"
             try:
@@ -1042,7 +1056,7 @@ class IngressServer:
             # backend init can take seconds, and counting it would let
             # the whole capture window elapse inside start_trace with
             # zero rounds observed.
-            now = time.monotonic()
+            now = telemetry.monotonic()
             prof["base"] = dict(self.sched.ledger)
             prof["t0"] = now
             prof["deadline"] = now + prof["ms"] / 1e3
@@ -1093,7 +1107,7 @@ class IngressServer:
             deadline = self._drain_deadline
         if deadline is None:
             return 1
-        return max(1, min(30, int(deadline - time.monotonic()) + 1))
+        return max(1, min(30, int(deadline - telemetry.monotonic()) + 1))
 
     def drain(self, timeout_ms: float | None = None) -> float:
         """Graceful drain (the SIGTERM path; tests call it directly):
@@ -1108,7 +1122,7 @@ class IngressServer:
         if timeout_ms is None:
             timeout_ms = float(os.environ.get(
                 "TPUBC_DRAIN_TIMEOUT_MS", "5000"))
-        t0 = time.monotonic()
+        t0 = telemetry.monotonic()
         with self._work:
             if not self._draining:
                 self._draining = True
@@ -1119,9 +1133,9 @@ class IngressServer:
             # window the caller proceeds to stop() and the OS reaps the
             # sockets (the watchdog will have marked the stall).
             grace = t0 + timeout_ms / 1e3 + 30.0
-            while not self._drained and time.monotonic() < grace:
+            while not self._drained and telemetry.monotonic() < grace:
                 self._work.wait(0.1)
-        ms = (time.monotonic() - t0) * 1e3
+        ms = (telemetry.monotonic() - t0) * 1e3
         telemetry.metrics().set_gauge("serve_drain_ms", round(ms, 1))
         return ms
 
@@ -1140,7 +1154,7 @@ class IngressServer:
                 if self._stop:
                     return
                 busy = bool(self._streams) or bool(self._pending)
-                age_ms = (time.monotonic() - self._beat) * 1e3
+                age_ms = (telemetry.monotonic() - self._beat) * 1e3
                 alive = self._engine.is_alive()
                 stalled = (busy and alive
                            and age_ms > self.watchdog_stall_ms)

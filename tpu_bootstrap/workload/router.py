@@ -70,6 +70,7 @@ import threading
 import time
 import urllib.error
 import urllib.request
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
@@ -219,7 +220,7 @@ class AutoscaleController:
         """One evaluation; returns the new target size, or None while
         hysteresis holds. Pure in (current, burn, now) plus streak
         state — tests drive it with canned burn series."""
-        now = time.monotonic() if now is None else now
+        now = telemetry.monotonic() if now is None else now
         burns = [d for slos in (burn or {}).values()
                  for d in slos.values() if isinstance(d, dict)]
         firing = any(d.get("firing") for d in burns)
@@ -412,6 +413,9 @@ class FleetRouter:
         # restarts (a replica's dedupe cache may outlive us).
         self._rid_seed = f"{os.getpid():x}-{telemetry.now_us():x}"
         self._stop = threading.Event()
+        # Arrival capture (/requestz?format=jsonl): every accepted
+        # front-door request as a replayable arrival record, bounded.
+        self._arrivals = deque(maxlen=4096)  # guarded-by: _lock
         self._scrape_thread: threading.Thread | None = None
         self._autoscale_thread: threading.Thread | None = None
         for r in (replicas or []):
@@ -437,11 +441,31 @@ class FleetRouter:
                 self.end_headers()
                 self.wfile.write(payload)
 
+            def _jsonl(self, records):
+                payload = "".join(
+                    json.dumps(r) + "\n" for r in records).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
             def do_GET(self):
                 url = urlparse(self.path)
                 path = url.path
                 if path == "/routerz":
                     return self._json(200, outer.routerz_json())
+                if path == "/requestz":
+                    # Fleet-level arrival capture: the router's accepted
+                    # front-door requests as replayable records.
+                    # ?format=jsonl streams one line per arrival (the
+                    # tools.sim --replay-trace input); bare wraps the
+                    # same records in one JSON document.
+                    fmt = parse_qs(url.query).get("format", [None])[0]
+                    if fmt == "jsonl":
+                        return self._jsonl(outer.arrival_records())
+                    return self._json(
+                        200, {"requests": outer.arrival_records()})
                 if path == "/metrics":
                     body = outer.reg.to_prometheus().encode()
                     self.send_response(200)
@@ -462,7 +486,7 @@ class FleetRouter:
                                 400, {"error": "window must be a number"})
                     return self._json(200, outer.reg.to_json())
                 if path == "/healthz":
-                    now = time.monotonic()
+                    now = telemetry.monotonic()
                     with outer._lock:
                         routable = sum(
                             1 for st in outer._replicas.values()
@@ -505,6 +529,7 @@ class FleetRouter:
                 if not request_id:
                     request_id = outer._gen_request_id()
                 body["request_id"] = request_id
+                outer._note_arrival(body, request_id)
                 # The router always streams its replica leg: first-token
                 # detection is what splits "safe to re-place" from
                 # "terminal failover error", and a non-stream leg would
@@ -569,7 +594,7 @@ class FleetRouter:
         refresh digest + queue + health, close the breaker on success,
         escalate it on failure. Runs outside the lock (a hung replica
         must not freeze placement); folds under it."""
-        now = time.monotonic() if now is None else now
+        now = telemetry.monotonic() if now is None else now
         with self._lock:
             due = [r for r, st in self._replicas.items()
                    if st["breaker"].state == "closed"
@@ -589,7 +614,7 @@ class FleetRouter:
 
     def _fold_scrape(self, replica: str, hz, cz, pz,
                      err: str | None = None) -> None:
-        now = time.monotonic()
+        now = telemetry.monotonic()
         with self._lock:
             st = self._replicas.get(replica)
             if st is None:
@@ -653,7 +678,7 @@ class FleetRouter:
         stale -> pure least-queue (degraded). Returns (replica,
         promised_tokens, degraded) or None when no replica is
         eligible."""
-        now = time.monotonic()
+        now = telemetry.monotonic()
         with self._lock:
             elig = []
             for r, st in self._replicas.items():
@@ -690,7 +715,7 @@ class FleetRouter:
     def retry_after_s(self) -> int:
         """Honest dynamic Retry-After for the all-breakers-open 503:
         the soonest half-open probe, clamped to [1, 30]s."""
-        now = time.monotonic()
+        now = telemetry.monotonic()
         with self._lock:
             waits = [st["breaker"].open_until - now
                      for st in self._replicas.values()
@@ -698,6 +723,31 @@ class FleetRouter:
         if not waits:
             return 1
         return int(min(max(1.0, min(waits) + 0.5), 30.0))
+
+    # ---- arrival capture -------------------------------------------------
+
+    def _note_arrival(self, body: dict, request_id: str) -> None:
+        """One accepted front-door request -> one replayable arrival
+        record (the same flat shape RequestLog.arrivals() exports, with
+        the router's idempotency key standing in for the engine rid)."""
+        try:
+            max_new = int(body.get("max_new") or 0)
+        except (TypeError, ValueError):
+            max_new = 0
+        rec = {"rid": request_id,
+               "t_arrival_us": telemetry.now_us(),
+               "prompt_len": len(body.get("tokens") or ()),
+               "max_new": max_new,
+               "priority": body.get("priority") or 0,
+               "deadline": body.get("deadline_ms"),
+               "trace_id": body.get("trace_id") or ""}
+        with self._lock:
+            self._arrivals.append(rec)
+
+    def arrival_records(self) -> list:
+        """The /requestz?format=jsonl records, arrival order."""
+        with self._lock:
+            return [dict(r) for r in self._arrivals]
 
     # ---- dispatch + failover ---------------------------------------------
 
@@ -758,7 +808,7 @@ class FleetRouter:
         return age is None or age > self.hedge_s * 1e3
 
     def _breaker_fail(self, replica: str, err: str) -> None:
-        now = time.monotonic()
+        now = telemetry.monotonic()
         with self._lock:
             st = self._replicas.get(replica)
             if st is not None:
@@ -837,7 +887,7 @@ class FleetRouter:
         committed: str | None = None
         hedged = False
         cached_seen = 0
-        t0 = time.monotonic()
+        t0 = telemetry.monotonic()
         try:
             while True:
                 try:
@@ -845,7 +895,7 @@ class FleetRouter:
                 except queue.Empty:
                     if (committed is None and not hedged
                             and self.hedge_s > 0
-                            and time.monotonic() - t0 > self.hedge_s
+                            and telemetry.monotonic() - t0 > self.hedge_s
                             and self._beat_stalled(replica)):
                         hedged = self._launch_hedge(
                             body, tried, legs, cancels, out_q,
@@ -1005,7 +1055,7 @@ class FleetRouter:
     # ---- views -----------------------------------------------------------
 
     def routerz_json(self) -> dict:
-        now = time.monotonic()
+        now = telemetry.monotonic()
         with self._lock:
             snap = {}
             for r, st in self._replicas.items():

@@ -140,7 +140,7 @@ class Request:
     max_new: int  # decode budget
     # SLO inputs the Scheduler orders its waiting queue by: higher
     # ``priority`` admits (and survives preemption) first; ``deadline``
-    # (absolute time.monotonic() seconds, None = best-effort) orders
+    # (absolute telemetry.monotonic() seconds, None = best-effort) orders
     # WITHIN a priority class ahead of deadline-less arrivals (EDF).
     priority: int = 0
     deadline: float | None = None
@@ -248,6 +248,10 @@ class RequestRecord:
     generated: int = 0
     footprint_blocks: int = 0
     cached_tokens: int = 0
+    # Arrival-record fields (/requestz?format=jsonl): what a replayable
+    # traffic trace needs to reconstruct the request as an arrival.
+    prompt_len: int = 0
+    max_new: int = 0
     # Device-time attribution (the round ledger): engine busy ms this
     # request was billed for, split by work kind. Wall-clock phases
     # above say where the request WAITED; this says what it CONSUMED.
@@ -350,14 +354,16 @@ class RequestLog:
     # ---- recording --------------------------------------------------------
 
     def start(self, rid: int, *, trace_id: str = "", priority: int = 0,
-              deadline: float | None = None, queue_position: int = 0) -> None:
+              deadline: float | None = None, queue_position: int = 0,
+              prompt_len: int = 0, max_new: int = 0) -> None:
         if not self.enabled:
             return
         with self._lock:
             t = telemetry.now_us()
             rec = RequestRecord(
                 rid=rid, trace_id=trace_id or telemetry.root_trace_id(),
-                priority=priority, deadline=deadline, submit_us=t)
+                priority=priority, deadline=deadline, submit_us=t,
+                prompt_len=prompt_len, max_new=max_new)
             rec.events.append({
                 "kind": "enqueued", "t_us": t, "priority": priority,
                 "deadline": deadline, "queue_position": queue_position})
@@ -529,6 +535,20 @@ class RequestLog:
                     "events": [dict(e) for e in r.events],
                 } for r in reversed(recs)],
             }
+
+    def arrivals(self) -> list:
+        """The /requestz?format=jsonl records: one flat dict per request
+        in arrival order — exactly what tools.sim replays as an arrival
+        process (t_arrival_us deltas become virtual-clock offsets)."""
+        with self._lock:
+            recs = sorted(self._recs.values(), key=lambda r: r.submit_us)
+            return [{"rid": r.rid,
+                     "t_arrival_us": r.submit_us,
+                     "prompt_len": r.prompt_len,
+                     "max_new": r.max_new,
+                     "priority": r.priority,
+                     "deadline": r.deadline,
+                     "trace_id": r.trace_id} for r in recs]
 
 
 class _PoolBase:
@@ -2552,7 +2572,7 @@ class PagedPool(_PoolBase):
                                             priority=s.priority,
                                             deadline=s.deadline),
                          "preload": list(s.generated), "seq": s.seq,
-                         "t": time.monotonic()})
+                         "t": telemetry.monotonic()})
         if alive:
             try:
                 if self.prefix_cache:
@@ -2840,7 +2860,7 @@ class PagedPool(_PoolBase):
                                   max_new=len(s.generated) + s.remaining,
                                   priority=s.priority, deadline=s.deadline),
                "preload": list(s.generated), "seq": s.seq,
-               "t": time.monotonic()}  # serve_resume_gap_ms start
+               "t": telemetry.monotonic()}  # serve_resume_gap_ms start
         self.preempted.append(rec)
         self._record_block_gauges()
         return rec
@@ -3381,12 +3401,13 @@ class Scheduler:
             position = len(self._waiting)
         self.log.start(r.rid, trace_id=getattr(r, "trace_id", ""),
                        priority=r.priority, deadline=r.deadline,
-                       queue_position=position)
+                       queue_position=position,
+                       prompt_len=len(r.tokens), max_new=r.max_new)
         with self._lock:
             self._push_locked(r, None, self._seq)
             self._seq += 1
             self.stats["submitted"] += 1
-            self._qstart[r.rid] = time.monotonic()
+            self._qstart[r.rid] = telemetry.monotonic()
             self._prio[r.rid] = r.priority
         self._record_gauges()
 
@@ -3496,11 +3517,11 @@ class Scheduler:
                     if tp is not None:
                         telemetry.metrics().observe(
                             "serve_resume_gap_ms",
-                            (time.monotonic() - tp) * 1e3)
+                            (telemetry.monotonic() - tp) * 1e3)
                 with self._lock:
                     t0 = self._qstart.pop(r.rid, None)
                     if t0 is not None:
-                        wait_ms = (time.monotonic() - t0) * 1e3
+                        wait_ms = (telemetry.monotonic() - t0) * 1e3
                         self._waits.append(wait_ms)
                 if t0 is not None:
                     telemetry.metrics().observe("serve_queue_wait_ms",
@@ -3528,7 +3549,7 @@ class Scheduler:
         cohort — and both emit terminal events carrying the committed
         prefix. Deadline-less traffic pays one monotonic read and a
         heap scan."""
-        now = time.monotonic()
+        now = telemetry.monotonic()
         events: dict = {}
         with self._lock:
             expired = [e for e in self._waiting if e[1] <= now]
